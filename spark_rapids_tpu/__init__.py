@@ -1,0 +1,42 @@
+"""spark_rapids_tpu — a TPU-native Spark-SQL-accelerator-class framework.
+
+A standalone columnar SQL engine with the architecture of NVIDIA's RAPIDS
+Accelerator for Apache Spark (the reference at /root/reference): a planning
+layer that rewrites physical plans so SQL operators execute as columnar
+kernels on accelerator-resident Arrow batches with per-operator CPU fallback,
+a tiered HBM->host->disk spill framework, task admission control, columnar
+shuffle, and Arrow/pandas interop — with the kernel layer implemented in
+JAX/XLA (plus Pallas) on TPU instead of cuDF/CUDA, and multi-chip exchange
+over ICI meshes instead of UCX.
+"""
+import jax as _jax
+
+# Spark semantics are 64-bit (LongType, DoubleType, 64-bit decimal); JAX's
+# 32-bit default would silently truncate, so the framework requires x64.
+# (On TPU, f64 is emulated — the planner keeps hot paths in 32-bit/bf16 where
+# Spark's types allow it.)
+_jax.config.update("jax_enable_x64", True)
+
+from . import config
+from .config import TpuConf
+from .types import (
+    BOOLEAN,
+    BYTE,
+    DATE,
+    DOUBLE,
+    FLOAT,
+    INT,
+    LONG,
+    NULL,
+    SHORT,
+    STRING,
+    TIMESTAMP,
+    DecimalType,
+    Schema,
+    StructField,
+)
+
+from .session import DataFrame, TpuSession
+from . import functions
+
+__version__ = "0.1.0"

@@ -1,0 +1,10 @@
+from .device import (
+    DeviceBatch,
+    DeviceColumn,
+    bucket_capacity,
+    bucket_width,
+    device_to_host,
+    empty_batch,
+    host_to_device,
+)
+from .host import arrow_from_np, batch_from_columns, concat_batches, np_from_arrow

@@ -1,0 +1,286 @@
+"""Typed configuration registry — the ``RapidsConf`` analogue.
+
+Mirrors the reference's config system (sql-plugin RapidsConf.scala: ``ConfEntry``
+builder DSL ~:60-120, ~120 ``spark.rapids.*`` keys, and the markdown doc
+generator at :1052-1149). Key names keep the ``spark.rapids.`` namespace so a
+spark-rapids user finds the same switches; device-specific keys live under
+``spark.rapids.tpu.*``.
+
+Every operator/expression replacement rule additionally gets an auto-derived
+kill switch (``spark.rapids.sql.exec.*`` / ``spark.rapids.sql.expression.*``),
+registered by the planner — the reference's ``DataFromReplacementRule.confKey``
+pattern (RapidsMeta.scala:35-43).
+"""
+from __future__ import annotations
+
+import os
+import threading
+from typing import Any, Callable, Generic, Optional, TypeVar
+
+T = TypeVar("T")
+
+_REGISTRY: dict[str, "ConfEntry"] = {}
+_REGISTRY_LOCK = threading.Lock()
+
+
+class ConfEntry(Generic[T]):
+    def __init__(
+        self,
+        key: str,
+        default: T,
+        doc: str,
+        conv: Callable[[str], T],
+        internal: bool = False,
+        startup_only: bool = False,
+    ):
+        self.key = key
+        self.default = default
+        self.doc = doc
+        self.conv = conv
+        self.internal = internal
+        self.startup_only = startup_only
+
+    def get(self, conf: "TpuConf") -> T:
+        return conf.get(self.key, self.default, self.conv)
+
+
+class _EntryBuilder:
+    def __init__(self, key: str):
+        self._key = key
+        self._doc = ""
+        self._internal = False
+        self._startup = False
+
+    def doc(self, text: str) -> "_EntryBuilder":
+        self._doc = text
+        return self
+
+    def internal(self) -> "_EntryBuilder":
+        self._internal = True
+        return self
+
+    def startup_only(self) -> "_EntryBuilder":
+        self._startup = True
+        return self
+
+    def _register(self, default, conv) -> ConfEntry:
+        entry = ConfEntry(
+            self._key, default, self._doc, conv, self._internal, self._startup
+        )
+        with _REGISTRY_LOCK:
+            if self._key in _REGISTRY:
+                raise ValueError(f"duplicate conf key {self._key}")
+            _REGISTRY[self._key] = entry
+        return entry
+
+    def boolean_conf(self, default: bool) -> ConfEntry[bool]:
+        return self._register(default, lambda s: s.strip().lower() in ("true", "1"))
+
+    def int_conf(self, default: int) -> ConfEntry[int]:
+        return self._register(default, int)
+
+    def bytes_conf(self, default: int) -> ConfEntry[int]:
+        return self._register(default, _parse_bytes)
+
+    def double_conf(self, default: float) -> ConfEntry[float]:
+        return self._register(default, float)
+
+    def string_conf(self, default: Optional[str]) -> ConfEntry[Optional[str]]:
+        return self._register(default, lambda s: s)
+
+
+def conf(key: str) -> _EntryBuilder:
+    return _EntryBuilder(key)
+
+
+def _parse_bytes(s: str) -> int:
+    s = s.strip().lower()
+    mult = 1
+    for suffix, m in (("k", 1 << 10), ("m", 1 << 20), ("g", 1 << 30), ("t", 1 << 40)):
+        if s.endswith(suffix + "b"):
+            s, mult = s[:-2], m
+            break
+        if s.endswith(suffix):
+            s, mult = s[:-1], m
+            break
+    return int(float(s) * mult)
+
+
+# ── Core keys (subset growing toward the reference's ~120) ──────────────────
+
+SQL_ENABLED = conf("spark.rapids.sql.enabled").doc(
+    "Enable (true) or disable (false) TPU acceleration of SQL operators."
+).boolean_conf(True)
+
+EXPLAIN = conf("spark.rapids.sql.explain").doc(
+    "Explain why parts of a query were or were not placed on the TPU: "
+    "NONE, NOT_ON_GPU (only log un-replaced nodes), ALL."
+).string_conf("NONE")
+
+INCOMPATIBLE_OPS = conf("spark.rapids.sql.incompatibleOps.enabled").doc(
+    "Enable operators that produce results that differ from Spark in corner "
+    "cases (e.g. float aggregation ordering)."
+).boolean_conf(False)
+
+BATCH_SIZE_BYTES = conf("spark.rapids.sql.batchSizeBytes").doc(
+    "Target size of a columnar batch the operators work on "
+    "(reference: RapidsConf.scala:402)."
+).bytes_conf(1 << 30)
+
+BATCH_SIZE_ROWS = conf("spark.rapids.sql.batchSizeRows").doc(
+    "Target row count of a device batch; capacities are bucketed to powers of "
+    "two above this to bound XLA recompilation."
+).int_conf(1 << 20)
+
+MAX_READER_BATCH_SIZE_ROWS = conf("spark.rapids.sql.reader.batchSizeRows").doc(
+    "Soft cap on rows per batch produced by file readers "
+    "(reference: RapidsConf.scala READER_BATCH_SIZE_ROWS)."
+).int_conf(1 << 20)
+
+MAX_READER_BATCH_SIZE_BYTES = conf("spark.rapids.sql.reader.batchSizeBytes").doc(
+    "Soft cap on bytes per batch produced by file readers."
+).bytes_conf(1 << 30)
+
+CONCURRENT_TPU_TASKS = conf("spark.rapids.sql.concurrentGpuTasks").doc(
+    "Number of concurrent tasks that may hold the device at once — admission "
+    "control via the device semaphore (reference: GpuSemaphore.scala)."
+).int_conf(2)
+
+HAS_NANS = conf("spark.rapids.sql.hasNans").doc(
+    "Assume floating point values may contain NaNs (gates some operators, "
+    "matching the reference)."
+).boolean_conf(True)
+
+VARIABLE_FLOAT_AGG = conf("spark.rapids.sql.variableFloatAgg.enabled").doc(
+    "Allow float/double aggregations whose result can vary with evaluation "
+    "order (sum/avg over float)."
+).boolean_conf(True)
+
+CAST_FLOAT_TO_STRING = conf("spark.rapids.sql.castFloatToString.enabled").doc(
+    "Enable float→string casts, which may differ from Spark in formatting."
+).boolean_conf(False)
+
+CAST_STRING_TO_FLOAT = conf("spark.rapids.sql.castStringToFloat.enabled").doc(
+    "Enable string→float casts, which may differ from Spark in corner cases."
+).boolean_conf(False)
+
+STRING_MAX_BYTES = conf("spark.rapids.tpu.string.maxBytes").doc(
+    "Maximum per-value string width the fixed-width device representation "
+    "pads to before the column falls back to the CPU."
+).int_conf(256)
+
+POOL_SIZE_FRACTION = conf("spark.rapids.memory.gpu.allocFraction").doc(
+    "Fraction of device memory the HBM pool may use "
+    "(reference: RapidsConf.scala RMM_ALLOC_FRACTION)."
+).double_conf(0.9)
+
+HOST_SPILL_STORAGE_SIZE = conf("spark.rapids.memory.host.spillStorageSize").doc(
+    "Amount of host memory to use for spilled device buffers before "
+    "overflowing to disk."
+).bytes_conf(1 << 31)
+
+SPILL_DIR = conf("spark.rapids.memory.spillDir").doc(
+    "Directory for the disk spill tier."
+).string_conf(None)
+
+SHUFFLE_PARTITIONS = conf("spark.sql.shuffle.partitions").doc(
+    "Default number of partitions for exchanges (Spark's key, honored here)."
+).int_conf(8)
+
+TEST_CONF = conf("spark.rapids.sql.test.enabled").doc(
+    "Test mode: fail if any operator that was expected on device fell back "
+    "(reference: RapidsConf TEST_CONF)."
+).internal().boolean_conf(False)
+
+TEST_ALLOWED_NONTPU = conf("spark.rapids.sql.test.allowedNonGpu").doc(
+    "Comma-separated exec names allowed to stay on CPU in test mode."
+).internal().string_conf(None)
+
+METRICS_LEVEL = conf("spark.rapids.sql.metrics.level").doc(
+    "ESSENTIAL, MODERATE or DEBUG — how many metrics operators publish "
+    "(reference: RapidsConf.scala:456)."
+).string_conf("MODERATE")
+
+CPU_ONLY = conf("spark.rapids.tpu.cpuOnly").doc(
+    "Force the JAX CPU backend (testing; the virtual-device mesh path)."
+).internal().boolean_conf(False)
+
+MULTITHREADED_READ_NUM_THREADS = conf(
+    "spark.rapids.sql.multiThreadedRead.numThreads"
+).doc(
+    "Thread pool size for the multithreaded (cloud) file reader "
+    "(reference: RapidsConf.scala:624-671)."
+).int_conf(20)
+
+IMPROVED_FLOAT_OPS = conf("spark.rapids.sql.improvedFloatOps.enabled").doc(
+    "Enable float ops (e.g. nearly-integral double→long) that round "
+    "differently from Spark in the last ulp."
+).boolean_conf(False)
+
+DECIMAL_ENABLED = conf("spark.rapids.sql.decimalType.enabled").doc(
+    "Enable decimal (64-bit) processing on device."
+).boolean_conf(True)
+
+
+class TpuConf:
+    """An immutable-ish view over a key→string dict, with typed access.
+
+    Mirrors ``RapidsConf``'s construction from the Spark conf; here it is
+    constructed from a plain dict plus ``SPARK_RAPIDS_*``-style environment
+    overrides.
+    """
+
+    def __init__(self, settings: Optional[dict[str, Any]] = None):
+        self._settings: dict[str, str] = {}
+        for k, v in (settings or {}).items():
+            self._settings[k] = str(v) if not isinstance(v, bool) else str(v).lower()
+
+    def get(self, key: str, default: T, conv: Callable[[str], T]) -> T:
+        raw = self._settings.get(key)
+        if raw is None:
+            raw = os.environ.get("SRT_CONF_" + key.replace(".", "_").upper())
+        if raw is None:
+            return default
+        return conv(raw)
+
+    def get_raw(self, key: str) -> Optional[str]:
+        return self._settings.get(key)
+
+    def set(self, key: str, value: Any) -> "TpuConf":
+        new = dict(self._settings)
+        new[key] = str(value) if not isinstance(value, bool) else str(value).lower()
+        return TpuConf(new)
+
+    def is_enabled(self, entry: ConfEntry[bool]) -> bool:
+        return entry.get(self)
+
+    # Rule kill switches (auto-derived keys): default True unless set.
+    def rule_enabled(self, conf_key: str, default: bool = True) -> bool:
+        raw = self._settings.get(conf_key)
+        if raw is None:
+            return default
+        return raw.strip().lower() in ("true", "1")
+
+    def items(self):
+        return self._settings.items()
+
+
+def registry() -> dict[str, ConfEntry]:
+    return dict(_REGISTRY)
+
+
+def generate_docs() -> str:
+    """Markdown doc table — the analogue of RapidsConf.scala's doc generator
+    (:1052-1149), so configuration docs cannot drift from the code."""
+    lines = [
+        "# Configuration",
+        "",
+        "Name | Description | Default",
+        "-----|-------------|--------",
+    ]
+    for key in sorted(_REGISTRY):
+        e = _REGISTRY[key]
+        if e.internal:
+            continue
+        lines.append(f"{e.key} | {e.doc} | {e.default}")
+    return "\n".join(lines) + "\n"

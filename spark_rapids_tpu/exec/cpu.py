@@ -1,0 +1,437 @@
+"""CPU physical operators — the fallback engine.
+
+In the reference, fallback means "leave the original Spark CPU exec in
+place" (RapidsMeta.willNotWorkOnGpu). Standalone, this module IS that CPU
+engine: numpy/arrow operators with Spark-exact semantics. It doubles as the
+differential-test oracle, the role SparkQueryCompareTestSuite's CPU session
+plays in the reference (tests/.../SparkQueryCompareTestSuite.scala:339).
+"""
+from __future__ import annotations
+
+import itertools
+from typing import Iterator, List, Optional
+
+import numpy as np
+import pyarrow as pa
+
+from ..columnar.host import arrow_from_np, batch_from_columns, concat_batches, np_from_arrow
+from ..expr import Expression, bind, output_name
+from ..expr.aggregates import AggregateFunction
+from ..expr.base import BoundReference, Ctx
+from ..ops.hash import murmur3_rows, partition_ids
+from ..plan.logical import SortOrder
+from ..plan.physical import Exec, ExecContext, PartitionSet
+from ..types import BOOLEAN, DataType, Schema, StructField
+from . import cpu_kernels as ck
+
+
+def _cpu_ctx(rb: pa.RecordBatch, schema: Schema) -> Ctx:
+    cols = [
+        np_from_arrow(rb.column(i), f.data_type) for i, f in enumerate(schema)
+    ]
+    return Ctx.for_cpu(cols, rb.num_rows)
+
+
+def _val_to_np(ctx: Ctx, val) -> tuple[np.ndarray, np.ndarray]:
+    data = val.data
+    if not isinstance(data, np.ndarray) or data.ndim == 0:
+        data = np.broadcast_to(np.asarray(data), (ctx.n,)).copy()
+    valid = val.valid
+    if not isinstance(valid, np.ndarray) or np.ndim(valid) == 0:
+        valid = np.broadcast_to(np.asarray(valid, dtype=bool), (ctx.n,)).copy()
+    return data, valid.astype(bool)
+
+
+class CpuScanExec(Exec):
+    """In-memory arrow table scan (LocalRelation)."""
+
+    def __init__(self, table: pa.Table, schema: Schema, num_partitions: int = 1):
+        super().__init__([])
+        self.table = table
+        self._schema = schema
+        self.num_partitions = num_partitions
+
+    @property
+    def output(self) -> Schema:
+        return self._schema
+
+    def execute(self, ctx: ExecContext) -> PartitionSet:
+        n = self.table.num_rows
+        parts = []
+        per = max(1, -(-n // self.num_partitions))
+        for p in range(self.num_partitions):
+            lo = min(p * per, n)
+            hi = min(lo + per, n)
+
+            def make(lo=lo, hi=hi):
+                def it():
+                    if hi > lo:
+                        for rb in self.table.slice(lo, hi - lo).combine_chunks().to_batches():
+                            yield rb
+                return it()
+
+            parts.append(make)
+        return PartitionSet(parts)
+
+    def node_string(self):
+        return f"CpuScan{self._schema.names}"
+
+
+class CpuProjectExec(Exec):
+    def __init__(self, exprs: List[Expression], child: Exec):
+        super().__init__([child])
+        self.exprs = [bind(e, child.output) for e in exprs]
+        self._schema = Schema(
+            [
+                StructField(output_name(e0), e.data_type, e.nullable)
+                for e0, e in zip(exprs, self.exprs)
+            ]
+        )
+
+    @property
+    def output(self) -> Schema:
+        return self._schema
+
+    def execute(self, ctx: ExecContext) -> PartitionSet:
+        child = self.children[0]
+        schema_in = child.output
+        schema_out = self._schema
+
+        def fn(it: Iterator[pa.RecordBatch]):
+            for rb in it:
+                c = _cpu_ctx(rb, schema_in)
+                cols = [_val_to_np(c, e.eval(c)) for e in self.exprs]
+                yield batch_from_columns(schema_out, cols)
+
+        return child.execute(ctx).map_partitions(fn)
+
+    def node_string(self):
+        return f"CpuProject [{', '.join(map(str, self.exprs))}]"
+
+
+class CpuFilterExec(Exec):
+    def __init__(self, condition: Expression, child: Exec):
+        super().__init__([child])
+        self.condition = bind(condition, child.output)
+
+    @property
+    def output(self) -> Schema:
+        return self.children[0].output
+
+    def execute(self, ctx: ExecContext) -> PartitionSet:
+        schema_in = self.children[0].output
+
+        def fn(it):
+            for rb in it:
+                c = _cpu_ctx(rb, schema_in)
+                v = self.condition.eval(c)
+                data, valid = _val_to_np(c, v)
+                keep = data.astype(bool) & valid
+                yield rb.filter(pa.array(keep))
+
+        return self.children[0].execute(ctx).map_partitions(fn)
+
+    def node_string(self):
+        return f"CpuFilter {self.condition}"
+
+
+class CpuUnionExec(Exec):
+    def __init__(self, children: List[Exec]):
+        super().__init__(children)
+
+    @property
+    def output(self) -> Schema:
+        return self.children[0].output
+
+    def execute(self, ctx: ExecContext) -> PartitionSet:
+        parts = []
+        for c in self.children:
+            parts.extend(c.execute(ctx).parts)
+        return PartitionSet(parts)
+
+
+class CpuCoalescePartitionsExec(Exec):
+    """Merge all partitions into one (used before single-partition ops)."""
+
+    def __init__(self, child: Exec):
+        super().__init__([child])
+
+    @property
+    def output(self) -> Schema:
+        return self.children[0].output
+
+    def execute(self, ctx: ExecContext) -> PartitionSet:
+        child_parts = self.children[0].execute(ctx)
+
+        def it():
+            for t in child_parts.parts:
+                yield from t()
+
+        return PartitionSet([it])
+
+
+class CpuShuffleExchangeExec(Exec):
+    """Hash-partitioned exchange (CPU path): murmur3(keys) pmod n.
+
+    Reference: GpuShuffleExchangeExec + GpuHashPartitioning (murmur3 on
+    device); here the CPU engine's oracle equivalent, one stage barrier.
+    """
+
+    def __init__(self, keys: List[Expression], num_partitions: int, child: Exec):
+        super().__init__([child])
+        self.keys = [bind(k, child.output) for k in keys]
+        self.num_partitions = num_partitions
+
+    @property
+    def output(self) -> Schema:
+        return self.children[0].output
+
+    def execute(self, ctx: ExecContext) -> PartitionSet:
+        schema = self.children[0].output
+        inputs = self.children[0].execute(ctx)
+        buckets: list[list[pa.RecordBatch]] = [[] for _ in range(self.num_partitions)]
+        for thunk in inputs.parts:
+            for rb in thunk():
+                if rb.num_rows == 0:
+                    continue
+                if not self.keys:
+                    buckets[0].append(rb)  # single partition
+                    continue
+                c = _cpu_ctx(rb, schema)
+                cols = []
+                for k in self.keys:
+                    v = k.eval(c)
+                    d, val = _val_to_np(c, v)
+                    cols.append((k.data_type, d, val, None))
+                h = murmur3_rows(np, cols, rb.num_rows)
+                pids = partition_ids(np, h, self.num_partitions)
+                for p in range(self.num_partitions):
+                    mask = pids == p
+                    if mask.any():
+                        buckets[p].append(rb.filter(pa.array(mask)))
+        def make(p):
+            def it():
+                yield from buckets[p]
+            return it
+        return PartitionSet([make(p) for p in range(self.num_partitions)])
+
+    def node_string(self):
+        return f"CpuShuffleExchange [{', '.join(map(str, self.keys))}] p={self.num_partitions}"
+
+
+class CpuHashAggregateExec(Exec):
+    """Group-by aggregate, one phase (mode: 'partial' | 'final' | 'complete').
+
+    Mirrors the reference's update/merge split (aggregate.scala:345-520):
+    partial consumes input rows producing (keys ++ buffers); final consumes
+    buffers producing results.
+    """
+
+    def __init__(
+        self,
+        mode: str,
+        grouping: List[Expression],
+        agg_fns: List[AggregateFunction],
+        result_exprs: Optional[List[Expression]],
+        result_names: Optional[List[str]],
+        child: Exec,
+    ):
+        super().__init__([child])
+        self.mode = mode
+        self.grouping = [bind(g, child.output) for g in grouping]
+        self.agg_fns = agg_fns  # bound against the ORIGINAL input schema
+        self.result_exprs = result_exprs
+        self.result_names = result_names
+        self._schema = self._compute_schema(child)
+
+    def _compute_schema(self, child: Exec) -> Schema:
+        fields = []
+        for g0, g in zip(self.grouping, self.grouping):
+            fields.append(StructField(output_name(g0), g.data_type, g.nullable))
+        if self.mode == "partial":
+            for i, f in enumerate(self.agg_fns):
+                for j, bt in enumerate(f.buffer_types):
+                    fields.append(StructField(f"buf{i}_{j}", bt, True))
+            return Schema(fields)
+        # final/complete: results after evaluate + result projection
+        assert self.result_exprs is not None
+        out = []
+        for name, e in zip(self.result_names, self.result_exprs):
+            out.append(StructField(name, e.data_type, e.nullable))
+        return Schema(out)
+
+    @property
+    def output(self) -> Schema:
+        return self._schema
+
+    def execute(self, ctx: ExecContext) -> PartitionSet:
+        child = self.children[0]
+        schema_in = child.output
+
+        def fn(it):
+            batches = list(it)
+            rb = concat_batches(schema_in, batches)
+            yield self._aggregate(rb, schema_in)
+
+        return child.execute(ctx).map_partitions(fn)
+
+    # ── core ────────────────────────────────────────────────────────────
+    def _aggregate(self, rb: pa.RecordBatch, schema_in: Schema) -> pa.RecordBatch:
+        c = _cpu_ctx(rb, schema_in)
+        n = rb.num_rows
+        key_np = [_val_to_np(c, g.eval(c)) for g in self.grouping]
+        encoded = []
+        for (d, v), g in zip(key_np, self.grouping):
+            encoded.extend(ck.encode_group_key(g.data_type, d, v))
+        inv, first_idx = ck.group_inverse(encoded, n)
+        if self.grouping:
+            num_groups = len(first_idx)
+        else:
+            num_groups = 1
+            inv = np.zeros(n, dtype=np.int64)
+        # reduction with no rows: one group with empty-input semantics
+        out_cols: list[tuple[np.ndarray, np.ndarray]] = []
+        for (d, v) in key_np:
+            out_cols.append((d[first_idx], v[first_idx]))
+        buffer_vals = []
+        for f in self.agg_fns:
+            if self.mode in ("partial", "complete"):
+                ins = [bind(e, schema_in) for e in f.update_exprs]
+                ops = f.update_ops
+            else:
+                ins = None
+                ops = f.merge_ops
+            bufs = []
+            for j, op in enumerate(ops):
+                if ins is not None:
+                    d, v = _val_to_np(c, ins[j].eval(c))
+                    dt = ins[j].data_type
+                else:
+                    ord_ = self._buffer_ordinal(f, j)
+                    d, v = _val_to_np(c, c.columns[ord_])
+                    dt = schema_in[ord_].data_type
+                gd, gv = ck.reduce_groups(op, dt, d, v, inv, num_groups)
+                bufs.append((gd, gv, dt))
+            buffer_vals.append(bufs)
+        if self.mode == "partial":
+            for bufs in buffer_vals:
+                for gd, gv, dt in bufs:
+                    out_cols.append((gd, gv))
+            return batch_from_columns(self._schema, out_cols)
+        # final/complete: evaluate agg fns then result projection
+        from ..expr.base import Val
+
+        gctx = Ctx.for_cpu([(d, v) for d, v in out_cols], num_groups)
+        agg_results: list[Val] = []
+        for f, bufs in zip(self.agg_fns, buffer_vals):
+            vals = [Val(gd, gv) for gd, gv, _ in bufs]
+            agg_results.append(f.evaluate(gctx, vals))
+        res_ctx_cols = [Val(d, v) for d, v in out_cols[: len(self.grouping)]]
+        res_ctx_cols.extend(agg_results)
+        rctx = Ctx.for_cpu([], num_groups)
+        rctx.columns = res_ctx_cols
+        final = []
+        for e in self.result_exprs:
+            final.append(_val_to_np(rctx, e.eval(rctx)))
+        return batch_from_columns(self._schema, final)
+
+    def _buffer_ordinal(self, f: AggregateFunction, j: int) -> int:
+        base = len(self.grouping)
+        for g in self.agg_fns:
+            if g is f:
+                return base + j
+            base += len(g.buffer_types)
+        raise KeyError
+
+    def node_string(self):
+        return f"CpuHashAggregate({self.mode}) keys={[str(g) for g in self.grouping]} aggs={[str(a) for a in self.agg_fns]}"
+
+
+class CpuSortExec(Exec):
+    def __init__(self, order: List[SortOrder], child: Exec):
+        super().__init__([child])
+        self.order = [
+            SortOrder(bind(o.child, child.output), o.ascending, o.nulls_first)
+            for o in order
+        ]
+
+    @property
+    def output(self) -> Schema:
+        return self.children[0].output
+
+    def execute(self, ctx: ExecContext) -> PartitionSet:
+        schema = self.children[0].output
+
+        def fn(it):
+            rb = concat_batches(schema, list(it))
+            if rb.num_rows == 0:
+                yield rb
+                return
+            c = _cpu_ctx(rb, schema)
+            n = rb.num_rows
+            # build numpy sort keys, last key first (lexsort semantics)
+            keys = []
+            for o in self.order:
+                d, v = _val_to_np(c, o.child.eval(c))
+                dt = o.child.data_type
+                from ..types import FloatType, DoubleType, StringType
+
+                if isinstance(dt, StringType):
+                    enc = np.array(
+                        [x.encode() if (x is not None and vv) else b"" for x, vv in zip(d, v)],
+                        dtype=object,
+                    )
+                    val_key = enc
+                elif isinstance(dt, (FloatType, DoubleType)):
+                    # signed-int64 total order: NaN (canonical, positive bits)
+                    # lands above +inf, matching Spark's NaN-greatest ordering
+                    x = np.where(d == 0, np.zeros_like(d), d)
+                    x = np.where(np.isnan(x), np.full_like(x, np.nan), x)
+                    bits = x.astype(np.float64).view(np.int64)
+                    val_key = np.where(bits < 0, ~bits ^ np.int64(-(2**63)), bits)
+                else:
+                    val_key = d.astype(np.int64)
+                if not o.ascending and val_key.dtype == object:
+                    # lexsort can't negate bytes; use rank trick
+                    order_idx = np.argsort(val_key, kind="stable")
+                    rank = np.empty(n, dtype=np.int64)
+                    rank[order_idx] = np.arange(n)
+                    val_key = -rank
+                elif not o.ascending:
+                    val_key = -1 - val_key  # avoid -MIN overflow? two's complement ok
+                nf = o.resolved_nulls_first()
+                null_key = np.where(v, 1, 0) if nf else np.where(v, 0, 1)
+                keys.append(val_key)
+                keys.append(null_key)
+            perm = np.lexsort(keys[::-1])
+            yield rb.take(pa.array(perm))
+
+        return self.children[0].execute(ctx).map_partitions(fn)
+
+
+class CpuLimitExec(Exec):
+    """CollectLimit: single partition, first n rows."""
+
+    def __init__(self, n: int, child: Exec):
+        super().__init__([child])
+        self.n = n
+
+    @property
+    def output(self) -> Schema:
+        return self.children[0].output
+
+    def execute(self, ctx: ExecContext) -> PartitionSet:
+        child_parts = self.children[0].execute(ctx)
+
+        def it():
+            remaining = self.n
+            for t in child_parts.parts:
+                for rb in t():
+                    if remaining <= 0:
+                        return
+                    if rb.num_rows > remaining:
+                        rb = rb.slice(0, remaining)
+                    remaining -= rb.num_rows
+                    yield rb
+
+        return PartitionSet([it])

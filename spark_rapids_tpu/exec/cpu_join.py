@@ -1,0 +1,300 @@
+"""CPU join operators — oracle/fallback for the join family.
+
+Reference: GpuHashJoin.scala (Table.innerJoin/leftJoin over key columns with
+null-key filtering, :282), GpuShuffledHashJoinBase, GpuBroadcastNestedLoop
+JoinExec. Spark join-key semantics: NULL keys never match (unlike grouping);
+NaN keys DO match each other and -0.0 == 0.0 (Spark normalizes join keys).
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+import pyarrow as pa
+
+from ..columnar.host import arrow_from_np, batch_from_columns, concat_batches, np_from_arrow
+from ..expr import Expression, bind
+from ..expr.base import Ctx
+from ..plan.physical import Exec, ExecContext, PartitionSet
+from ..types import Schema, StructField
+from . import cpu_kernels as ck
+from .cpu import _cpu_ctx, _val_to_np
+
+
+def _key_codes(keys: List[Expression], rb: pa.RecordBatch, schema: Schema):
+    """Encode key columns to int64 code tuples + per-row all-valid mask."""
+    c = _cpu_ctx(rb, schema)
+    n = rb.num_rows
+    words = []
+    all_valid = np.ones(n, dtype=bool)
+    for k in keys:
+        d, v = _val_to_np(c, k.eval(c))
+        all_valid &= v
+        # encode_group_key gives NaN/-0.0-normalized codes; validity word is
+        # dropped because null keys are excluded from matching entirely
+        enc = ck.encode_group_key(k.data_type, d, v)
+        words.append(enc[1])
+    if not words:
+        return np.zeros((n, 0), dtype=np.int64), all_valid
+    return np.stack(words, axis=1), all_valid
+
+
+def _take(rb: pa.RecordBatch, idx: np.ndarray) -> pa.RecordBatch:
+    return rb.take(pa.array(idx, type=pa.int64()))
+
+
+def _null_batch(schema: Schema, n: int) -> list[pa.Array]:
+    return [pa.nulls(n, type=f.data_type.to_arrow()) for f in schema]
+
+
+class CpuShuffledHashJoinExec(Exec):
+    """Equi-join: both sides hash-partitioned by key; per-partition hash join."""
+
+    def __init__(
+        self,
+        join_type: str,
+        left_keys: List[Expression],
+        right_keys: List[Expression],
+        residual: Optional[Expression],
+        left: Exec,
+        right: Exec,
+        drop_right_keys: Optional[List[str]] = None,
+    ):
+        super().__init__([left, right])
+        self.join_type = join_type
+        self.left_keys = [bind(k, left.output) for k in left_keys]
+        self.right_keys = [bind(k, right.output) for k in right_keys]
+        self.residual = residual
+        self.drop_right_keys = drop_right_keys or []
+        self._schema = self._compute_schema()
+
+    def _compute_schema(self) -> Schema:
+        import dataclasses as dc
+
+        left, right = self.children
+        lt = list(left.output.fields)
+        rt = [f for f in right.output.fields if f.name not in self.drop_right_keys]
+        if self.join_type in ("left_semi", "left_anti"):
+            return Schema(lt)
+        if self.join_type in ("left", "full"):
+            rt = [dc.replace(f, nullable=True) for f in rt]
+        if self.join_type in ("right", "full"):
+            lt = [dc.replace(f, nullable=True) for f in lt]
+        return Schema(lt + rt)
+
+    @property
+    def output(self) -> Schema:
+        return self._schema
+
+    def execute(self, ctx: ExecContext) -> PartitionSet:
+        left, right = self.children
+        lparts = left.execute(ctx)
+        rparts = right.execute(ctx)
+        assert lparts.num_partitions == rparts.num_partitions
+        lschema, rschema = left.output, right.output
+
+        def make(lt, rt):
+            def it():
+                lrb = concat_batches(lschema, list(lt()))
+                rrb = concat_batches(rschema, list(rt()))
+                yield self._join_partition(lrb, rrb)
+
+            return it
+
+        return PartitionSet(
+            [make(lt, rt) for lt, rt in zip(lparts.parts, rparts.parts)]
+        )
+
+    def _join_partition(self, lrb: pa.RecordBatch, rrb: pa.RecordBatch) -> pa.RecordBatch:
+        left, right = self.children
+        lcodes, lvalid = _key_codes(self.left_keys, lrb, left.output)
+        rcodes, rvalid = _key_codes(self.right_keys, rrb, right.output)
+        # build on right (stream=left), matching the reference's build-side
+        table: dict = {}
+        for j in range(rrb.num_rows):
+            if not rvalid[j]:
+                continue
+            table.setdefault(tuple(rcodes[j]), []).append(j)
+        li: list[int] = []
+        ri: list[int] = []
+        lmatched = np.zeros(lrb.num_rows, dtype=bool)
+        rmatched = np.zeros(rrb.num_rows, dtype=bool)
+        for i in range(lrb.num_rows):
+            if lvalid[i]:
+                js = table.get(tuple(lcodes[i]))
+                if js:
+                    for j in js:
+                        li.append(i)
+                        ri.append(j)
+                    lmatched[i] = True
+                    for j in js:
+                        rmatched[j] = True
+        li_a = np.asarray(li, dtype=np.int64)
+        ri_a = np.asarray(ri, dtype=np.int64)
+        # residual condition filters matched pairs (then outer rows re-added)
+        if self.residual is not None and len(li_a):
+            pairs = self._pairs_batch(lrb, rrb, li_a, ri_a, drop=False)
+            rs = Schema(list(self.children[0].output.fields) + list(self.children[1].output.fields))
+            c = _cpu_ctx(pairs, rs)
+            cond = bind(self.residual, rs)
+            d, v = _val_to_np(c, cond.eval(c))
+            keep = d.astype(bool) & v
+            # recompute matched flags post-residual
+            lmatched = np.zeros(lrb.num_rows, dtype=bool)
+            rmatched = np.zeros(rrb.num_rows, dtype=bool)
+            lmatched[li_a[keep]] = True
+            rmatched[ri_a[keep]] = True
+            li_a, ri_a = li_a[keep], ri_a[keep]
+        jt = self.join_type
+        if jt == "inner":
+            return self._pairs_batch(lrb, rrb, li_a, ri_a)
+        if jt == "left_semi":
+            return _take(lrb, np.nonzero(lmatched)[0])
+        if jt == "left_anti":
+            return _take(lrb, np.nonzero(~lmatched)[0])
+        if jt in ("left", "full"):
+            extra_l = np.nonzero(~lmatched)[0]
+        else:
+            extra_l = np.zeros(0, dtype=np.int64)
+        if jt in ("right", "full"):
+            extra_r = np.nonzero(~rmatched)[0]
+        else:
+            extra_r = np.zeros(0, dtype=np.int64)
+        return self._outer_batch(lrb, rrb, li_a, ri_a, extra_l, extra_r)
+
+    def _right_cols(self, rrb: pa.RecordBatch):
+        right = self.children[1]
+        return [
+            (i, f)
+            for i, f in enumerate(right.output.fields)
+            if f.name not in self.drop_right_keys
+        ]
+
+    def _pairs_batch(self, lrb, rrb, li, ri, drop=True) -> pa.RecordBatch:
+        arrays = [lrb.column(i).take(pa.array(li)) for i in range(lrb.num_columns)]
+        rcols = self._right_cols(rrb) if drop else [
+            (i, f) for i, f in enumerate(self.children[1].output.fields)
+        ]
+        arrays += [rrb.column(i).take(pa.array(ri)) for i, _ in rcols]
+        schema = self._schema if drop else Schema(
+            list(self.children[0].output.fields) + list(self.children[1].output.fields)
+        )
+        names = schema.names
+        return pa.RecordBatch.from_arrays(
+            [a.combine_chunks() if isinstance(a, pa.ChunkedArray) else a for a in arrays],
+            schema=schema.to_arrow(),
+        )
+
+    def _outer_batch(self, lrb, rrb, li, ri, extra_l, extra_r) -> pa.RecordBatch:
+        parts = []
+        matched = self._pairs_batch(lrb, rrb, li, ri)
+        parts.append(matched)
+        rcols = self._right_cols(rrb)
+        if len(extra_l):
+            arrays = [lrb.column(i).take(pa.array(extra_l)) for i in range(lrb.num_columns)]
+            arrays += _null_batch(Schema([f for _, f in rcols]), len(extra_l))
+            parts.append(pa.RecordBatch.from_arrays(arrays, schema=self._schema.to_arrow()))
+        if len(extra_r):
+            arrays = _null_batch(Schema(list(self.children[0].output.fields)), len(extra_r))
+            arrays += [rrb.column(i).take(pa.array(extra_r)) for i, _ in rcols]
+            parts.append(pa.RecordBatch.from_arrays(arrays, schema=self._schema.to_arrow()))
+        return concat_batches(self._schema, parts)
+
+    def node_string(self):
+        return f"CpuShuffledHashJoin {self.join_type} [{', '.join(map(str, self.left_keys))}] [{', '.join(map(str, self.right_keys))}]"
+
+
+class CpuNestedLoopJoinExec(Exec):
+    """Cross/conditional join (GpuBroadcastNestedLoopJoinExec analogue)."""
+
+    def __init__(self, join_type: str, condition: Optional[Expression], left: Exec, right: Exec):
+        super().__init__([left, right])
+        self.join_type = join_type
+        self.condition = condition
+        import dataclasses as dc
+
+        lt = list(left.output.fields)
+        rt = list(right.output.fields)
+        if join_type in ("left", "full"):
+            rt = [dc.replace(f, nullable=True) for f in rt]
+        if join_type in ("right", "full"):
+            lt = [dc.replace(f, nullable=True) for f in lt]
+        self._schema = Schema(lt + rt)
+
+    @property
+    def output(self) -> Schema:
+        return self._schema
+
+    def execute(self, ctx: ExecContext) -> PartitionSet:
+        left, right = self.children
+        lschema, rschema = left.output, right.output
+        lparts = left.execute(ctx)
+        rparts = right.execute(ctx)
+
+        def it():
+            lrb = concat_batches(lschema, [b for t in lparts.parts for b in t()])
+            rrb = concat_batches(rschema, [b for t in rparts.parts for b in t()])
+            nl, nr = lrb.num_rows, rrb.num_rows
+            li = np.repeat(np.arange(nl, dtype=np.int64), nr)
+            ri = np.tile(np.arange(nr, dtype=np.int64), nl)
+            arrays = [lrb.column(i).take(pa.array(li)) for i in range(lrb.num_columns)]
+            arrays += [rrb.column(i).take(pa.array(ri)) for i in range(rrb.num_columns)]
+            out = pa.RecordBatch.from_arrays(arrays, schema=self._schema.to_arrow())
+            if self.condition is not None:
+                rs = Schema(list(lschema.fields) + list(rschema.fields))
+                c = _cpu_ctx(out, rs)
+                cond = bind(self.condition, rs)
+                d, v = _val_to_np(c, cond.eval(c))
+                out = out.filter(pa.array(d.astype(bool) & v))
+            yield out
+
+        return PartitionSet([it])
+
+
+def extract_equi_join_keys(condition, left_schema: Schema, right_schema: Schema):
+    """Split a join condition into (left_keys, right_keys, residual)."""
+    from ..expr.predicates import EqualTo, And
+    from ..expr import UnresolvedAttribute
+
+    if condition is None:
+        return [], [], None
+    conjuncts = []
+
+    def flatten(e):
+        if isinstance(e, And):
+            flatten(e.l)
+            flatten(e.r)
+        else:
+            conjuncts.append(e)
+
+    flatten(condition)
+    lk, rk, residual = [], [], []
+    for e in conjuncts:
+        if isinstance(e, EqualTo):
+            sides = []
+            for operand in (e.l, e.r):
+                if isinstance(operand, UnresolvedAttribute):
+                    in_l = operand.name in left_schema.names
+                    in_r = operand.name in right_schema.names
+                    if in_l and not in_r:
+                        sides.append("l")
+                        continue
+                    if in_r and not in_l:
+                        sides.append("r")
+                        continue
+                sides.append("?")
+            if sides == ["l", "r"]:
+                lk.append(e.l)
+                rk.append(e.r)
+                continue
+            if sides == ["r", "l"]:
+                lk.append(e.r)
+                rk.append(e.l)
+                continue
+        residual.append(e)
+    res = None
+    for e in residual:
+        from ..expr.predicates import And as AndE
+
+        res = e if res is None else AndE(res, e)
+    return lk, rk, res

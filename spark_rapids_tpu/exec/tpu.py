@@ -1,0 +1,616 @@
+"""TPU physical operators — the GpuExec family.
+
+Reference analogues: basicPhysicalOperators.scala (GpuProjectExec,
+GpuFilterExec), aggregate.scala (GpuHashAggregateExec), GpuSortExec.scala,
+GpuShuffleExchangeExec + GpuPartitioning, GpuTransitionOverrides' transitions.
+
+Each operator compiles ONE fused XLA program per (expression tree, schema,
+capacity) via jax.jit over DeviceBatch pytrees; the device semaphore gates
+first touch of the device per partition-task (GpuSemaphore protocol).
+"""
+from __future__ import annotations
+
+import functools
+from typing import Iterator, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pyarrow as pa
+
+from ..columnar.device import (
+    DeviceBatch,
+    DeviceColumn,
+    bucket_capacity,
+    device_to_host,
+    empty_batch,
+    host_to_device,
+)
+from ..columnar.host import concat_batches
+from ..expr import Expression, bind, output_name
+from ..expr.aggregates import AggregateFunction
+from ..expr.base import BoundReference, Ctx, Val
+from ..ops.aggregate import group_aggregate
+from ..ops.concat import concat_device
+from ..ops.gather import compact, gather_batch
+from ..ops.hash import murmur3_rows, partition_ids
+from ..ops.sortkeys import batch_radix_words, sort_permutation
+from ..plan.logical import SortOrder
+from ..plan.physical import Exec, ExecContext, PartitionSet
+from ..types import Schema, StringType, StructField
+
+
+def val_to_column(ctx: Ctx, val: Val, dtype) -> DeviceColumn:
+    """Materialize an expression result into a full DeviceColumn."""
+    if isinstance(dtype, StringType):
+        data = val.data
+        if data.ndim == 1:  # scalar string literal [w]
+            data = jnp.broadcast_to(data[None, :], (ctx.n, data.shape[0]))
+        lengths = jnp.broadcast_to(jnp.asarray(val.lengths), (ctx.n,))
+        return DeviceColumn(dtype, data, val.full_valid(ctx), lengths)
+    data = ctx.broadcast(val.data)
+    if data.dtype != dtype.np_dtype:
+        data = data.astype(dtype.np_dtype)
+    return DeviceColumn(dtype, data, val.full_valid(ctx))
+
+
+# ── transitions ─────────────────────────────────────────────────────────────
+
+
+class HostToDeviceExec(Exec):
+    """Host Arrow batches → device batches (HostColumnarToGpu analogue)."""
+
+    def __init__(self, child: Exec):
+        super().__init__([child])
+
+    @property
+    def output(self) -> Schema:
+        return self.children[0].output
+
+    @property
+    def is_device(self) -> bool:
+        return True
+
+    def execute(self, ctx: ExecContext) -> PartitionSet:
+        schema = self.output
+
+        def fn(it):
+            for rb in it:
+                ctx.semaphore.acquire_if_necessary()
+                if rb.num_rows == 0:
+                    continue
+                yield host_to_device(rb)
+
+        return self.children[0].execute(ctx).map_partitions(fn)
+
+
+class DeviceToHostExec(Exec):
+    """Device batches → host Arrow (GpuColumnarToRow/GpuBringBackToHost)."""
+
+    def __init__(self, child: Exec):
+        super().__init__([child])
+
+    @property
+    def output(self) -> Schema:
+        return self.children[0].output
+
+    def execute(self, ctx: ExecContext) -> PartitionSet:
+        def fn(it):
+            for db in it:
+                rb = device_to_host(db)
+                ctx.semaphore.release_if_necessary()
+                if rb.num_rows:
+                    yield rb
+
+        return self.children[0].execute(ctx).map_partitions(fn)
+
+
+# ── compute execs ───────────────────────────────────────────────────────────
+
+
+class TpuProjectExec(Exec):
+    def __init__(self, exprs: List[Expression], child: Exec):
+        super().__init__([child])
+        self.exprs = [bind(e, child.output) for e in exprs]
+        self._schema = Schema(
+            [
+                StructField(output_name(e0), e.data_type, e.nullable)
+                for e0, e in zip(exprs, self.exprs)
+            ]
+        )
+        schema = self._schema
+
+        @jax.jit
+        def _project(batch: DeviceBatch) -> DeviceBatch:
+            c = Ctx.for_device(batch)
+            cols = [
+                val_to_column(c, e.eval(c), e.data_type) for e in self.exprs
+            ]
+            # keep padding rows inert
+            live = batch.row_mask()
+            cols = [
+                DeviceColumn(col.dtype, col.data, col.validity & live, col.lengths)
+                for col in cols
+            ]
+            return DeviceBatch(schema, cols, batch.num_rows)
+
+        self._fn = _project
+
+    @property
+    def output(self) -> Schema:
+        return self._schema
+
+    @property
+    def is_device(self) -> bool:
+        return True
+
+    def execute(self, ctx: ExecContext) -> PartitionSet:
+        fn = self._fn
+
+        def run(it):
+            for db in it:
+                yield fn(db)
+
+        return self.children[0].execute(ctx).map_partitions(run)
+
+    def node_string(self):
+        return f"TpuProject [{', '.join(map(str, self.exprs))}]"
+
+
+class TpuFilterExec(Exec):
+    def __init__(self, condition: Expression, child: Exec):
+        super().__init__([child])
+        self.condition = bind(condition, child.output)
+
+        @jax.jit
+        def _filter(batch: DeviceBatch) -> DeviceBatch:
+            c = Ctx.for_device(batch)
+            v = self.condition.eval(c)
+            keep = c.broadcast_bool(v.data) & v.full_valid(c)
+            return compact(batch, keep)
+
+        self._fn = _filter
+
+    @property
+    def output(self) -> Schema:
+        return self.children[0].output
+
+    @property
+    def is_device(self) -> bool:
+        return True
+
+    def execute(self, ctx: ExecContext) -> PartitionSet:
+        fn = self._fn
+
+        def run(it):
+            for db in it:
+                yield fn(db)
+
+        return self.children[0].execute(ctx).map_partitions(run)
+
+    def node_string(self):
+        return f"TpuFilter {self.condition}"
+
+
+class TpuUnionExec(Exec):
+    def __init__(self, children: List[Exec]):
+        super().__init__(children)
+
+    @property
+    def output(self) -> Schema:
+        return self.children[0].output
+
+    @property
+    def is_device(self) -> bool:
+        return True
+
+    def execute(self, ctx: ExecContext) -> PartitionSet:
+        parts = []
+        for c in self.children:
+            parts.extend(c.execute(ctx).parts)
+        return PartitionSet(parts)
+
+
+class TpuCoalescePartitionsExec(Exec):
+    def __init__(self, child: Exec):
+        super().__init__([child])
+
+    @property
+    def output(self) -> Schema:
+        return self.children[0].output
+
+    @property
+    def is_device(self) -> bool:
+        return True
+
+    def execute(self, ctx: ExecContext) -> PartitionSet:
+        child_parts = self.children[0].execute(ctx)
+
+        def it():
+            for t in child_parts.parts:
+                yield from t()
+
+        return PartitionSet([it])
+
+
+class TpuHashAggregateExec(Exec):
+    """Sort-based group-by on device; one phase (partial|final|complete).
+
+    The reference's hot loop (aggregate.scala:406-468) is: per-batch update
+    aggregate → concat partials → merge aggregate. Here both update and merge
+    are the same fused kernel with different reduce ops.
+    """
+
+    def __init__(
+        self,
+        mode: str,
+        grouping: List[Expression],
+        agg_fns: List[AggregateFunction],
+        result_exprs: Optional[List[Expression]],
+        result_names: Optional[List[str]],
+        child: Exec,
+    ):
+        super().__init__([child])
+        self.mode = mode
+        self.grouping = [bind(g, child.output) for g in grouping]
+        self.agg_fns = agg_fns
+        self.result_exprs = result_exprs
+        self.result_names = result_names
+        self._schema = self._compute_schema(child)
+        self._agg_fn_cache: dict = {}
+
+    def _compute_schema(self, child: Exec) -> Schema:
+        fields = []
+        for g in self.grouping:
+            fields.append(StructField(output_name(g), g.data_type, g.nullable))
+        if self.mode == "partial":
+            for i, f in enumerate(self.agg_fns):
+                for j, bt in enumerate(f.buffer_types):
+                    fields.append(StructField(f"buf{i}_{j}", bt, True))
+            return Schema(fields)
+        assert self.result_exprs is not None
+        return Schema(
+            [
+                StructField(name, e.data_type, e.nullable)
+                for name, e in zip(self.result_names, self.result_exprs)
+            ]
+        )
+
+    @property
+    def output(self) -> Schema:
+        return self._schema
+
+    @property
+    def is_device(self) -> bool:
+        return True
+
+    def _buffer_ordinal(self, f: AggregateFunction, j: int) -> int:
+        base = len(self.grouping)
+        for g in self.agg_fns:
+            if g is f:
+                return base + j
+            base += len(g.buffer_types)
+        raise KeyError
+
+    def _make_kernel(self, child_schema: Schema):
+        mode = self.mode
+        out_schema = self._schema
+        grouping = self.grouping
+        agg_fns = self.agg_fns
+
+        def _aggregate(batch: DeviceBatch) -> DeviceBatch:
+            c = Ctx.for_device(batch)
+            live = batch.row_mask()
+            # materialize grouping keys + agg inputs as columns
+            key_cols = [
+                val_to_column(c, g.eval(c), g.data_type) for g in grouping
+            ]
+            key_cols = [
+                DeviceColumn(k.dtype, k.data, k.validity & live, k.lengths)
+                for k in key_cols
+            ]
+            in_cols: list[DeviceColumn] = []
+            ops: list[str] = []
+            for f in agg_fns:
+                if mode in ("partial", "complete"):
+                    exprs = [bind(e, child_schema) for e in f.update_exprs]
+                    for e, op in zip(exprs, f.update_ops):
+                        col = val_to_column(c, e.eval(c), e.data_type)
+                        in_cols.append(
+                            DeviceColumn(col.dtype, col.data, col.validity & live, col.lengths)
+                        )
+                        ops.append(op)
+                else:
+                    for j, op in enumerate(f.merge_ops):
+                        in_cols.append(batch.columns[self._buffer_ordinal(f, j)])
+                        ops.append(op)
+            tmp_schema = Schema(
+                [StructField(f"k{i}", k.dtype, True) for i, k in enumerate(key_cols)]
+            )
+            work = DeviceBatch(
+                Schema(list(tmp_schema.fields)), key_cols, batch.num_rows
+            )
+            # group_aggregate works on a batch containing the key columns;
+            # ungrouped reductions force one output group even when empty
+            out_keys, out_aggs, num_groups = group_aggregate(
+                work,
+                list(range(len(key_cols))),
+                in_cols,
+                ops,
+                min_groups=0 if grouping else 1,
+            )
+            if mode == "partial":
+                cols = out_keys + out_aggs
+                return DeviceBatch(out_schema, cols, num_groups)
+            # final/complete: evaluate aggregates + result projection
+            cap = batch.capacity
+            gctx = Ctx(jnp, cap, True, [Val(k.data, k.validity, k.lengths) for k in out_keys], num_groups)
+            agg_results: list[Val] = []
+            i = 0
+            for f in agg_fns:
+                nbuf = len(f.buffer_types)
+                bufs = [
+                    Val(out_aggs[i + j].data, out_aggs[i + j].validity, out_aggs[i + j].lengths)
+                    for j in range(nbuf)
+                ]
+                agg_results.append(f.evaluate(gctx, bufs))
+                i += nbuf
+            rctx = Ctx(
+                jnp,
+                cap,
+                True,
+                [Val(k.data, k.validity, k.lengths) for k in out_keys] + agg_results,
+                num_groups,
+            )
+            glive = jnp.arange(cap, dtype=jnp.int32) < num_groups
+            cols = []
+            for e in self.result_exprs:
+                col = val_to_column(rctx, e.eval(rctx), e.data_type)
+                cols.append(
+                    DeviceColumn(col.dtype, col.data, col.validity & glive, col.lengths)
+                )
+            return DeviceBatch(out_schema, cols, num_groups)
+
+        return jax.jit(_aggregate)
+
+    def execute(self, ctx: ExecContext) -> PartitionSet:
+        child = self.children[0]
+        child_schema = child.output
+        kernel = self._make_kernel(child_schema)
+        merge_jit = self._merge_jit()
+
+        def run(it):
+            if self.mode == "partial":
+                # per-batch update aggregate, then concat + merge — the
+                # reference's hot loop (aggregate.scala:406-468)
+                partials = [kernel(db) for db in it]
+                if not partials:
+                    if self.grouping:
+                        return
+                    partials = [kernel(empty_batch(child_schema))]
+                if len(partials) == 1:
+                    yield partials[0]
+                else:
+                    yield merge_jit(concat_device(partials))
+                return
+            # final/complete: single merge+evaluate over the whole partition
+            batches = list(it)
+            if not batches:
+                if self.grouping:
+                    return
+                batches = [empty_batch(child_schema)]
+            merged = batches[0] if len(batches) == 1 else concat_device(batches)
+            yield kernel(merged)
+
+        return child.execute(ctx).map_partitions(run)
+
+    def _merge_jit(self):
+        """Merge-mode aggregation kernel over (concatenated) partial batches.
+        The partial-output layout is keys ++ buffers, so key ordinals and
+        _buffer_ordinal line up with self's layout."""
+
+        @jax.jit
+        def _m(batch: DeviceBatch) -> DeviceBatch:
+            in_cols = []
+            ops = []
+            for f in self.agg_fns:
+                for j, op in enumerate(f.merge_ops):
+                    in_cols.append(batch.columns[self._buffer_ordinal(f, j)])
+                    ops.append(op)
+            out_keys, out_aggs, num_groups = group_aggregate(
+                batch,
+                list(range(len(self.grouping))),
+                in_cols,
+                ops,
+                min_groups=0 if self.grouping else 1,
+            )
+            return DeviceBatch(self._schema, out_keys + out_aggs, num_groups)
+
+        return _m
+
+    def node_string(self):
+        return (
+            f"TpuHashAggregate({self.mode}) keys={[str(g) for g in self.grouping]} "
+            f"aggs={[str(a) for a in self.agg_fns]}"
+        )
+
+
+class _SchemaOnly(Exec):
+    """Placeholder child carrying just a schema (for kernel construction)."""
+
+    def __init__(self, schema: Schema):
+        super().__init__([])
+        self._schema = schema
+
+    @property
+    def output(self) -> Schema:
+        return self._schema
+
+
+class TpuSortExec(Exec):
+    """Per-partition sort; coalesces the partition into one batch (the
+    reference's single-batch mode; out-of-core merge sort comes with the
+    spill framework — GpuSortExec.scala:212)."""
+
+    def __init__(self, order: List[SortOrder], child: Exec):
+        super().__init__([child])
+        self.order = [
+            SortOrder(bind(o.child, child.output), o.ascending, o.nulls_first)
+            for o in order
+        ]
+
+    @property
+    def output(self) -> Schema:
+        return self.children[0].output
+
+    @property
+    def is_device(self) -> bool:
+        return True
+
+    def execute(self, ctx: ExecContext) -> PartitionSet:
+        order = self.order
+
+        @jax.jit
+        def _sort(batch: DeviceBatch) -> DeviceBatch:
+            c = Ctx.for_device(batch)
+            live = batch.row_mask()
+            words = []
+            for o in order:
+                col = val_to_column(c, o.child.eval(c), o.child.data_type)
+                col = DeviceColumn(col.dtype, col.data, col.validity & live, col.lengths)
+                from ..ops.sortkeys import column_radix_words
+
+                words.extend(
+                    column_radix_words(col, o.ascending, o.resolved_nulls_first())
+                )
+            perm = sort_permutation(words, live)
+            return gather_batch(batch, perm, batch.num_rows)
+
+        def run(it):
+            batches = list(it)
+            if not batches:
+                return
+            merged = concat_device(batches)
+            yield _sort(merged)
+
+        return self.children[0].execute(ctx).map_partitions(run)
+
+    def node_string(self):
+        return f"TpuSort [{', '.join(map(str, self.order))}]"
+
+
+class TpuShuffleExchangeExec(Exec):
+    """Hash-partitioned exchange with on-device murmur3 bucketing and
+    device-side slicing (GpuHashPartitioning + GpuPartitioning
+    sliceInternalOnGpu analogue). In-process: device batches move between
+    partitions without leaving HBM; the multi-process serializer path lives
+    in shuffle/."""
+
+    def __init__(self, keys: List[Expression], num_partitions: int, child: Exec):
+        super().__init__([child])
+        self.keys = [bind(k, child.output) for k in keys]
+        self.num_partitions = num_partitions
+
+    @property
+    def output(self) -> Schema:
+        return self.children[0].output
+
+    @property
+    def is_device(self) -> bool:
+        return True
+
+    def execute(self, ctx: ExecContext) -> PartitionSet:
+        keys = self.keys
+        nparts = self.num_partitions
+
+        @functools.lru_cache(maxsize=None)
+        def slicer():
+            @jax.jit
+            def _slice(batch: DeviceBatch) -> list[DeviceBatch]:
+                c = Ctx.for_device(batch)
+                cols = []
+                for k in keys:
+                    col = val_to_column(c, k.eval(c), k.data_type)
+                    cols.append((k.data_type, col.data, col.validity, col.lengths))
+                h = murmur3_rows(jnp, cols, batch.capacity)
+                pids = partition_ids(jnp, h, nparts)
+                return [
+                    compact(batch, (pids == p) & batch.row_mask())
+                    for p in range(nparts)
+                ]
+
+            return _slice
+
+        child_parts = self.children[0].execute(ctx)
+        state = {"buckets": None}
+
+        def materialize():
+            if state["buckets"] is None:
+                buckets = [[] for _ in range(nparts)]
+                fn = slicer()
+                for t in child_parts.parts:
+                    for db in t():
+                        if not keys:
+                            buckets[0].append(db)
+                            continue
+                        slices = fn(db)
+                        for p in range(nparts):
+                            buckets[p].append(slices[p])
+                state["buckets"] = buckets
+            return state["buckets"]
+
+        def make(p):
+            def it():
+                for db in materialize()[p]:
+                    yield db
+
+            return it
+
+        return PartitionSet([make(p) for p in range(nparts)])
+
+    def node_string(self):
+        return (
+            f"TpuShuffleExchange [{', '.join(map(str, self.keys))}] p={self.num_partitions}"
+        )
+
+
+class TpuLimitExec(Exec):
+    def __init__(self, n: int, child: Exec):
+        super().__init__([child])
+        self.n = n
+
+    @property
+    def output(self) -> Schema:
+        return self.children[0].output
+
+    @property
+    def is_device(self) -> bool:
+        return True
+
+    def execute(self, ctx: ExecContext) -> PartitionSet:
+        limit = self.n
+        child_parts = self.children[0].execute(ctx)
+
+        @jax.jit
+        def _head(batch: DeviceBatch, remaining) -> DeviceBatch:
+            take = jnp.minimum(batch.num_rows, remaining)
+            live = jnp.arange(batch.capacity, dtype=jnp.int32) < take
+            cols = [
+                DeviceColumn(c.dtype, c.data, c.validity & live, c.lengths)
+                for c in batch.columns
+            ]
+            return DeviceBatch(batch.schema, cols, take)
+
+        def it():
+            remaining = limit
+            for t in child_parts.parts:
+                for db in t():
+                    if remaining <= 0:
+                        return
+                    out = _head(db, jnp.asarray(remaining, jnp.int32))
+                    n = out.row_count()
+                    remaining -= n
+                    if n:
+                        yield out
+
+        return PartitionSet([it])

@@ -1,0 +1,44 @@
+"""Expression IR (see base.py for design notes)."""
+from .base import (
+    Alias,
+    BoundReference,
+    Ctx,
+    Expression,
+    Literal,
+    UnresolvedAttribute,
+    Val,
+    bind,
+    output_name,
+    to_expr,
+)
+from .arithmetic import (
+    Abs,
+    Add,
+    Divide,
+    IntegralDivide,
+    Multiply,
+    Pmod,
+    Remainder,
+    Subtract,
+    UnaryMinus,
+    UnaryPositive,
+)
+from .cast import Cast, can_cast_on_device
+from .conditional import CaseWhen, Coalesce, If
+from .predicates import (
+    And,
+    EqualNullSafe,
+    EqualTo,
+    GreaterThan,
+    GreaterThanOrEqual,
+    In,
+    IsNaN,
+    IsNotNull,
+    IsNull,
+    LessThan,
+    LessThanOrEqual,
+    Not,
+    Or,
+)
+
+__all__ = [n for n in dir() if not n.startswith("_")]

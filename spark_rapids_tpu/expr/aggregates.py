@@ -1,0 +1,288 @@
+"""Aggregate functions — reference: org/.../rapids/AggregateFunctions.scala
+(CudfAggregate mapping) + aggregate.scala's update/merge two-phase model.
+
+Each aggregate declares, exactly like the reference's ``GpuAggregateFunction``:
+
+* ``update_exprs``   — projections of the input evaluated before the update
+* ``buffer_fields``  — the aggregation buffer schema (e.g. Average: sum, count)
+* ``update_ops`` / ``merge_ops`` — per-buffer-column segment reductions
+  ('sum' | 'min' | 'max' | 'count' | 'first' | 'last'), executed by the
+  sort+segment-reduce device kernel (ops/aggregate.py) or the numpy fallback
+* ``evaluate(ctx, buffers)`` — final projection from buffer values
+
+Spark result-type rules implemented: sum(integral)=long (wrapping),
+sum(float/double)=double, sum(decimal(p,s))=decimal(min(p+10,18),s) under the
+DECIMAL64 gate; count=long never-null; avg=double (decimal later); min/max
+keep the input type and are null on empty groups.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional, Sequence, Tuple
+
+from ..types import (
+    DOUBLE,
+    DataType,
+    DecimalType,
+    DoubleType,
+    FloatType,
+    IntegralType,
+    LONG,
+    NullType,
+    StringType,
+)
+from .base import Ctx, Expression, Literal, Val
+
+
+@dataclass(frozen=True)
+class AggregateFunction(Expression):
+    """Base; concrete functions are frozen dataclasses with child exprs."""
+
+    @property
+    def update_exprs(self) -> Tuple[Expression, ...]:
+        raise NotImplementedError
+
+    @property
+    def buffer_types(self) -> Tuple[DataType, ...]:
+        raise NotImplementedError
+
+    @property
+    def update_ops(self) -> Tuple[str, ...]:
+        raise NotImplementedError
+
+    @property
+    def merge_ops(self) -> Tuple[str, ...]:
+        raise NotImplementedError
+
+    def evaluate(self, ctx: Ctx, buffers: Sequence[Val]) -> Val:
+        """Final projection; default: first buffer."""
+        return buffers[0]
+
+
+@dataclass(frozen=True)
+class Sum(AggregateFunction):
+    child: Expression
+
+    @property
+    def data_type(self) -> DataType:
+        ct = self.child.data_type
+        if isinstance(ct, DecimalType):
+            return DecimalType(min(ct.precision + 10, DecimalType.MAX_PRECISION), ct.scale)
+        if isinstance(ct, (FloatType, DoubleType)):
+            return DOUBLE
+        return LONG
+
+    @property
+    def update_exprs(self):
+        from .cast import Cast
+
+        ct = self.child.data_type
+        if self.data_type == ct:
+            return (self.child,)
+        return (Cast(self.child, self.data_type),)
+
+    @property
+    def buffer_types(self):
+        return (self.data_type,)
+
+    @property
+    def update_ops(self):
+        return ("sum",)
+
+    @property
+    def merge_ops(self):
+        return ("sum",)
+
+    def __str__(self):
+        return f"sum({self.child})"
+
+
+@dataclass(frozen=True)
+class Count(AggregateFunction):
+    """count(expr) — counts non-null; count(*) via Count(Literal(1))."""
+
+    child: Expression
+
+    @property
+    def data_type(self) -> DataType:
+        return LONG
+
+    @property
+    def nullable(self) -> bool:
+        return False
+
+    @property
+    def update_exprs(self):
+        return (self.child,)
+
+    @property
+    def buffer_types(self):
+        return (LONG,)
+
+    @property
+    def update_ops(self):
+        return ("count",)
+
+    @property
+    def merge_ops(self):
+        return ("sum",)
+
+    def __str__(self):
+        return f"count({self.child})"
+
+
+@dataclass(frozen=True)
+class Min(AggregateFunction):
+    child: Expression
+
+    @property
+    def data_type(self) -> DataType:
+        return self.child.data_type
+
+    @property
+    def update_exprs(self):
+        return (self.child,)
+
+    @property
+    def buffer_types(self):
+        return (self.child.data_type,)
+
+    @property
+    def update_ops(self):
+        return ("min",)
+
+    @property
+    def merge_ops(self):
+        return ("min",)
+
+    def __str__(self):
+        return f"min({self.child})"
+
+
+@dataclass(frozen=True)
+class Max(AggregateFunction):
+    child: Expression
+
+    @property
+    def data_type(self) -> DataType:
+        return self.child.data_type
+
+    @property
+    def update_exprs(self):
+        return (self.child,)
+
+    @property
+    def buffer_types(self):
+        return (self.child.data_type,)
+
+    @property
+    def update_ops(self):
+        return ("max",)
+
+    @property
+    def merge_ops(self):
+        return ("max",)
+
+    def __str__(self):
+        return f"max({self.child})"
+
+
+@dataclass(frozen=True)
+class Average(AggregateFunction):
+    child: Expression
+
+    @property
+    def data_type(self) -> DataType:
+        return DOUBLE
+
+    @property
+    def update_exprs(self):
+        from .cast import Cast
+
+        c = self.child
+        if c.data_type != DOUBLE:
+            c = Cast(c, DOUBLE)
+        return (c, self.child)
+
+    @property
+    def buffer_types(self):
+        return (DOUBLE, LONG)
+
+    @property
+    def update_ops(self):
+        return ("sum", "count")
+
+    @property
+    def merge_ops(self):
+        return ("sum", "sum")
+
+    def evaluate(self, ctx: Ctx, buffers: Sequence[Val]) -> Val:
+        xp = ctx.xp
+        s, c = buffers
+        cnt = ctx.broadcast(c.data)
+        nz = cnt != 0
+        safe = xp.where(nz, cnt, 1)
+        data = ctx.broadcast(s.data) / safe
+        valid = ctx.broadcast_bool(s.valid) & nz
+        return Val(data, valid)
+
+    def __str__(self):
+        return f"avg({self.child})"
+
+
+@dataclass(frozen=True)
+class First(AggregateFunction):
+    child: Expression
+    ignore_nulls: bool = False
+
+    @property
+    def data_type(self) -> DataType:
+        return self.child.data_type
+
+    @property
+    def update_exprs(self):
+        return (self.child,)
+
+    @property
+    def buffer_types(self):
+        return (self.child.data_type,)
+
+    @property
+    def update_ops(self):
+        return ("first_ignore_nulls" if self.ignore_nulls else "first",)
+
+    @property
+    def merge_ops(self):
+        return ("first_ignore_nulls" if self.ignore_nulls else "first",)
+
+
+@dataclass(frozen=True)
+class Last(AggregateFunction):
+    child: Expression
+    ignore_nulls: bool = False
+
+    @property
+    def data_type(self) -> DataType:
+        return self.child.data_type
+
+    @property
+    def update_exprs(self):
+        return (self.child,)
+
+    @property
+    def buffer_types(self):
+        return (self.child.data_type,)
+
+    @property
+    def update_ops(self):
+        return ("last_ignore_nulls" if self.ignore_nulls else "last",)
+
+    @property
+    def merge_ops(self):
+        return ("last_ignore_nulls" if self.ignore_nulls else "last",)
+
+
+def is_aggregate(e: Expression) -> bool:
+    if isinstance(e, AggregateFunction):
+        return True
+    return any(is_aggregate(c) for c in e.children())

@@ -1,0 +1,107 @@
+"""Type coercion — the slice of Catalyst's analyzer the reference relies on
+Spark to run before its planning pass. Inserts Casts so binary operators see
+same-type operands, and promotes Divide operands to double (Spark's
+``ImplicitTypeCasts``/``DecimalPrecision`` behavior for the supported types).
+"""
+from __future__ import annotations
+
+import dataclasses
+
+from ..types import (
+    DOUBLE,
+    BooleanType,
+    DataType,
+    DecimalType,
+    DateType,
+    IntegralType,
+    NullType,
+    NumericType,
+    StringType,
+    TimestampType,
+    numeric_promote,
+)
+from .arithmetic import Add, Divide, IntegralDivide, Multiply, Pmod, Remainder, Subtract
+from .base import Expression, Literal
+from .cast import Cast
+from .predicates import (
+    Comparison,
+    EqualNullSafe,
+    EqualTo,
+    GreaterThan,
+    GreaterThanOrEqual,
+    In,
+    LessThan,
+    LessThanOrEqual,
+)
+
+_ARITH = (Add, Subtract, Multiply, Remainder, Pmod)
+_CMP = (
+    EqualTo,
+    EqualNullSafe,
+    LessThan,
+    LessThanOrEqual,
+    GreaterThan,
+    GreaterThanOrEqual,
+)
+
+
+def _cast_to(e: Expression, dt: DataType) -> Expression:
+    if e.data_type == dt:
+        return e
+    if isinstance(e, Literal) and e.value is None:
+        return Literal(None, dt)
+    return Cast(e, dt)
+
+
+def _common_type(a: DataType, b: DataType) -> DataType:
+    if a == b:
+        return a
+    if isinstance(a, NullType):
+        return b
+    if isinstance(b, NullType):
+        return a
+    if isinstance(a, DecimalType) and isinstance(b, IntegralType) and not isinstance(b, (DateType, TimestampType)):
+        # Spark: integral promotes to decimal of exact width
+        widths = {1: 3, 2: 5, 4: 10, 8: 19}
+        p = min(widths[b.np_dtype.itemsize], DecimalType.MAX_PRECISION)
+        return DecimalType(max(a.precision, min(p + a.scale, DecimalType.MAX_PRECISION)), a.scale)
+    if isinstance(b, DecimalType):
+        return _common_type(b, a)
+    if isinstance(a, NumericType) and isinstance(b, NumericType) and not isinstance(
+        a, (DateType, TimestampType)
+    ) and not isinstance(b, (DateType, TimestampType)):
+        return numeric_promote(a, b)
+    if isinstance(a, StringType) and isinstance(b, NumericType):
+        return DOUBLE
+    if isinstance(b, StringType) and isinstance(a, NumericType):
+        return DOUBLE
+    raise TypeError(f"cannot find common type for {a} and {b}")
+
+
+def coerce(e: Expression) -> Expression:
+    """Rewrite one (already child-resolved) node with the casts Spark's
+    analyzer would insert."""
+    if isinstance(e, _ARITH) or isinstance(e, _CMP):
+        lt, rt = e.l.data_type, e.r.data_type
+        if lt == rt and not isinstance(lt, NullType):
+            return e
+        ct = _common_type(lt, rt)
+        return dataclasses.replace(e, l=_cast_to(e.l, ct), r=_cast_to(e.r, ct))
+    if isinstance(e, (Divide, IntegralDivide)):
+        lt, rt = e.l.data_type, e.r.data_type
+        if isinstance(lt, DecimalType) or isinstance(rt, DecimalType):
+            ct = _common_type(lt, rt)
+            return dataclasses.replace(e, l=_cast_to(e.l, ct), r=_cast_to(e.r, ct))
+        # Spark: Divide on anything non-decimal runs on double
+        return dataclasses.replace(e, l=_cast_to(e.l, DOUBLE), r=_cast_to(e.r, DOUBLE))
+    if isinstance(e, In):
+        ct = e.c.data_type
+        for v in e.values:
+            if not isinstance(v.data_type, NullType):
+                ct = _common_type(ct, v.data_type)
+        return dataclasses.replace(
+            e,
+            c=_cast_to(e.c, ct),
+            values=tuple(_cast_to(v, ct) for v in e.values),
+        )
+    return e

@@ -1,0 +1,220 @@
+"""User-facing column functions — the pyspark.sql.functions-shaped facade.
+
+The reference has no such layer (it plugs under Spark SQL); standalone, this
+is the query-authoring surface. Names follow pyspark so TPC-H/DS workloads
+translate one-to-one.
+"""
+from __future__ import annotations
+
+from typing import Any, Optional, Union
+
+from .expr import (
+    Abs,
+    Add,
+    Alias,
+    And,
+    CaseWhen,
+    Cast,
+    Coalesce,
+    Divide,
+    EqualNullSafe,
+    EqualTo,
+    Expression,
+    GreaterThan,
+    GreaterThanOrEqual,
+    If,
+    In,
+    IntegralDivide,
+    IsNaN,
+    IsNotNull,
+    IsNull,
+    LessThan,
+    LessThanOrEqual,
+    Literal,
+    Multiply,
+    Not,
+    Or,
+    Pmod,
+    Remainder,
+    Subtract,
+    UnaryMinus,
+    UnresolvedAttribute,
+    to_expr,
+)
+from .expr.aggregates import Average, Count, First, Last, Max, Min, Sum
+from .types import INT, DataType
+
+
+class Column:
+    """Expression wrapper with operator overloading (pyspark's Column)."""
+
+    def __init__(self, expr: Expression):
+        self.expr = expr
+
+    # arithmetic
+    def __add__(self, o):
+        return Column(Add(self.expr, _e(o)))
+
+    def __radd__(self, o):
+        return Column(Add(_e(o), self.expr))
+
+    def __sub__(self, o):
+        return Column(Subtract(self.expr, _e(o)))
+
+    def __rsub__(self, o):
+        return Column(Subtract(_e(o), self.expr))
+
+    def __mul__(self, o):
+        return Column(Multiply(self.expr, _e(o)))
+
+    def __rmul__(self, o):
+        return Column(Multiply(_e(o), self.expr))
+
+    def __truediv__(self, o):
+        return Column(Divide(self.expr, _e(o)))
+
+    def __rtruediv__(self, o):
+        return Column(Divide(_e(o), self.expr))
+
+    def __mod__(self, o):
+        return Column(Remainder(self.expr, _e(o)))
+
+    def __neg__(self):
+        return Column(UnaryMinus(self.expr))
+
+    # comparisons
+    def __eq__(self, o):  # type: ignore[override]
+        return Column(EqualTo(self.expr, _e(o)))
+
+    def __ne__(self, o):  # type: ignore[override]
+        return Column(Not(EqualTo(self.expr, _e(o))))
+
+    def __lt__(self, o):
+        return Column(LessThan(self.expr, _e(o)))
+
+    def __le__(self, o):
+        return Column(LessThanOrEqual(self.expr, _e(o)))
+
+    def __gt__(self, o):
+        return Column(GreaterThan(self.expr, _e(o)))
+
+    def __ge__(self, o):
+        return Column(GreaterThanOrEqual(self.expr, _e(o)))
+
+    # logic
+    def __and__(self, o):
+        return Column(And(self.expr, _e(o)))
+
+    def __or__(self, o):
+        return Column(Or(self.expr, _e(o)))
+
+    def __invert__(self):
+        return Column(Not(self.expr))
+
+    # misc
+    def alias(self, name: str) -> "Column":
+        return Column(Alias(self.expr, name))
+
+    def cast(self, dt: DataType) -> "Column":
+        return Column(Cast(self.expr, dt))
+
+    def isin(self, *values) -> "Column":
+        return Column(In(self.expr, tuple(_e(v) for v in values)))
+
+    def is_null(self) -> "Column":
+        return Column(IsNull(self.expr))
+
+    isNull = is_null
+
+    def is_not_null(self) -> "Column":
+        return Column(IsNotNull(self.expr))
+
+    isNotNull = is_not_null
+
+    def eq_null_safe(self, o) -> "Column":
+        return Column(EqualNullSafe(self.expr, _e(o)))
+
+    def __hash__(self):
+        return hash(self.expr)
+
+
+def _e(v: Union[Column, Any]) -> Expression:
+    if isinstance(v, Column):
+        return v.expr
+    return to_expr(v)
+
+
+def col(name: str) -> Column:
+    return Column(UnresolvedAttribute(name))
+
+
+def lit(v: Any) -> Column:
+    return Column(to_expr(v))
+
+
+def expr_col(e: Expression) -> Column:
+    return Column(e)
+
+
+# aggregates
+def sum(c) -> Column:  # noqa: A001 - pyspark parity
+    return Column(Sum(_e(c)))
+
+
+def count(c="*") -> Column:
+    if c == "*":
+        return Column(Count(Literal(1, INT)))
+    return Column(Count(_e(c)))
+
+
+def avg(c) -> Column:
+    return Column(Average(_e(c)))
+
+
+mean = avg
+
+
+def min(c) -> Column:  # noqa: A001
+    return Column(Min(_e(c)))
+
+
+def max(c) -> Column:  # noqa: A001
+    return Column(Max(_e(c)))
+
+
+def first(c, ignorenulls: bool = False) -> Column:
+    return Column(First(_e(c), ignorenulls))
+
+
+def last(c, ignorenulls: bool = False) -> Column:
+    return Column(Last(_e(c), ignorenulls))
+
+
+def when(condition: Column, value) -> "WhenBuilder":
+    return WhenBuilder([(condition.expr, _e(value))])
+
+
+class WhenBuilder(Column):
+    def __init__(self, branches):
+        self.branches = branches
+        from .types import NULL
+
+        super().__init__(CaseWhen(tuple(branches), Literal(None, NULL)))
+
+    def when(self, condition: Column, value) -> "WhenBuilder":
+        return WhenBuilder(self.branches + [(condition.expr, _e(value))])
+
+    def otherwise(self, value) -> Column:
+        return Column(CaseWhen(tuple(self.branches), _e(value)))
+
+
+def coalesce(*cols) -> Column:
+    return Column(Coalesce(tuple(_e(c) for c in cols)))
+
+
+def isnan(c) -> Column:
+    return Column(IsNaN(_e(c)))
+
+
+def abs(c) -> Column:  # noqa: A001
+    return Column(Abs(_e(c)))

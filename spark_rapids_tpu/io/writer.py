@@ -1,0 +1,63 @@
+"""File writers — reference: GpuParquetFileFormat.scala, GpuOrcFileFormat
+.scala, GpuFileFormatWriter.scala (single-directory writer; dynamic-partition
+writing follows with the writer rework)."""
+from __future__ import annotations
+
+import os
+import uuid
+
+import pyarrow as pa
+import pyarrow.csv as pacsv
+import pyarrow.orc as paorc
+import pyarrow.parquet as papq
+
+
+class DataFrameWriter:
+    def __init__(self, df):
+        self._df = df
+        self._mode = "error"
+        self._options: dict = {}
+
+    def mode(self, m: str) -> "DataFrameWriter":
+        self._mode = m
+        return self
+
+    def option(self, k, v) -> "DataFrameWriter":
+        self._options[k] = v
+        return self
+
+    def _prep(self, path: str):
+        if os.path.exists(path):
+            if self._mode in ("error", "errorifexists"):
+                raise FileExistsError(path)
+            if self._mode == "overwrite":
+                import shutil
+
+                shutil.rmtree(path)
+            elif self._mode == "ignore":
+                return None
+        os.makedirs(path, exist_ok=True)
+        return os.path.join(path, f"part-00000-{uuid.uuid4().hex}")
+
+    def parquet(self, path: str):
+        f = self._prep(path)
+        if f is None:
+            return
+        papq.write_table(self._df.to_arrow(), f + ".parquet")
+
+    def orc(self, path: str):
+        f = self._prep(path)
+        if f is None:
+            return
+        paorc.write_table(self._df.to_arrow(), f + ".orc")
+
+    def csv(self, path: str):
+        f = self._prep(path)
+        if f is None:
+            return
+        include_header = str(self._options.get("header", "false")).lower() in ("true", "1")
+        pacsv.write_csv(
+            self._df.to_arrow(),
+            f + ".csv",
+            pacsv.WriteOptions(include_header=include_header),
+        )

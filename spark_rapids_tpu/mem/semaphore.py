@@ -1,0 +1,42 @@
+"""Device admission control — the GpuSemaphore analogue.
+
+Reference: GpuSemaphore.scala (:58-154): N concurrent tasks may hold the
+device at once (``spark.rapids.sql.concurrentGpuTasks``), acquired before any
+operator touches HBM and released when the task finishes. This bounds the
+device-memory working set across concurrent tasks — the same role here, where
+"task" is a partition computation on the executor thread pool.
+"""
+from __future__ import annotations
+
+import threading
+
+
+class DeviceSemaphore:
+    def __init__(self, permits: int):
+        self._sem = threading.BoundedSemaphore(max(1, permits))
+        self._held = threading.local()
+
+    def acquire_if_necessary(self):
+        """Idempotent per-thread acquire (GpuSemaphore.acquireIfNecessary)."""
+        if getattr(self._held, "count", 0) == 0:
+            self._sem.acquire()
+            self._held.count = 1
+
+    def release_if_necessary(self):
+        if getattr(self._held, "count", 0) > 0:
+            self._held.count = 0
+            self._sem.release()
+
+    class _Scope:
+        def __init__(self, sem):
+            self.sem = sem
+
+        def __enter__(self):
+            self.sem.acquire_if_necessary()
+            return self
+
+        def __exit__(self, *a):
+            self.sem.release_if_necessary()
+
+    def held(self) -> "_Scope":
+        return DeviceSemaphore._Scope(self)

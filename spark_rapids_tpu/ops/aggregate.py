@@ -1,0 +1,170 @@
+"""Sort-based group-by aggregation kernel — the device engine under
+TpuHashAggregateExec.
+
+Reference: aggregate.scala's ``Table.groupBy(...).aggregate`` hot loop
+(:345-520). cudf hash-aggregates; the TPU-first equivalent is ONE fused XLA
+program per (schema, capacity): radix-encode keys → variadic ``lax.sort`` →
+segment-ids by adjacent-difference → scatter/segment reductions. Everything is
+static-shape (output capacity == input capacity; live groups prefix-compacted
+with a device-resident count), so the whole update/merge pipeline stays on
+device with no host syncs.
+
+Spark semantics: NULL keys form a group; float keys are normalized
+(-0.0 → 0.0, canonical NaN) as Spark's NormalizeFloatingNumbers does; sums
+wrap for longs; min/max/first/last are NULL on all-null groups.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..columnar.device import DeviceBatch, DeviceColumn
+from ..types import DoubleType, FloatType, StringType
+from .gather import gather_column
+from .sortkeys import batch_radix_words, segment_starts, sort_permutation
+
+_BIG = jnp.int32(2**31 - 1)
+
+
+def _normalize_float(col: DeviceColumn) -> DeviceColumn:
+    if isinstance(col.dtype, (FloatType, DoubleType)):
+        x = col.data
+        x = jnp.where(x == 0, jnp.zeros_like(x), x)
+        x = jnp.where(jnp.isnan(x), jnp.full_like(x, jnp.nan), x)
+        return DeviceColumn(col.dtype, x, col.validity, col.lengths)
+    return col
+
+
+def _segment_reduce(op: str, data, valid, seg_ids, idx, cap, is_string: bool):
+    """One reduction over sorted rows.
+
+    Returns ``(data[cap], valid[cap], pick)`` where ``pick`` is the per-group
+    source-row index for index-pick ops (first/last) and None otherwise —
+    callers gather auxiliary buffers (string lengths) by it."""
+    live_valid = valid  # caller already masked by row liveness
+    any_valid = jax.ops.segment_max(
+        live_valid.astype(jnp.int32), seg_ids, num_segments=cap
+    ).astype(bool)
+    if op == "sum":
+        out = jax.ops.segment_sum(
+            jnp.where(live_valid, data, jnp.zeros_like(data)), seg_ids, num_segments=cap
+        )
+        return out, any_valid, None
+    if op == "count":
+        out = jax.ops.segment_sum(
+            live_valid.astype(jnp.int64), seg_ids, num_segments=cap
+        )
+        return out, jnp.ones(cap, dtype=bool), None
+    if op in ("min", "max"):
+        assert not is_string, "string min/max handled by re-sort strategy"
+        if jnp.issubdtype(data.dtype, jnp.floating):
+            fill = jnp.array(jnp.inf if op == "min" else -jnp.inf, dtype=data.dtype)
+        else:
+            info = jnp.iinfo(data.dtype)
+            fill = jnp.array(info.max if op == "min" else info.min, dtype=data.dtype)
+        masked = jnp.where(live_valid, data, fill)
+        # Spark NaN ordering: NaN is the greatest value. Use a +inf sentinel so
+        # min never picks NaN and max treats NaN as greatest, then restore NaN.
+        if jnp.issubdtype(data.dtype, jnp.floating):
+            masked = jnp.where(jnp.isnan(masked), jnp.inf, masked)
+        fn = jax.ops.segment_min if op == "min" else jax.ops.segment_max
+        out = fn(masked, seg_ids, num_segments=cap)
+        if jnp.issubdtype(data.dtype, jnp.floating):
+            had_nan = jax.ops.segment_max(
+                (live_valid & jnp.isnan(data)).astype(jnp.int32),
+                seg_ids,
+                num_segments=cap,
+            ).astype(bool)
+            if op == "max":
+                out = jnp.where(had_nan, jnp.nan, out)
+            else:
+                # all-NaN group: min is NaN (every value is NaN)
+                all_nan = had_nan & (out == jnp.inf)
+                out = jnp.where(all_nan, jnp.nan, out)
+        return out, any_valid, None
+    # first/last family: pick a row index per segment, then gather
+    if op == "first":
+        pick = jax.ops.segment_min(idx, seg_ids, num_segments=cap)
+    elif op == "last":
+        pick = jax.ops.segment_max(idx, seg_ids, num_segments=cap)
+    elif op == "first_ignore_nulls":
+        pick = jax.ops.segment_min(
+            jnp.where(live_valid, idx, _BIG), seg_ids, num_segments=cap
+        )
+    elif op == "last_ignore_nulls":
+        pick = jax.ops.segment_max(
+            jnp.where(live_valid, idx, jnp.int32(-1)), seg_ids, num_segments=cap
+        )
+    else:  # pragma: no cover
+        raise ValueError(f"unknown reduce op {op}")
+    ok = (pick != _BIG) & (pick >= 0)
+    safe = jnp.clip(pick, 0, data.shape[0] - 1)
+    out = data[safe]
+    out_valid = valid[safe] & ok
+    return out, out_valid, safe
+
+
+def group_aggregate(
+    batch: DeviceBatch,
+    key_ordinals: list[int],
+    agg_columns: list[DeviceColumn],
+    ops: list[str],
+    min_groups: int = 0,
+) -> tuple[list[DeviceColumn], list[DeviceColumn], jax.Array]:
+    """Group ``batch`` rows by key columns; reduce ``agg_columns[i]`` with
+    ``ops[i]``. Returns (key cols, agg cols, num_groups) — all [capacity]
+    with live groups in the prefix. ``min_groups=1`` gives ungrouped
+    reductions their one output row even on empty input (Spark: global
+    count() over nothing is 0, not no-rows)."""
+    cap = batch.capacity
+    if not batch.columns and agg_columns:
+        cap = agg_columns[0].capacity  # ungrouped: key-less work batch
+    keys = [_normalize_float(batch.columns[i]) for i in key_ordinals]
+    words = batch_radix_words(keys)
+    row_mask = batch.row_mask()
+    live = jnp.arange(cap, dtype=jnp.int32) < batch.num_rows  # live rows sort first
+    if not keys:
+        # ungrouped reduction: no sort, all live rows form one segment
+        perm = jnp.arange(cap, dtype=jnp.int32)
+        starts = (jnp.arange(cap, dtype=jnp.int32) == 0) & (batch.num_rows > 0)
+    else:
+        perm = sort_permutation(words, row_mask)
+        s_words = [w[perm] for w in words]
+        starts = segment_starts(s_words, live)
+    seg_ids = jnp.cumsum(starts.astype(jnp.int32)) - 1
+    seg_ids = jnp.clip(seg_ids, 0, cap - 1)
+    num_groups = jnp.maximum(starts.sum().astype(jnp.int32), min_groups)
+
+    # representative keys: scatter the first row of each segment
+    out_keys: list[DeviceColumn] = []
+    for k in keys:
+        sk = gather_column(k, perm)
+        tgt = jnp.where(starts, seg_ids, cap - 1)  # dead rows collide harmlessly
+        kdata = jnp.zeros_like(sk.data)
+        if sk.data.ndim == 2:
+            kdata = kdata.at[tgt].set(jnp.where(starts[:, None], sk.data, 0), mode="drop")
+        else:
+            kdata = kdata.at[tgt].set(jnp.where(starts, sk.data, jnp.zeros_like(sk.data)), mode="drop")
+        kvalid = jnp.zeros_like(sk.validity).at[tgt].set(starts & sk.validity, mode="drop")
+        klen = None
+        if sk.lengths is not None:
+            klen = jnp.zeros_like(sk.lengths).at[tgt].set(
+                jnp.where(starts, sk.lengths, 0), mode="drop"
+            )
+        group_live = jnp.arange(cap, dtype=jnp.int32) < num_groups
+        out_keys.append(DeviceColumn(k.dtype, kdata, kvalid & group_live, klen))
+
+    idx = jnp.arange(cap, dtype=jnp.int32)
+    group_live = jnp.arange(cap, dtype=jnp.int32) < num_groups
+    out_aggs: list[DeviceColumn] = []
+    for col, op in zip(agg_columns, ops):
+        sc = gather_column(col, perm)
+        v = sc.validity & live
+        is_str = isinstance(col.dtype, StringType)
+        data, valid, pick = _segment_reduce(op, sc.data, v, seg_ids, idx, cap, is_str)
+        lengths = None
+        if is_str:
+            assert pick is not None, f"string op {op} requires an index-pick"
+            lengths = sc.lengths[pick]
+        out_aggs.append(DeviceColumn(col.dtype, data, valid & group_live, lengths))
+    return out_keys, out_aggs, num_groups

@@ -1,0 +1,88 @@
+"""Device batch concatenation — the Table.concatenate analogue used by the
+aggregate merge loop, sort, and shuffle coalesce (reference:
+GpuCoalesceBatches.scala:133-455, aggregate.scala:451).
+
+Static shapes: the output capacity is the bucketed sum of input capacities
+(a trace-time constant); live rows from each input are packed at offsets
+carried as device scalars via ``lax.dynamic_update_slice`` — no host syncs.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..columnar.device import DeviceBatch, DeviceColumn, bucket_capacity
+from ..types import StringType
+
+
+def _pad_width(data: jax.Array, w: int) -> jax.Array:
+    if data.shape[1] < w:
+        return jnp.pad(data, ((0, 0), (0, w - data.shape[1])))
+    return data
+
+
+def concat_device(batches: list[DeviceBatch], capacity: int | None = None) -> DeviceBatch:
+    """Concatenate device batches (same schema) into one batch."""
+    assert batches, "concat of zero batches"
+    if len(batches) == 1 and (capacity is None or batches[0].capacity == capacity):
+        return batches[0]
+    schema = batches[0].schema
+    cap = capacity or bucket_capacity(sum(b.capacity for b in batches))
+    ncols = len(schema)
+    widths = []
+    for i, f in enumerate(schema):
+        if isinstance(f.data_type, StringType):
+            widths.append(max(b.columns[i].data.shape[1] for b in batches))
+        else:
+            widths.append(None)
+    out_cols = []
+    for i, f in enumerate(schema):
+        w = widths[i]
+        if w is not None:
+            data = jnp.zeros((cap, w), dtype=jnp.uint8)
+            lengths = jnp.zeros(cap, dtype=jnp.int32)
+        else:
+            data = jnp.zeros(cap, dtype=f.data_type.np_dtype)
+            lengths = None
+        validity = jnp.zeros(cap, dtype=bool)
+        offset = jnp.asarray(0, dtype=jnp.int32)
+        for b in batches:
+            c = b.columns[i]
+            src = _pad_width(c.data, w) if w is not None else c.data
+            # live-prefix invariant: rows >= b.num_rows are inert (validity
+            # False, zeroed); writing them past the offset is harmless as the
+            # final live count masks them out — but they'd collide with the
+            # next batch's slot, so mask the tail to zero before placing.
+            live = (jnp.arange(b.capacity, dtype=jnp.int32) < b.num_rows)
+            if w is not None:
+                src = jnp.where(live[:, None], src, 0)
+            else:
+                src = jnp.where(live, src, jnp.zeros_like(src))
+            v = c.validity & live
+            if w is not None:
+                data = _dus_rows(data, src, offset)
+                lengths = _dus_rows(lengths, jnp.where(live, c.lengths, 0), offset)
+            else:
+                data = _dus_rows(data, src, offset)
+            validity = _dus_or(validity, v, offset)
+            offset = offset + b.num_rows
+        out_cols.append(DeviceColumn(f.data_type, data, validity, lengths))
+    total = jnp.asarray(0, jnp.int32)
+    for b in batches:
+        total = total + b.num_rows
+    return DeviceBatch(schema, out_cols, total)
+
+
+def _dus_rows(dst: jax.Array, src: jax.Array, offset) -> jax.Array:
+    """Scatter src rows into dst starting at (traced) offset.
+
+    dynamic_update_slice would clamp at the end; capacities are bucketed so
+    offset + src rows can exceed dst — use an explicit scatter instead.
+    """
+    idx = jnp.arange(src.shape[0], dtype=jnp.int32) + offset
+    return dst.at[idx].set(src, mode="drop")
+
+
+def _dus_or(dst: jax.Array, src: jax.Array, offset) -> jax.Array:
+    idx = jnp.arange(src.shape[0], dtype=jnp.int32) + offset
+    return dst.at[idx].set(src, mode="drop")

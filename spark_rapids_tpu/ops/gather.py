@@ -1,0 +1,43 @@
+"""Row gather/compaction primitives over DeviceBatch — the analogues of
+cudf's gather / Table.filter (reference: basicPhysicalOperators.scala
+GpuFilterExec; Table.filter applies a boolean-mask gather).
+
+All static shapes: compaction permutes kept rows to the front of the same
+capacity and updates the device-resident ``num_rows``; downstream kernels
+mask by ``row_mask()``.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..columnar.device import DeviceBatch, DeviceColumn
+
+
+def gather_column(col: DeviceColumn, idx: jax.Array, idx_valid=None) -> DeviceColumn:
+    data = col.data[idx]
+    validity = col.validity[idx]
+    if idx_valid is not None:
+        validity = validity & idx_valid
+    lengths = col.lengths[idx] if col.lengths is not None else None
+    return DeviceColumn(col.dtype, data, validity, lengths)
+
+
+def gather_batch(batch: DeviceBatch, idx: jax.Array, new_num_rows) -> DeviceBatch:
+    cols = [gather_column(c, idx) for c in batch.columns]
+    return DeviceBatch(batch.schema, cols, jnp.asarray(new_num_rows, jnp.int32))
+
+
+def compact(batch: DeviceBatch, keep: jax.Array) -> DeviceBatch:
+    """Stable-compact rows where ``keep`` (bool[cap]) into the prefix."""
+    keep = keep & batch.row_mask()
+    perm = jnp.argsort(~keep, stable=True)
+    n = keep.sum().astype(jnp.int32)
+    out = gather_batch(batch, perm, n)
+    # zero validity in the tail so padding rows are inert and deterministic
+    live = jnp.arange(batch.capacity, dtype=jnp.int32) < n
+    cols = [
+        DeviceColumn(c.dtype, c.data, c.validity & live, c.lengths)
+        for c in out.columns
+    ]
+    return DeviceBatch(out.schema, cols, n)

@@ -1,0 +1,269 @@
+"""Logical plans — the slice of Catalyst the framework provides itself.
+
+The reference plugs into Spark and receives resolved physical plans; running
+standalone, this module supplies the minimal logical algebra (resolution +
+schema propagation) that feeds the physical planner. Node vocabulary mirrors
+Spark's: Project, Filter, Aggregate, Join, Sort, Limit, Union, Expand, etc.
+"""
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass, field
+from typing import Optional, Sequence, Tuple
+
+from ..expr import (
+    Alias,
+    Expression,
+    UnresolvedAttribute,
+    bind,
+    output_name,
+)
+from ..expr.base import BoundReference
+from ..types import BOOLEAN, DataType, LONG, Schema, StructField
+
+
+class LogicalPlan:
+    def children(self) -> Sequence["LogicalPlan"]:
+        return []
+
+    @property
+    def schema(self) -> Schema:
+        raise NotImplementedError
+
+    def __str__(self):
+        return self._tree_string(0)
+
+    def _tree_string(self, indent: int) -> str:
+        line = " " * indent + self._node_string()
+        return "\n".join([line] + [c._tree_string(indent + 2) for c in self.children()])
+
+    def _node_string(self) -> str:
+        return type(self).__name__
+
+
+@dataclass
+class LocalRelation(LogicalPlan):
+    """In-memory arrow table source."""
+
+    table: object  # pa.Table
+    _schema: Schema
+    num_partitions: int = 1
+
+    @property
+    def schema(self) -> Schema:
+        return self._schema
+
+    def _node_string(self):
+        return f"LocalRelation{self._schema.names}"
+
+
+@dataclass
+class FileScan(LogicalPlan):
+    """File source (parquet/orc/csv)."""
+
+    paths: list[str]
+    file_format: str
+    _schema: Schema
+    options: dict = field(default_factory=dict)
+
+    @property
+    def schema(self) -> Schema:
+        return self._schema
+
+    def _node_string(self):
+        return f"FileScan {self.file_format} {self.paths[:1]}..."
+
+
+@dataclass
+class Project(LogicalPlan):
+    exprs: list[Expression]  # resolved on construction via resolve()
+    child: LogicalPlan
+
+    def children(self):
+        return [self.child]
+
+    @property
+    def schema(self) -> Schema:
+        return Schema(
+            [
+                StructField(output_name(e), _bound(e, self.child.schema).data_type,
+                            _bound(e, self.child.schema).nullable)
+                for e in self.exprs
+            ]
+        )
+
+    def _node_string(self):
+        return f"Project [{', '.join(map(str, self.exprs))}]"
+
+
+@dataclass
+class Filter(LogicalPlan):
+    condition: Expression
+    child: LogicalPlan
+
+    def children(self):
+        return [self.child]
+
+    @property
+    def schema(self) -> Schema:
+        return self.child.schema
+
+    def _node_string(self):
+        return f"Filter {self.condition}"
+
+
+@dataclass
+class Aggregate(LogicalPlan):
+    grouping: list[Expression]
+    aggregates: list[Expression]  # mix of grouping refs and AggregateExpression trees
+    child: LogicalPlan
+
+    def children(self):
+        return [self.child]
+
+    @property
+    def schema(self) -> Schema:
+        fields = []
+        for e in self.aggregates:
+            b = _bound(e, self.child.schema)
+            fields.append(StructField(output_name(e), b.data_type, b.nullable))
+        return Schema(fields)
+
+    def _node_string(self):
+        return f"Aggregate [{', '.join(map(str, self.grouping))}] [{', '.join(map(str, self.aggregates))}]"
+
+
+@dataclass
+class SortOrder:
+    child: Expression
+    ascending: bool = True
+    nulls_first: Optional[bool] = None  # Spark default: asc→nulls first, desc→nulls last
+
+    def resolved_nulls_first(self) -> bool:
+        if self.nulls_first is None:
+            return self.ascending
+        return self.nulls_first
+
+    def __str__(self):
+        d = "ASC" if self.ascending else "DESC"
+        nf = "NULLS FIRST" if self.resolved_nulls_first() else "NULLS LAST"
+        return f"{self.child} {d} {nf}"
+
+
+@dataclass
+class Sort(LogicalPlan):
+    order: list[SortOrder]
+    is_global: bool
+    child: LogicalPlan
+
+    def children(self):
+        return [self.child]
+
+    @property
+    def schema(self) -> Schema:
+        return self.child.schema
+
+    def _node_string(self):
+        return f"Sort [{', '.join(map(str, self.order))}] global={self.is_global}"
+
+
+@dataclass
+class Limit(LogicalPlan):
+    n: int
+    child: LogicalPlan
+
+    def children(self):
+        return [self.child]
+
+    @property
+    def schema(self) -> Schema:
+        return self.child.schema
+
+    def _node_string(self):
+        return f"Limit {self.n}"
+
+
+@dataclass
+class Join(LogicalPlan):
+    left: LogicalPlan
+    right: LogicalPlan
+    join_type: str  # inner, left, right, full, left_semi, left_anti, cross
+    left_keys: list  # exprs over left (empty → cross/conditional join)
+    right_keys: list  # exprs over right, same length
+    residual: Optional[Expression] = None  # evaluated over joined rows
+    using: bool = False  # USING join: right key columns dropped from output
+
+    def children(self):
+        return [self.left, self.right]
+
+    @property
+    def schema(self) -> Schema:
+        lt = list(self.left.schema.fields)
+        rt = list(self.right.schema.fields)
+        if self.using:
+            drop = {output_name(k) for k in self.right_keys}
+            rt = [f for f in rt if f.name not in drop]
+        if self.join_type in ("left_semi", "left_anti"):
+            return Schema(lt)
+        if self.join_type in ("left", "full"):
+            rt = [dataclasses.replace(f, nullable=True) for f in rt]
+        if self.join_type in ("right", "full"):
+            lt = [dataclasses.replace(f, nullable=True) for f in lt]
+        return Schema(lt + rt)
+
+    def _node_string(self):
+        keys = ", ".join(
+            f"{l}={r}" for l, r in zip(self.left_keys, self.right_keys)
+        )
+        return f"Join {self.join_type} [{keys}] {self.residual or ''}"
+
+
+@dataclass
+class Union(LogicalPlan):
+    plans: list[LogicalPlan]
+
+    def children(self):
+        return self.plans
+
+    @property
+    def schema(self) -> Schema:
+        return self.plans[0].schema
+
+    def _node_string(self):
+        return "Union"
+
+
+@dataclass
+class Repartition(LogicalPlan):
+    num_partitions: int
+    exprs: Optional[list[Expression]]  # None → round robin
+    child: LogicalPlan
+
+    def children(self):
+        return [self.child]
+
+    @property
+    def schema(self) -> Schema:
+        return self.child.schema
+
+
+@dataclass
+class Range(LogicalPlan):
+    """spark.range() — reference analogue GpuRangeExec."""
+
+    start: int
+    end: int
+    step: int
+    num_partitions: int
+
+    @property
+    def schema(self) -> Schema:
+        return Schema([StructField("id", LONG, False)])
+
+    def _node_string(self):
+        return f"Range({self.start}, {self.end}, {self.step})"
+
+
+def _bound(e: Expression, schema: Schema) -> Expression:
+    """Resolve an expression against a child schema (idempotent)."""
+    return bind(e, schema)

@@ -1,0 +1,135 @@
+"""Physical plan base — the ``SparkPlan``/``GpuExec`` seam.
+
+Reference: GpuExec.scala (the GpuExec trait: supportsColumnar, GpuMetric
+system, CoalesceGoal batching contracts :166-277). Here every node is an
+``Exec`` producing a ``PartitionSet`` — a list of lazily-computable partition
+iterators of batches. CPU execs stream ``pyarrow.RecordBatch``; TPU execs
+stream ``DeviceBatch``; explicit transition execs convert (the
+GpuRowToColumnarExec / GpuColumnarToRowExec / HostColumnarToGpu analogues are
+HostToDeviceExec / DeviceToHostExec — rows never exist as a format here, the
+engine is columnar end to end).
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Iterator, List, Optional, Sequence
+
+from ..config import TpuConf
+from ..types import Schema
+
+
+class Metric:
+    """One operator metric — the GpuMetric analogue (GpuExec.scala:40-157)."""
+
+    __slots__ = ("name", "value", "_lock")
+
+    def __init__(self, name: str):
+        self.name = name
+        self.value = 0
+        self._lock = threading.Lock()
+
+    def add(self, v: int):
+        with self._lock:
+            self.value += v
+
+    class _Timer:
+        __slots__ = ("m", "t0")
+
+        def __init__(self, m):
+            self.m = m
+
+        def __enter__(self):
+            self.t0 = time.perf_counter_ns()
+            return self
+
+        def __exit__(self, *a):
+            self.m.add(time.perf_counter_ns() - self.t0)
+
+    def timed(self) -> "_Timer":
+        return Metric._Timer(self)
+
+
+class ExecContext:
+    """Per-query execution context: conf, semaphore, memory, metrics."""
+
+    def __init__(self, conf: TpuConf, session=None):
+        self.conf = conf
+        self.session = session
+        from ..mem.semaphore import DeviceSemaphore
+        from .. import config as cfg
+
+        self.semaphore = DeviceSemaphore(cfg.CONCURRENT_TPU_TASKS.get(conf))
+
+
+class PartitionSet:
+    """Lazily computable partitions (the RDD[ColumnarBatch] analogue)."""
+
+    def __init__(self, parts: List[Callable[[], Iterator]]):
+        self.parts = parts
+
+    @property
+    def num_partitions(self) -> int:
+        return len(self.parts)
+
+    def map_partitions(self, fn) -> "PartitionSet":
+        def wrap(thunk):
+            return lambda: fn(thunk())
+
+        return PartitionSet([wrap(t) for t in self.parts])
+
+    def materialize(self) -> List[list]:
+        return [list(t()) for t in self.parts]
+
+
+class Exec:
+    """Physical operator base."""
+
+    def __init__(self, children: Sequence["Exec"]):
+        self._children = list(children)
+        self.metrics: dict[str, Metric] = {}
+
+    # ── tree ────────────────────────────────────────────────────────────
+    @property
+    def children(self) -> List["Exec"]:
+        return self._children
+
+    def with_new_children(self, children: List["Exec"]) -> "Exec":
+        import copy
+
+        new = copy.copy(self)
+        new._children = list(children)
+        new.metrics = {}
+        return new
+
+    # ── contract ────────────────────────────────────────────────────────
+    @property
+    def output(self) -> Schema:
+        raise NotImplementedError
+
+    @property
+    def is_device(self) -> bool:
+        """True if this exec produces DeviceBatch (the supportsColumnar bit)."""
+        return False
+
+    def execute(self, ctx: ExecContext) -> PartitionSet:
+        raise NotImplementedError
+
+    # ── metrics ─────────────────────────────────────────────────────────
+    def metric(self, name: str) -> Metric:
+        if name not in self.metrics:
+            self.metrics[name] = Metric(name)
+        return self.metrics[name]
+
+    # ── pretty print ────────────────────────────────────────────────────
+    def node_string(self) -> str:
+        return type(self).__name__
+
+    def tree_string(self, indent: int = 0) -> str:
+        lines = [" " * indent + ("* " if self.is_device else "  ") + self.node_string()]
+        for c in self.children:
+            lines.append(c.tree_string(indent + 2))
+        return "\n".join(lines)
+
+    def __str__(self):
+        return self.tree_string()

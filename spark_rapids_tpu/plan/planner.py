@@ -1,0 +1,187 @@
+"""Logical → CPU physical planning.
+
+Produces the "Spark plan" that the override pass (overrides.py) then rewrites
+onto the device — mirroring how the reference receives Catalyst physical
+plans. Aggregations are split into partial → hash exchange → final exactly
+like Spark's physical aggregation strategy (which the reference inherits);
+global sorts currently plan as coalesce-to-one + local sort (range
+partitioning lands with the exchange work).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Tuple
+
+from .. import config as cfg
+from ..config import TpuConf
+from ..expr import Alias, Expression, UnresolvedAttribute, bind, output_name
+from ..expr.aggregates import AggregateFunction, is_aggregate
+from ..expr.base import BoundReference
+from ..exec.cpu import (
+    CpuCoalescePartitionsExec,
+    CpuFilterExec,
+    CpuHashAggregateExec,
+    CpuLimitExec,
+    CpuProjectExec,
+    CpuScanExec,
+    CpuShuffleExchangeExec,
+    CpuSortExec,
+    CpuUnionExec,
+)
+from ..plan import logical as L
+from ..plan.physical import Exec
+from ..types import Schema
+
+
+def plan_physical(lp: L.LogicalPlan, conf: TpuConf) -> Exec:
+    if isinstance(lp, L.LocalRelation):
+        return CpuScanExec(lp.table, lp.schema, lp.num_partitions)
+    if isinstance(lp, L.FileScan):
+        from ..io.files import CpuFileScanExec
+
+        return CpuFileScanExec(lp.paths, lp.file_format, lp.schema, lp.options, conf)
+    if isinstance(lp, L.Project):
+        return CpuProjectExec(lp.exprs, plan_physical(lp.child, conf))
+    if isinstance(lp, L.Filter):
+        return CpuFilterExec(lp.condition, plan_physical(lp.child, conf))
+    if isinstance(lp, L.Aggregate):
+        return _plan_aggregate(lp, conf)
+    if isinstance(lp, L.Sort):
+        child = plan_physical(lp.child, conf)
+        if lp.is_global and _num_partitions_hint(child) != 1:
+            child = CpuCoalescePartitionsExec(child)
+        return CpuSortExec(lp.order, child)
+    if isinstance(lp, L.Limit):
+        return CpuLimitExec(lp.n, plan_physical(lp.child, conf))
+    if isinstance(lp, L.Union):
+        return CpuUnionExec([plan_physical(p, conf) for p in lp.plans])
+    if isinstance(lp, L.Repartition):
+        child = plan_physical(lp.child, conf)
+        keys = lp.exprs or []
+        return CpuShuffleExchangeExec(keys, lp.num_partitions, child)
+    if isinstance(lp, L.Join):
+        return _plan_join(lp, conf)
+    raise NotImplementedError(f"no physical plan for {type(lp).__name__}")
+
+
+def _num_partitions_hint(e: Exec) -> int:
+    if isinstance(e, CpuScanExec):
+        return e.num_partitions
+    if isinstance(e, CpuShuffleExchangeExec):
+        return e.num_partitions
+    if isinstance(e, (CpuCoalescePartitionsExec, CpuLimitExec)):
+        return 1
+    if e.children:
+        return _num_partitions_hint(e.children[0])
+    return 1
+
+
+def _extract_aggs(
+    e: Expression, agg_fns: List[AggregateFunction]
+) -> Expression:
+    """Replace AggregateFunction nodes with placeholders indexing agg_fns."""
+    if isinstance(e, AggregateFunction):
+        try:
+            i = agg_fns.index(e)
+        except ValueError:
+            i = len(agg_fns)
+            agg_fns.append(e)
+        return _AggResultRef(i, e)
+    if not e.children():
+        return e
+    from ..expr.base import map_child_exprs
+
+    return map_child_exprs(e, lambda c: _extract_aggs(c, agg_fns))
+
+
+@dataclasses.dataclass(frozen=True)
+class _AggResultRef(Expression):
+    """Placeholder resolved to a BoundReference over [keys ++ agg results]."""
+
+    index: int
+    fn: AggregateFunction
+
+    @property
+    def data_type(self):
+        return self.fn.data_type
+
+    @property
+    def nullable(self):
+        return self.fn.nullable
+
+
+def _finalize_result_expr(e: Expression, num_keys: int, key_exprs) -> Expression:
+    """Rewrite grouping-expr occurrences and agg placeholders to bound refs
+    over the virtual post-aggregation schema [key0..k, agg0..m]."""
+    if isinstance(e, _AggResultRef):
+        return BoundReference(num_keys + e.index, e.fn.data_type, e.fn.nullable)
+    for i, k in enumerate(key_exprs):
+        if e == k:
+            return BoundReference(i, k.data_type, k.nullable)
+    if not e.children():
+        return e
+    from ..expr.base import map_child_exprs
+
+    return map_child_exprs(e, lambda c: _finalize_result_expr(c, num_keys, key_exprs))
+
+
+def _plan_aggregate(lp: L.Aggregate, conf: TpuConf) -> Exec:
+    child = plan_physical(lp.child, conf)
+    child_schema = child.output
+    bound_grouping = [bind(g, child_schema) for g in lp.grouping]
+    # resolve aggregate list, splitting agg fns from result expressions
+    agg_fns: List[AggregateFunction] = []
+    result_exprs: List[Expression] = []
+    result_names: List[str] = []
+    for e in lp.aggregates:
+        name = output_name(e)
+        inner = e.child if isinstance(e, Alias) else e
+        bound = bind(inner, child_schema)
+        rewritten = _extract_aggs(bound, agg_fns)
+        result_exprs.append(
+            _finalize_result_expr(rewritten, len(bound_grouping), bound_grouping)
+        )
+        result_names.append(name)
+    partial_grouping = [
+        Alias(g, f"key{i}") for i, g in enumerate(bound_grouping)
+    ]
+    partial = CpuHashAggregateExec(
+        "partial", partial_grouping, agg_fns, None, None, child
+    )
+    nparts = cfg.SHUFFLE_PARTITIONS.get(conf)
+    if bound_grouping:
+        exchange = CpuShuffleExchangeExec(
+            [UnresolvedAttribute(f"key{i}") for i in range(len(bound_grouping))],
+            nparts,
+            partial,
+        )
+    else:
+        exchange = CpuCoalescePartitionsExec(partial)
+    final_grouping = [
+        Alias(UnresolvedAttribute(f"key{i}"), f"key{i}")
+        for i in range(len(bound_grouping))
+    ]
+    return CpuHashAggregateExec(
+        "final", final_grouping, agg_fns, result_exprs, result_names, exchange
+    )
+
+
+def _plan_join(lp: L.Join, conf: TpuConf) -> Exec:
+    from ..exec.cpu_join import CpuNestedLoopJoinExec, CpuShuffledHashJoinExec
+
+    left = plan_physical(lp.left, conf)
+    right = plan_physical(lp.right, conf)
+    nparts = cfg.SHUFFLE_PARTITIONS.get(conf)
+    if lp.left_keys:
+        lex = CpuShuffleExchangeExec(lp.left_keys, nparts, left)
+        rex = CpuShuffleExchangeExec(lp.right_keys, nparts, right)
+        drop = [output_name(k) for k in lp.right_keys] if lp.using else None
+        return CpuShuffledHashJoinExec(
+            lp.join_type, lp.left_keys, lp.right_keys, lp.residual, lex, rex, drop
+        )
+    return CpuNestedLoopJoinExec(
+        lp.join_type,
+        lp.residual,
+        CpuCoalescePartitionsExec(left),
+        CpuCoalescePartitionsExec(right),
+    )

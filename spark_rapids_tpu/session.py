@@ -1,0 +1,359 @@
+"""TpuSession + DataFrame — the SparkSession-shaped entry point.
+
+The reference is a plugin into a running SparkSession (Plugin.scala injects
+ColumnarOverrideRules); standalone, this module owns the whole query
+lifecycle: DataFrame → logical plan → CPU physical plan → TpuOverrides
+rewrite → execution. ``conf["spark.rapids.sql.enabled"]=False`` gives the
+pure-CPU run — which is exactly how the differential test harness produces
+its oracle (the reference's with_cpu_session/with_gpu_session idiom,
+integration_tests asserts.py:313-377).
+"""
+from __future__ import annotations
+
+from typing import Any, Iterable, List, Optional, Sequence, Union
+
+import pyarrow as pa
+
+from . import config as cfg
+from .config import TpuConf
+from .expr import Alias, Expression, UnresolvedAttribute, output_name
+from .functions import Column, _e, col
+from .plan import logical as L
+from .plan.overrides import TpuOverrides
+from .plan.physical import Exec, ExecContext
+from .plan.planner import plan_physical
+from .types import Schema
+from .columnar.host import concat_batches
+
+
+class TpuSession:
+    def __init__(self, conf: Optional[dict] = None):
+        self.conf = TpuConf(conf or {})
+        self.read = DataFrameReader(self)
+        self._last_plan: Optional[Exec] = None
+        self._last_overrides: Optional[TpuOverrides] = None
+
+    # ── builders ────────────────────────────────────────────────────────
+    def create_dataframe(
+        self,
+        data: Union[pa.Table, pa.RecordBatch, dict, list],
+        schema: Optional[Schema] = None,
+        num_partitions: int = 1,
+    ) -> "DataFrame":
+        if isinstance(data, pa.RecordBatch):
+            table = pa.Table.from_batches([data])
+        elif isinstance(data, pa.Table):
+            table = data
+        elif isinstance(data, dict):
+            table = pa.table(data)
+        else:
+            raise TypeError(f"cannot create dataframe from {type(data)}")
+        if schema is None:
+            schema = Schema.from_arrow(table.schema)
+        else:
+            table = table.cast(schema.to_arrow())
+        return DataFrame(self, L.LocalRelation(table, schema, num_partitions))
+
+    createDataFrame = create_dataframe
+
+    def range(self, start: int, end: Optional[int] = None, step: int = 1, num_partitions: int = 1):
+        if end is None:
+            start, end = 0, start
+        import numpy as np
+
+        ids = np.arange(start, end, step, dtype=np.int64)
+        return self.create_dataframe(pa.table({"id": ids}), num_partitions=num_partitions)
+
+    def set_conf(self, key: str, value: Any):
+        self.conf = self.conf.set(key, value)
+
+    # ── execution ───────────────────────────────────────────────────────
+    def _execute(self, lp: L.LogicalPlan) -> pa.Table:
+        cpu_plan = plan_physical(lp, self.conf)
+        overrides = TpuOverrides(self.conf)
+        final_plan = overrides.apply(cpu_plan)
+        self._last_plan = final_plan
+        self._last_overrides = overrides
+        self._assert_test_mode(overrides, final_plan)
+        ctx = ExecContext(self.conf, self)
+        parts = final_plan.execute(ctx)
+        batches: List[pa.RecordBatch] = []
+        for thunk in parts.parts:
+            for rb in thunk():
+                if rb.num_rows:
+                    batches.append(rb)
+        schema = final_plan.output
+        if not batches:
+            return pa.table(
+                {f.name: pa.array([], type=f.data_type.to_arrow()) for f in schema}
+            )
+        return pa.Table.from_batches(batches)
+
+    def _assert_test_mode(self, overrides: TpuOverrides, plan: Exec):
+        """TEST_CONF: fail when expected-on-device execs fell back
+        (reference: GpuTransitionOverrides validation under TEST_CONF)."""
+        if not cfg.TEST_CONF.get(self.conf):
+            return
+        allowed = (cfg.TEST_ALLOWED_NONTPU.get(self.conf) or "").split(",")
+        allowed = {a.strip() for a in allowed if a.strip()}
+        allowed |= {"CpuScan", "CpuFileScan", "DeviceToHost", "HostToDevice"}
+        bad = []
+        for e in overrides.explain:
+            if e.on_device:
+                continue
+            name = e.node.split(" ")[0].split("[")[0]
+            if not any(name.startswith(a) for a in allowed):
+                bad.append((e.node, e.reasons))
+        if bad:
+            msg = "; ".join(f"{n}: {r}" for n, r in bad)
+            raise AssertionError(f"execs unexpectedly not on device: {msg}")
+
+
+class DataFrameReader:
+    def __init__(self, session: TpuSession):
+        self._session = session
+        self._options: dict = {}
+
+    def option(self, k: str, v) -> "DataFrameReader":
+        self._options[k] = v
+        return self
+
+    def parquet(self, *paths: str) -> "DataFrame":
+        from .io.files import infer_schema, expand_paths
+
+        files = expand_paths(paths, "parquet")
+        schema = infer_schema(files, "parquet", self._options)
+        return DataFrame(
+            self._session,
+            L.FileScan(files, "parquet", schema, dict(self._options)),
+        )
+
+    def orc(self, *paths: str) -> "DataFrame":
+        from .io.files import infer_schema, expand_paths
+
+        files = expand_paths(paths, "orc")
+        schema = infer_schema(files, "orc", self._options)
+        return DataFrame(
+            self._session, L.FileScan(files, "orc", schema, dict(self._options))
+        )
+
+    def csv(self, *paths: str, **kwargs) -> "DataFrame":
+        from .io.files import infer_schema, expand_paths
+
+        opts = dict(self._options)
+        opts.update(kwargs)
+        files = expand_paths(paths, "csv")
+        schema = infer_schema(files, "csv", opts)
+        return DataFrame(self._session, L.FileScan(files, "csv", schema, opts))
+
+
+def _to_exprs(cols: Sequence[Union[str, Column, Expression]]) -> List[Expression]:
+    out = []
+    for c in cols:
+        if isinstance(c, str):
+            out.append(UnresolvedAttribute(c))
+        elif isinstance(c, Column):
+            out.append(c.expr)
+        else:
+            out.append(c)
+    return out
+
+
+class DataFrame:
+    def __init__(self, session: TpuSession, plan: L.LogicalPlan):
+        self._session = session
+        self._plan = plan
+
+    @property
+    def schema(self) -> Schema:
+        return self._plan.schema
+
+    @property
+    def columns(self) -> List[str]:
+        return self.schema.names
+
+    # ── transformations ─────────────────────────────────────────────────
+    def select(self, *cols) -> "DataFrame":
+        return DataFrame(self._session, L.Project(_to_exprs(cols), self._plan))
+
+    def with_column(self, name: str, c: Column) -> "DataFrame":
+        exprs: List[Expression] = []
+        replaced = False
+        for f in self.schema:
+            if f.name == name:
+                exprs.append(Alias(c.expr, name))
+                replaced = True
+            else:
+                exprs.append(UnresolvedAttribute(f.name))
+        if not replaced:
+            exprs.append(Alias(c.expr, name))
+        return DataFrame(self._session, L.Project(exprs, self._plan))
+
+    withColumn = with_column
+
+    def filter(self, condition: Union[Column, Expression]) -> "DataFrame":
+        e = condition.expr if isinstance(condition, Column) else condition
+        return DataFrame(self._session, L.Filter(e, self._plan))
+
+    where = filter
+
+    def group_by(self, *cols) -> "GroupedData":
+        return GroupedData(self, _to_exprs(cols))
+
+    groupBy = group_by
+
+    def agg(self, *aggs) -> "DataFrame":
+        return GroupedData(self, []).agg(*aggs)
+
+    def sort(self, *cols, ascending: Union[bool, List[bool]] = True) -> "DataFrame":
+        orders = self._sort_orders(cols, ascending)
+        return DataFrame(self._session, L.Sort(orders, True, self._plan))
+
+    orderBy = sort
+    order_by = sort
+
+    def sort_within_partitions(self, *cols, ascending=True) -> "DataFrame":
+        orders = self._sort_orders(cols, ascending)
+        return DataFrame(self._session, L.Sort(orders, False, self._plan))
+
+    def _sort_orders(self, cols, ascending) -> List[L.SortOrder]:
+        exprs = _to_exprs(cols)
+        if isinstance(ascending, bool):
+            ascending = [ascending] * len(exprs)
+        return [L.SortOrder(e, a) for e, a in zip(exprs, ascending)]
+
+    def limit(self, n: int) -> "DataFrame":
+        return DataFrame(self._session, L.Limit(n, self._plan))
+
+    def union(self, other: "DataFrame") -> "DataFrame":
+        return DataFrame(self._session, L.Union([self._plan, other._plan]))
+
+    unionAll = union
+
+    def repartition(self, n: int, *cols) -> "DataFrame":
+        exprs = _to_exprs(cols) if cols else None
+        return DataFrame(self._session, L.Repartition(n, exprs, self._plan))
+
+    def join(
+        self,
+        other: "DataFrame",
+        on: Union[str, List, None] = None,
+        how: str = "inner",
+    ) -> "DataFrame":
+        how = {
+            "inner": "inner",
+            "left": "left",
+            "left_outer": "left",
+            "leftouter": "left",
+            "right": "right",
+            "right_outer": "right",
+            "rightouter": "right",
+            "outer": "full",
+            "full": "full",
+            "full_outer": "full",
+            "cross": "cross",
+            "semi": "left_semi",
+            "left_semi": "left_semi",
+            "leftsemi": "left_semi",
+            "anti": "left_anti",
+            "left_anti": "left_anti",
+            "leftanti": "left_anti",
+        }[how]
+        lk: List[Expression] = []
+        rk: List[Expression] = []
+        using = False
+        residual = None
+        if on is None:
+            pass
+        elif isinstance(on, str):
+            lk, rk, using = [UnresolvedAttribute(on)], [UnresolvedAttribute(on)], True
+        elif isinstance(on, list) and on and isinstance(on[0], str):
+            lk = [UnresolvedAttribute(n) for n in on]
+            rk = [UnresolvedAttribute(n) for n in on]
+            using = True
+        elif isinstance(on, list) and on and isinstance(on[0], tuple):
+            lk = [UnresolvedAttribute(l) for l, _ in on]
+            rk = [UnresolvedAttribute(r) for _, r in on]
+        else:
+            raise TypeError("join on= must be a name, list of names, or list of (l, r) pairs")
+        return DataFrame(
+            self._session,
+            L.Join(self._plan, other._plan, how, lk, rk, residual, using),
+        )
+
+    # ── actions ─────────────────────────────────────────────────────────
+    def to_arrow(self) -> pa.Table:
+        return self._session._execute(self._plan)
+
+    def collect(self) -> List[tuple]:
+        t = self.to_arrow()
+        cols = [c.to_pylist() for c in t.columns]
+        return [tuple(c[i] for c in cols) for i in range(t.num_rows)]
+
+    def count(self) -> int:
+        from .functions import count as count_fn
+
+        t = self.agg(count_fn("*").alias("count")).to_arrow()
+        return t.column(0)[0].as_py()
+
+    def explain(self, mode: str = "plans") -> str:
+        cpu_plan = plan_physical(self._plan, self._session.conf)
+        overrides = TpuOverrides(self._session.conf)
+        final_plan = overrides.apply(cpu_plan)
+        s = final_plan.tree_string()
+        print(s)
+        return s
+
+    def to_pandas(self):
+        return self.to_arrow().to_pandas()
+
+    toPandas = to_pandas
+
+    @property
+    def write(self):
+        from .io.writer import DataFrameWriter
+
+        return DataFrameWriter(self)
+
+
+class GroupedData:
+    def __init__(self, df: DataFrame, grouping: List[Expression]):
+        self._df = df
+        self._grouping = grouping
+
+    def agg(self, *aggs) -> DataFrame:
+        agg_exprs = []
+        for a in aggs:
+            e = a.expr if isinstance(a, Column) else a
+            agg_exprs.append(e)
+        # Spark: group-by output = grouping columns ++ aggregates
+        all_out = list(self._grouping) + agg_exprs
+        return DataFrame(
+            self._df._session,
+            L.Aggregate(self._grouping, all_out, self._df._plan),
+        )
+
+    def count(self) -> DataFrame:
+        from .functions import count as count_fn
+
+        return self.agg(count_fn("*").alias("count"))
+
+    def sum(self, *names: str) -> DataFrame:
+        from .functions import sum as sum_fn
+
+        return self.agg(*[sum_fn(col(n)).alias(f"sum({n})") for n in names])
+
+    def avg(self, *names: str) -> DataFrame:
+        from .functions import avg as avg_fn
+
+        return self.agg(*[avg_fn(col(n)).alias(f"avg({n})") for n in names])
+
+    def min(self, *names: str) -> DataFrame:
+        from .functions import min as min_fn
+
+        return self.agg(*[min_fn(col(n)).alias(f"min({n})") for n in names])
+
+    def max(self, *names: str) -> DataFrame:
+        from .functions import max as max_fn
+
+        return self.agg(*[max_fn(col(n)).alias(f"max({n})") for n in names])

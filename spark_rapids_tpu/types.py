@@ -1,0 +1,307 @@
+"""Spark SQL type system for the TPU accelerator.
+
+Mirrors the subset of ``org.apache.spark.sql.types`` the reference supports on
+device (reference: sql-plugin TypeChecks.scala:129 ``TypeSig`` — BOOLEAN..DECIMAL_64).
+Each type knows its JAX storage dtype (Arrow-layout device buffers) and its
+Arrow logical type (host currency).
+
+Decimal follows the reference's DECIMAL64 restriction (precision <= 18,
+unscaled int64 storage — TypeChecks.scala "DECIMAL" gating).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import numpy as np
+import pyarrow as pa
+
+
+class DataType:
+    """Base of the SQL type lattice. Instances are value objects."""
+
+    #: numpy/jax storage dtype for the device data buffer.
+    np_dtype: np.dtype = None  # type: ignore
+
+    @property
+    def simple_string(self) -> str:
+        return type(self).__name__.replace("Type", "").lower()
+
+    def to_arrow(self) -> pa.DataType:
+        raise NotImplementedError
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        return self.simple_string
+
+    def __eq__(self, other: Any) -> bool:
+        return type(self) is type(other)
+
+    def __hash__(self) -> int:
+        return hash(type(self))
+
+
+class NumericType(DataType):
+    pass
+
+
+class IntegralType(NumericType):
+    pass
+
+
+class FractionalType(NumericType):
+    pass
+
+
+class BooleanType(DataType):
+    np_dtype = np.dtype(np.bool_)
+
+    def to_arrow(self) -> pa.DataType:
+        return pa.bool_()
+
+
+class ByteType(IntegralType):
+    np_dtype = np.dtype(np.int8)
+
+    def to_arrow(self) -> pa.DataType:
+        return pa.int8()
+
+
+class ShortType(IntegralType):
+    np_dtype = np.dtype(np.int16)
+
+    def to_arrow(self) -> pa.DataType:
+        return pa.int16()
+
+
+class IntegerType(IntegralType):
+    np_dtype = np.dtype(np.int32)
+
+    def to_arrow(self) -> pa.DataType:
+        return pa.int32()
+
+
+class LongType(IntegralType):
+    np_dtype = np.dtype(np.int64)
+
+    def to_arrow(self) -> pa.DataType:
+        return pa.int64()
+
+
+class FloatType(FractionalType):
+    np_dtype = np.dtype(np.float32)
+
+    def to_arrow(self) -> pa.DataType:
+        return pa.float32()
+
+
+class DoubleType(FractionalType):
+    np_dtype = np.dtype(np.float64)
+
+    def to_arrow(self) -> pa.DataType:
+        return pa.float64()
+
+
+class StringType(DataType):
+    # Device representation is (uint8[capacity, width], int32 lengths); host is
+    # Arrow string. np_dtype marks the per-byte storage.
+    np_dtype = np.dtype(np.uint8)
+
+    def to_arrow(self) -> pa.DataType:
+        return pa.string()
+
+
+class DateType(IntegralType):
+    """Days since epoch, int32 — Spark's internal representation."""
+
+    np_dtype = np.dtype(np.int32)
+
+    def to_arrow(self) -> pa.DataType:
+        return pa.date32()
+
+
+class TimestampType(IntegralType):
+    """Microseconds since epoch UTC, int64 — Spark's internal representation."""
+
+    np_dtype = np.dtype(np.int64)
+
+    def to_arrow(self) -> pa.DataType:
+        return pa.timestamp("us", tz="UTC")
+
+
+class NullType(DataType):
+    np_dtype = np.dtype(np.int8)
+
+    def to_arrow(self) -> pa.DataType:
+        return pa.null()
+
+
+@dataclasses.dataclass(frozen=True)
+class DecimalType(FractionalType):
+    """DECIMAL64 only, like the reference (unscaled int64 storage).
+
+    Reference: TypeChecks.scala DECIMAL_64 gating; DecimalUtil.scala.
+    """
+
+    precision: int = 10
+    scale: int = 0
+
+    MAX_PRECISION = 18  # decimal64
+
+    def __post_init__(self):
+        if self.precision > self.MAX_PRECISION:
+            raise ValueError(
+                f"decimal precision {self.precision} > {self.MAX_PRECISION} "
+                "(DECIMAL64 only, matching the reference's gating)"
+            )
+
+    @property
+    def np_dtype(self) -> np.dtype:  # type: ignore[override]
+        return np.dtype(np.int64)
+
+    @property
+    def simple_string(self) -> str:
+        return f"decimal({self.precision},{self.scale})"
+
+    def to_arrow(self) -> pa.DataType:
+        return pa.decimal128(self.precision, self.scale)
+
+    def __eq__(self, other: Any) -> bool:
+        return (
+            isinstance(other, DecimalType)
+            and other.precision == self.precision
+            and other.scale == self.scale
+        )
+
+    def __hash__(self) -> int:
+        return hash((DecimalType, self.precision, self.scale))
+
+
+# Singletons (Spark convention).
+BOOLEAN = BooleanType()
+BYTE = ByteType()
+SHORT = ShortType()
+INT = IntegerType()
+LONG = LongType()
+FLOAT = FloatType()
+DOUBLE = DoubleType()
+STRING = StringType()
+DATE = DateType()
+TIMESTAMP = TimestampType()
+NULL = NullType()
+
+_INTEGRAL_ORDER = [ByteType, ShortType, IntegerType, LongType]
+_NUMERIC_ORDER = _INTEGRAL_ORDER + [FloatType, DoubleType]
+
+
+def is_numeric(dt: DataType) -> bool:
+    return isinstance(dt, NumericType)
+
+
+def is_integral(dt: DataType) -> bool:
+    return isinstance(dt, IntegralType) and not isinstance(dt, (DateType, TimestampType))
+
+
+def numeric_promote(a: DataType, b: DataType) -> DataType:
+    """Spark's binary-arithmetic common type (tightest common numeric type).
+
+    Decimal promotion follows Spark's DecimalPrecision rules, applied by the
+    arithmetic expressions themselves; here decimals only unify with equal
+    decimals.
+    """
+    if a == b:
+        return a
+    if isinstance(a, DecimalType) or isinstance(b, DecimalType):
+        raise TypeError(f"no implicit promotion between {a} and {b}")
+    order = {t: i for i, t in enumerate(_NUMERIC_ORDER)}
+    ta, tb = type(a), type(b)
+    if ta in order and tb in order:
+        return (_NUMERIC_ORDER[max(order[ta], order[tb])])()
+    raise TypeError(f"no common type for {a} and {b}")
+
+
+def from_arrow(at: pa.DataType) -> DataType:
+    """Arrow → SQL type. Inverse of ``DataType.to_arrow`` plus widening of
+    arrow variants (large_string, date64, non-UTC timestamps)."""
+    if pa.types.is_boolean(at):
+        return BOOLEAN
+    if pa.types.is_int8(at):
+        return BYTE
+    if pa.types.is_int16(at):
+        return SHORT
+    if pa.types.is_int32(at):
+        return INT
+    if pa.types.is_int64(at):
+        return LONG
+    if pa.types.is_uint8(at) or pa.types.is_uint16(at) or pa.types.is_uint32(at):
+        # Spark has no unsigned types; widen like Spark's Parquet reader.
+        return {1: SHORT, 2: INT, 4: LONG}[at.bit_width // 8]
+    if pa.types.is_float32(at):
+        return FLOAT
+    if pa.types.is_float64(at):
+        return DOUBLE
+    if pa.types.is_string(at) or pa.types.is_large_string(at):
+        return STRING
+    if pa.types.is_date32(at):
+        return DATE
+    if pa.types.is_timestamp(at):
+        return TIMESTAMP
+    if pa.types.is_decimal(at):
+        return DecimalType(at.precision, at.scale)
+    if pa.types.is_null(at):
+        return NULL
+    raise TypeError(f"unsupported arrow type {at}")
+
+
+@dataclasses.dataclass(frozen=True)
+class StructField:
+    name: str
+    data_type: DataType
+    nullable: bool = True
+
+
+class Schema:
+    """Ordered named fields; the planner's row type."""
+
+    def __init__(self, fields: list[StructField]):
+        self.fields = list(fields)
+        self._index = {f.name: i for i, f in enumerate(self.fields)}
+
+    @property
+    def names(self) -> list[str]:
+        return [f.name for f in self.fields]
+
+    @property
+    def types(self) -> list[DataType]:
+        return [f.data_type for f in self.fields]
+
+    def index_of(self, name: str) -> int:
+        return self._index[name]
+
+    def __len__(self) -> int:
+        return len(self.fields)
+
+    def __iter__(self):
+        return iter(self.fields)
+
+    def __getitem__(self, i):
+        if isinstance(i, str):
+            return self.fields[self._index[i]]
+        return self.fields[i]
+
+    def __eq__(self, other):
+        return isinstance(other, Schema) and self.fields == other.fields
+
+    def __repr__(self):
+        inner = ", ".join(f"{f.name}:{f.data_type}" for f in self.fields)
+        return f"Schema({inner})"
+
+    def to_arrow(self) -> pa.Schema:
+        return pa.schema(
+            [pa.field(f.name, f.data_type.to_arrow(), f.nullable) for f in self.fields]
+        )
+
+    @staticmethod
+    def from_arrow(schema: pa.Schema) -> "Schema":
+        return Schema(
+            [StructField(f.name, from_arrow(f.type), f.nullable) for f in schema]
+        )

@@ -1,0 +1,19 @@
+import os
+
+# 8 virtual devices for mesh tests; must be set before jax initializes backends
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+
+import jax
+
+# The axon sitecustomize pins jax_platforms to the tunneled TPU; tests run on
+# the CPU backend (the driver exercises real-TPU paths separately).
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def session():
+    from spark_rapids_tpu import TpuSession
+
+    return TpuSession()

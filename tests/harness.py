@@ -1,0 +1,95 @@
+"""Differential test harness — CPU engine vs TPU engine on the same query.
+
+This is the analogue of the reference's single most valuable test asset
+(SURVEY.md §4): SparkQueryCompareTestSuite.runOnCpuAndGpu (tests/.../
+SparkQueryCompareTestSuite.scala:339) and the pytest
+assert_gpu_and_cpu_are_equal_collect idiom (integration_tests asserts.py:313).
+
+``assert_cpu_and_tpu_equal(build_df)`` runs the same DataFrame function under
+a CPU-only session and a device session (test mode on: any unexpected
+fallback fails), then deep-compares results.
+"""
+from __future__ import annotations
+
+import math
+from typing import Callable, Optional
+
+from spark_rapids_tpu import TpuSession
+
+
+def cpu_session(extra_conf: Optional[dict] = None) -> TpuSession:
+    conf = {"spark.rapids.sql.enabled": False}
+    conf.update(extra_conf or {})
+    return TpuSession(conf)
+
+
+def tpu_session(extra_conf: Optional[dict] = None, strict: bool = True) -> TpuSession:
+    conf = {
+        "spark.rapids.sql.enabled": True,
+        "spark.rapids.sql.test.enabled": strict,
+    }
+    conf.update(extra_conf or {})
+    return TpuSession(conf)
+
+
+def _normalize(rows, sort: bool):
+    def key(row):
+        # string keys: deterministic total order across mixed/null types;
+        # semantic comparison happens later, this only aligns rows
+        return tuple(
+            (v is None, type(v).__name__, repr(_canon(v))) for v in row
+        )
+
+    if sort:
+        return sorted(rows, key=key)
+    return rows
+
+
+def _canon(v):
+    if isinstance(v, float):
+        if math.isnan(v):
+            return "nan"
+        if v == 0.0:
+            return 0.0  # align -0.0 and 0.0 in the sort key only
+    return v
+
+
+def _values_equal(a, b, approx_float: bool) -> bool:
+    if a is None or b is None:
+        return a is None and b is None
+    if isinstance(a, float) and isinstance(b, float):
+        if math.isnan(a) or math.isnan(b):
+            return math.isnan(a) and math.isnan(b)
+        if a == b:
+            return True
+        if approx_float:
+            return math.isclose(a, b, rel_tol=1e-9, abs_tol=1e-11)
+        return False
+    return a == b
+
+
+def assert_cpu_and_tpu_equal(
+    build_df: Callable[[TpuSession], "object"],
+    conf: Optional[dict] = None,
+    sort_result: bool = True,
+    approx_float: bool = False,
+    allowed_non_tpu: Optional[list[str]] = None,
+):
+    extra = dict(conf or {})
+    if allowed_non_tpu:
+        extra["spark.rapids.sql.test.allowedNonGpu"] = ",".join(allowed_non_tpu)
+    cpu_rows = build_df(cpu_session(conf)).collect()
+    tpu_rows = build_df(tpu_session(extra)).collect()
+    cpu_n, tpu_n = _normalize(cpu_rows, sort_result), _normalize(tpu_rows, sort_result)
+    assert len(cpu_n) == len(tpu_n), (
+        f"row count mismatch: cpu={len(cpu_n)} tpu={len(tpu_n)}\n"
+        f"cpu={cpu_n[:10]}\ntpu={tpu_n[:10]}"
+    )
+    for i, (cr, tr) in enumerate(zip(cpu_n, tpu_n)):
+        assert len(cr) == len(tr), f"row {i} arity mismatch: {cr} vs {tr}"
+        for j, (cv, tv) in enumerate(zip(cr, tr)):
+            assert _values_equal(cv, tv, approx_float), (
+                f"row {i} col {j}: cpu={cv!r} tpu={tv!r}\n"
+                f"cpu rows: {cpu_n[max(0, i - 2) : i + 3]}\n"
+                f"tpu rows: {tpu_n[max(0, i - 2) : i + 3]}"
+            )

@@ -1,0 +1,117 @@
+"""Hash-aggregate differential tests (HashAggregatesSuite analogue)."""
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu.functions import avg, col, count, first, lit, max, min, sum
+from spark_rapids_tpu.types import BYTE, DOUBLE, FLOAT, INT, LONG, SHORT, STRING
+
+from data_gen import gen_grouped_table, gen_table
+from harness import assert_cpu_and_tpu_equal
+
+
+def _df(s, t, parts=3):
+    return s.create_dataframe(t, num_partitions=parts)
+
+
+@pytest.mark.parametrize("dt", [BYTE, SHORT, INT, LONG, FLOAT, DOUBLE], ids=str)
+def test_groupby_sum_count(dt):
+    t = gen_grouped_table([("v", dt)], 500, num_groups=20, seed=20)
+    assert_cpu_and_tpu_equal(
+        lambda s: _df(s, t)
+        .group_by("k")
+        .agg(sum(col("v")).alias("s"), count(col("v")).alias("c"), count("*").alias("cs")),
+        approx_float=dt in (FLOAT, DOUBLE),
+    )
+
+
+@pytest.mark.parametrize("dt", [INT, LONG, DOUBLE], ids=str)
+def test_groupby_min_max(dt):
+    t = gen_grouped_table([("v", dt)], 400, num_groups=15, seed=21)
+    assert_cpu_and_tpu_equal(
+        lambda s: _df(s, t)
+        .group_by("k")
+        .agg(min(col("v")).alias("mn"), max(col("v")).alias("mx"))
+    )
+
+
+def test_groupby_min_max_nan():
+    t = pa.table(
+        {
+            "k": [1, 1, 1, 2, 2, 3, 3],
+            "v": [1.0, float("nan"), 2.0, float("nan"), float("nan"), None, 5.0],
+        }
+    )
+    assert_cpu_and_tpu_equal(
+        lambda s: _df(s, t).group_by("k").agg(min(col("v")).alias("mn"), max(col("v")).alias("mx"))
+    )
+
+
+def test_groupby_avg():
+    t = gen_grouped_table([("v", INT)], 500, num_groups=12, seed=22)
+    assert_cpu_and_tpu_equal(
+        lambda s: _df(s, t).group_by("k").agg(avg(col("v")).alias("a")),
+        approx_float=True,
+    )
+
+
+def test_groupby_string_key():
+    t = gen_table([("s", STRING), ("v", LONG)], 400, seed=23)
+    assert_cpu_and_tpu_equal(
+        lambda s: _df(s, t).group_by("s").agg(sum(col("v")).alias("sv"), count("*").alias("c"))
+    )
+
+
+def test_groupby_multi_key():
+    t = gen_grouped_table([("k2", INT), ("v", LONG)], 600, num_groups=8, seed=24)
+    assert_cpu_and_tpu_equal(
+        lambda s: _df(s, t)
+        .group_by("k", "k2")
+        .agg(sum(col("v")).alias("s"), count("*").alias("c"))
+    )
+
+
+def test_groupby_float_key_normalization():
+    # -0.0 and 0.0 one group; NaNs one group (Spark NormalizeFloatingNumbers)
+    t = pa.table(
+        {
+            "k": [0.0, -0.0, float("nan"), float("nan"), 1.0, None],
+            "v": [1, 2, 3, 4, 5, 6],
+        }
+    )
+    assert_cpu_and_tpu_equal(
+        lambda s: _df(s, t).group_by("k").agg(sum(col("v")).alias("s"))
+    )
+
+
+def test_reduction_no_groups():
+    t = gen_table([("v", LONG)], 300, seed=25)
+    assert_cpu_and_tpu_equal(
+        lambda s: _df(s, t).agg(
+            sum(col("v")).alias("s"), count("*").alias("c"), min(col("v")).alias("m")
+        )
+    )
+
+
+def test_reduction_empty_input():
+    t = pa.table({"v": pa.array([], type=pa.int64())})
+    assert_cpu_and_tpu_equal(
+        lambda s: _df(s, t, parts=1).agg(sum(col("v")).alias("s"), count("*").alias("c"))
+    )
+
+
+def test_groupby_expression_key_and_result():
+    t = gen_grouped_table([("v", LONG)], 400, num_groups=10, seed=26)
+    assert_cpu_and_tpu_equal(
+        lambda s: _df(s, t)
+        .group_by((col("k") % 3).alias("km"))
+        .agg((sum(col("v")) + count("*")).alias("sc"))
+    )
+
+
+def test_count_dataframe():
+    t = gen_table([("v", INT)], 250, seed=27)
+
+    def q(s):
+        return _df(s, t).filter(col("v").is_not_null()).agg(count("*").alias("c"))
+
+    assert_cpu_and_tpu_equal(q)

@@ -1,0 +1,151 @@
+"""Project/filter/expression differential tests — the HashAggregatesSuite/
+OpSuite slice of the reference's test strategy (SURVEY.md §4)."""
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu import TpuSession
+from spark_rapids_tpu.functions import col, lit, when, coalesce, isnan
+from spark_rapids_tpu.types import (
+    BYTE,
+    DOUBLE,
+    FLOAT,
+    INT,
+    LONG,
+    SHORT,
+    STRING,
+)
+
+from data_gen import gen_table
+from harness import assert_cpu_and_tpu_equal
+
+
+def _df(s: TpuSession, table):
+    return s.create_dataframe(table, num_partitions=3)
+
+
+NUMERIC_TYPES = [BYTE, SHORT, INT, LONG, FLOAT, DOUBLE]
+
+
+@pytest.mark.parametrize("dt", NUMERIC_TYPES, ids=str)
+def test_arithmetic_ops(dt):
+    t = gen_table([("a", dt), ("b", dt)], 200, seed=3)
+    assert_cpu_and_tpu_equal(
+        lambda s: _df(s, t).select(
+            (col("a") + col("b")).alias("add"),
+            (col("a") - col("b")).alias("sub"),
+            (col("a") * col("b")).alias("mul"),
+            (-col("a")).alias("neg"),
+        )
+    )
+
+
+@pytest.mark.parametrize("dt", NUMERIC_TYPES, ids=str)
+def test_division(dt):
+    t = gen_table([("a", dt), ("b", dt)], 200, seed=4, special_fraction=0.3)
+    assert_cpu_and_tpu_equal(
+        lambda s: _df(s, t).select(
+            (col("a") / col("b")).alias("div"),
+            (col("a") % col("b")).alias("mod"),
+        )
+    )
+
+
+@pytest.mark.parametrize("dt", NUMERIC_TYPES + [STRING], ids=str)
+def test_comparisons(dt):
+    t = gen_table([("a", dt), ("b", dt)], 300, seed=5, special_fraction=0.3)
+    assert_cpu_and_tpu_equal(
+        lambda s: _df(s, t).select(
+            (col("a") == col("b")).alias("eq"),
+            (col("a") < col("b")).alias("lt"),
+            (col("a") <= col("b")).alias("le"),
+            (col("a") > col("b")).alias("gt"),
+            (col("a") >= col("b")).alias("ge"),
+            col("a").eq_null_safe(col("b")).alias("nseq"),
+        )
+    )
+
+
+def test_float_nan_comparison_semantics():
+    # Spark: NaN == NaN is true, NaN greater than everything
+    t = pa.table({"a": [float("nan"), 1.0, None, float("inf")]})
+    assert_cpu_and_tpu_equal(
+        lambda s: _df(s, t).select(
+            (col("a") == float("nan")).alias("eqnan"),
+            (col("a") > lit(1e300)).alias("gtbig"),
+            isnan(col("a")).alias("isnan"),
+        )
+    )
+
+
+def test_logical_kleene():
+    t = pa.table(
+        {
+            "a": [True, True, False, False, None, None, True, False, None],
+            "b": [True, False, True, False, True, False, None, None, None],
+        }
+    )
+    assert_cpu_and_tpu_equal(
+        lambda s: _df(s, t).select(
+            (col("a") & col("b")).alias("and"),
+            (col("a") | col("b")).alias("or"),
+            (~col("a")).alias("not"),
+        )
+    )
+
+
+def test_filter_basic():
+    t = gen_table([("a", INT), ("b", DOUBLE), ("s", STRING)], 500, seed=6)
+    assert_cpu_and_tpu_equal(
+        lambda s: _df(s, t).filter((col("a") > 0) & col("b").is_not_null())
+    )
+
+
+def test_filter_string_predicate():
+    t = gen_table([("s", STRING), ("x", INT)], 300, seed=7)
+    assert_cpu_and_tpu_equal(lambda s: _df(s, t).filter(col("s") > lit("M")))
+
+
+def test_conditional():
+    t = gen_table([("a", INT), ("b", INT)], 200, seed=8)
+    assert_cpu_and_tpu_equal(
+        lambda s: _df(s, t).select(
+            when(col("a") > 0, col("a")).otherwise(col("b")).alias("w"),
+            coalesce(col("a"), col("b"), lit(0)).alias("c"),
+        )
+    )
+
+
+def test_in_list():
+    t = gen_table([("a", INT)], 300, seed=9, special_fraction=0.3)
+    assert_cpu_and_tpu_equal(
+        lambda s: _df(s, t).select(
+            col("a").isin(0, 1, -1, 2**31 - 1).alias("in4"),
+        )
+    )
+
+
+def test_union_and_limit():
+    t = gen_table([("a", INT), ("s", STRING)], 100, seed=10)
+    assert_cpu_and_tpu_equal(
+        lambda s: _df(s, t).union(_df(s, t)).limit(150),
+        sort_result=True,
+    )
+
+
+def test_casts_numeric():
+    t = gen_table([("a", DOUBLE), ("i", LONG)], 300, seed=11, special_fraction=0.3)
+    assert_cpu_and_tpu_equal(
+        lambda s: _df(s, t).select(
+            col("a").cast(INT).alias("d2i"),
+            col("a").cast(LONG).alias("d2l"),
+            col("a").cast(FLOAT).alias("d2f"),
+            col("i").cast(INT).alias("l2i"),
+            col("i").cast(SHORT).alias("l2s"),
+            col("i").cast(DOUBLE).alias("l2d"),
+        )
+    )
+
+
+def test_cast_string_to_int():
+    t = pa.table({"s": ["12", " 34 ", "-5", "abc", "", None, "2147483648", "99"]})
+    assert_cpu_and_tpu_equal(lambda s: _df(s, t).select(col("s").cast(INT).alias("i")))
