@@ -1,0 +1,134 @@
+"""Benchmark: TPC-H q1 + q6 shaped queries, device engine vs CPU engine.
+
+The reference publishes only qualitative numbers ("3x-7x, 4x typical" vs CPU
+Spark — docs/FAQ.md:87-88, see BASELINE.md); it ships no benchmark rig, so
+this one is built here. The metric is end-to-end wall-clock speedup of the
+TPU engine over this framework's own CPU (numpy/arrow) engine on the same
+queries — the analogue of the reference's plugin-on vs plugin-off
+comparison. ``vs_baseline`` normalizes by the reference's "4x typical".
+
+Prints ONE JSON line.
+"""
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+import pyarrow as pa
+
+SCALE_ROWS = 2_000_000
+PARTITIONS = 4
+
+
+def gen_lineitem(n: int) -> pa.Table:
+    rng = np.random.default_rng(42)
+    return pa.table(
+        {
+            "l_returnflag": pa.array(
+                np.asarray(["A", "N", "R"], dtype=object)[rng.integers(0, 3, n)]
+            ),
+            "l_linestatus": pa.array(
+                np.asarray(["F", "O"], dtype=object)[rng.integers(0, 2, n)]
+            ),
+            "l_quantity": rng.integers(1, 51, n).astype(np.float64),
+            "l_extendedprice": (rng.random(n) * 1e5).round(2),
+            "l_discount": rng.integers(0, 11, n) / 100.0,
+            "l_tax": rng.integers(0, 9, n) / 100.0,
+            "l_shipdate": rng.integers(8000, 12000, n).astype(np.int32),
+        }
+    )
+
+
+def q1(session, table):
+    from spark_rapids_tpu.functions import avg, col, count, sum as sum_
+
+    df = session.create_dataframe(table, num_partitions=PARTITIONS)
+    return (
+        df.filter(col("l_shipdate") <= 11000)
+        .group_by("l_returnflag", "l_linestatus")
+        .agg(
+            sum_(col("l_quantity")).alias("sum_qty"),
+            sum_(col("l_extendedprice")).alias("sum_base_price"),
+            sum_(col("l_extendedprice") * (1 - col("l_discount"))).alias("sum_disc_price"),
+            sum_(
+                col("l_extendedprice") * (1 - col("l_discount")) * (1 + col("l_tax"))
+            ).alias("sum_charge"),
+            avg(col("l_quantity")).alias("avg_qty"),
+            avg(col("l_extendedprice")).alias("avg_price"),
+            avg(col("l_discount")).alias("avg_disc"),
+            count("*").alias("count_order"),
+        )
+    )
+
+
+def q6(session, table):
+    from spark_rapids_tpu.functions import col, sum as sum_
+
+    df = session.create_dataframe(table, num_partitions=PARTITIONS)
+    return (
+        df.filter(
+            (col("l_shipdate") >= 9000)
+            & (col("l_shipdate") < 9365)
+            & (col("l_discount") >= 0.05)
+            & (col("l_discount") <= 0.07)
+            & (col("l_quantity") < 24)
+        ).agg(sum_(col("l_extendedprice") * col("l_discount")).alias("revenue"))
+    )
+
+
+def time_query(build, n_warm: int = 1, n_run: int = 3) -> float:
+    for _ in range(n_warm):
+        build().collect()
+    best = float("inf")
+    for _ in range(n_run):
+        t0 = time.perf_counter()
+        build().collect()
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def main():
+    from spark_rapids_tpu import TpuSession
+
+    table = gen_lineitem(SCALE_ROWS)
+    tpu = TpuSession({"spark.rapids.sql.enabled": True})
+    cpu = TpuSession({"spark.rapids.sql.enabled": False})
+
+    t_tpu = time_query(lambda: q1(tpu, table)) + time_query(lambda: q6(tpu, table))
+    t_cpu = time_query(lambda: q1(cpu, table)) + time_query(lambda: q6(cpu, table))
+
+    # sanity: identical results (values, not just shape)
+    r_t = sorted(q1(tpu, table).collect())
+    r_c = sorted(q1(cpu, table).collect())
+    assert len(r_t) == len(r_c), f"row mismatch {len(r_t)} vs {len(r_c)}"
+    for rt, rc in zip(r_t, r_c):
+        for vt, vc in zip(rt, rc):
+            if isinstance(vt, float):
+                assert vc == vt or abs(vt - vc) <= 1e-9 * max(abs(vt), abs(vc), 1.0), (
+                    rt,
+                    rc,
+                )
+            else:
+                assert vt == vc, (rt, rc)
+
+    speedup = t_cpu / t_tpu if t_tpu > 0 else 0.0
+    print(
+        json.dumps(
+            {
+                "metric": "tpch_q1_q6_wallclock_speedup_vs_cpu_engine",
+                "value": round(speedup, 3),
+                "unit": "x",
+                "vs_baseline": round(speedup / 4.0, 3),
+                "detail": {
+                    "rows": SCALE_ROWS,
+                    "tpu_s": round(t_tpu, 3),
+                    "cpu_s": round(t_cpu, 3),
+                },
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -435,18 +435,6 @@ class TpuHashAggregateExec(Exec):
         )
 
 
-class _SchemaOnly(Exec):
-    """Placeholder child carrying just a schema (for kernel construction)."""
-
-    def __init__(self, schema: Schema):
-        super().__init__([])
-        self._schema = schema
-
-    @property
-    def output(self) -> Schema:
-        return self._schema
-
-
 class TpuSortExec(Exec):
     """Per-partition sort; coalesces the partition into one batch (the
     reference's single-batch mode; out-of-core merge sort comes with the

@@ -31,6 +31,18 @@ def _is_float(dt: DataType) -> bool:
     return isinstance(dt, (FloatType, DoubleType))
 
 
+def _java_div(xp, l, r):
+    """Integer division truncating toward zero (Java `/`), r must be nonzero."""
+    q = l // r
+    remnz = (l - q * r) != 0
+    return xp.where(remnz & ((l < 0) != (r < 0)), q + 1, q)
+
+
+def _java_rem(xp, l, r):
+    """Java `%`: remainder carries the sign of the dividend, r nonzero."""
+    return l - _java_div(xp, l, r) * r
+
+
 @dataclass(frozen=True)
 class Add(BinaryExpression):
     l: Expression
@@ -148,17 +160,15 @@ class Divide(BinaryExpression):
             lt: DecimalType = self.l.data_type  # type: ignore
             rt: DecimalType = self.r.data_type  # type: ignore
             out_scale = self.data_type.scale
-            # unscaled result = l * 10^(out_scale - s1 + s2) / r, rounded half-up
+            # unscaled result = l * 10^(out_scale - s1 + s2) / r, ROUND_HALF_UP
             shift = out_scale - lt.scale + rt.scale
             num = l.astype(xp.int64) * (10**shift)
             denom = xp.where(r == 0, xp.ones_like(r), r)
-            q = num // denom
-            rem = num - q * denom
-            # round half up (Spark's ROUND_HALF_UP on Decimal divide)
-            half = xp.abs(denom) // 2 + (xp.abs(denom) % 2)
-            adj = xp.where(2 * xp.abs(rem) >= xp.abs(denom), xp.sign(num) * xp.sign(denom), 0)
-            data = q + adj
-            return data, r != 0
+            q = _java_div(xp, num, denom)  # truncate toward zero
+            rem = num - q * denom  # sign of num (or 0)
+            sign = xp.sign(num).astype(xp.int64) * xp.sign(denom).astype(xp.int64)
+            adj = xp.where(2 * xp.abs(rem) >= xp.abs(denom), sign, 0)
+            return q + adj, r != 0
         denom_zero = r == 0
         safe = xp.where(denom_zero, xp.ones_like(r), r)
         return l / safe, ~denom_zero
@@ -182,11 +192,7 @@ class IntegralDivide(BinaryExpression):
         xp = ctx.xp
         zero = r == 0
         safe = xp.where(zero, xp.ones_like(r), r)
-        # Java integer division truncates toward zero; // floors. Fix up.
-        q = l // safe
-        remnz = (l - q * safe) != 0
-        q = xp.where(remnz & ((l < 0) != (safe < 0)), q + 1, q)
-        return q.astype(xp.int64), ~zero
+        return _java_div(xp, l, safe).astype(xp.int64), ~zero
 
     def __str__(self):
         return f"({self.l} div {self.r})"
@@ -205,14 +211,11 @@ class Remainder(BinaryExpression):
 
     def _compute(self, ctx: Ctx, l, r):
         xp = ctx.xp
-        if _is_float(self.data_type):
-            zero = r == 0
-            safe = xp.where(zero, xp.ones_like(r), r)
-            return xp.fmod(l, safe), ~zero
         zero = r == 0
         safe = xp.where(zero, xp.ones_like(r), r)
-        m = l - (xp.where((l % safe != 0) & ((l < 0) != (safe < 0)), l // safe + 1, l // safe)) * safe
-        return m, ~zero
+        if _is_float(self.data_type):
+            return xp.fmod(l, safe), ~zero
+        return _java_rem(xp, l, safe), ~zero
 
     def __str__(self):
         return f"({self.l} % {self.r})"
@@ -220,7 +223,9 @@ class Remainder(BinaryExpression):
 
 @dataclass(frozen=True)
 class Pmod(BinaryExpression):
-    """Positive modulus, NULL on zero divisor."""
+    """Spark's pmod: ``r = a % n; if (r < 0) (r + n) % n else r`` — NULL on
+    zero divisor. Note the result keeps Java-% semantics per that formula and
+    is NOT always positive when the divisor is negative (pmod(-7,-3) = -1)."""
 
     l: Expression
     r: Expression
@@ -235,11 +240,9 @@ class Pmod(BinaryExpression):
         safe = xp.where(zero, xp.ones_like(r), r)
         if _is_float(self.data_type):
             m = xp.fmod(l, safe)
-            m = xp.where(m != 0, xp.where((m < 0) != (safe < 0), m + safe, m), m)
-            return m, ~zero
-        m = xp.mod(l, safe)  # floored mod: sign of divisor
-        m = xp.where((m != 0) & (safe < 0), m - safe, m)
-        return m, ~zero
+            return xp.where(m < 0, xp.fmod(m + safe, safe), m), ~zero
+        m = _java_rem(xp, l, safe)
+        return xp.where(m < 0, _java_rem(xp, m + safe, safe), m), ~zero
 
 
 @dataclass(frozen=True)

@@ -1,0 +1,77 @@
+"""Spark-exact arithmetic semantics, asserted against known values (not just
+engine-vs-engine, which shared-spec bugs would slip past)."""
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu import TpuSession
+from spark_rapids_tpu.functions import col, lit
+from spark_rapids_tpu.types import DecimalType, INT, LONG
+
+from harness import assert_cpu_and_tpu_equal, cpu_session, tpu_session
+
+
+def _vals(df):
+    return df.collect()
+
+
+@pytest.mark.parametrize("dev", [False, True])
+def test_decimal_divide_half_up_negative(dev):
+    import decimal as d
+
+    t = pa.table(
+        {
+            "a": pa.array(
+                [d.Decimal("-7"), d.Decimal("-7"), d.Decimal("7"), d.Decimal("7")],
+                type=pa.decimal128(5, 0),
+            ),
+            "b": pa.array(
+                [d.Decimal("2"), d.Decimal("3"), d.Decimal("-2"), d.Decimal("2")],
+                type=pa.decimal128(5, 0),
+            ),
+        }
+    )
+    s = tpu_session() if dev else cpu_session()
+    rows = _vals(s.create_dataframe(t).select((col("a") / col("b")).alias("q")))
+    got = [r[0] for r in rows]
+    # ROUND_HALF_UP at scale 6: -3.5, -2.333333, -3.5, 3.5
+    assert [str(g) for g in got] == ["-3.500000", "-2.333333", "-3.500000", "3.500000"]
+
+
+@pytest.mark.parametrize("dev", [False, True])
+def test_pmod_and_remainder_signs(dev):
+    t = pa.table(
+        {
+            "a": pa.array([-7, -7, 7, 7, -7], type=pa.int32()),
+            "n": pa.array([3, -3, 3, -3, 0], type=pa.int32()),
+        }
+    )
+    s = tpu_session() if dev else cpu_session()
+    from spark_rapids_tpu.expr.arithmetic import Pmod, Remainder
+
+    from spark_rapids_tpu.functions import Column
+
+    df = s.create_dataframe(t).select(
+        Column(Pmod(col("a").expr, col("n").expr)).alias("pmod"),
+        Column(Remainder(col("a").expr, col("n").expr)).alias("rem"),
+    )
+    rows = _vals(df)
+    # Spark: pmod(-7,3)=2, pmod(-7,-3)=-1, pmod(7,3)=1, pmod(7,-3)=1, pmod(-7,0)=NULL
+    assert [r[0] for r in rows] == [2, -1, 1, 1, None]
+    # Java %: -7%3=-1, -7%-3=-1, 7%3=1, 7%-3=1, NULL
+    assert [r[1] for r in rows] == [-1, -1, 1, 1, None]
+
+
+def test_integral_divide_differential():
+    t = pa.table({"a": pa.array([-7, -7, 7, 7, None], type=pa.int64()),
+                  "n": pa.array([2, -2, 2, -2, 3], type=pa.int64())})
+    from spark_rapids_tpu.expr.arithmetic import IntegralDivide
+    from spark_rapids_tpu.functions import Column
+
+    def q(s):
+        return s.create_dataframe(t).select(
+            Column(IntegralDivide(col("a").expr, col("n").expr)).alias("d")
+        )
+
+    assert_cpu_and_tpu_equal(q)
+    rows = _vals(q(cpu_session()))
+    assert [r[0] for r in rows] == [-3, 3, 3, -3, None]
