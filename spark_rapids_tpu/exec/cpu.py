@@ -385,24 +385,28 @@ class CpuSortExec(Exec):
                 elif isinstance(dt, (FloatType, DoubleType)):
                     # signed-int64 total order: NaN (canonical, positive bits)
                     # lands above +inf, matching Spark's NaN-greatest ordering
-                    x = np.where(d == 0, np.zeros_like(d), d)
-                    x = np.where(np.isnan(x), np.full_like(x, np.nan), x)
-                    bits = x.astype(np.float64).view(np.int64)
+                    bits = ck.normalized_float_bits(d)
                     val_key = np.where(bits < 0, ~bits ^ np.int64(-(2**63)), bits)
                 else:
                     val_key = d.astype(np.int64)
                 if not o.ascending and val_key.dtype == object:
-                    # lexsort can't negate bytes; use rank trick
+                    # lexsort can't negate bytes; use DENSE ranks so equal
+                    # values share a rank (keeps ties stable under negation)
                     order_idx = np.argsort(val_key, kind="stable")
+                    sv = val_key[order_idx]
+                    new_grp = np.ones(n, dtype=np.int64)
+                    new_grp[1:] = (sv[1:] != sv[:-1]).astype(np.int64)
+                    dense = np.cumsum(new_grp) - 1
                     rank = np.empty(n, dtype=np.int64)
-                    rank[order_idx] = np.arange(n)
+                    rank[order_idx] = dense
                     val_key = -rank
                 elif not o.ascending:
                     val_key = -1 - val_key  # avoid -MIN overflow? two's complement ok
                 nf = o.resolved_nulls_first()
                 null_key = np.where(v, 1, 0) if nf else np.where(v, 0, 1)
-                keys.append(val_key)
+                # null flag is MORE significant than the value within a column
                 keys.append(null_key)
+                keys.append(val_key)
             perm = np.lexsort(keys[::-1])
             yield rb.take(pa.array(perm))
 

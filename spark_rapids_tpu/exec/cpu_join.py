@@ -17,12 +17,17 @@ from ..expr import Expression, bind
 from ..expr.base import Ctx
 from ..plan.physical import Exec, ExecContext, PartitionSet
 from ..types import Schema, StructField
-from . import cpu_kernels as ck
+from .cpu_kernels import normalized_float_bits
 from .cpu import _cpu_ctx, _val_to_np
 
 
 def _key_codes(keys: List[Expression], rb: pa.RecordBatch, schema: Schema):
-    """Encode key columns to int64 code tuples + per-row all-valid mask."""
+    """Encode key columns into side-independent comparable values + per-row
+    all-valid mask. Must NOT use per-side dictionaries (codes from one side
+    would be meaningless on the other): strings stay strings, floats become
+    normalized bit patterns (NaN canonical, -0.0 -> 0.0), others int64."""
+    from ..types import DoubleType, FloatType, StringType
+
     c = _cpu_ctx(rb, schema)
     n = rb.num_rows
     words = []
@@ -30,13 +35,16 @@ def _key_codes(keys: List[Expression], rb: pa.RecordBatch, schema: Schema):
     for k in keys:
         d, v = _val_to_np(c, k.eval(c))
         all_valid &= v
-        # encode_group_key gives NaN/-0.0-normalized codes; validity word is
-        # dropped because null keys are excluded from matching entirely
-        enc = ck.encode_group_key(k.data_type, d, v)
-        words.append(enc[1])
+        dt = k.data_type
+        if isinstance(dt, StringType):
+            words.append(d)  # object array of str
+        elif isinstance(dt, (FloatType, DoubleType)):
+            words.append(normalized_float_bits(d))
+        else:
+            words.append(d.astype(np.int64))
     if not words:
-        return np.zeros((n, 0), dtype=np.int64), all_valid
-    return np.stack(words, axis=1), all_valid
+        return np.zeros((n, 0), dtype=object), all_valid
+    return np.stack([w.astype(object) for w in words], axis=1), all_valid
 
 
 def _take(rb: pa.RecordBatch, idx: np.ndarray) -> pa.RecordBatch:

@@ -18,6 +18,14 @@ from ..types import (
 )
 
 
+def normalized_float_bits(data: np.ndarray) -> np.ndarray:
+    """Float -> comparable int64 bits with Spark's grouping/join/sort
+    normalization: -0.0 == 0.0 and one canonical NaN."""
+    x = np.where(data == 0, np.zeros_like(data), data)
+    x = np.where(np.isnan(x), np.full_like(x, np.nan), x)
+    return x.astype(np.float64).view(np.int64)
+
+
 def encode_group_key(dt: DataType, data: np.ndarray, valid: np.ndarray):
     """Encode one key column into int64 word columns such that equal words ⇔
     same Spark group (nulls one group, NaNs one group, -0.0 == 0.0).
@@ -38,10 +46,7 @@ def encode_group_key(dt: DataType, data: np.ndarray, valid: np.ndarray):
             codes[i] = code
         return [vw, codes]
     if isinstance(dt, (FloatType, DoubleType)):
-        x = np.where(data == 0, np.zeros_like(data), data)
-        x = np.where(np.isnan(x), np.full_like(x, np.nan), x)
-        bits = x.astype(np.float64).view(np.int64)
-        return [vw, np.where(valid, bits, 0)]
+        return [vw, np.where(valid, normalized_float_bits(data), 0)]
     return [vw, np.where(valid, data.astype(np.int64), 0)]
 
 

@@ -1,0 +1,260 @@
+"""TPU join operators.
+
+Reference: GpuShuffledHashJoinBase + GpuHashJoin.scala (build side coalesced
+to a single batch, stream side batched — :165-362) and the per-version
+GpuBroadcastHashJoinExec shims. The kernel is the sort-merge matcher in
+ops/join.py; the execution contract matches the reference: build on the
+RIGHT side, stream the LEFT, one device sync per stream batch to size the
+output bucket.
+"""
+from __future__ import annotations
+
+import dataclasses as dc
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..columnar.device import DeviceBatch, DeviceColumn, bucket_capacity, empty_batch
+from ..expr import Expression, bind
+from ..expr.base import Ctx, Val
+from ..ops.concat import concat_device
+from ..ops.gather import compact, gather_column
+from ..ops.join import gather_pairs, join_bounds, pad_string_column
+from ..plan.physical import Exec, ExecContext, PartitionSet
+from ..types import Schema, StringType, StructField
+from .tpu import val_to_column
+
+
+class TpuShuffledHashJoinExec(Exec):
+    def __init__(
+        self,
+        join_type: str,
+        left_keys: List[Expression],
+        right_keys: List[Expression],
+        residual: Optional[Expression],
+        left: Exec,
+        right: Exec,
+        drop_right_keys: Optional[List[str]] = None,
+    ):
+        super().__init__([left, right])
+        self.join_type = join_type
+        self.left_keys = [bind(k, left.output) for k in left_keys]
+        self.right_keys = [bind(k, right.output) for k in right_keys]
+        self.residual = residual
+        self.drop_right_keys = drop_right_keys or []
+        self._schema = self._compute_schema()
+
+    def _compute_schema(self) -> Schema:
+        left, right = self.children
+        lt = list(left.output.fields)
+        rt = [f for f in right.output.fields if f.name not in self.drop_right_keys]
+        if self.join_type in ("left_semi", "left_anti"):
+            return Schema(lt)
+        if self.join_type in ("left", "full"):
+            rt = [dc.replace(f, nullable=True) for f in rt]
+        if self.join_type in ("right", "full"):
+            lt = [dc.replace(f, nullable=True) for f in lt]
+        return Schema(lt + rt)
+
+    @property
+    def output(self) -> Schema:
+        return self._schema
+
+    @property
+    def is_device(self) -> bool:
+        return True
+
+    def _right_ordinals(self) -> List[int]:
+        right = self.children[1]
+        return [
+            i
+            for i, f in enumerate(right.output.fields)
+            if f.name not in self.drop_right_keys
+        ]
+
+    # ── kernels ─────────────────────────────────────────────────────────
+    def _phase1(self):
+        """counts per probe row (+ build order/lower for phase 2)."""
+        left_keys, right_keys = self.left_keys, self.right_keys
+
+        @jax.jit
+        def fn(build: DeviceBatch, probe: DeviceBatch):
+            bctx = Ctx.for_device(build)
+            pctx = Ctx.for_device(probe)
+            bcols = [val_to_column(bctx, k.eval(bctx), k.data_type) for k in right_keys]
+            pcols = [val_to_column(pctx, k.eval(pctx), k.data_type) for k in left_keys]
+            # unify string widths across sides per key position
+            for i, (b, p) in enumerate(zip(bcols, pcols)):
+                if isinstance(b.dtype, StringType):
+                    w = max(b.data.shape[1], p.data.shape[1])
+                    bcols[i] = pad_string_column(b, w)
+                    pcols[i] = pad_string_column(p, w)
+            build_order, lower, upper = join_bounds(
+                bcols, build.row_mask(), pcols, probe.row_mask()
+            )
+            counts = upper - lower
+            return build_order, lower, counts
+
+        return fn
+
+    def _phase2(self):
+        """Gather matched pairs into a static-capacity output batch."""
+        out_schema = self._schema
+        left_exec, right_exec = self.children
+        right_ords = self._right_ordinals()
+        jt = self.join_type
+        residual = self.residual
+        if residual is not None:
+            pair_schema = Schema(
+                list(left_exec.output.fields) + list(right_exec.output.fields)
+            )
+            residual = bind(residual, pair_schema)
+
+        @jax.jit
+        def fn(
+            build: DeviceBatch,
+            probe: DeviceBatch,
+            build_order,
+            lower,
+            counts,
+            out_cap_arr,
+        ):
+            out_cap = out_cap_arr.shape[0]
+            probe_idx, build_idx, pair_live, total = gather_pairs(
+                build_order, lower, counts, probe.row_mask(), out_cap
+            )
+            lcols = [gather_column(c, probe_idx, pair_live) for c in probe.columns]
+            rcols_all = [gather_column(c, build_idx, pair_live) for c in build.columns]
+            live = pair_live
+            if residual is not None:
+                rctx = Ctx(
+                    jnp,
+                    out_cap,
+                    True,
+                    [Val(c.data, c.validity, c.lengths) for c in lcols + rcols_all],
+                    total,
+                )
+                rv = residual.eval(rctx)
+                keep = rctx.broadcast_bool(rv.data) & rv.full_valid(rctx) & pair_live
+                live = keep
+            # per-probe / per-build matched flags (for outer joins)
+            npr = probe.capacity
+            nb = build.capacity
+            probe_matched = (
+                jnp.zeros(npr, bool).at[jnp.where(live, probe_idx, npr)].set(True, mode="drop")
+            )
+            build_matched = (
+                jnp.zeros(nb, bool).at[jnp.where(live, build_idx, nb)].set(True, mode="drop")
+            )
+            rcols = [rcols_all[i] for i in right_ords]
+            if jt in ("left_semi", "left_anti"):
+                want = probe_matched if jt == "left_semi" else (
+                    ~probe_matched & probe.row_mask()
+                )
+                return compact(probe, want), probe_matched, build_matched
+            cols = lcols + rcols
+            out = DeviceBatch(
+                out_schema,
+                [
+                    DeviceColumn(c.dtype, c.data, c.validity & live, c.lengths)
+                    for c in cols
+                ],
+                live.sum().astype(jnp.int32),
+            )
+            out = compact(out, live)
+            return out, probe_matched, build_matched
+
+        return fn
+
+    def _null_extend(self, batch: DeviceBatch, keep: jax.Array, side: str) -> DeviceBatch:
+        """Rows of one side with the other side's columns as NULLs."""
+        sub = compact(batch, keep)
+        cap = sub.capacity
+        left_exec, right_exec = self.children
+        right_fields = [
+            f for f in right_exec.output.fields if f.name not in self.drop_right_keys
+        ]
+        if side == "left":  # left rows + null right
+            cols = list(sub.columns)
+            for f in right_fields:
+                cols.append(_null_column(f, cap))
+        else:  # null left + right rows (sub has full right schema)
+            cols = [_null_column(f, cap) for f in left_exec.output.fields]
+            for i in self._right_ordinals():
+                cols.append(sub.columns[i])
+        return DeviceBatch(self._schema, cols, sub.num_rows)
+
+    # ── execution ───────────────────────────────────────────────────────
+    def execute(self, ctx: ExecContext) -> PartitionSet:
+        left, right = self.children
+        lparts = left.execute(ctx)
+        rparts = right.execute(ctx)
+        assert lparts.num_partitions == rparts.num_partitions, (
+            f"{lparts.num_partitions} vs {rparts.num_partitions}"
+        )
+        phase1 = self._phase1()
+        phase2 = self._phase2()
+        jt = self.join_type
+
+        def make(lt, rt):
+            def it():
+                bbatches = list(rt())
+                build = (
+                    concat_device(bbatches)
+                    if bbatches
+                    else empty_batch(right.output)
+                )
+                build_matched = jnp.zeros(build.capacity, dtype=bool)
+                for probe in lt():
+                    build_order, lower, counts = phase1(build, probe)
+                    total = int(counts.sum())
+                    out_cap = bucket_capacity(max(total, 1))
+                    out, probe_matched, bmatch = phase2(
+                        build,
+                        probe,
+                        build_order,
+                        lower,
+                        counts,
+                        jnp.zeros(out_cap, jnp.int8),
+                    )
+                    build_matched = build_matched | bmatch
+                    if jt in ("left", "full"):
+                        unmatched = (~probe_matched) & probe.row_mask()
+                        extra = self._null_extend(probe, unmatched, "left")
+                        if extra.row_count():
+                            yield extra
+                    if out.row_count():
+                        yield out
+                if jt in ("right", "full"):
+                    unmatched = (~build_matched) & build.row_mask()
+                    extra = self._null_extend(build, unmatched, "right")
+                    if extra.row_count():
+                        yield extra
+
+            return it
+
+        return PartitionSet([make(lt, rt) for lt, rt in zip(lparts.parts, rparts.parts)])
+
+    def node_string(self):
+        return (
+            f"TpuShuffledHashJoin {self.join_type} "
+            f"[{', '.join(map(str, self.left_keys))}] [{', '.join(map(str, self.right_keys))}]"
+        )
+
+
+def _null_column(f: StructField, cap: int) -> DeviceColumn:
+    from ..columnar.device import MIN_STR_WIDTH
+
+    if isinstance(f.data_type, StringType):
+        return DeviceColumn(
+            f.data_type,
+            jnp.zeros((cap, MIN_STR_WIDTH), jnp.uint8),
+            jnp.zeros(cap, bool),
+            jnp.zeros(cap, jnp.int32),
+        )
+    return DeviceColumn(
+        f.data_type,
+        jnp.zeros(cap, f.data_type.np_dtype),
+        jnp.zeros(cap, bool),
+    )

@@ -234,6 +234,32 @@ _rule(
 _rule(C.CpuLimitExec, "CollectLimitExec", _conv_limit, lambda e: [])
 
 
+def _conv_join(e, ch):
+    from ..exec.tpu_join import TpuShuffledHashJoinExec
+
+    return TpuShuffledHashJoinExec(
+        e.join_type,
+        e.left_keys,
+        e.right_keys,
+        e.residual,
+        ch[0],
+        ch[1],
+        e.drop_right_keys,
+    )
+
+
+def _join_exprs_of(e):
+    out = list(e.left_keys) + list(e.right_keys)
+    if e.residual is not None:
+        out.append(e.residual)
+    return out
+
+
+from ..exec.cpu_join import CpuShuffledHashJoinExec as _CpuSHJ  # noqa: E402
+
+_rule(_CpuSHJ, "ShuffledHashJoinExec", _conv_join, _join_exprs_of)
+
+
 def exec_rules() -> dict[type, ExecRule]:
     return dict(_EXEC_RULES)
 

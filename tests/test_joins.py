@@ -1,0 +1,121 @@
+"""Join differential tests (join_test.py / HashJoinSuite analogue)."""
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu.functions import col
+from spark_rapids_tpu.types import DOUBLE, INT, LONG, STRING
+
+from data_gen import gen_grouped_table, gen_table
+from harness import assert_cpu_and_tpu_equal
+
+
+def _two_tables(seed, n_left=300, n_right=200, groups=25):
+    lt = gen_grouped_table([("lv", LONG)], n_left, num_groups=groups, seed=seed)
+    rt = gen_grouped_table([("rv", LONG)], n_right, num_groups=groups, seed=seed + 1)
+    return lt, rt
+
+
+JOIN_TYPES = ["inner", "left", "right", "full", "left_semi", "left_anti"]
+
+
+@pytest.mark.parametrize("how", JOIN_TYPES)
+def test_join_int_key(how):
+    lt, rt = _two_tables(40)
+    assert_cpu_and_tpu_equal(
+        lambda s: s.create_dataframe(lt, num_partitions=3).join(
+            s.create_dataframe(rt, num_partitions=2),
+            on=[("k", "k")],
+            how=how,
+        )
+    )
+
+
+@pytest.mark.parametrize("how", ["inner", "left"])
+def test_join_using_name(how):
+    lt, rt = _two_tables(41)
+    assert_cpu_and_tpu_equal(
+        lambda s: s.create_dataframe(lt, num_partitions=2).join(
+            s.create_dataframe(rt, num_partitions=2).select(
+                col("k"), col("rv").alias("rv2")
+            ),
+            on="k",
+            how=how,
+        )
+    )
+
+
+def test_join_string_key():
+    lt = gen_table([("s", STRING), ("a", INT)], 200, seed=42, str_len=4)
+    rt = gen_table([("s", STRING), ("b", INT)], 150, seed=43, str_len=4)
+    assert_cpu_and_tpu_equal(
+        lambda s: s.create_dataframe(lt, num_partitions=2).join(
+            s.create_dataframe(rt, num_partitions=2), on=[("s", "s")], how="inner"
+        )
+    )
+
+
+def test_join_multi_key():
+    lt = gen_grouped_table([("k2", INT), ("lv", LONG)], 300, num_groups=6, seed=44)
+    rt = gen_grouped_table([("k2", INT), ("rv", LONG)], 200, num_groups=6, seed=45)
+    assert_cpu_and_tpu_equal(
+        lambda s: s.create_dataframe(lt, num_partitions=2).join(
+            s.create_dataframe(rt, num_partitions=2),
+            on=[("k", "k"), ("k2", "k2")],
+            how="inner",
+        )
+    )
+
+
+def test_join_null_keys_never_match():
+    lt = pa.table({"k": pa.array([1, None, 2, None]), "a": [10, 20, 30, 40]})
+    rt = pa.table({"k": pa.array([1, None, 3]), "b": [100, 200, 300]})
+    for how in ("inner", "left", "full"):
+        assert_cpu_and_tpu_equal(
+            lambda s, how=how: s.create_dataframe(lt).join(
+                s.create_dataframe(rt), on=[("k", "k")], how=how
+            )
+        )
+
+
+def test_join_float_key_nan_matches():
+    nan = float("nan")
+    lt = pa.table({"k": [1.0, nan, -0.0, 2.0], "a": [1, 2, 3, 4]})
+    rt = pa.table({"k": [nan, 0.0, 2.0], "b": [10, 20, 30]})
+    assert_cpu_and_tpu_equal(
+        lambda s: s.create_dataframe(lt).join(
+            s.create_dataframe(rt), on=[("k", "k")], how="inner"
+        )
+    )
+
+
+def test_join_duplicate_keys_cartesian_within_group():
+    lt = pa.table({"k": [1, 1, 2], "a": [1, 2, 3]})
+    rt = pa.table({"k": [1, 1, 1, 2], "b": [10, 20, 30, 40]})
+    assert_cpu_and_tpu_equal(
+        lambda s: s.create_dataframe(lt).join(
+            s.create_dataframe(rt), on=[("k", "k")], how="inner"
+        )
+    )
+
+
+def test_join_then_aggregate():
+    lt, rt = _two_tables(46)
+    from spark_rapids_tpu.functions import sum as sum_, count
+
+    assert_cpu_and_tpu_equal(
+        lambda s: s.create_dataframe(lt, num_partitions=3)
+        .join(s.create_dataframe(rt, num_partitions=3), on=[("k", "k")], how="inner")
+        .group_by("k")
+        .agg(sum_(col("lv") + col("rv")).alias("s"), count("*").alias("c"))
+    )
+
+
+def test_join_empty_sides():
+    lt = pa.table({"k": pa.array([], type=pa.int64()), "a": pa.array([], type=pa.int64())})
+    rt = pa.table({"k": pa.array([1, 2]), "b": [1, 2]})
+    for how in ("inner", "left", "right", "full"):
+        assert_cpu_and_tpu_equal(
+            lambda s, how=how: s.create_dataframe(lt).join(
+                s.create_dataframe(rt), on=[("k", "k")], how=how
+            )
+        )
