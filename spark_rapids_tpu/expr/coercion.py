@@ -22,7 +22,9 @@ from ..types import (
 )
 from .arithmetic import Add, Divide, IntegralDivide, Multiply, Pmod, Remainder, Subtract
 from .base import Expression, Literal
+from .bitwise import BitwiseAnd, BitwiseOr, BitwiseXor
 from .cast import Cast
+from .nullexprs import Greatest, Least, NaNvl
 from .predicates import (
     Comparison,
     EqualNullSafe,
@@ -94,6 +96,25 @@ def coerce(e: Expression) -> Expression:
             return dataclasses.replace(e, l=_cast_to(e.l, ct), r=_cast_to(e.r, ct))
         # Spark: Divide on anything non-decimal runs on double
         return dataclasses.replace(e, l=_cast_to(e.l, DOUBLE), r=_cast_to(e.r, DOUBLE))
+    if isinstance(e, (BitwiseAnd, BitwiseOr, BitwiseXor)):
+        lt, rt = e.l.data_type, e.r.data_type
+        if lt == rt:
+            return e
+        ct = _common_type(lt, rt)
+        return dataclasses.replace(e, l=_cast_to(e.l, ct), r=_cast_to(e.r, ct))
+    if isinstance(e, (Greatest, Least)):
+        ct = e.exprs[0].data_type
+        for v in e.exprs[1:]:
+            if not isinstance(v.data_type, NullType):
+                ct = _common_type(ct, v.data_type) if not isinstance(ct, NullType) else v.data_type
+        return dataclasses.replace(e, exprs=tuple(_cast_to(v, ct) for v in e.exprs))
+    if isinstance(e, NaNvl):
+        # Spark keeps the operands' common fractional type (float stays float)
+        lt, rt = e.l.data_type, e.r.data_type
+        ct = lt if lt == rt else _common_type(lt, rt)
+        if not isinstance(ct, (NullType,)):
+            return dataclasses.replace(e, l=_cast_to(e.l, ct), r=_cast_to(e.r, ct))
+        return e
     if isinstance(e, In):
         ct = e.c.data_type
         for v in e.values:

@@ -42,6 +42,86 @@ from .expr import (
     to_expr,
 )
 from .expr.aggregates import Average, Count, First, Last, Max, Min, Sum
+from .expr.bitwise import (
+    BitwiseAnd,
+    BitwiseNot,
+    BitwiseOr,
+    BitwiseXor,
+    ShiftLeft,
+    ShiftRight,
+    ShiftRightUnsigned,
+)
+from .expr.math import (
+    Acos,
+    Asin,
+    Atan,
+    Atan2,
+    BRound,
+    Cbrt,
+    Ceil,
+    Cos,
+    Cosh,
+    Exp,
+    Expm1,
+    Floor,
+    Hypot,
+    Log,
+    Log1p,
+    Log2,
+    Log10,
+    Pow,
+    Rint,
+    Round,
+    Signum,
+    Sin,
+    Sinh,
+    Sqrt,
+    Tan,
+    Tanh,
+    ToDegrees,
+    ToRadians,
+)
+from .expr.nullexprs import AtLeastNNonNulls, Greatest, Least, NaNvl, Nvl2
+from .expr.datetime import (
+    AddMonths,
+    DateAdd,
+    DateDiff,
+    DateSub,
+    DayOfMonth,
+    DayOfWeek,
+    DayOfYear,
+    Hour,
+    LastDay,
+    Minute,
+    Month,
+    Quarter,
+    Second,
+    UnixTimestamp,
+    WeekDay,
+    Year,
+)
+from .expr.strings import (
+    Ascii,
+    Concat,
+    Contains,
+    EndsWith,
+    InitCap,
+    Length,
+    Like,
+    Lower,
+    Reverse,
+    StartsWith,
+    StringLPad,
+    StringLocate,
+    StringRPad,
+    StringRepeat,
+    StringReplace,
+    StringTrim,
+    StringTrimLeft,
+    StringTrimRight,
+    Substring,
+    Upper,
+)
 from .types import INT, DataType
 
 
@@ -134,6 +214,32 @@ class Column:
     def eq_null_safe(self, o) -> "Column":
         return Column(EqualNullSafe(self.expr, _e(o)))
 
+    # bitwise (pyspark Column API)
+    def bitwiseAND(self, o) -> "Column":
+        return Column(BitwiseAnd(self.expr, _e(o)))
+
+    def bitwiseOR(self, o) -> "Column":
+        return Column(BitwiseOr(self.expr, _e(o)))
+
+    def bitwiseXOR(self, o) -> "Column":
+        return Column(BitwiseXor(self.expr, _e(o)))
+
+    # strings (pyspark Column API)
+    def like(self, pattern: str) -> "Column":
+        return Column(Like(self.expr, _e(pattern)))
+
+    def startswith(self, o) -> "Column":
+        return Column(StartsWith(self.expr, _e(o)))
+
+    def endswith(self, o) -> "Column":
+        return Column(EndsWith(self.expr, _e(o)))
+
+    def contains(self, o) -> "Column":
+        return Column(Contains(self.expr, _e(o)))
+
+    def substr(self, start, length) -> "Column":
+        return Column(Substring(self.expr, _e(start), _e(length)))
+
     def __hash__(self):
         return hash(self.expr)
 
@@ -218,3 +324,236 @@ def isnan(c) -> Column:
 
 def abs(c) -> Column:  # noqa: A001
     return Column(Abs(_e(c)))
+
+
+# string functions (pyspark.sql.functions parity)
+def length(c) -> Column:
+    return Column(Length(_e(c)))
+
+
+def upper(c) -> Column:
+    return Column(Upper(_e(c)))
+
+
+def lower(c) -> Column:
+    return Column(Lower(_e(c)))
+
+
+def initcap(c) -> Column:
+    return Column(InitCap(_e(c)))
+
+
+def reverse(c) -> Column:
+    return Column(Reverse(_e(c)))
+
+
+def ascii(c) -> Column:  # noqa: A001
+    return Column(Ascii(_e(c)))
+
+
+def substring(c, pos, length) -> Column:  # noqa: A002
+    return Column(Substring(_e(c), _e(pos), _e(length)))
+
+
+def concat(*cols) -> Column:
+    return Column(Concat(tuple(_e(c) for c in cols)))
+
+
+def trim(c) -> Column:
+    return Column(StringTrim(_e(c)))
+
+
+def ltrim(c) -> Column:
+    return Column(StringTrimLeft(_e(c)))
+
+
+def rtrim(c) -> Column:
+    return Column(StringTrimRight(_e(c)))
+
+
+def lpad(c, len_: int, pad: str = " ") -> Column:
+    return Column(StringLPad(_e(c), _e(len_), _e(pad)))
+
+
+def rpad(c, len_: int, pad: str = " ") -> Column:
+    return Column(StringRPad(_e(c), _e(len_), _e(pad)))
+
+
+def repeat(c, n: int) -> Column:
+    return Column(StringRepeat(_e(c), _e(n)))
+
+
+def regexp_replace(c, search: str, replacement: str) -> Column:
+    raise NotImplementedError("regex replace is not supported (reference gates it too)")
+
+
+def replace(c, search, replacement) -> Column:
+    return Column(StringReplace(_e(c), _e(search), _e(replacement)))
+
+
+def locate(substr: str, c, pos: int = 1) -> Column:
+    return Column(StringLocate(_e(substr), _e(c), _e(pos)))
+
+
+def instr(c, substr: str) -> Column:
+    return Column(StringLocate(_e(substr), _e(c), _e(1)))
+
+
+# date/time functions
+def year(c) -> Column:
+    return Column(Year(_e(c)))
+
+
+def month(c) -> Column:
+    return Column(Month(_e(c)))
+
+
+def dayofmonth(c) -> Column:
+    return Column(DayOfMonth(_e(c)))
+
+
+def quarter(c) -> Column:
+    return Column(Quarter(_e(c)))
+
+
+def dayofweek(c) -> Column:
+    return Column(DayOfWeek(_e(c)))
+
+
+def weekday(c) -> Column:
+    return Column(WeekDay(_e(c)))
+
+
+def dayofyear(c) -> Column:
+    return Column(DayOfYear(_e(c)))
+
+
+def last_day(c) -> Column:
+    return Column(LastDay(_e(c)))
+
+
+def date_add(c, days) -> Column:
+    return Column(DateAdd(_e(c), _e(days)))
+
+
+def date_sub(c, days) -> Column:
+    return Column(DateSub(_e(c), _e(days)))
+
+
+def datediff(end, start) -> Column:
+    return Column(DateDiff(_e(end), _e(start)))
+
+
+def add_months(c, months) -> Column:
+    return Column(AddMonths(_e(c), _e(months)))
+
+
+def hour(c) -> Column:
+    return Column(Hour(_e(c)))
+
+
+def minute(c) -> Column:
+    return Column(Minute(_e(c)))
+
+
+def second(c) -> Column:
+    return Column(Second(_e(c)))
+
+
+def unix_timestamp(c) -> Column:
+    return Column(UnixTimestamp(_e(c)))
+
+
+# math functions
+def _unary_fn(cls):
+    def f(c) -> Column:
+        return Column(cls(_e(c)))
+
+    f.__name__ = cls.__name__.lower()
+    return f
+
+
+sqrt = _unary_fn(Sqrt)
+cbrt = _unary_fn(Cbrt)
+exp = _unary_fn(Exp)
+expm1 = _unary_fn(Expm1)
+sin = _unary_fn(Sin)
+cos = _unary_fn(Cos)
+tan = _unary_fn(Tan)
+asin = _unary_fn(Asin)
+acos = _unary_fn(Acos)
+atan = _unary_fn(Atan)
+sinh = _unary_fn(Sinh)
+cosh = _unary_fn(Cosh)
+tanh = _unary_fn(Tanh)
+degrees = _unary_fn(ToDegrees)
+radians = _unary_fn(ToRadians)
+rint = _unary_fn(Rint)
+signum = _unary_fn(Signum)
+log10 = _unary_fn(Log10)
+log2 = _unary_fn(Log2)
+log1p = _unary_fn(Log1p)
+floor = _unary_fn(Floor)
+ceil = _unary_fn(Ceil)
+
+
+def log(c) -> Column:
+    return Column(Log(_e(c)))
+
+
+def pow(l, r) -> Column:  # noqa: A001
+    return Column(Pow(_e(l), _e(r)))
+
+
+def atan2(l, r) -> Column:
+    return Column(Atan2(_e(l), _e(r)))
+
+
+def hypot(l, r) -> Column:
+    return Column(Hypot(_e(l), _e(r)))
+
+
+def round(c, scale: int = 0) -> Column:  # noqa: A001
+    return Column(Round(_e(c), _e(scale)))
+
+
+def bround(c, scale: int = 0) -> Column:
+    return Column(BRound(_e(c), _e(scale)))
+
+
+# bitwise
+def shiftleft(c, n) -> Column:
+    return Column(ShiftLeft(_e(c), _e(n)))
+
+
+def shiftright(c, n) -> Column:
+    return Column(ShiftRight(_e(c), _e(n)))
+
+
+def shiftrightunsigned(c, n) -> Column:
+    return Column(ShiftRightUnsigned(_e(c), _e(n)))
+
+
+def bitwise_not(c) -> Column:
+    return Column(BitwiseNot(_e(c)))
+
+
+# null handling
+def greatest(*cols) -> Column:
+    return Column(Greatest(tuple(_e(c) for c in cols)))
+
+
+def least(*cols) -> Column:
+    return Column(Least(tuple(_e(c) for c in cols)))
+
+
+def nanvl(a, b) -> Column:
+    return Column(NaNvl(_e(a), _e(b)))
+
+
+def nvl(a, b) -> Column:
+    return Column(Coalesce((_e(a), _e(b))))
+
+
+def nvl2(a, b, c) -> Column:
+    return Column(Nvl2(_e(a), _e(b), _e(c)))

@@ -25,7 +25,12 @@ from ..expr import Expression
 from ..expr import aggregates as agg
 from ..expr import arithmetic as ar
 from ..expr import conditional as cond
+from ..expr import bitwise as bw
+from ..expr import datetime as dtx
+from ..expr import math as mx
+from ..expr import nullexprs as nx
 from ..expr import predicates as pred
+from ..expr import strings as st
 from ..expr.base import Alias, BoundReference, Literal, UnresolvedAttribute
 from ..expr.cast import Cast, can_cast_on_device
 from ..exec import cpu as C
@@ -112,6 +117,147 @@ for _cls in (
 _expr(Cast, check=_cast_check)
 _expr(agg.Min, check=_agg_minmax_check)
 _expr(agg.Max, check=_agg_minmax_check)
+
+
+# string rules — device paths that need a scalar pattern are gated exactly
+# like the reference (GpuOverrides requires Literal for like/contains/replace
+# search operands: GpuOverrides.scala string rules)
+def _lit_check(attr: str, what: str):
+    def check(e, conf: TpuConf) -> Optional[str]:
+        if not st.is_string_literal(getattr(e, attr)):
+            return f"{what} must be a string literal for the device path"
+        return None
+
+    return check
+
+
+def _pad_check(e, conf: TpuConf) -> Optional[str]:
+    p = e.pad
+    if not st.is_string_literal(p):
+        return "pad must be a string literal for the device path"
+    if len(p.value.encode("utf-8")) != 1:
+        return "device pad requires a single-byte pad string"
+    if not isinstance(e.length, Literal):
+        return "pad length must be a literal for the device path"
+    return None
+
+
+def _locate_check(e, conf: TpuConf) -> Optional[str]:
+    if not st.is_string_literal(e.substr):
+        return "locate substring must be a string literal for the device path"
+    if not isinstance(e.start, Literal):
+        return "locate start must be a literal for the device path"
+    return None
+
+
+def _like_check(e, conf: TpuConf) -> Optional[str]:
+    if not st.is_string_literal(e.pattern):
+        return "LIKE pattern must be a string literal for the device path"
+    try:
+        st.like_tokens(e.pattern.value, e.escape)
+    except ValueError as ex:
+        return str(ex)
+    return None
+
+
+def _repeat_check(e, conf: TpuConf) -> Optional[str]:
+    if not isinstance(e.times, Literal):
+        return "repeat count must be a literal for the device path"
+    return None
+
+
+def _replace_check(e, conf: TpuConf) -> Optional[str]:
+    if not st.is_string_literal(e.search) or not st.is_string_literal(e.replacement):
+        return "replace search/replacement must be string literals for the device path"
+    return None
+
+
+def _trim_check(e, conf: TpuConf) -> Optional[str]:
+    if e.trim_str is not None and not st.is_string_literal(e.trim_str):
+        return "trim character set must be a string literal for the device path"
+    return None
+
+
+for _cls in (
+    st.Length,
+    st.Upper,
+    st.Lower,
+    st.InitCap,
+    st.Reverse,
+    st.Ascii,
+    st.Substring,
+    st.Concat,
+):
+    _expr(_cls)
+_expr(st.StartsWith, check=_lit_check("pattern", "startswith pattern"))
+_expr(st.EndsWith, check=_lit_check("pattern", "endswith pattern"))
+_expr(st.Contains, check=_lit_check("pattern", "contains pattern"))
+_expr(st.Like, check=_like_check)
+_expr(st.StringReplace, check=_replace_check)
+_expr(st.StringRepeat, check=_repeat_check)
+_expr(st.StringLocate, check=_locate_check)
+_expr(st.StringLPad, check=_pad_check)
+_expr(st.StringRPad, check=_pad_check)
+_expr(st.StringTrim, check=_trim_check)
+_expr(st.StringTrimLeft, check=_trim_check)
+_expr(st.StringTrimRight, check=_trim_check)
+
+for _cls in (
+    dtx.Year,
+    dtx.Month,
+    dtx.DayOfMonth,
+    dtx.Quarter,
+    dtx.DayOfWeek,
+    dtx.WeekDay,
+    dtx.DayOfYear,
+    dtx.LastDay,
+    dtx.DateAdd,
+    dtx.DateSub,
+    dtx.DateDiff,
+    dtx.AddMonths,
+    dtx.Hour,
+    dtx.Minute,
+    dtx.Second,
+    dtx.UnixTimestamp,
+):
+    _expr(_cls)
+
+for _cls in (
+    mx.Sqrt, mx.Cbrt, mx.Exp, mx.Expm1, mx.Sin, mx.Cos, mx.Tan,
+    mx.Asin, mx.Acos, mx.Atan, mx.Sinh, mx.Cosh, mx.Tanh,
+    mx.ToDegrees, mx.ToRadians, mx.Rint, mx.Signum,
+    mx.Log, mx.Log10, mx.Log2, mx.Log1p,
+    mx.Pow, mx.Atan2, mx.Hypot, mx.Floor, mx.Ceil,
+    bw.BitwiseAnd, bw.BitwiseOr, bw.BitwiseXor, bw.BitwiseNot,
+    bw.ShiftLeft, bw.ShiftRight, bw.ShiftRightUnsigned,
+    nx.NaNvl, nx.Nvl2, nx.AtLeastNNonNulls,
+):
+    _expr(_cls)
+
+
+def _round_check(e, conf: TpuConf) -> Optional[str]:
+    from ..types import IntegralType as _IT
+
+    if not isinstance(e.scale, Literal):
+        return "round scale must be a literal for the device path"
+    if not isinstance(e.child.data_type, _IT):
+        return (
+            "round on floating point is CPU-only (java BigDecimal semantics; "
+            "the reference has no GPU Round either)"
+        )
+    return None
+
+
+def _greatest_check(e, conf: TpuConf) -> Optional[str]:
+    if any(isinstance(x.data_type, StringType) for x in e.exprs):
+        return "greatest/least over strings is CPU-only"
+    return None
+
+
+_expr(mx.Round, check=_round_check)
+_expr(mx.BRound, check=_round_check)
+_expr(nx.Greatest, check=_greatest_check)
+_expr(nx.Least, check=_greatest_check)
 
 
 def expr_rules() -> dict[type, ExprRule]:

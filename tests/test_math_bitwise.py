@@ -1,0 +1,190 @@
+"""Math / bitwise / null-handling expression differential tests — mirrors
+the reference's mathExpressions + bitwise + nullExpressions rule coverage."""
+import math as pymath
+
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu import TpuSession
+from spark_rapids_tpu import functions as F
+from spark_rapids_tpu.functions import col
+from spark_rapids_tpu.types import DOUBLE, INT, LONG, SHORT, STRING
+
+from data_gen import gen_table
+from harness import assert_cpu_and_tpu_equal, cpu_session
+
+
+def _df(s: TpuSession, table):
+    return s.create_dataframe(table, num_partitions=3)
+
+
+def test_double_fns():
+    t = gen_table([("a", DOUBLE), ("b", DOUBLE)], 300, seed=40, special_fraction=0.2)
+    assert_cpu_and_tpu_equal(
+        lambda s: _df(s, t).select(
+            F.sqrt(col("a")).alias("sqrt"),
+            F.cbrt(col("a")).alias("cbrt"),
+            F.exp(col("a") / 100.0).alias("exp"),
+            F.sin(col("a")).alias("sin"),
+            F.cos(col("a")).alias("cos"),
+            F.atan(col("a")).alias("atan"),
+            F.tanh(col("a") / 1000.0).alias("tanh"),
+            F.signum(col("a")).alias("sig"),
+            F.rint(col("a")).alias("rint"),
+            F.degrees(col("a")).alias("deg"),
+            F.atan2(col("a"), col("b")).alias("at2"),
+            F.hypot(col("a"), col("b")).alias("hyp"),
+            F.pow(col("a") / 100.0, 2.0).alias("pw"),
+        ),
+        approx_float=True,
+    )
+
+
+def test_log_domain_null():
+    """Spark returns NULL (not NaN/-inf) outside the log domain."""
+    t = pa.table({"a": pa.array([1.0, 0.0, -1.0, None, 2.718281828, -0.5, 1e-300])})
+    assert_cpu_and_tpu_equal(
+        lambda s: _df(s, t).select(
+            F.log(col("a")).alias("ln"),
+            F.log10(col("a")).alias("l10"),
+            F.log2(col("a")).alias("l2"),
+            F.log1p(col("a")).alias("l1p"),
+        ),
+        approx_float=True,
+    )
+
+
+def test_log_nan_stays_nan():
+    """Spark: log(NaN) is NaN (Java NaN <= 0.0 is false), not NULL."""
+    t = pa.table({"a": pa.array([float("nan"), 1.0, 0.0])})
+    s = cpu_session()
+    rows = _df(s, t).select(F.log(col("a")).alias("ln")).collect()
+    assert pymath.isnan(rows[0][0])
+    assert rows[1][0] == 0.0
+    assert rows[2][0] is None
+    assert_cpu_and_tpu_equal(
+        lambda s2: _df(s2, t).select(F.log(col("a")).alias("ln"))
+    )
+
+
+def test_floor_ceil():
+    t = gen_table([("a", DOUBLE), ("i", LONG)], 300, seed=41, special_fraction=0.2)
+    assert_cpu_and_tpu_equal(
+        lambda s: _df(s, t).select(
+            F.floor(col("a")).alias("fl"),
+            F.ceil(col("a")).alias("ce"),
+            F.floor(col("i")).alias("fli"),
+        )
+    )
+
+
+@pytest.mark.parametrize("scale", [0, 1, 2, -1, -2])
+def test_round_integral_device(scale):
+    t = gen_table([("a", INT), ("b", LONG)], 300, seed=42, special_fraction=0.2)
+    assert_cpu_and_tpu_equal(
+        lambda s: _df(s, t).select(
+            F.round(col("a"), scale).alias("r"),
+            F.bround(col("a"), scale).alias("br"),
+            F.round(col("b"), scale).alias("rl"),
+        )
+    )
+
+
+def test_round_double_cpu_fallback():
+    t = pa.table({"a": pa.array([2.5, -2.5, 2.675, 1.005, 0.125, None, 3.14159])})
+    assert_cpu_and_tpu_equal(
+        lambda s: _df(s, t).select(
+            F.round(col("a"), 2).alias("r2"),
+            F.bround(col("a"), 0).alias("br0"),
+        ),
+        allowed_non_tpu=["CpuProject"],
+    )
+
+
+def test_round_ground_truth():
+    """HALF_UP/HALF_EVEN vs java BigDecimal expectations."""
+    t = pa.table({"a": pa.array([25, -25, 35, -35, 26, -26], type=pa.int32())})
+    s = cpu_session()
+    rows = (
+        _df(s, t)
+        .select(
+            F.round(col("a"), -1).alias("r"),
+            F.bround(col("a"), -1).alias("br"),
+        )
+        .collect()
+    )
+    assert [r[0] for r in rows] == [30, -30, 40, -40, 30, -30]
+    assert [r[1] for r in rows] == [20, -20, 40, -40, 30, -30]
+
+
+def test_bitwise():
+    t = gen_table([("a", LONG), ("b", LONG), ("i", INT), ("n", INT)], 300, seed=43)
+    assert_cpu_and_tpu_equal(
+        lambda s: _df(s, t).select(
+            col("a").bitwiseAND(col("b")).alias("band"),
+            col("a").bitwiseOR(col("b")).alias("bor"),
+            col("a").bitwiseXOR(col("b")).alias("bxor"),
+            F.bitwise_not(col("a")).alias("bnot"),
+            F.shiftleft(col("a"), col("n")).alias("shl"),
+            F.shiftright(col("a"), col("n")).alias("shr"),
+            F.shiftrightunsigned(col("a"), col("n")).alias("shru"),
+            F.shiftleft(col("i"), col("n")).alias("shli"),
+            F.shiftrightunsigned(col("i"), col("n")).alias("shrui"),
+        )
+    )
+
+
+def test_shift_java_masking():
+    """Java masks shift amounts to the operand width: 1 << 33 (int) == 2."""
+    t = pa.table(
+        {
+            "v": pa.array([1, 1, -8, 2**31 - 1], type=pa.int32()),
+            "n": pa.array([33, -1, 1, 1], type=pa.int32()),
+        }
+    )
+    s = cpu_session()
+    rows = (
+        _df(s, t)
+        .select(
+            F.shiftleft(col("v"), col("n")).alias("shl"),
+            F.shiftright(col("v"), col("n")).alias("shr"),
+            F.shiftrightunsigned(col("v"), col("n")).alias("shru"),
+        )
+        .collect()
+    )
+    assert rows[0] == (2, 0, 0)  # n=33 -> 1
+    assert rows[1][0] == -(2**31)  # n=-1 -> 31
+    assert rows[2] == (-16, -4, 2**31 - 4)
+
+
+def test_greatest_least():
+    t = gen_table(
+        [("a", DOUBLE), ("b", DOUBLE), ("c", DOUBLE)], 300, seed=44, special_fraction=0.3
+    )
+    assert_cpu_and_tpu_equal(
+        lambda s: _df(s, t).select(
+            F.greatest(col("a"), col("b"), col("c")).alias("g"),
+            F.least(col("a"), col("b"), col("c")).alias("l"),
+        )
+    )
+
+
+def test_greatest_int_mixed_nulls():
+    t = gen_table([("a", INT), ("b", INT), ("c", INT)], 300, seed=45, null_fraction=0.4)
+    assert_cpu_and_tpu_equal(
+        lambda s: _df(s, t).select(
+            F.greatest(col("a"), col("b"), col("c")).alias("g"),
+            F.least(col("a"), col("b"), col("c")).alias("l"),
+        )
+    )
+
+
+def test_null_handling():
+    t = gen_table([("a", DOUBLE), ("b", DOUBLE), ("s", STRING)], 300, seed=46, special_fraction=0.3)
+    assert_cpu_and_tpu_equal(
+        lambda s: _df(s, t).select(
+            F.nanvl(col("a"), col("b")).alias("nv"),
+            F.nvl(col("a"), col("b")).alias("nvl"),
+            F.nvl2(col("a"), col("b"), col("a")).alias("nvl2"),
+        )
+    )

@@ -1,0 +1,230 @@
+"""String expression differential tests — mirrors the reference's string op
+suites (stringFunctions.scala rules exercised by StringOperatorsSuite +
+integration_tests string_test.py per SURVEY.md §4)."""
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu import TpuSession
+from spark_rapids_tpu.expr.strings import StringLPad
+from spark_rapids_tpu.functions import (
+    Column,
+    ascii,
+    col,
+    concat,
+    initcap,
+    instr,
+    length,
+    lit,
+    locate,
+    lower,
+    lpad,
+    ltrim,
+    repeat,
+    replace,
+    reverse,
+    rpad,
+    rtrim,
+    substring,
+    trim,
+    upper,
+)
+from spark_rapids_tpu.types import INT, STRING
+
+from data_gen import gen_table
+from harness import assert_cpu_and_tpu_equal
+
+
+def _df(s: TpuSession, table):
+    return s.create_dataframe(table, num_partitions=3)
+
+
+def _str_table(n=200, seed=11, **kw):
+    return gen_table([("a", STRING), ("b", STRING)], n, seed=seed, **kw)
+
+
+EDGE = pa.table(
+    {
+        "a": pa.array(
+            ["", " ", "  pad  ", "a", "ab", "abc", None, "aaa", "abab",
+             "x_y%z", "CamelCase words", "  lead", "trail  ", "_" * 31]
+        ),
+        "b": pa.array(
+            ["", "a", "b", "ab", None, "aa", " ", "%", "_", "zz", "ca", "  ", "l", "_"]
+        ),
+    }
+)
+
+
+@pytest.mark.parametrize("table", [_str_table(), EDGE], ids=["fuzz", "edge"])
+def test_length_case_reverse(table):
+    assert_cpu_and_tpu_equal(
+        lambda s: _df(s, table).select(
+            length(col("a")).alias("len"),
+            upper(col("a")).alias("up"),
+            lower(col("a")).alias("low"),
+            reverse(col("a")).alias("rev"),
+            initcap(col("a")).alias("ic"),
+            ascii(col("a")).alias("asc"),
+        )
+    )
+
+
+@pytest.mark.parametrize("pos,ln", [(1, 3), (2, 100), (0, 2), (-3, 2), (-100, 3), (5, 0)])
+def test_substring(pos, ln):
+    t = _str_table(seed=12)
+    assert_cpu_and_tpu_equal(
+        lambda s: _df(s, t).select(substring(col("a"), pos, ln).alias("sub"))
+    )
+
+
+def test_substring_column_args():
+    t = gen_table([("a", STRING), ("p", INT)], 150, seed=13)
+    assert_cpu_and_tpu_equal(
+        lambda s: _df(s, t)
+        .select(col("a"), (col("p") % 5).alias("p5"))
+        .select(substring(col("a"), col("p5"), 3).alias("sub"))
+    )
+
+
+def test_concat():
+    t = _str_table(seed=14)
+    assert_cpu_and_tpu_equal(
+        lambda s: _df(s, t).select(
+            concat(col("a"), col("b")).alias("c2"),
+            concat(col("a"), lit("-"), col("b")).alias("c3"),
+        )
+    )
+
+
+@pytest.mark.parametrize("table", [_str_table(seed=15), EDGE], ids=["fuzz", "edge"])
+def test_trim(table):
+    assert_cpu_and_tpu_equal(
+        lambda s: _df(s, table).select(
+            trim(col("a")).alias("t"),
+            ltrim(col("a")).alias("lt"),
+            rtrim(col("a")).alias("rt"),
+        )
+    )
+
+
+def test_pad_repeat():
+    t = _str_table(seed=16)
+    assert_cpu_and_tpu_equal(
+        lambda s: _df(s, t).select(
+            lpad(col("a"), 8, "0").alias("lp"),
+            rpad(col("a"), 8, "x").alias("rp"),
+            lpad(col("a"), 3, "0").alias("lp3"),
+            repeat(col("a"), 3).alias("r3"),
+            repeat(col("a"), 0).alias("r0"),
+        )
+    )
+
+
+@pytest.mark.parametrize("search,rep", [("a", "XY"), ("ab", ""), ("aa", "b"), ("", "z")])
+def test_replace(search, rep):
+    t = _str_table(seed=17)
+    assert_cpu_and_tpu_equal(
+        lambda s: _df(s, t).select(replace(col("a"), search, rep).alias("r"))
+    )
+
+
+def test_replace_edge():
+    assert_cpu_and_tpu_equal(
+        lambda s: _df(s, EDGE).select(
+            replace(col("a"), "a", "bb").alias("r1"),
+            replace(col("a"), "aa", "c").alias("r2"),
+        )
+    )
+
+
+@pytest.mark.parametrize("pat", ["a", "ab", "", "zz"])
+def test_search_predicates(pat):
+    t = _str_table(seed=18)
+    assert_cpu_and_tpu_equal(
+        lambda s: _df(s, t).select(
+            col("a").startswith(pat).alias("sw"),
+            col("a").endswith(pat).alias("ew"),
+            col("a").contains(pat).alias("ct"),
+        )
+    )
+
+
+@pytest.mark.parametrize(
+    "pat",
+    ["a%", "%a", "%ab%", "a_c", "_", "%", "", "abc", "a%b_c%", "100\\%"],
+)
+def test_like(pat):
+    t = _str_table(seed=19)
+    assert_cpu_and_tpu_equal(
+        lambda s: _df(s, t).select(col("a").like(pat).alias("lk"))
+    )
+
+
+def test_locate_instr():
+    t = _str_table(seed=20)
+    assert_cpu_and_tpu_equal(
+        lambda s: _df(s, t).select(
+            locate("a", col("a")).alias("l1"),
+            locate("a", col("a"), 3).alias("l3"),
+            locate("", col("a"), 2).alias("lempty"),
+            instr(col("a"), "b").alias("ins"),
+        )
+    )
+
+
+def test_string_filter_pipeline():
+    """Strings flowing through filter + project together (q-shaped)."""
+    t = _str_table(400, seed=21)
+    assert_cpu_and_tpu_equal(
+        lambda s: _df(s, t)
+        .filter(col("a").contains("a") | col("a").startswith("B"))
+        .select(
+            upper(col("a")).alias("u"),
+            length(col("b")).alias("lb"),
+            concat(col("a"), col("b")).alias("ab"),
+        )
+    )
+
+
+def test_pad_multibyte_utf8():
+    """Pad width accounting is in BYTES: multi-byte chars must not overflow
+    the device byte matrix."""
+    t = pa.table({"a": pa.array(["ééé", "é", "", "abc", None, "ééééééé"])})
+    assert_cpu_and_tpu_equal(
+        lambda s: _df(s, t).select(
+            lpad(col("a"), 7, " ").alias("lp"),
+            rpad(col("a"), 7, "x").alias("rp"),
+        )
+    )
+
+
+def test_pad_column_length_falls_back():
+    t = gen_table([("a", STRING), ("n", INT)], 60, seed=23)
+    assert_cpu_and_tpu_equal(
+        lambda s: _df(s, t)
+        .select(col("a"), (col("n") % 20).alias("n20"))
+        .select(lpad(col("a"), col("n20"), "x").alias("lp")),
+        allowed_non_tpu=["CpuProject"],
+    )
+
+
+def test_pad_column_pad_string_cpu():
+    """Non-literal pad strings fall back to CPU and must actually use the
+    column value (not silently pad with spaces)."""
+    t = pa.table({"a": pa.array(["ab", "c", None]), "p": pa.array(["x", "yz", "w"])})
+    assert_cpu_and_tpu_equal(
+        lambda s: _df(s, t).select(
+            Column(StringLPad(col("a").expr, lit(4).expr, col("p").expr)).alias("lp")
+        ),
+        allowed_non_tpu=["CpuProject"],
+    )
+
+
+def test_non_literal_pattern_falls_back():
+    """Column-valued search patterns fall back to CPU per-node, like the
+    reference's scalar-only gating (GpuOverrides string rules)."""
+    t = _str_table(60, seed=22)
+    assert_cpu_and_tpu_equal(
+        lambda s: _df(s, t).select(col("a").contains(col("b")).alias("c")),
+        allowed_non_tpu=["CpuProject"],
+    )
