@@ -21,7 +21,7 @@ from ..expr.base import BoundReference, Ctx
 from ..ops.hash import murmur3_rows, partition_ids
 from ..plan.logical import SortOrder
 from ..plan.physical import Exec, ExecContext, PartitionSet
-from ..types import BOOLEAN, DataType, Schema, StructField
+from ..types import BOOLEAN, DataType, NullType, Schema, StringType, StructField
 from . import cpu_kernels as ck
 
 
@@ -347,6 +347,51 @@ class CpuHashAggregateExec(Exec):
         return f"CpuHashAggregate({self.mode}) keys={[str(g) for g in self.grouping]} aggs={[str(a) for a in self.agg_fns]}"
 
 
+def cpu_sort_indices(rb: pa.RecordBatch, schema: Schema, order: List[SortOrder]) -> np.ndarray:
+    """Stable permutation realizing Spark's sort order over one batch."""
+    c = _cpu_ctx(rb, schema)
+    n = rb.num_rows
+    # build numpy sort keys, last key first (lexsort semantics)
+    keys = []
+    for o in order:
+        d, v = _val_to_np(c, o.child.eval(c))
+        dt = o.child.data_type
+        from ..types import FloatType, DoubleType, StringType
+
+        if isinstance(dt, StringType):
+            enc = np.array(
+                [x.encode() if (x is not None and vv) else b"" for x, vv in zip(d, v)],
+                dtype=object,
+            )
+            val_key = enc
+        elif isinstance(dt, (FloatType, DoubleType)):
+            # signed-int64 total order: NaN (canonical, positive bits)
+            # lands above +inf, matching Spark's NaN-greatest ordering
+            bits = ck.normalized_float_bits(d)
+            val_key = np.where(bits < 0, ~bits ^ np.int64(-(2**63)), bits)
+        else:
+            val_key = d.astype(np.int64)
+        if not o.ascending and val_key.dtype == object:
+            # lexsort can't negate bytes; use DENSE ranks so equal
+            # values share a rank (keeps ties stable under negation)
+            order_idx = np.argsort(val_key, kind="stable")
+            sv = val_key[order_idx]
+            new_grp = np.ones(n, dtype=np.int64)
+            new_grp[1:] = (sv[1:] != sv[:-1]).astype(np.int64)
+            dense = np.cumsum(new_grp) - 1
+            rank = np.empty(n, dtype=np.int64)
+            rank[order_idx] = dense
+            val_key = -rank
+        elif not o.ascending:
+            val_key = -1 - val_key  # avoid -MIN overflow? two's complement ok
+        nf = o.resolved_nulls_first()
+        null_key = np.where(v, 1, 0) if nf else np.where(v, 0, 1)
+        # null flag is MORE significant than the value within a column
+        keys.append(null_key)
+        keys.append(val_key)
+    return np.lexsort(keys[::-1])
+
+
 class CpuSortExec(Exec):
     def __init__(self, order: List[SortOrder], child: Exec):
         super().__init__([child])
@@ -367,50 +412,97 @@ class CpuSortExec(Exec):
             if rb.num_rows == 0:
                 yield rb
                 return
-            c = _cpu_ctx(rb, schema)
-            n = rb.num_rows
-            # build numpy sort keys, last key first (lexsort semantics)
-            keys = []
-            for o in self.order:
-                d, v = _val_to_np(c, o.child.eval(c))
-                dt = o.child.data_type
-                from ..types import FloatType, DoubleType, StringType
-
-                if isinstance(dt, StringType):
-                    enc = np.array(
-                        [x.encode() if (x is not None and vv) else b"" for x, vv in zip(d, v)],
-                        dtype=object,
-                    )
-                    val_key = enc
-                elif isinstance(dt, (FloatType, DoubleType)):
-                    # signed-int64 total order: NaN (canonical, positive bits)
-                    # lands above +inf, matching Spark's NaN-greatest ordering
-                    bits = ck.normalized_float_bits(d)
-                    val_key = np.where(bits < 0, ~bits ^ np.int64(-(2**63)), bits)
-                else:
-                    val_key = d.astype(np.int64)
-                if not o.ascending and val_key.dtype == object:
-                    # lexsort can't negate bytes; use DENSE ranks so equal
-                    # values share a rank (keeps ties stable under negation)
-                    order_idx = np.argsort(val_key, kind="stable")
-                    sv = val_key[order_idx]
-                    new_grp = np.ones(n, dtype=np.int64)
-                    new_grp[1:] = (sv[1:] != sv[:-1]).astype(np.int64)
-                    dense = np.cumsum(new_grp) - 1
-                    rank = np.empty(n, dtype=np.int64)
-                    rank[order_idx] = dense
-                    val_key = -rank
-                elif not o.ascending:
-                    val_key = -1 - val_key  # avoid -MIN overflow? two's complement ok
-                nf = o.resolved_nulls_first()
-                null_key = np.where(v, 1, 0) if nf else np.where(v, 0, 1)
-                # null flag is MORE significant than the value within a column
-                keys.append(null_key)
-                keys.append(val_key)
-            perm = np.lexsort(keys[::-1])
+            perm = cpu_sort_indices(rb, schema, self.order)
             yield rb.take(pa.array(perm))
 
         return self.children[0].execute(ctx).map_partitions(fn)
+
+
+class CpuTakeOrderedAndProjectExec(Exec):
+    """TopN: per-partition sort + slice(n), then merged final sort + slice(n)
+    — the reference's GpuTakeOrderedAndProjectExec pattern (limit.scala)."""
+
+    def __init__(self, n: int, order: List[SortOrder], child: Exec):
+        super().__init__([child])
+        self.n = n
+        self.order = [
+            SortOrder(bind(o.child, child.output), o.ascending, o.nulls_first)
+            for o in order
+        ]
+
+    @property
+    def output(self) -> Schema:
+        return self.children[0].output
+
+    def execute(self, ctx: ExecContext) -> PartitionSet:
+        schema = self.children[0].output
+        n = self.n
+
+        def topn(it):
+            rb = concat_batches(schema, list(it))
+            if rb.num_rows == 0:
+                return []
+            perm = cpu_sort_indices(rb, schema, self.order)[:n]
+            return [rb.take(pa.array(perm))]
+
+        child_parts = self.children[0].execute(ctx)
+
+        def it():
+            partials: list[pa.RecordBatch] = []
+            for t in child_parts.parts:
+                partials.extend(topn(t()))
+            yield from topn(iter(partials))
+
+        return PartitionSet([it])
+
+    def node_string(self):
+        return f"CpuTakeOrderedAndProject n={self.n} [{', '.join(map(str, self.order))}]"
+
+
+class CpuExpandExec(Exec):
+    """Projection-list fan-out: each input row produces one output row per
+    projection (reference: GpuExpandExec.scala) — the engine under
+    rollup/cube/grouping sets."""
+
+    def __init__(self, projections: List[List[Expression]], names: List[str], child: Exec):
+        super().__init__([child])
+        self.projections = [
+            [bind(e, child.output) for e in proj] for proj in projections
+        ]
+        fields = []
+        for i, name in enumerate(names):
+            es = [proj[i] for proj in self.projections]
+            dt = next(
+                (e.data_type for e in es if not isinstance(e.data_type, NullType)),
+                es[0].data_type,
+            )
+            fields.append(StructField(name, dt, any(e.nullable for e in es)))
+        self._schema = Schema(fields)
+
+    @property
+    def output(self) -> Schema:
+        return self._schema
+
+    def execute(self, ctx: ExecContext) -> PartitionSet:
+        schema_in = self.children[0].output
+        schema_out = self._schema
+
+        def fn(it):
+            for rb in it:
+                c = _cpu_ctx(rb, schema_in)
+                for proj in self.projections:
+                    cols = []
+                    for e, f in zip(proj, schema_out):
+                        d, v = _val_to_np(c, e.eval(c))
+                        if not isinstance(f.data_type, StringType) and d.dtype != f.data_type.np_dtype:
+                            d = d.astype(f.data_type.np_dtype)
+                        cols.append((d, v))
+                    yield batch_from_columns(schema_out, cols)
+
+        return self.children[0].execute(ctx).map_partitions(fn)
+
+    def node_string(self):
+        return f"CpuExpand x{len(self.projections)}"
 
 
 class CpuLimitExec(Exec):

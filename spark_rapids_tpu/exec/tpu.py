@@ -456,23 +456,7 @@ class TpuSortExec(Exec):
         return True
 
     def execute(self, ctx: ExecContext) -> PartitionSet:
-        order = self.order
-
-        @jax.jit
-        def _sort(batch: DeviceBatch) -> DeviceBatch:
-            c = Ctx.for_device(batch)
-            live = batch.row_mask()
-            words = []
-            for o in order:
-                col = val_to_column(c, o.child.eval(c), o.child.data_type)
-                col = DeviceColumn(col.dtype, col.data, col.validity & live, col.lengths)
-                from ..ops.sortkeys import column_radix_words
-
-                words.extend(
-                    column_radix_words(col, o.ascending, o.resolved_nulls_first())
-                )
-            perm = sort_permutation(words, live)
-            return gather_batch(batch, perm, batch.num_rows)
+        _sort = device_sort_fn(self.order)
 
         def run(it):
             batches = list(it)
@@ -485,6 +469,148 @@ class TpuSortExec(Exec):
 
     def node_string(self):
         return f"TpuSort [{', '.join(map(str, self.order))}]"
+
+
+def device_sort_fn(order: List[SortOrder]):
+    """Jitted whole-batch sort kernel shared by TpuSortExec and TopN."""
+
+    @jax.jit
+    def _sort(batch: DeviceBatch) -> DeviceBatch:
+        c = Ctx.for_device(batch)
+        live = batch.row_mask()
+        words = []
+        for o in order:
+            col = val_to_column(c, o.child.eval(c), o.child.data_type)
+            col = DeviceColumn(col.dtype, col.data, col.validity & live, col.lengths)
+            from ..ops.sortkeys import column_radix_words
+
+            words.extend(
+                column_radix_words(col, o.ascending, o.resolved_nulls_first())
+            )
+        perm = sort_permutation(words, live)
+        return gather_batch(batch, perm, batch.num_rows)
+
+    return _sort
+
+
+class TpuTakeOrderedAndProjectExec(Exec):
+    """TopN on device: per-partition sort + head(n), then merged final
+    sort + head(n) (reference: GpuTakeOrderedAndProjectExec, limit.scala)."""
+
+    def __init__(self, n: int, order: List[SortOrder], child: Exec):
+        super().__init__([child])
+        self.n = n
+        self.order = [
+            SortOrder(bind(o.child, child.output), o.ascending, o.nulls_first)
+            for o in order
+        ]
+
+    @property
+    def output(self) -> Schema:
+        return self.children[0].output
+
+    @property
+    def is_device(self) -> bool:
+        return True
+
+    def execute(self, ctx: ExecContext) -> PartitionSet:
+        n = self.n
+        sort_fn = device_sort_fn(self.order)
+
+        @jax.jit
+        def _head(batch: DeviceBatch) -> DeviceBatch:
+            take = jnp.minimum(batch.num_rows, n)
+            live = jnp.arange(batch.capacity, dtype=jnp.int32) < take
+            cols = [
+                DeviceColumn(c.dtype, c.data, c.validity & live, c.lengths)
+                for c in batch.columns
+            ]
+            return DeviceBatch(batch.schema, cols, take)
+
+        def topn(batches):
+            if not batches:
+                return None
+            merged = batches[0] if len(batches) == 1 else concat_device(batches)
+            return _head(sort_fn(merged))
+
+        child_parts = self.children[0].execute(ctx)
+
+        def it():
+            partials = []
+            for t in child_parts.parts:
+                out = topn(list(t()))
+                if out is not None:
+                    partials.append(out)
+            final = topn(partials)
+            if final is not None:
+                yield final
+
+        return PartitionSet([it])
+
+    def node_string(self):
+        return f"TpuTakeOrderedAndProject n={self.n} [{', '.join(map(str, self.order))}]"
+
+
+class TpuExpandExec(Exec):
+    """Projection-list fan-out per batch (GpuExpandExec analogue): each
+    projection compiles into the same fused kernel; output batches share the
+    input's row count."""
+
+    def __init__(self, projections: List[List[Expression]], names: List[str], child: Exec):
+        super().__init__([child])
+        self.projections = [
+            [bind(e, child.output) for e in proj] for proj in projections
+        ]
+        from ..types import NullType
+
+        fields = []
+        for i, name in enumerate(names):
+            es = [proj[i] for proj in self.projections]
+            dt = next(
+                (e.data_type for e in es if not isinstance(e.data_type, NullType)),
+                es[0].data_type,
+            )
+            fields.append(StructField(name, dt, any(e.nullable for e in es)))
+        self._schema = Schema(fields)
+        schema = self._schema
+        projections = self.projections
+
+        @jax.jit
+        def _expand(batch: DeviceBatch) -> list[DeviceBatch]:
+            c = Ctx.for_device(batch)
+            live = batch.row_mask()
+            out = []
+            for proj in projections:
+                cols = []
+                for e, f in zip(proj, schema):
+                    col = val_to_column(c, e.eval(c), f.data_type)
+                    cols.append(
+                        DeviceColumn(f.data_type, col.data, col.validity & live, col.lengths)
+                    )
+                out.append(DeviceBatch(schema, cols, batch.num_rows))
+            return out
+
+        self._fn = _expand
+
+    @property
+    def output(self) -> Schema:
+        return self._schema
+
+    @property
+    def is_device(self) -> bool:
+        return True
+
+    def execute(self, ctx: ExecContext) -> PartitionSet:
+        fn = self._fn
+
+        def run(it):
+            for db in it:
+                yield from fn(db)
+
+        return self.children[0].execute(ctx).map_partitions(run)
+
+    def node_string(self):
+        return f"TpuExpand x{len(self.projections)}"
 
 
 class TpuShuffleExchangeExec(Exec):

@@ -187,6 +187,16 @@ class Literal(Expression):
     def eval(self, ctx: Ctx) -> Val:
         xp = ctx.xp
         if self.value is None:
+            if isinstance(self.dtype, StringType):
+                if ctx.is_device:
+                    from ..columnar.device import MIN_STR_WIDTH
+
+                    return Val(
+                        xp.zeros(MIN_STR_WIDTH, dtype=xp.uint8),
+                        xp.asarray(False),
+                        xp.asarray(0, dtype=xp.int32),
+                    )
+                return Val(np.asarray(None, dtype=object), np.asarray(False))
             zero = xp.zeros((), dtype=self.dtype.np_dtype)
             return Val(zero, xp.asarray(False))
         if isinstance(self.dtype, StringType):

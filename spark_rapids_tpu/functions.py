@@ -557,3 +557,8 @@ def nvl(a, b) -> Column:
 
 def nvl2(a, b, c) -> Column:
     return Column(Nvl2(_e(a), _e(b), _e(c)))
+
+
+def grouping_id() -> Column:
+    """The grouping-set id column inside rollup/cube aggregates."""
+    return Column(UnresolvedAttribute("__grouping_id"))

@@ -219,6 +219,36 @@ class Join(LogicalPlan):
 
 
 @dataclass
+class Expand(LogicalPlan):
+    """Projection fan-out (rollup/cube/grouping sets substrate)."""
+
+    projections: list[list[Expression]]  # all the same arity
+    names: list[str]
+    child: LogicalPlan
+
+    def children(self):
+        return [self.child]
+
+    @property
+    def schema(self) -> Schema:
+        from ..types import NullType
+
+        cs = self.child.schema
+        fields = []
+        for i, name in enumerate(self.names):
+            es = [_bound(p[i], cs) for p in self.projections]
+            dt = next(
+                (e.data_type for e in es if not isinstance(e.data_type, NullType)),
+                es[0].data_type,
+            )
+            fields.append(StructField(name, dt, any(e.nullable for e in es)))
+        return Schema(fields)
+
+    def _node_string(self):
+        return f"Expand x{len(self.projections)}"
+
+
+@dataclass
 class Union(LogicalPlan):
     plans: list[LogicalPlan]
 

@@ -355,6 +355,14 @@ def _conv_limit(e: C.CpuLimitExec, ch):
     return T.TpuLimitExec(e.n, ch[0])
 
 
+def _conv_topn(e: C.CpuTakeOrderedAndProjectExec, ch):
+    return T.TpuTakeOrderedAndProjectExec(e.n, e.order, ch[0])
+
+
+def _conv_expand(e: C.CpuExpandExec, ch):
+    return T.TpuExpandExec(e.projections, e.output.names, ch[0])
+
+
 _rule(C.CpuProjectExec, "ProjectExec", _conv_project, lambda e: e.exprs)
 _rule(C.CpuFilterExec, "FilterExec", _conv_filter, lambda e: [e.condition])
 _rule(
@@ -378,6 +386,18 @@ _rule(
     lambda e: [],
 )
 _rule(C.CpuLimitExec, "CollectLimitExec", _conv_limit, lambda e: [])
+_rule(
+    C.CpuTakeOrderedAndProjectExec,
+    "TakeOrderedAndProjectExec",
+    _conv_topn,
+    lambda e: [o.child for o in e.order],
+)
+_rule(
+    C.CpuExpandExec,
+    "ExpandExec",
+    _conv_expand,
+    lambda e: [x for proj in e.projections for x in proj],
+)
 
 
 def _conv_join(e, ch):

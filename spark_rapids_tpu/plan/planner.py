@@ -19,6 +19,7 @@ from ..expr.aggregates import AggregateFunction, is_aggregate
 from ..expr.base import BoundReference
 from ..exec.cpu import (
     CpuCoalescePartitionsExec,
+    CpuExpandExec,
     CpuFilterExec,
     CpuHashAggregateExec,
     CpuLimitExec,
@@ -26,6 +27,7 @@ from ..exec.cpu import (
     CpuScanExec,
     CpuShuffleExchangeExec,
     CpuSortExec,
+    CpuTakeOrderedAndProjectExec,
     CpuUnionExec,
 )
 from ..plan import logical as L
@@ -52,7 +54,15 @@ def plan_physical(lp: L.LogicalPlan, conf: TpuConf) -> Exec:
             child = CpuCoalescePartitionsExec(child)
         return CpuSortExec(lp.order, child)
     if isinstance(lp, L.Limit):
+        # Limit over a global Sort plans as TopN (Spark's
+        # TakeOrderedAndProject strategy; reference limit.scala)
+        if isinstance(lp.child, L.Sort) and lp.child.is_global:
+            return CpuTakeOrderedAndProjectExec(
+                lp.n, lp.child.order, plan_physical(lp.child.child, conf)
+            )
         return CpuLimitExec(lp.n, plan_physical(lp.child, conf))
+    if isinstance(lp, L.Expand):
+        return CpuExpandExec(lp.projections, lp.names, plan_physical(lp.child, conf))
     if isinstance(lp, L.Union):
         return CpuUnionExec([plan_physical(p, conf) for p in lp.plans])
     if isinstance(lp, L.Repartition):

@@ -202,6 +202,22 @@ class DataFrame:
 
     groupBy = group_by
 
+    def rollup(self, *cols) -> "GroupedData":
+        """ROLLUP grouping sets: (all), (all-1), …, () — reference analogue:
+        GpuExpandExec under the aggregate."""
+        exprs = _to_exprs(cols)
+        sets = [list(range(k)) for k in range(len(exprs), -1, -1)]
+        return GroupedData(self, exprs, grouping_sets=sets)
+
+    def cube(self, *cols) -> "GroupedData":
+        """CUBE grouping sets: every subset of the grouping columns."""
+        exprs = _to_exprs(cols)
+        n = len(exprs)
+        sets = [
+            [i for i in range(n) if mask & (1 << i)] for mask in range(2**n - 1, -1, -1)
+        ]
+        return GroupedData(self, exprs, grouping_sets=sets)
+
     def agg(self, *aggs) -> "DataFrame":
         return GroupedData(self, []).agg(*aggs)
 
@@ -316,21 +332,75 @@ class DataFrame:
         return DataFrameWriter(self)
 
 
+GROUPING_ID = "__grouping_id"
+
+
 class GroupedData:
-    def __init__(self, df: DataFrame, grouping: List[Expression]):
+    def __init__(
+        self,
+        df: DataFrame,
+        grouping: List[Expression],
+        grouping_sets: Optional[List[List[int]]] = None,
+    ):
         self._df = df
         self._grouping = grouping
+        self._grouping_sets = grouping_sets
 
     def agg(self, *aggs) -> DataFrame:
         agg_exprs = []
         for a in aggs:
             e = a.expr if isinstance(a, Column) else a
             agg_exprs.append(e)
+        if self._grouping_sets is not None:
+            return self._agg_grouping_sets(agg_exprs)
         # Spark: group-by output = grouping columns ++ aggregates
         all_out = list(self._grouping) + agg_exprs
         return DataFrame(
             self._df._session,
             L.Aggregate(self._grouping, all_out, self._df._plan),
+        )
+
+    def _agg_grouping_sets(self, agg_exprs: List[Expression]) -> DataFrame:
+        """rollup/cube: Expand fans each row out once per grouping set with
+        non-member keys nulled and a grouping-id tiebreaker column, then a
+        plain aggregate groups on [keys…, grouping_id] (Spark's
+        ResolveGroupingAnalytics → Expand plan; reference GpuExpandExec)."""
+        from .expr import Literal
+        from .types import INT
+
+        child_schema = self._df.schema
+        n_keys = len(self._grouping)
+        names = list(child_schema.names)
+        key_names = [f"__key{i}" for i in range(n_keys)]
+        out_names = names + key_names + [GROUPING_ID]
+        projections: List[List[Expression]] = []
+        for s in self._grouping_sets:
+            proj: List[Expression] = [UnresolvedAttribute(nm) for nm in names]
+            for i, g in enumerate(self._grouping):
+                if i in s:
+                    proj.append(Alias(g, key_names[i]))
+                else:
+                    from .expr import bind as _bind
+
+                    dt = _bind(g, child_schema).data_type
+                    proj.append(Alias(Literal(None, dt), key_names[i]))
+            gid = sum((1 << (n_keys - 1 - i)) for i in range(n_keys) if i not in s)
+            proj.append(Alias(Literal(gid, INT), GROUPING_ID))
+            projections.append(proj)
+        expand = L.Expand(projections, out_names, self._df._plan)
+        grouping = [UnresolvedAttribute(nm) for nm in key_names] + [
+            UnresolvedAttribute(GROUPING_ID)
+        ]
+        # output: original grouping names, then aggregates (gid internal)
+        out_keys = [
+            Alias(UnresolvedAttribute(kn), output_name(g))
+            for kn, g in zip(key_names, self._grouping)
+        ]
+        # aggregate inputs read the ORIGINAL columns (passed through Expand
+        # unchanged), exactly like Spark's grouping-analytics plan
+        return DataFrame(
+            self._df._session,
+            L.Aggregate(grouping, out_keys + agg_exprs, expand),
         )
 
     def count(self) -> DataFrame:

@@ -1,0 +1,108 @@
+"""Limit / TopN (TakeOrderedAndProject) / Expand / rollup / cube tests —
+mirrors the reference's limit.scala + GpuExpandExec coverage."""
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu import TpuSession
+from spark_rapids_tpu import functions as F
+from spark_rapids_tpu.functions import col
+from spark_rapids_tpu.types import DOUBLE, INT, LONG, STRING
+
+from data_gen import gen_table
+from harness import assert_cpu_and_tpu_equal, cpu_session, tpu_session
+
+
+def _df(s: TpuSession, table, parts=3):
+    return s.create_dataframe(table, num_partitions=parts)
+
+
+def test_limit():
+    t = gen_table([("a", INT)], 300, seed=50)
+    for n in (0, 1, 10, 500):
+        assert_cpu_and_tpu_equal(
+            lambda s: _df(s, t).limit(n), sort_result=True
+        )
+
+
+def test_topn_sort_limit():
+    t = gen_table([("a", INT), ("b", DOUBLE), ("s", STRING)], 500, seed=51, special_fraction=0.2)
+    assert_cpu_and_tpu_equal(
+        lambda s: _df(s, t).sort(col("a"), col("s")).limit(7),
+        sort_result=False,
+    )
+    assert_cpu_and_tpu_equal(
+        lambda s: _df(s, t).sort(col("b"), ascending=False).limit(13),
+        sort_result=False,
+    )
+
+
+def test_topn_plans_as_take_ordered():
+    t = gen_table([("a", INT)], 100, seed=52)
+    s = tpu_session()
+    df = _df(s, t).sort(col("a")).limit(5)
+    plan = df.explain()
+    assert "TakeOrderedAndProject" in plan
+
+
+def test_topn_larger_than_input():
+    t = gen_table([("a", INT)], 20, seed=53)
+    assert_cpu_and_tpu_equal(
+        lambda s: _df(s, t).sort(col("a")).limit(100), sort_result=False
+    )
+
+
+def test_rollup():
+    t = gen_table([("k1", STRING), ("k2", INT), ("v", LONG)], 400, seed=54)
+    assert_cpu_and_tpu_equal(
+        lambda s: _df(s, t)
+        .rollup(col("k1"), col("k2"))
+        .agg(F.sum(col("v")).alias("sv"), F.count("*").alias("c"))
+    )
+
+
+def test_cube():
+    t = gen_table([("k1", INT), ("k2", INT), ("v", DOUBLE)], 300, seed=55)
+    assert_cpu_and_tpu_equal(
+        lambda s: _df(s, t)
+        .cube(col("k1"), col("k2"))
+        .agg(F.count("*").alias("c"), F.min(col("v")).alias("mn")),
+        approx_float=True,
+    )
+
+
+def test_rollup_grouping_id():
+    t = gen_table([("k1", INT), ("k2", INT), ("v", LONG)], 200, seed=56)
+    assert_cpu_and_tpu_equal(
+        lambda s: _df(s, t)
+        .rollup(col("k1"), col("k2"))
+        .agg(F.sum(col("v")).alias("sv"), F.grouping_id().alias("gid"))
+    )
+
+
+def test_rollup_distinguishes_null_data_from_rollup_null():
+    """A NULL key value in the data must not merge with the rolled-up total
+    row — the grouping id separates them (Spark semantics)."""
+    t = pa.table(
+        {
+            "k": pa.array([None, None, "a", "a"]),
+            "v": pa.array([1, 2, 10, 20], type=pa.int64()),
+        }
+    )
+    s = cpu_session()
+    rows = sorted(
+        _df(s, t, parts=1).rollup(col("k")).agg(F.sum(col("v")).alias("sv")).collect(),
+        key=repr,
+    )
+    # groups: (None data, 3), ('a', 30), (rollup total None, 33)
+    assert sorted([r[1] for r in rows]) == [3, 30, 33]
+
+
+def test_cube_vs_manual_union():
+    """cube(k1) results equal groupBy(k1) union global agg."""
+    t = gen_table([("k", INT), ("v", LONG)], 150, seed=57, null_fraction=0.2)
+    s = cpu_session()
+    cube_rows = _df(s, t).rollup(col("k")).agg(F.sum(col("v")).alias("s")).collect()
+    grouped = _df(s, t).group_by(col("k")).agg(F.sum(col("v")).alias("s")).collect()
+    total = _df(s, t).agg(F.sum(col("v")).alias("s")).collect()
+    want = sorted(grouped + [(None, total[0][0])], key=repr)
+    assert sorted(cube_rows, key=repr) == want
