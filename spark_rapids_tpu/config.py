@@ -221,6 +221,18 @@ DECIMAL_ENABLED = conf("spark.rapids.sql.decimalType.enabled").doc(
     "Enable decimal (64-bit) processing on device."
 ).boolean_conf(True)
 
+DEVICE_POOL_LIMIT = conf("spark.rapids.tpu.memory.deviceLimitBytes").doc(
+    "Spillable-buffer budget on device; 0 means unlimited. When registered "
+    "spillable bytes would exceed this, the catalog proactively spills "
+    "(reference: RMM pool size via spark.rapids.memory.gpu.allocFraction)."
+).bytes_conf(0)
+
+OUT_OF_CORE_SORT_THRESHOLD = conf("spark.rapids.tpu.sort.outOfCoreThresholdBytes").doc(
+    "Partition size above which TpuSortExec switches from single-batch sort "
+    "to spillable sorted-run merge (reference: GpuSortExec.scala:212 "
+    "out-of-core mode gated by targetSize)."
+).bytes_conf(1 << 30)
+
 
 class TpuConf:
     """An immutable-ish view over a key→string dict, with typed access.

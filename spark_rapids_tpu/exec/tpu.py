@@ -436,9 +436,14 @@ class TpuHashAggregateExec(Exec):
 
 
 class TpuSortExec(Exec):
-    """Per-partition sort; coalesces the partition into one batch (the
-    reference's single-batch mode; out-of-core merge sort comes with the
-    spill framework — GpuSortExec.scala:212)."""
+    """Per-partition sort. Two modes (GpuSortExec.scala:36-42,212-510):
+
+    * single-batch: coalesce the partition into one batch and sort it;
+    * out-of-core: when the partition exceeds the configured threshold, sort
+      each incoming batch into a *run*, park runs in the spill catalog
+      (device→host→disk as memory demands), then merge runs pairwise — at
+      most two runs are device-resident at any moment.
+    """
 
     def __init__(self, order: List[SortOrder], child: Exec):
         super().__init__([child])
@@ -456,14 +461,62 @@ class TpuSortExec(Exec):
         return True
 
     def execute(self, ctx: ExecContext) -> PartitionSet:
+        from .. import config as cfg
+        from ..mem.spill import SpillPriorities, with_oom_retry
+
         _sort = device_sort_fn(self.order)
+        threshold = cfg.OUT_OF_CORE_SORT_THRESHOLD.get(ctx.conf)
+        catalog = ctx.catalog
+
+        def make_run(b):
+            """Sort one input batch into a spillable run; drop the input ref."""
+            catalog.ensure_headroom(2 * b.size_bytes())
+            return catalog.register(
+                with_oom_retry(catalog, _sort, b), SpillPriorities.WORKING
+            )
 
         def run(it):
-            batches = list(it)
-            if not batches:
+            # Stream the input: buffer small partitions for the single-batch
+            # fast path; past the threshold, convert each incoming batch into
+            # a sorted spillable run immediately so the unsorted input never
+            # accumulates on device.
+            pending, pending_bytes, runs = [], 0, None
+            for b in it:
+                if runs is None:
+                    pending.append(b)
+                    pending_bytes += b.size_bytes()
+                    if pending_bytes > threshold and len(pending) > 1:
+                        runs = [make_run(p) for p in pending]
+                        pending = []
+                else:
+                    runs.append(make_run(b))
+            if runs is None:
+                if not pending:
+                    return
+                merged = concat_device(pending)
+                del pending
+                yield with_oom_retry(catalog, _sort, merged)
                 return
-            merged = concat_device(batches)
-            yield _sort(merged)
+            # Pairwise merge of sorted runs; a merge reuses the sort kernel
+            # over the concatenation of exactly two runs, which get_batch()
+            # pins so the retry-spill cannot evict what it is merging.
+            while len(runs) > 1:
+                nxt = []
+                for i in range(0, len(runs) - 1, 2):
+                    a, b = runs[i], runs[i + 1]
+
+                    def merge_pair(a=a, b=b):
+                        return _sort(concat_device([a.get_batch(), b.get_batch()]))
+
+                    catalog.ensure_headroom(2 * (a.size_bytes + b.size_bytes))
+                    out = with_oom_retry(catalog, merge_pair)
+                    a.close(), b.close()
+                    nxt.append(catalog.register(out, SpillPriorities.WORKING))
+                if len(runs) % 2:
+                    nxt.append(runs[-1])
+                runs = nxt
+            with runs[0] as final:
+                yield final.get_batch()
 
         return self.children[0].execute(ctx).map_partitions(run)
 

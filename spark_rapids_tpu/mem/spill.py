@@ -1,0 +1,306 @@
+"""Tiered spill framework — HBM → host RAM → disk.
+
+Reference architecture: RapidsBufferCatalog.scala (id→tiered buffers, store
+chain init :177-199), RapidsBufferStore.scala (priority spill queue,
+``synchronousSpill`` :41-260), RapidsDeviceMemoryStore / RapidsHostMemoryStore
+/ RapidsDiskStore, SpillableColumnarBatch.scala (:29-130 re-materialize from
+any tier), SpillPriorities.scala (priority bands), and
+DeviceMemoryEventHandler.scala (:42-69 alloc-failure → synchronous spill →
+retry).
+
+TPU-first redesign: PJRT exposes no RMM-style allocation-failure callback, so
+OOM handling is a *wrapper* at the point device work is launched
+(``with_oom_retry``) that catches XLA RESOURCE_EXHAUSTED, synchronously spills
+registered buffers, and retries — plus *proactive* headroom maintenance
+(``ensure_headroom``) driven by byte accounting of registered spillable
+buffers against a pool budget, since jax.Array sizes are statically known.
+
+Tier currencies:
+
+* DEVICE — the live ``DeviceBatch`` pytree (jax.Arrays in HBM).
+* HOST   — ``jax.device_get`` of the same pytree (padded numpy arrays), so
+  re-upload restores identical static shapes and never re-triggers XLA
+  compilation (the pinned-host-pool analogue).
+* DISK   — the numpy leaves written with ``np.savez`` into the spill dir
+  (RapidsDiskStore analogue; metadata stays in the in-process catalog exactly
+  as the reference keeps TableMeta in memory).
+"""
+from __future__ import annotations
+
+import os
+import tempfile
+import threading
+from typing import Callable, Iterator, Optional
+
+import jax
+import numpy as np
+
+from .. import config as cfg
+from ..columnar.device import DeviceBatch
+
+
+class StorageTier:
+    """RapidsBuffer.scala:53-59 tier enum (no GDS analogue on TPU)."""
+
+    DEVICE = 0
+    HOST = 1
+    DISK = 2
+
+    NAMES = {0: "DEVICE", 1: "HOST", 2: "DISK"}
+
+
+class SpillPriorities:
+    """Priority bands (SpillPriorities.scala): lower spills first."""
+
+    INPUT_FROM_SHUFFLE = -100
+    ACTIVE_ON_DECK = 0
+    WORKING = 100
+    OUTPUT_FOR_SHUFFLE = 200
+
+
+def _is_oom(err: BaseException) -> bool:
+    s = str(err)
+    return "RESOURCE_EXHAUSTED" in s or "Out of memory" in s or "out of memory" in s
+
+
+class SpillableBatch:
+    """Handle to a batch owned by the catalog; re-materializes from whatever
+    tier it currently lives at (SpillableColumnarBatch.scala:29-130).
+
+    Not thread-safe per-handle (one owner task), but catalog operations are.
+    """
+
+    def __init__(self, catalog: "BufferCatalog", buf_id: int, schema, size: int):
+        self._catalog = catalog
+        self.id = buf_id
+        self.schema = schema
+        self.size_bytes = size
+        self._closed = False
+
+    def get_batch(self) -> DeviceBatch:
+        """Bring the batch back to DEVICE tier, *pin* it (unspillable until
+        unpin()/close() — the RapidsBuffer.addReference protocol,
+        RapidsBuffer.scala:82-172) and return it."""
+        assert not self._closed, "use after close"
+        return self._catalog._acquire_device(self.id)
+
+    def unpin(self):
+        """Make the buffer spillable again after a get_batch(). The caller
+        must drop its DeviceBatch reference — a held pytree keeps HBM alive
+        regardless of what the catalog does."""
+        self._catalog._unpin(self.id)
+
+    def close(self):
+        if not self._closed:
+            self._closed = True
+            self._catalog._remove(self.id)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        self.close()
+
+
+class _Buffer:
+    __slots__ = ("id", "size", "priority", "tier", "device", "host", "path", "aux", "pinned")
+
+    def __init__(self, buf_id: int, size: int, priority: int):
+        self.id = buf_id
+        self.size = size
+        self.priority = priority
+        self.tier = StorageTier.DEVICE
+        self.device: Optional[DeviceBatch] = None
+        self.host: Optional[list] = None  # numpy leaves
+        self.path: Optional[str] = None
+        self.aux = None  # pytree treedef
+        self.pinned = False
+
+
+class BufferCatalog:
+    """id → buffer at exactly one tier; spills walk DEVICE→HOST→DISK
+    (RapidsBufferCatalog.scala:40-199)."""
+
+    def __init__(
+        self,
+        device_limit: Optional[int] = None,
+        host_limit: int = 1 << 31,
+        spill_dir: Optional[str] = None,
+    ):
+        self._lock = threading.RLock()
+        self._buffers: dict[int, _Buffer] = {}
+        self._next_id = 0
+        self.device_limit = device_limit  # None = unlimited (tests / CPU)
+        self.host_limit = host_limit
+        self._spill_dir = spill_dir
+        self._owned_tmp: Optional[tempfile.TemporaryDirectory] = None
+        # accounting (registered spillable bytes per tier)
+        self.device_bytes = 0
+        self.host_bytes = 0
+        self.disk_bytes = 0
+        self.spill_count = 0
+
+    @classmethod
+    def from_conf(cls, conf) -> "BufferCatalog":
+        return cls(
+            device_limit=None,
+            host_limit=cfg.HOST_SPILL_STORAGE_SIZE.get(conf),
+            spill_dir=cfg.SPILL_DIR.get(conf),
+        )
+
+    def _dir(self) -> str:
+        if self._spill_dir is None:
+            self._owned_tmp = tempfile.TemporaryDirectory(prefix="srt_spill_")
+            self._spill_dir = self._owned_tmp.name
+        os.makedirs(self._spill_dir, exist_ok=True)
+        return self._spill_dir
+
+    # ── registration ────────────────────────────────────────────────────
+    def register(
+        self, batch: DeviceBatch, priority: int = SpillPriorities.WORKING
+    ) -> SpillableBatch:
+        """Take ownership of a device batch, making it spillable."""
+        size = batch.size_bytes()
+        with self._lock:
+            buf = _Buffer(self._next_id, size, priority)
+            self._next_id += 1
+            buf.device = batch
+            self._buffers[buf.id] = buf
+            self.device_bytes += size
+        return SpillableBatch(self, buf.id, batch.schema, size)
+
+    # ── acquire / remove ────────────────────────────────────────────────
+    def _acquire_device(self, buf_id: int) -> DeviceBatch:
+        with self._lock:
+            buf = self._buffers[buf_id]
+            buf.pinned = True
+            if buf.tier == StorageTier.DEVICE:
+                return buf.device
+            if buf.tier == StorageTier.DISK:
+                self._disk_to_host(buf)
+            # HOST → DEVICE
+            leaves = buf.host
+            batch = jax.tree_util.tree_unflatten(buf.aux, [
+                None if a is None else jax.numpy.asarray(a) for a in leaves
+            ])
+            buf.device = batch
+            buf.host = None
+            buf.tier = StorageTier.DEVICE
+            self.host_bytes -= buf.size
+            self.device_bytes += buf.size
+            return batch
+
+    def _unpin(self, buf_id: int):
+        with self._lock:
+            buf = self._buffers.get(buf_id)
+            if buf is not None:
+                buf.pinned = False
+
+    def _remove(self, buf_id: int):
+        with self._lock:
+            buf = self._buffers.pop(buf_id, None)
+            if buf is None:
+                return
+            if buf.tier == StorageTier.DEVICE:
+                self.device_bytes -= buf.size
+            elif buf.tier == StorageTier.HOST:
+                self.host_bytes -= buf.size
+            else:
+                self.disk_bytes -= buf.size
+                if buf.path and os.path.exists(buf.path):
+                    os.unlink(buf.path)
+
+    # ── spilling ────────────────────────────────────────────────────────
+    def _device_to_host(self, buf: _Buffer):
+        leaves, aux = jax.tree_util.tree_flatten(buf.device)
+        host_leaves = jax.device_get(leaves)
+        buf.host = host_leaves
+        buf.aux = aux
+        buf.device = None
+        buf.tier = StorageTier.HOST
+        self.device_bytes -= buf.size
+        self.host_bytes += buf.size
+        self.spill_count += 1
+
+    def _host_to_disk(self, buf: _Buffer):
+        path = os.path.join(self._dir(), f"buf{buf.id}.npz")
+        arrays = {f"a{i}": (np.zeros(0) if a is None else np.asarray(a))
+                  for i, a in enumerate(buf.host)}
+        nones = [i for i, a in enumerate(buf.host) if a is None]
+        np.savez(path, __none_idx=np.asarray(nones, dtype=np.int64), **arrays)
+        buf.path = path
+        buf.host = None
+        buf.tier = StorageTier.DISK
+        self.host_bytes -= buf.size
+        self.disk_bytes += buf.size
+        self.spill_count += 1
+
+    def _disk_to_host(self, buf: _Buffer):
+        with np.load(buf.path) as z:
+            nones = set(z["__none_idx"].tolist())
+            n = len([k for k in z.files if k.startswith("a")])
+            buf.host = [None if i in nones else z[f"a{i}"] for i in range(n)]
+        os.unlink(buf.path)
+        buf.path = None
+        buf.tier = StorageTier.HOST
+        self.disk_bytes -= buf.size
+        self.host_bytes += buf.size
+
+    def _spill_order(self, tier: int) -> list[_Buffer]:
+        """Lowest priority first, then largest (frees most per spill).
+        Pinned (acquired, in-use) buffers are never candidates."""
+        bufs = [b for b in self._buffers.values() if b.tier == tier and not b.pinned]
+        bufs.sort(key=lambda b: (b.priority, -b.size))
+        return bufs
+
+    def synchronous_spill(self, target_bytes: int) -> int:
+        """Move device buffers down-tier until >= target_bytes freed from the
+        device (RapidsBufferStore.synchronousSpill). Returns bytes freed."""
+        freed = 0
+        with self._lock:
+            for buf in self._spill_order(StorageTier.DEVICE):
+                if freed >= target_bytes:
+                    break
+                self._device_to_host(buf)
+                freed += buf.size
+            # overflow host tier to disk
+            if self.host_bytes > self.host_limit:
+                for buf in self._spill_order(StorageTier.HOST):
+                    if self.host_bytes <= self.host_limit:
+                        break
+                    self._host_to_disk(buf)
+        return freed
+
+    def ensure_headroom(self, want_bytes: int):
+        """Proactive admission: spill until want_bytes fits under the device
+        pool budget (DeviceMemoryEventHandler, but ahead of the allocation)."""
+        if self.device_limit is None:
+            return
+        with self._lock:
+            excess = self.device_bytes + want_bytes - self.device_limit
+            if excess > 0:
+                self.synchronous_spill(excess)
+
+    def stats(self) -> dict:
+        return {
+            "device_bytes": self.device_bytes,
+            "host_bytes": self.host_bytes,
+            "disk_bytes": self.disk_bytes,
+            "buffers": len(self._buffers),
+            "spill_count": self.spill_count,
+        }
+
+
+def with_oom_retry(catalog: Optional[BufferCatalog], fn: Callable, *args, retries: int = 2):
+    """Run device work; on XLA RESOURCE_EXHAUSTED spill everything spillable
+    and retry (DeviceMemoryEventHandler.scala:42-69 RMM-callback analogue,
+    relocated to the launch site because PJRT has no alloc callback)."""
+    attempt = 0
+    while True:
+        try:
+            return fn(*args)
+        except Exception as e:  # XlaRuntimeError lives in jaxlib; match by text
+            if catalog is None or not _is_oom(e) or attempt >= retries:
+                raise
+            attempt += 1
+            catalog.synchronous_spill(catalog.device_bytes)

@@ -57,9 +57,14 @@ class ExecContext:
         self.conf = conf
         self.session = session
         from ..mem.semaphore import DeviceSemaphore
+        from ..mem.spill import BufferCatalog
         from .. import config as cfg
 
         self.semaphore = DeviceSemaphore(cfg.CONCURRENT_TPU_TASKS.get(conf))
+        self.catalog = BufferCatalog.from_conf(conf)
+        limit = cfg.DEVICE_POOL_LIMIT.get(conf)
+        if limit > 0:
+            self.catalog.device_limit = limit
 
 
 class PartitionSet:

@@ -1,0 +1,147 @@
+"""Spill framework tests — the RapidsBufferCatalogSuite / RapidsDeviceMemory
+StoreSuite / RapidsDiskStoreSuite analogues (SURVEY.md §4 tier walks, spill,
+accounting), plus the out-of-core sort path (GpuSortExec.scala:212)."""
+from __future__ import annotations
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu.columnar.device import host_to_device, device_to_host
+from spark_rapids_tpu.mem.spill import (
+    BufferCatalog,
+    SpillPriorities,
+    StorageTier,
+    with_oom_retry,
+)
+
+from harness import assert_cpu_and_tpu_equal
+
+
+def _batch(n=100, seed=0):
+    rng = np.random.default_rng(seed)
+    rb = pa.record_batch(
+        {
+            "a": pa.array(rng.integers(0, 1000, n).astype(np.int64)),
+            "s": pa.array([f"val{i % 17}" for i in range(n)]),
+        }
+    )
+    return host_to_device(rb)
+
+
+def _rows(db):
+    rb = device_to_host(db)
+    return [tuple(c[i].as_py() for c in rb.columns) for i in range(rb.num_rows)]
+
+
+def test_register_acquire_roundtrip():
+    cat = BufferCatalog()
+    db = _batch()
+    want = _rows(db)
+    handle = cat.register(db)
+    assert cat.device_bytes == handle.size_bytes > 0
+    got = handle.get_batch()
+    assert _rows(got) == want
+    handle.close()
+    assert cat.device_bytes == 0 and cat.stats()["buffers"] == 0
+
+
+def test_tier_walk_device_host_disk(tmp_path):
+    cat = BufferCatalog(spill_dir=str(tmp_path))
+    db = _batch()
+    want = _rows(db)
+    h = cat.register(db)
+    freed = cat.synchronous_spill(h.size_bytes)
+    assert freed >= h.size_bytes
+    assert cat.device_bytes == 0 and cat.host_bytes == h.size_bytes
+    # force host → disk by shrinking the host limit
+    cat.host_limit = 0
+    cat.synchronous_spill(0)
+    assert cat.host_bytes == 0 and cat.disk_bytes == h.size_bytes
+    assert len(list(tmp_path.iterdir())) == 1
+    # re-materialize from disk
+    got = h.get_batch()
+    assert _rows(got) == want
+    assert cat.device_bytes == h.size_bytes and cat.disk_bytes == 0
+    assert len(list(tmp_path.iterdir())) == 0
+    h.close()
+
+
+def test_spill_priority_order():
+    cat = BufferCatalog()
+    low = cat.register(_batch(seed=1), SpillPriorities.INPUT_FROM_SHUFFLE)
+    high = cat.register(_batch(seed=2), SpillPriorities.OUTPUT_FOR_SHUFFLE)
+    cat.synchronous_spill(1)  # one spill's worth: must pick the low band
+    assert cat.spill_count == 1
+    # low-priority one moved; high-priority stayed on device
+    assert cat._buffers[low.id].tier == StorageTier.HOST
+    assert cat._buffers[high.id].tier == StorageTier.DEVICE
+    low.close(), high.close()
+
+
+def test_pinned_buffer_not_spilled():
+    cat = BufferCatalog()
+    pinned = cat.register(_batch(seed=1))
+    other = cat.register(_batch(seed=2))
+    _ = pinned.get_batch()  # pins
+    cat.synchronous_spill(cat.device_bytes)
+    assert cat._buffers[pinned.id].tier == StorageTier.DEVICE
+    assert cat._buffers[other.id].tier == StorageTier.HOST
+    pinned.unpin()
+    cat.synchronous_spill(cat.device_bytes)
+    assert cat._buffers[pinned.id].tier == StorageTier.HOST
+    pinned.close(), other.close()
+
+
+def test_ensure_headroom_proactive_spill():
+    cat = BufferCatalog()
+    h1 = cat.register(_batch(seed=1))
+    cat.device_limit = cat.device_bytes  # pool exactly full
+    cat.ensure_headroom(1)  # need 1 more byte → must spill something
+    assert cat.device_bytes == 0 and cat.host_bytes == h1.size_bytes
+    h1.close()
+
+
+def test_oom_retry_spills_and_retries():
+    cat = BufferCatalog()
+    h = cat.register(_batch())
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise RuntimeError("RESOURCE_EXHAUSTED: Out of memory allocating X")
+        return 42
+
+    assert with_oom_retry(cat, flaky) == 42
+    assert calls["n"] == 2 and cat.spill_count == 1  # spilled between tries
+    h.close()
+
+
+def test_oom_retry_reraises_non_oom():
+    cat = BufferCatalog()
+    with pytest.raises(ValueError):
+        with_oom_retry(cat, lambda: (_ for _ in ()).throw(ValueError("boom")))
+
+
+def test_out_of_core_sort_matches_cpu():
+    # Tiny threshold forces the spillable-run merge path over many batches.
+    conf = {
+        "spark.rapids.tpu.sort.outOfCoreThresholdBytes": "1",
+        "spark.rapids.sql.batchSizeRows": "64",
+    }
+    rng = np.random.default_rng(7)
+    n = 1000
+    data = pa.table(
+        {
+            "k": pa.array(rng.integers(-500, 500, n).astype(np.int64)),
+            "v": pa.array(rng.random(n)),
+            "s": pa.array([f"s{int(x)}" for x in rng.integers(0, 50, n)]),
+        }
+    )
+
+    def q(spark):
+        df = spark.create_dataframe(data, num_partitions=5)
+        return df.sort("k", "s")
+
+    assert_cpu_and_tpu_equal(q, conf=conf, sort_result=False)
