@@ -171,52 +171,135 @@ class CpuCoalescePartitionsExec(Exec):
 
 
 class CpuShuffleExchangeExec(Exec):
-    """Hash-partitioned exchange (CPU path): murmur3(keys) pmod n.
+    """Partitioned exchange (CPU path) over the four partitionings:
+    hash (murmur3 pmod n), range (sampled radix-word bounds), round-robin,
+    single — GpuShuffleExchangeExec + the GpuPartitioning impls (§1 L6)."""
 
-    Reference: GpuShuffleExchangeExec + GpuHashPartitioning (murmur3 on
-    device); here the CPU engine's oracle equivalent, one stage barrier.
-    """
-
-    def __init__(self, keys: List[Expression], num_partitions: int, child: Exec):
+    def __init__(self, partitioning, child: Exec):
         super().__init__([child])
-        self.keys = [bind(k, child.output) for k in keys]
-        self.num_partitions = num_partitions
+        self.partitioning = _bind_partitioning(partitioning, child.output)
+
+    @property
+    def num_partitions(self) -> int:
+        return self.partitioning.num_partitions
 
     @property
     def output(self) -> Schema:
         return self.children[0].output
 
+    def _np_word_groups(self, rb: pa.RecordBatch, schema: Schema):
+        from ..ops.sortkeys import np_column_radix_words
+
+        c = _cpu_ctx(rb, schema)
+        groups = []
+        for o in self.partitioning.order:
+            d, v = _val_to_np(c, o.child.eval(c))
+            groups.append(
+                np_column_radix_words(
+                    o.child.data_type, d, v, None, o.ascending, o.resolved_nulls_first()
+                )
+            )
+        return groups
+
     def execute(self, ctx: ExecContext) -> PartitionSet:
+        from ..plan.partitioning import (
+            SAMPLE_PER_BATCH,
+            HashPartitioning,
+            RangePartitioning,
+            RoundRobinPartitioning,
+            compute_range_bounds,
+            words_partition_ids,
+        )
+
         schema = self.children[0].output
         inputs = self.children[0].execute(ctx)
-        buckets: list[list[pa.RecordBatch]] = [[] for _ in range(self.num_partitions)]
-        for thunk in inputs.parts:
-            for rb in thunk():
-                if rb.num_rows == 0:
-                    continue
-                if not self.keys:
-                    buckets[0].append(rb)  # single partition
-                    continue
-                c = _cpu_ctx(rb, schema)
-                cols = []
-                for k in self.keys:
-                    v = k.eval(c)
-                    d, val = _val_to_np(c, v)
-                    cols.append((k.data_type, d, val, None))
-                h = murmur3_rows(np, cols, rb.num_rows)
-                pids = partition_ids(np, h, self.num_partitions)
-                for p in range(self.num_partitions):
-                    mask = pids == p
-                    if mask.any():
-                        buckets[p].append(rb.filter(pa.array(mask)))
+        nparts = self.num_partitions
+        part = self.partitioning
+        buckets: list[list[pa.RecordBatch]] = [[] for _ in range(nparts)]
+
+        def scatter(rb, pids):
+            for p in range(nparts):
+                mask = pids == p
+                if mask.any():
+                    buckets[p].append(rb.filter(pa.array(mask)))
+
+        if isinstance(part, RangePartitioning):
+            from ..plan.partitioning import align_word_groups
+
+            batches, group_lists = [], []
+            for thunk in inputs.parts:
+                for rb in thunk():
+                    if rb.num_rows == 0:
+                        continue
+                    batches.append(rb)
+                    group_lists.append(self._np_word_groups(rb, schema))
+            # align per-batch string word counts (see align_word_groups)
+            all_words = align_word_groups(group_lists, part.order, np)
+            samples = []
+            for rb, words in zip(batches, all_words):
+                idx = np.arange(0, rb.num_rows, max(1, rb.num_rows // SAMPLE_PER_BATCH))
+                samples.append([w[idx] for w in words])
+            bounds = None
+            if samples:
+                sample_words = [
+                    np.concatenate([s[i] for s in samples]) for i in range(len(samples[0]))
+                ]
+                bounds = compute_range_bounds(sample_words, nparts)
+            for rb, words in zip(batches, all_words):
+                if bounds is None:
+                    buckets[0].append(rb)
+                else:
+                    scatter(rb, words_partition_ids(np, words, bounds))
+        else:
+            for pi, thunk in enumerate(inputs.parts):
+                offset = 0
+                for rb in thunk():
+                    if rb.num_rows == 0:
+                        continue
+                    if isinstance(part, HashPartitioning) and part.keys:
+                        c = _cpu_ctx(rb, schema)
+                        cols = []
+                        for k in part.keys:
+                            d, val = _val_to_np(c, k.eval(c))
+                            cols.append((k.data_type, d, val, None))
+                        h = murmur3_rows(np, cols, rb.num_rows)
+                        scatter(rb, partition_ids(np, h, nparts))
+                    elif isinstance(part, RoundRobinPartitioning):
+                        # deterministic start per input partition (the
+                        # reference seeds with the partition index)
+                        pids = (pi + offset + np.arange(rb.num_rows)) % nparts
+                        offset += rb.num_rows
+                        scatter(rb, pids)
+                    else:  # single partition
+                        buckets[0].append(rb)
+
         def make(p):
             def it():
                 yield from buckets[p]
             return it
-        return PartitionSet([make(p) for p in range(self.num_partitions)])
+        return PartitionSet([make(p) for p in range(nparts)])
 
     def node_string(self):
-        return f"CpuShuffleExchange [{', '.join(map(str, self.keys))}] p={self.num_partitions}"
+        return f"CpuShuffleExchange {self.partitioning} p={self.num_partitions}"
+
+
+def _bind_partitioning(part, schema: Schema):
+    """Bind a partitioning's expressions against the child schema."""
+    import dataclasses as _dc
+
+    from ..plan import partitioning as P
+
+    if isinstance(part, P.HashPartitioning):
+        return _dc.replace(part, keys=[bind(k, schema) for k in part.keys])
+    if isinstance(part, P.RangePartitioning):
+        return _dc.replace(
+            part,
+            order=[
+                SortOrder(bind(o.child, schema), o.ascending, o.nulls_first)
+                for o in part.order
+            ],
+        )
+    return part
 
 
 class CpuHashAggregateExec(Exec):

@@ -506,9 +506,12 @@ class TpuSortExec(Exec):
                     a, b = runs[i], runs[i + 1]
 
                     def merge_pair(a=a, b=b):
-                        return _sort(concat_device([a.get_batch(), b.get_batch()]))
+                        # pin the operands FIRST so the headroom pass (and
+                        # any retry-spill) cannot evict what is being merged
+                        ba, bb = a.get_batch(), b.get_batch()
+                        catalog.ensure_headroom(2 * (a.size_bytes + b.size_bytes))
+                        return _sort(concat_device([ba, bb]))
 
-                    catalog.ensure_headroom(2 * (a.size_bytes + b.size_bytes))
                     out = with_oom_retry(catalog, merge_pair)
                     a.close(), b.close()
                     nxt.append(catalog.register(out, SpillPriorities.WORKING))
@@ -667,16 +670,22 @@ class TpuExpandExec(Exec):
 
 
 class TpuShuffleExchangeExec(Exec):
-    """Hash-partitioned exchange with on-device murmur3 bucketing and
-    device-side slicing (GpuHashPartitioning + GpuPartitioning
-    sliceInternalOnGpu analogue). In-process: device batches move between
-    partitions without leaving HBM; the multi-process serializer path lives
-    in shuffle/."""
+    """Partitioned exchange with on-device bucketing and device-side slicing
+    (GpuShuffleExchangeExec + the four GpuPartitioning impls;
+    sliceInternalOnGpu analogue). Hash = murmur3 pmod; range = radix-word
+    compare against host-sampled bounds; round-robin; single. In-process:
+    device batches move between partitions without leaving HBM; the
+    multi-process serializer path lives in shuffle/."""
 
-    def __init__(self, keys: List[Expression], num_partitions: int, child: Exec):
+    def __init__(self, partitioning, child: Exec):
         super().__init__([child])
-        self.keys = [bind(k, child.output) for k in keys]
-        self.num_partitions = num_partitions
+        from .cpu import _bind_partitioning
+
+        self.partitioning = _bind_partitioning(partitioning, child.output)
+
+    @property
+    def num_partitions(self) -> int:
+        return self.partitioning.num_partitions
 
     @property
     def output(self) -> Schema:
@@ -686,14 +695,23 @@ class TpuShuffleExchangeExec(Exec):
     def is_device(self) -> bool:
         return True
 
-    def execute(self, ctx: ExecContext) -> PartitionSet:
-        keys = self.keys
-        nparts = self.num_partitions
+    def _scatter_fns(self, nparts):
+        """Build the jitted kernels for this exchange's partitioning; XLA's
+        own compile cache dedupes retraces across execute() calls."""
+        from ..plan.partitioning import (
+            HashPartitioning,
+            RangePartitioning,
+            RoundRobinPartitioning,
+            words_partition_ids,
+        )
 
-        @functools.lru_cache(maxsize=None)
-        def slicer():
+        part = self.partitioning
+
+        if isinstance(part, HashPartitioning) and part.keys:
+            keys = part.keys
+
             @jax.jit
-            def _slice(batch: DeviceBatch) -> list[DeviceBatch]:
+            def hash_slice(batch: DeviceBatch) -> list[DeviceBatch]:
                 c = Ctx.for_device(batch)
                 cols = []
                 for k in keys:
@@ -706,25 +724,114 @@ class TpuShuffleExchangeExec(Exec):
                     for p in range(nparts)
                 ]
 
-            return _slice
+            return ("hash", hash_slice)
 
+        if isinstance(part, RoundRobinPartitioning):
+
+            @jax.jit
+            def rr_slice(batch: DeviceBatch, start) -> list[DeviceBatch]:
+                pids = (start + jnp.arange(batch.capacity, dtype=jnp.int32)) % nparts
+                return [
+                    compact(batch, (pids == p) & batch.row_mask())
+                    for p in range(nparts)
+                ]
+
+            return ("roundrobin", rr_slice)
+
+        if isinstance(part, RangePartitioning):
+            order = part.order
+
+            def batch_word_groups(batch: DeviceBatch):
+                """Per-order-column radix word lists (aligned later)."""
+                from ..ops.sortkeys import column_radix_words
+
+                c = Ctx.for_device(batch)
+                return [
+                    column_radix_words(
+                        val_to_column(c, o.child.eval(c), o.child.data_type),
+                        o.ascending,
+                        o.resolved_nulls_first(),
+                    )
+                    for o in order
+                ]
+
+            words_jit = jax.jit(batch_word_groups)
+
+            @jax.jit
+            def range_slice(batch: DeviceBatch, words, bounds) -> list[DeviceBatch]:
+                pids = words_partition_ids(jnp, words, bounds)
+                return [
+                    compact(batch, (pids == p) & batch.row_mask())
+                    for p in range(nparts)
+                ]
+
+            return ("range", (words_jit, range_slice))
+
+        return ("single", None)
+
+    def execute(self, ctx: ExecContext) -> PartitionSet:
+        from ..plan.partitioning import SAMPLE_PER_BATCH, compute_range_bounds
+
+        nparts = self.num_partitions
+        kind, fn = self._scatter_fns(nparts)
         child_parts = self.children[0].execute(ctx)
         state = {"buckets": None}
 
         def materialize():
-            if state["buckets"] is None:
-                buckets = [[] for _ in range(nparts)]
-                fn = slicer()
+            if state["buckets"] is not None:
+                return state["buckets"]
+            buckets = [[] for _ in range(nparts)]
+            if kind == "range":
+                from ..plan.partitioning import align_word_groups
+
+                words_jit, range_slice = fn
+                order = self.partitioning.order
+                batches, group_lists = [], []
                 for t in child_parts.parts:
                     for db in t():
-                        if not keys:
-                            buckets[0].append(db)
+                        if db.row_count() == 0:
                             continue
-                        slices = fn(db)
-                        for p in range(nparts):
-                            buckets[p].append(slices[p])
-                state["buckets"] = buckets
-            return state["buckets"]
+                        batches.append(db)
+                        group_lists.append(words_jit(db))
+                # string columns may encode to different word counts per
+                # batch (bucketed widths) — align before sampling/bucketing
+                all_words = align_word_groups(group_lists, order, jnp)
+                del group_lists
+                samples = []
+                for db, words in zip(batches, all_words):
+                    n = db.row_count()
+                    idx = np.arange(0, n, max(1, n // SAMPLE_PER_BATCH))
+                    samples.append([np.asarray(w[:n])[idx] for w in words])
+                bounds = None
+                if samples:
+                    sample_words = [
+                        np.concatenate([s[i] for s in samples])
+                        for i in range(len(samples[0]))
+                    ]
+                    bounds = compute_range_bounds(sample_words, nparts)
+                jb = None if bounds is None else [jnp.asarray(b) for b in bounds]
+                for db, words in zip(batches, all_words):
+                    if jb is None:
+                        buckets[0].append(db)
+                        continue
+                    for p, s in enumerate(range_slice(db, words, jb)):
+                        buckets[p].append(s)
+            else:
+                for pi, t in enumerate(child_parts.parts):
+                    offset = 0
+                    for db in t():
+                        if kind == "hash":
+                            for p, s in enumerate(fn(db)):
+                                buckets[p].append(s)
+                        elif kind == "roundrobin":
+                            start = jnp.asarray((pi + offset) % nparts, jnp.int32)
+                            offset += db.row_count()
+                            for p, s in enumerate(fn(db, start)):
+                                buckets[p].append(s)
+                        else:
+                            buckets[0].append(db)
+            state["buckets"] = buckets
+            return buckets
 
         def make(p):
             def it():
@@ -736,9 +843,7 @@ class TpuShuffleExchangeExec(Exec):
         return PartitionSet([make(p) for p in range(nparts)])
 
     def node_string(self):
-        return (
-            f"TpuShuffleExchange [{', '.join(map(str, self.keys))}] p={self.num_partitions}"
-        )
+        return f"TpuShuffleExchange {self.partitioning} p={self.num_partitions}"
 
 
 class TpuLimitExec(Exec):

@@ -159,8 +159,10 @@ class BufferCatalog:
     def register(
         self, batch: DeviceBatch, priority: int = SpillPriorities.WORKING
     ) -> SpillableBatch:
-        """Take ownership of a device batch, making it spillable."""
+        """Take ownership of a device batch, making it spillable. Admission
+        enforces the device pool budget by spilling older buffers first."""
         size = batch.size_bytes()
+        self.ensure_headroom(size)
         with self._lock:
             buf = _Buffer(self._next_id, size, priority)
             self._next_id += 1

@@ -119,6 +119,51 @@ def sort_permutation(
     return sorted_ops[-1]
 
 
+def np_column_radix_words(
+    dt: DataType,
+    data,
+    valid,
+    lengths=None,
+    ascending: bool = True,
+    nulls_first: bool = True,
+):
+    """Numpy twin of :func:`column_radix_words` for the CPU engine's range
+    partitioner (same word layout; engines never mix word spaces)."""
+    import numpy as np
+
+    valid = np.asarray(valid).astype(bool)
+    one, zero, sign = np.uint64(1), np.uint64(0), np.uint64(1 << 63)
+    vw = np.where(valid, one, zero) if nulls_first else np.where(valid, zero, one)
+    words: list = []
+    if isinstance(dt, StringType):
+        if getattr(data, "ndim", 1) != 2 or lengths is None:
+            from .hash import np_strings_to_padded
+
+            data, lengths = np_strings_to_padded(data, valid)
+        n, w = data.shape
+        nwords = (w + 7) // 8
+        padded = np.zeros((n, nwords * 8), dtype=np.uint8)
+        padded[:, :w] = data
+        d64 = padded.astype(np.uint64).reshape(n, nwords, 8)
+        shifts = np.arange(7, -1, -1, dtype=np.uint64) * np.uint64(8)
+        packed = (d64 << shifts[None, None, :]).sum(axis=-1, dtype=np.uint64)
+        words = [packed[:, k] for k in range(nwords)]
+        words.append(np.asarray(lengths).astype(np.uint64))
+    elif isinstance(dt, BooleanType):
+        words.append(np.asarray(data).astype(np.uint64))
+    elif isinstance(dt, (FloatType, DoubleType)):
+        from ..exec.cpu_kernels import normalized_float_bits
+
+        b = normalized_float_bits(np.asarray(data))
+        words.append(np.where(b < 0, ~b.view(np.uint64), b.view(np.uint64) | sign))
+    else:  # integral / date / timestamp / decimal(int64)
+        words.append((np.asarray(data).astype(np.int64).view(np.uint64)) ^ sign)
+    words = [np.where(valid, wd, zero) for wd in words]
+    if not ascending:
+        words = [~wd for wd in words]
+    return [vw] + words
+
+
 def segment_starts(words: list[jax.Array], row_mask: jax.Array) -> jax.Array:
     """bool[cap]: row i starts a new group (equal radix words ⇔ equal keys).
     Assumes rows already sorted by ``words`` with live rows first."""

@@ -340,7 +340,7 @@ def _conv_sort(e: C.CpuSortExec, ch):
 
 
 def _conv_exchange(e: C.CpuShuffleExchangeExec, ch):
-    return T.TpuShuffleExchangeExec(e.keys, e.num_partitions, ch[0])
+    return T.TpuShuffleExchangeExec(e.partitioning, ch[0])
 
 
 def _conv_union(e: C.CpuUnionExec, ch):
@@ -376,7 +376,7 @@ _rule(
     C.CpuShuffleExchangeExec,
     "ShuffleExchangeExec",
     _conv_exchange,
-    lambda e: e.keys,
+    lambda e: e.partitioning.exprs(),
 )
 _rule(C.CpuUnionExec, "UnionExec", _conv_union, lambda e: [])
 _rule(

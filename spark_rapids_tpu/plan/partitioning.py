@@ -1,0 +1,127 @@
+"""Output partitionings for exchanges — the four ``GpuPartitioning`` impls.
+
+Reference: GpuHashPartitioning.scala (:49-76 murmur3 pmod bucketing on
+device), GpuRangePartitioning.scala + GpuRangePartitioner.scala +
+SamplingUtils.scala (sample rows → CPU-computed bounds → device bucketing),
+GpuRoundRobinPartitioning.scala, GpuSinglePartitioning.scala.
+
+TPU-first range design: rows and sampled bound rows are both encoded to the
+framework's order-preserving uint64 *radix words* (ops/sortkeys.py); a row's
+partition id is the count of bounds lexicographically below it — one fused
+compare kernel on device, no per-type comparators. Bounds are picked on the
+host from an evenly-strided sample of encoded words (the reservoir-sample
+analogue; bounds only shape balance, never results).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional
+
+import numpy as np
+
+from ..expr import Expression
+from .logical import SortOrder
+
+
+@dataclasses.dataclass
+class Partitioning:
+    num_partitions: int
+
+    def exprs(self) -> List[Expression]:
+        return []
+
+
+@dataclasses.dataclass
+class SinglePartitioning(Partitioning):
+    num_partitions: int = 1
+
+
+@dataclasses.dataclass
+class HashPartitioning(Partitioning):
+    keys: List[Expression] = dataclasses.field(default_factory=list)
+
+    def exprs(self) -> List[Expression]:
+        return list(self.keys)
+
+
+@dataclasses.dataclass
+class RoundRobinPartitioning(Partitioning):
+    pass
+
+
+@dataclasses.dataclass
+class RangePartitioning(Partitioning):
+    order: List[SortOrder] = dataclasses.field(default_factory=list)
+
+    def exprs(self) -> List[Expression]:
+        return [o.child for o in self.order]
+
+
+SAMPLE_PER_BATCH = 128  # rows sampled per input batch for range bounds
+
+
+def align_word_groups(per_batch_groups, orders, xp):
+    """Align per-batch radix-word group lists to a common word count.
+
+    String columns encode to a *variable* number of char words (width is
+    bucketed per batch), so two batches of the same column can produce word
+    lists of different lengths. A narrower batch's missing char words are
+    exactly the zero words the wider padding would have produced (all-ones
+    under descending, where value words are complemented), so alignment pads
+    with that constant *before* the trailing length word.
+
+    ``per_batch_groups``: list over batches of per-order-column word lists.
+    Returns a list over batches of flat, aligned word lists.
+    """
+    if not per_batch_groups:
+        return []
+    ncols = len(orders)
+    targets = [
+        max(len(g[ci]) for g in per_batch_groups) for ci in range(ncols)
+    ]
+    out = []
+    for groups in per_batch_groups:
+        flat = []
+        for ci, o in enumerate(orders):
+            g = list(groups[ci])
+            missing = targets[ci] - len(g)
+            if missing:
+                zero = xp.zeros_like(g[0])
+                pad = zero if o.ascending else ~zero
+                g = g[:-1] + [pad] * missing + [g[-1]]
+            flat.extend(g)
+        out.append(flat)
+    return out
+
+
+def compute_range_bounds(
+    sample_words: List[np.ndarray], num_partitions: int
+) -> Optional[List[np.ndarray]]:
+    """Sampled radix words → P-1 bound rows (as word vectors), picked at even
+    quantiles of the lexicographically-sorted sample (GpuRangePartitioner
+    createRangeBounds analogue). Returns None when the sample is empty."""
+    if not sample_words or sample_words[0].size == 0:
+        return None
+    k = sample_words[0].shape[0]
+    order = np.lexsort(tuple(reversed(sample_words)))
+    idx = np.minimum((np.arange(1, num_partitions) * k) // num_partitions, k - 1)
+    return [w[order][idx] for w in sample_words]
+
+
+def words_partition_ids(xp, words, bounds, int32_dtype=None):
+    """pid[i] = #bounds lexicographically < row i's words (row == bound goes
+    left). ``words``: per-row word arrays [cap]; ``bounds``: same-length list
+    of [P-1] arrays. Works for numpy and jax.numpy."""
+    i32 = int32_dtype or xp.int32
+    cap = words[0].shape[0]
+    nb = bounds[0].shape[0]
+    if nb == 0:
+        return xp.zeros(cap, dtype=i32)
+    gt = xp.zeros((cap, nb), dtype=bool)
+    eq = xp.ones((cap, nb), dtype=bool)
+    for w, bw in zip(words, bounds):
+        wv = w[:, None]
+        bv = bw[None, :]
+        gt = gt | (eq & (wv > bv))
+        eq = eq & (wv == bv)
+    return gt.sum(axis=1).astype(i32)

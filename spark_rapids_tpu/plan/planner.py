@@ -31,6 +31,7 @@ from ..exec.cpu import (
     CpuUnionExec,
 )
 from ..plan import logical as L
+from ..plan import partitioning as P
 from ..plan.physical import Exec
 from ..types import Schema
 
@@ -51,7 +52,16 @@ def plan_physical(lp: L.LogicalPlan, conf: TpuConf) -> Exec:
     if isinstance(lp, L.Sort):
         child = plan_physical(lp.child, conf)
         if lp.is_global and _num_partitions_hint(child) != 1:
-            child = CpuCoalescePartitionsExec(child)
+            # Distributed total sort: range-partition on the sort keys, then
+            # sort each partition locally; partition order == global order
+            # (Spark's SortExec + range exchange; GpuRangePartitioning).
+            nparts = cfg.SHUFFLE_PARTITIONS.get(conf)
+            if nparts > 1:
+                child = CpuShuffleExchangeExec(
+                    P.RangePartitioning(nparts, lp.order), child
+                )
+            else:
+                child = CpuCoalescePartitionsExec(child)
         return CpuSortExec(lp.order, child)
     if isinstance(lp, L.Limit):
         # Limit over a global Sort plans as TopN (Spark's
@@ -67,8 +77,11 @@ def plan_physical(lp: L.LogicalPlan, conf: TpuConf) -> Exec:
         return CpuUnionExec([plan_physical(p, conf) for p in lp.plans])
     if isinstance(lp, L.Repartition):
         child = plan_physical(lp.child, conf)
-        keys = lp.exprs or []
-        return CpuShuffleExchangeExec(keys, lp.num_partitions, child)
+        if lp.exprs:
+            part = P.HashPartitioning(lp.num_partitions, lp.exprs)
+        else:
+            part = P.RoundRobinPartitioning(lp.num_partitions)
+        return CpuShuffleExchangeExec(part, child)
     if isinstance(lp, L.Join):
         return _plan_join(lp, conf)
     raise NotImplementedError(f"no physical plan for {type(lp).__name__}")
@@ -161,8 +174,10 @@ def _plan_aggregate(lp: L.Aggregate, conf: TpuConf) -> Exec:
     nparts = cfg.SHUFFLE_PARTITIONS.get(conf)
     if bound_grouping:
         exchange = CpuShuffleExchangeExec(
-            [UnresolvedAttribute(f"key{i}") for i in range(len(bound_grouping))],
-            nparts,
+            P.HashPartitioning(
+                nparts,
+                [UnresolvedAttribute(f"key{i}") for i in range(len(bound_grouping))],
+            ),
             partial,
         )
     else:
@@ -183,8 +198,8 @@ def _plan_join(lp: L.Join, conf: TpuConf) -> Exec:
     right = plan_physical(lp.right, conf)
     nparts = cfg.SHUFFLE_PARTITIONS.get(conf)
     if lp.left_keys:
-        lex = CpuShuffleExchangeExec(lp.left_keys, nparts, left)
-        rex = CpuShuffleExchangeExec(lp.right_keys, nparts, right)
+        lex = CpuShuffleExchangeExec(P.HashPartitioning(nparts, lp.left_keys), left)
+        rex = CpuShuffleExchangeExec(P.HashPartitioning(nparts, lp.right_keys), right)
         drop = [output_name(k) for k in lp.right_keys] if lp.using else None
         return CpuShuffledHashJoinExec(
             lp.join_type, lp.left_keys, lp.right_keys, lp.residual, lex, rex, drop

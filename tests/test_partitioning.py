@@ -1,0 +1,109 @@
+"""Partitioning tests — GpuPartitioningSuite analogue (SURVEY.md §4):
+hash/range/round-robin/single bucketing on device vs the CPU oracle, plus
+distribution properties the results-comparison can't see."""
+from __future__ import annotations
+
+import numpy as np
+import pyarrow as pa
+
+from harness import assert_cpu_and_tpu_equal, tpu_session
+
+from spark_rapids_tpu.plan.partitioning import (
+    compute_range_bounds,
+    words_partition_ids,
+)
+
+
+def _table(n=500, seed=11):
+    rng = np.random.default_rng(seed)
+    return pa.table(
+        {
+            "k": pa.array(rng.integers(-50, 50, n).astype(np.int64)),
+            "v": pa.array(rng.random(n)),
+            "s": pa.array([f"g{int(x)}" for x in rng.integers(0, 30, n)]),
+        }
+    )
+
+
+def test_round_robin_repartition_preserves_rows():
+    t = _table()
+    assert_cpu_and_tpu_equal(
+        lambda s: s.create_dataframe(t, num_partitions=3).repartition(5),
+    )
+
+
+def test_hash_repartition_by_key():
+    t = _table()
+    assert_cpu_and_tpu_equal(
+        lambda s: s.create_dataframe(t, num_partitions=3).repartition(4, "k"),
+    )
+
+
+def test_global_sort_via_range_partitioning_multi_partition():
+    t = _table(n=2000)
+    assert_cpu_and_tpu_equal(
+        lambda s: s.create_dataframe(t, num_partitions=4).sort(
+            "k", "v", ascending=[False, True]
+        ),
+        sort_result=False,
+        conf={"spark.sql.shuffle.partitions": "6"},
+    )
+
+
+def test_global_sort_strings_desc_nulls():
+    vals = ["zeta", None, "alpha", "beta", None, "omega", "a", "zz", ""] * 30
+    t = pa.table({"s": pa.array(vals), "i": pa.array(range(len(vals)))})
+    assert_cpu_and_tpu_equal(
+        lambda s: s.create_dataframe(t, num_partitions=3).sort(
+            "s", "i", ascending=[False, True]
+        ),
+        sort_result=False,
+        conf={"spark.sql.shuffle.partitions": "4"},
+    )
+
+
+def test_round_robin_spreads_rows():
+    # distribution property on the device engine: buckets are balanced
+    from spark_rapids_tpu.config import TpuConf
+    from spark_rapids_tpu.exec.tpu import HostToDeviceExec, TpuShuffleExchangeExec
+    from spark_rapids_tpu.exec.cpu import CpuScanExec
+    from spark_rapids_tpu.plan.partitioning import RoundRobinPartitioning
+    from spark_rapids_tpu.plan.physical import ExecContext
+
+    from spark_rapids_tpu.types import Schema
+
+    t = _table(n=400)
+    scan = CpuScanExec(t, Schema.from_arrow(t.schema), 2)
+    ex = TpuShuffleExchangeExec(
+        RoundRobinPartitioning(4), HostToDeviceExec(scan)
+    )
+    parts = ex.execute(ExecContext(TpuConf({}))).materialize()
+    sizes = [sum(db.row_count() for db in p) for p in parts]
+    assert sum(sizes) == 400
+    assert min(sizes) >= 90 and max(sizes) <= 110  # ~100 each
+
+
+def test_range_partition_mixed_string_widths():
+    # regression: batches whose string columns pad to different device widths
+    # must still range-partition monotonically (word-count alignment)
+    short = [f"s{i % 7}" for i in range(300)]            # <= 8 bytes, 1 word
+    long_ = [f"long-string-{i % 13:04d}" for i in range(300)]  # > 8, 2+ words
+    t = pa.table({"s": pa.array(short + long_), "i": pa.array(range(600))})
+    assert_cpu_and_tpu_equal(
+        lambda s: s.create_dataframe(t, num_partitions=2).sort("s", "i"),
+        sort_result=False,
+        conf={"spark.sql.shuffle.partitions": "4"},
+    )
+
+
+def test_range_bounds_quantiles():
+    words = [np.asarray([5, 1, 9, 3, 7, 2, 8, 4, 6, 0], dtype=np.uint64)]
+    bounds = compute_range_bounds(words, 4)
+    assert [int(b) for b in bounds[0]] == [2, 5, 7]
+    pids = words_partition_ids(np, words, bounds)
+    # rows <= 2 -> 0, <= 5 -> 1, <= 7 -> 2, else 3
+    assert pids.tolist() == [1, 0, 3, 1, 2, 0, 3, 1, 2, 0]
+
+
+def test_range_bounds_empty_sample():
+    assert compute_range_bounds([np.zeros(0, dtype=np.uint64)], 4) is None
