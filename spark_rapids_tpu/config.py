@@ -227,6 +227,11 @@ DEVICE_POOL_LIMIT = conf("spark.rapids.tpu.memory.deviceLimitBytes").doc(
     "(reference: RMM pool size via spark.rapids.memory.gpu.allocFraction)."
 ).bytes_conf(0)
 
+AUTO_BROADCAST_THRESHOLD = conf("spark.sql.autoBroadcastJoinThreshold").doc(
+    "Maximum estimated build-side size for which a join is planned as a "
+    "broadcast hash join (Spark's key, honored here; -1 disables)."
+).bytes_conf(10 << 20)
+
 OUT_OF_CORE_SORT_THRESHOLD = conf("spark.rapids.tpu.sort.outOfCoreThresholdBytes").doc(
     "Partition size above which TpuSortExec switches from single-batch sort "
     "to spillable sorted-run merge (reference: GpuSortExec.scala:212 "

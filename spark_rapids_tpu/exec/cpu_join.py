@@ -77,18 +77,12 @@ class CpuShuffledHashJoinExec(Exec):
         self._schema = self._compute_schema()
 
     def _compute_schema(self) -> Schema:
-        import dataclasses as dc
+        from ..ops.join import join_output_schema
 
         left, right = self.children
-        lt = list(left.output.fields)
-        rt = [f for f in right.output.fields if f.name not in self.drop_right_keys]
-        if self.join_type in ("left_semi", "left_anti"):
-            return Schema(lt)
-        if self.join_type in ("left", "full"):
-            rt = [dc.replace(f, nullable=True) for f in rt]
-        if self.join_type in ("right", "full"):
-            lt = [dc.replace(f, nullable=True) for f in lt]
-        return Schema(lt + rt)
+        return join_output_schema(
+            self.join_type, left.output.fields, right.output.fields, self.drop_right_keys
+        )
 
     @property
     def output(self) -> Schema:
@@ -212,6 +206,65 @@ class CpuShuffledHashJoinExec(Exec):
         return f"CpuShuffledHashJoin {self.join_type} [{', '.join(map(str, self.left_keys))}] [{', '.join(map(str, self.right_keys))}]"
 
 
+class CpuBroadcastExchangeExec(Exec):
+    """Collect the build side once into a single batch shared by every join
+    task (GpuBroadcastExchangeExecBase; the JVM-broadcast step collapses to
+    an in-process cached batch)."""
+
+    def __init__(self, child: Exec):
+        super().__init__([child])
+        self._cache = None
+
+    @property
+    def output(self) -> Schema:
+        return self.children[0].output
+
+    def broadcast_batch(self, ctx: ExecContext) -> pa.RecordBatch:
+        if self._cache is None:
+            schema = self.children[0].output
+            parts = self.children[0].execute(ctx)
+            self._cache = concat_batches(
+                schema, [b for t in parts.parts for b in t()]
+            )
+        return self._cache
+
+    def execute(self, ctx: ExecContext) -> PartitionSet:
+        def it():
+            yield self.broadcast_batch(ctx)
+
+        return PartitionSet([it])
+
+    def node_string(self):
+        return "CpuBroadcastExchange"
+
+
+class CpuBroadcastHashJoinExec(CpuShuffledHashJoinExec):
+    """Hash join against a broadcast build side: the stream (left) keeps its
+    partitioning, every partition joins the same build batch
+    (GpuBroadcastHashJoinExec shims)."""
+
+    def execute(self, ctx: ExecContext) -> PartitionSet:
+        left, right = self.children
+        lparts = left.execute(ctx)
+        lschema = left.output
+        assert isinstance(right, CpuBroadcastExchangeExec)
+
+        def make(lt):
+            def it():
+                lrb = concat_batches(lschema, list(lt()))
+                yield self._join_partition(lrb, right.broadcast_batch(ctx))
+
+            return it
+
+        return PartitionSet([make(lt) for lt in lparts.parts])
+
+    def node_string(self):
+        return (
+            f"CpuBroadcastHashJoin {self.join_type} "
+            f"[{', '.join(map(str, self.left_keys))}]"
+        )
+
+
 class CpuNestedLoopJoinExec(Exec):
     """Cross/conditional join (GpuBroadcastNestedLoopJoinExec analogue)."""
 
@@ -219,15 +272,11 @@ class CpuNestedLoopJoinExec(Exec):
         super().__init__([left, right])
         self.join_type = join_type
         self.condition = condition
-        import dataclasses as dc
+        from ..ops.join import join_output_schema
 
-        lt = list(left.output.fields)
-        rt = list(right.output.fields)
-        if join_type in ("left", "full"):
-            rt = [dc.replace(f, nullable=True) for f in rt]
-        if join_type in ("right", "full"):
-            lt = [dc.replace(f, nullable=True) for f in lt]
-        self._schema = Schema(lt + rt)
+        self._schema = join_output_schema(
+            join_type, left.output.fields, right.output.fields
+        )
 
     @property
     def output(self) -> Schema:
@@ -238,6 +287,7 @@ class CpuNestedLoopJoinExec(Exec):
         lschema, rschema = left.output, right.output
         lparts = left.execute(ctx)
         rparts = right.execute(ctx)
+        jt = self.join_type
 
         def it():
             lrb = concat_batches(lschema, [b for t in lparts.parts for b in t()])
@@ -245,16 +295,49 @@ class CpuNestedLoopJoinExec(Exec):
             nl, nr = lrb.num_rows, rrb.num_rows
             li = np.repeat(np.arange(nl, dtype=np.int64), nr)
             ri = np.tile(np.arange(nr, dtype=np.int64), nl)
+            pair_schema = Schema(list(lschema.fields) + list(rschema.fields))
             arrays = [lrb.column(i).take(pa.array(li)) for i in range(lrb.num_columns)]
             arrays += [rrb.column(i).take(pa.array(ri)) for i in range(rrb.num_columns)]
-            out = pa.RecordBatch.from_arrays(arrays, schema=self._schema.to_arrow())
+            pairs = pa.RecordBatch.from_arrays(arrays, schema=pair_schema.to_arrow())
             if self.condition is not None:
-                rs = Schema(list(lschema.fields) + list(rschema.fields))
-                c = _cpu_ctx(out, rs)
-                cond = bind(self.condition, rs)
+                c = _cpu_ctx(pairs, pair_schema)
+                cond = bind(self.condition, pair_schema)
                 d, v = _val_to_np(c, cond.eval(c))
-                out = out.filter(pa.array(d.astype(bool) & v))
-            yield out
+                keep = d.astype(bool) & v
+            else:
+                keep = np.ones(nl * nr, dtype=bool)
+            matched_l = keep.reshape(nl, nr).any(axis=1) if nl and nr else np.zeros(nl, bool)
+            matched_r = keep.reshape(nl, nr).any(axis=0) if nl and nr else np.zeros(nr, bool)
+            if jt in ("left_semi", "left_anti"):
+                mask = matched_l if jt == "left_semi" else ~matched_l
+                yield lrb.filter(pa.array(mask))
+                return
+            matched = pairs.filter(pa.array(keep))
+            blocks = [
+                pa.RecordBatch.from_arrays(
+                    [matched.column(i) for i in range(matched.num_columns)],
+                    schema=self._schema.to_arrow(),
+                )
+            ]
+            if jt in ("left", "full") and (~matched_l).any():
+                lsub = lrb.filter(pa.array(~matched_l))
+                blocks.append(
+                    pa.RecordBatch.from_arrays(
+                        [lsub.column(i) for i in range(lsub.num_columns)]
+                        + [pa.nulls(lsub.num_rows, f.data_type.to_arrow()) for f in rschema],
+                        schema=self._schema.to_arrow(),
+                    )
+                )
+            if jt in ("right", "full") and (~matched_r).any():
+                rsub = rrb.filter(pa.array(~matched_r))
+                blocks.append(
+                    pa.RecordBatch.from_arrays(
+                        [pa.nulls(rsub.num_rows, f.data_type.to_arrow()) for f in lschema]
+                        + [rsub.column(i) for i in range(rsub.num_columns)],
+                        schema=self._schema.to_arrow(),
+                    )
+                )
+            yield from blocks
 
         return PartitionSet([it])
 

@@ -10,7 +10,6 @@ first touch of the device per partition-task (GpuSemaphore protocol).
 """
 from __future__ import annotations
 
-import functools
 from typing import Iterator, List, Optional
 
 import jax
@@ -770,10 +769,12 @@ class TpuShuffleExchangeExec(Exec):
         return ("single", None)
 
     def execute(self, ctx: ExecContext) -> PartitionSet:
+        from ..mem.spill import with_oom_retry
         from ..plan.partitioning import SAMPLE_PER_BATCH, compute_range_bounds
 
         nparts = self.num_partitions
         kind, fn = self._scatter_fns(nparts)
+        catalog = ctx.catalog
         child_parts = self.children[0].execute(ctx)
         state = {"buckets": None}
 
@@ -792,7 +793,7 @@ class TpuShuffleExchangeExec(Exec):
                         if db.row_count() == 0:
                             continue
                         batches.append(db)
-                        group_lists.append(words_jit(db))
+                        group_lists.append(with_oom_retry(catalog, words_jit, db))
                 # string columns may encode to different word counts per
                 # batch (bucketed widths) — align before sampling/bucketing
                 all_words = align_word_groups(group_lists, order, jnp)
@@ -814,19 +815,23 @@ class TpuShuffleExchangeExec(Exec):
                     if jb is None:
                         buckets[0].append(db)
                         continue
-                    for p, s in enumerate(range_slice(db, words, jb)):
+                    for p, s in enumerate(
+                        with_oom_retry(catalog, range_slice, db, words, jb)
+                    ):
                         buckets[p].append(s)
             else:
                 for pi, t in enumerate(child_parts.parts):
                     offset = 0
                     for db in t():
                         if kind == "hash":
-                            for p, s in enumerate(fn(db)):
+                            for p, s in enumerate(with_oom_retry(catalog, fn, db)):
                                 buckets[p].append(s)
                         elif kind == "roundrobin":
                             start = jnp.asarray((pi + offset) % nparts, jnp.int32)
                             offset += db.row_count()
-                            for p, s in enumerate(fn(db, start)):
+                            for p, s in enumerate(
+                                with_oom_retry(catalog, fn, db, start)
+                            ):
                                 buckets[p].append(s)
                         else:
                             buckets[0].append(db)

@@ -20,7 +20,7 @@ from ..expr import Expression, bind
 from ..expr.base import Ctx, Val
 from ..ops.concat import concat_device
 from ..ops.gather import compact, gather_column
-from ..ops.join import gather_pairs, join_bounds, pad_string_column
+from ..ops.join import gather_pairs, join_bounds, join_output_schema, pad_string_column
 from ..plan.physical import Exec, ExecContext, PartitionSet
 from ..types import Schema, StringType, StructField
 from .tpu import val_to_column
@@ -47,15 +47,9 @@ class TpuShuffledHashJoinExec(Exec):
 
     def _compute_schema(self) -> Schema:
         left, right = self.children
-        lt = list(left.output.fields)
-        rt = [f for f in right.output.fields if f.name not in self.drop_right_keys]
-        if self.join_type in ("left_semi", "left_anti"):
-            return Schema(lt)
-        if self.join_type in ("left", "full"):
-            rt = [dc.replace(f, nullable=True) for f in rt]
-        if self.join_type in ("right", "full"):
-            lt = [dc.replace(f, nullable=True) for f in lt]
-        return Schema(lt + rt)
+        return join_output_schema(
+            self.join_type, left.output.fields, right.output.fields, self.drop_right_keys
+        )
 
     @property
     def output(self) -> Schema:
@@ -154,13 +148,16 @@ class TpuShuffledHashJoinExec(Exec):
                 )
                 return compact(probe, want), probe_matched, build_matched
             cols = lcols + rcols
+            # num_rows = full capacity: live pairs are scattered across the
+            # pair grid, so compact must see every slot (its keep mask is
+            # intersected with row_mask)
             out = DeviceBatch(
                 out_schema,
                 [
                     DeviceColumn(c.dtype, c.data, c.validity & live, c.lengths)
                     for c in cols
                 ],
-                live.sum().astype(jnp.int32),
+                jnp.asarray(out_cap, jnp.int32),
             )
             out = compact(out, live)
             return out, probe_matched, build_matched
@@ -169,21 +166,19 @@ class TpuShuffledHashJoinExec(Exec):
 
     def _null_extend(self, batch: DeviceBatch, keep: jax.Array, side: str) -> DeviceBatch:
         """Rows of one side with the other side's columns as NULLs."""
-        sub = compact(batch, keep)
-        cap = sub.capacity
         left_exec, right_exec = self.children
         right_fields = [
             f for f in right_exec.output.fields if f.name not in self.drop_right_keys
         ]
-        if side == "left":  # left rows + null right
-            cols = list(sub.columns)
-            for f in right_fields:
-                cols.append(_null_column(f, cap))
-        else:  # null left + right rows (sub has full right schema)
-            cols = [_null_column(f, cap) for f in left_exec.output.fields]
-            for i in self._right_ordinals():
-                cols.append(sub.columns[i])
-        return DeviceBatch(self._schema, cols, sub.num_rows)
+        return null_extend_batch(
+            self._schema,
+            batch,
+            keep,
+            side,
+            left_exec.output.fields,
+            right_fields,
+            self._right_ordinals(),
+        )
 
     # ── execution ───────────────────────────────────────────────────────
     def execute(self, ctx: ExecContext) -> PartitionSet:
@@ -241,6 +236,282 @@ class TpuShuffledHashJoinExec(Exec):
             f"TpuShuffledHashJoin {self.join_type} "
             f"[{', '.join(map(str, self.left_keys))}] [{', '.join(map(str, self.right_keys))}]"
         )
+
+
+class TpuBroadcastExchangeExec(Exec):
+    """Build side collected once to a single device batch shared by all join
+    tasks (GpuBroadcastExchangeExecBase:238; in-process, the serialize/
+    JVM-broadcast/deserialize round trip collapses to one cached batch)."""
+
+    def __init__(self, child: Exec):
+        super().__init__([child])
+        self._cache = None
+
+    @property
+    def output(self) -> Schema:
+        return self.children[0].output
+
+    @property
+    def is_device(self) -> bool:
+        return True
+
+    def broadcast_batch(self, ctx: ExecContext) -> DeviceBatch:
+        if self._cache is None:
+            parts = self.children[0].execute(ctx)
+            batches = [b for t in parts.parts for b in t()]
+            self._cache = (
+                concat_device(batches) if batches else empty_batch(self.output)
+            )
+        return self._cache
+
+    def execute(self, ctx: ExecContext) -> PartitionSet:
+        def it():
+            yield self.broadcast_batch(ctx)
+
+        return PartitionSet([it])
+
+    def node_string(self):
+        return "TpuBroadcastExchange"
+
+
+class TpuBroadcastHashJoinExec(TpuShuffledHashJoinExec):
+    """Hash join with a broadcast build (right) side: stream partitions stay
+    put, each joins the one broadcast batch (GpuBroadcastHashJoinExec shims).
+    Join types requiring build-side null-extension (right/full) are not
+    planned onto this exec."""
+
+    def execute(self, ctx: ExecContext) -> PartitionSet:
+        left, right = self.children
+        assert isinstance(right, TpuBroadcastExchangeExec)
+        assert self.join_type in ("inner", "left", "left_semi", "left_anti")
+        lparts = left.execute(ctx)
+        phase1 = self._phase1()
+        phase2 = self._phase2()
+        jt = self.join_type
+
+        def make(lt):
+            def it():
+                build = right.broadcast_batch(ctx)
+                for probe in lt():
+                    build_order, lower, counts = phase1(build, probe)
+                    total = int(counts.sum())
+                    out_cap = bucket_capacity(max(total, 1))
+                    out, probe_matched, _ = phase2(
+                        build,
+                        probe,
+                        build_order,
+                        lower,
+                        counts,
+                        jnp.zeros(out_cap, jnp.int8),
+                    )
+                    if jt == "left":
+                        unmatched = (~probe_matched) & probe.row_mask()
+                        extra = self._null_extend(probe, unmatched, "left")
+                        if extra.row_count():
+                            yield extra
+                    if out.row_count():
+                        yield out
+
+            return it
+
+        return PartitionSet([make(lt) for lt in lparts.parts])
+
+    def node_string(self):
+        return (
+            f"TpuBroadcastHashJoin {self.join_type} "
+            f"[{', '.join(map(str, self.left_keys))}]"
+        )
+
+
+class TpuBroadcastNestedLoopJoinExec(Exec):
+    """Cross / conditional (non-equi) join on device.
+
+    Reference: GpuBroadcastNestedLoopJoinExec.scala (Table.crossJoin +
+    condition filter) and GpuCartesianProductExec.scala (pairwise batch
+    cross join). TPU design: the pair space [n x m] is enumerated as a
+    static-capacity flat index batch (li = k // m, ri = k % m), both sides
+    gathered, the condition evaluated on the pairs, and matches compacted —
+    one fused kernel per (shapes) pair; the stream side is chunked so the
+    pair capacity stays bounded."""
+
+    MAX_PAIR_CAP = 1 << 20
+
+    def __init__(
+        self,
+        join_type: str,
+        condition: Optional[Expression],
+        left: Exec,
+        right: Exec,
+    ):
+        super().__init__([left, right])
+        self.join_type = join_type
+        self._schema = join_output_schema(
+            join_type, left.output.fields, right.output.fields
+        )
+        self.condition = (
+            bind(condition, Schema(list(left.output.fields) + list(right.output.fields)))
+            if condition is not None
+            else None
+        )
+
+    @property
+    def output(self) -> Schema:
+        return self._schema
+
+    @property
+    def is_device(self) -> bool:
+        return True
+
+    def _pair_kernel(self):
+        out_schema = self._schema
+        condition = self.condition
+        jt = self.join_type
+
+        @jax.jit
+        def fn(lb: DeviceBatch, rb: DeviceBatch):
+            n, m = lb.capacity, rb.capacity
+            cap = n * m
+            li = jnp.arange(cap, dtype=jnp.int32) // m
+            ri = jnp.arange(cap, dtype=jnp.int32) % m
+            pair_live = (li < lb.num_rows) & (ri < rb.num_rows)
+            lcols = [gather_column(c, li, pair_live) for c in lb.columns]
+            rcols = [gather_column(c, ri, pair_live) for c in rb.columns]
+            live = pair_live
+            if condition is not None:
+                cctx = Ctx(
+                    jnp,
+                    cap,
+                    True,
+                    [Val(c.data, c.validity, c.lengths) for c in lcols + rcols],
+                    live.sum().astype(jnp.int32),
+                )
+                cv = condition.eval(cctx)
+                live = cctx.broadcast_bool(cv.data) & cv.full_valid(cctx) & pair_live
+            # matched flags per side row (outer/semi/anti bookkeeping)
+            left_matched = (
+                jnp.zeros(n, bool).at[jnp.where(live, li, n)].set(True, mode="drop")
+            )
+            right_matched = (
+                jnp.zeros(m, bool).at[jnp.where(live, ri, m)].set(True, mode="drop")
+            )
+            if jt in ("left_semi", "left_anti"):
+                return None, left_matched, right_matched
+            # num_rows = cap: live pairs are scattered over the [n x m] grid
+            # and compact intersects its keep mask with row_mask
+            out = DeviceBatch(
+                out_schema,
+                [
+                    DeviceColumn(c.dtype, c.data, c.validity & live, c.lengths)
+                    for c in lcols + rcols
+                ],
+                jnp.asarray(cap, jnp.int32),
+            )
+            return compact(out, live), left_matched, right_matched
+
+        return fn
+
+    def _null_extend(self, batch: DeviceBatch, keep: jax.Array, side: str) -> DeviceBatch:
+        left_exec, right_exec = self.children
+        return null_extend_batch(
+            self._schema, batch, keep, side,
+            left_exec.output.fields, right_exec.output.fields,
+        )
+
+    def execute(self, ctx: ExecContext) -> PartitionSet:
+        left, right = self.children
+        lparts = left.execute(ctx)
+        kernel = self._pair_kernel()
+        jt = self.join_type
+
+        def chunk(db: DeviceBatch, rows: int):
+            """Slice a device batch into static sub-batches of <= rows."""
+            if db.capacity <= rows:
+                yield db
+                return
+            n = db.row_count()
+            for lo in range(0, max(n, 1), rows):
+                idx = jnp.arange(rows, dtype=jnp.int32) + lo
+                live = idx < db.num_rows
+                cols = [gather_column(c, idx, live) for c in db.columns]
+                yield DeviceBatch(
+                    db.schema,
+                    cols,
+                    jnp.clip(db.num_rows - lo, 0, rows).astype(jnp.int32),
+                )
+
+        def make(lt):
+            def it():
+                rparts = right.execute(ctx)
+                rbatches = [b for t in rparts.parts for b in t()]
+                build = (
+                    concat_device(rbatches) if rbatches else empty_batch(right.output)
+                )
+                m = build.capacity
+                lrows = max(1, self.MAX_PAIR_CAP // max(m, 1))
+                p = 1
+                while p * 2 <= lrows:  # round down to a power of two
+                    p *= 2
+                lrows = p
+                build_matched = jnp.zeros(m, dtype=bool)
+                for stream in lt():
+                    for lb in chunk(stream, lrows):
+                        out, lmatch, rmatch = kernel(lb, build)
+                        build_matched = build_matched | rmatch
+                        if jt in ("left_semi", "left_anti"):
+                            want = lmatch if jt == "left_semi" else (
+                                ~lmatch & lb.row_mask()
+                            )
+                            sub = compact(lb, want)
+                            if sub.row_count():
+                                yield sub
+                            continue
+                        if jt in ("left", "full"):
+                            unmatched = (~lmatch) & lb.row_mask()
+                            extra = self._null_extend(lb, unmatched, "left")
+                            if extra.row_count():
+                                yield extra
+                        if out is not None and out.row_count():
+                            yield out
+                if jt in ("right", "full"):
+                    unmatched = (~build_matched) & build.row_mask()
+                    extra = self._null_extend(build, unmatched, "right")
+                    if extra.row_count():
+                        yield extra
+
+            return it
+
+        # stream side is coalesced to one partition by the planner
+        return PartitionSet([make(lt) for lt in lparts.parts])
+
+    def node_string(self):
+        return f"TpuBroadcastNestedLoopJoin {self.join_type} {self.condition or ''}"
+
+
+def null_extend_batch(
+    out_schema: Schema,
+    batch: DeviceBatch,
+    keep: jax.Array,
+    side: str,
+    left_fields,
+    right_fields,
+    right_ordinals=None,
+) -> DeviceBatch:
+    """Rows of one join side with the other side's columns as NULLs — shared
+    by the hash and nested-loop joins' outer-extension paths."""
+    sub = compact(batch, keep)
+    cap = sub.capacity
+    if side == "left":  # left rows + null right
+        cols = list(sub.columns) + [_null_column(f, cap) for f in right_fields]
+    else:  # null left + right rows
+        ords = (
+            right_ordinals
+            if right_ordinals is not None
+            else range(len(batch.columns))
+        )
+        cols = [_null_column(f, cap) for f in left_fields] + [
+            sub.columns[i] for i in ords
+        ]
+    return DeviceBatch(out_schema, cols, sub.num_rows)
 
 
 def _null_column(f: StructField, cap: int) -> DeviceColumn:

@@ -250,6 +250,15 @@ def _e(v: Union[Column, Any]) -> Expression:
     return to_expr(v)
 
 
+def broadcast(df):
+    """Mark a DataFrame for broadcast in joins (pyspark parity; reference:
+    broadcast hint → GpuBroadcastHashJoinExec build side)."""
+    from .plan import logical as L
+    from .session import DataFrame
+
+    return DataFrame(df._session, L.Hint("broadcast", df._plan))
+
+
 def col(name: str) -> Column:
     return Column(UnresolvedAttribute(name))
 

@@ -27,6 +27,29 @@ from ..ops.sortkeys import column_radix_words
 from ..types import StringType
 
 
+def join_output_schema(
+    join_type: str,
+    left_fields,
+    right_fields,
+    drop_right: list[str] | None = None,
+):
+    """Join output schema shared by every join exec (CPU and TPU): semi/anti
+    keep only the left side; outer sides become nullable."""
+    import dataclasses as _dc
+
+    from ..types import Schema
+
+    lt = list(left_fields)
+    rt = [f for f in right_fields if f.name not in (drop_right or [])]
+    if join_type in ("left_semi", "left_anti"):
+        return Schema(lt)
+    if join_type in ("left", "full"):
+        rt = [_dc.replace(f, nullable=True) for f in rt]
+    if join_type in ("right", "full"):
+        lt = [_dc.replace(f, nullable=True) for f in lt]
+    return Schema(lt + rt)
+
+
 def pad_string_column(col: DeviceColumn, width: int) -> DeviceColumn:
     if not isinstance(col.dtype, StringType) or col.data.shape[1] >= width:
         return col

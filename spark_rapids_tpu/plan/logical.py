@@ -249,6 +249,24 @@ class Expand(LogicalPlan):
 
 
 @dataclass
+class Hint(LogicalPlan):
+    """Planner hint wrapper (Spark's ResolvedHint; only 'broadcast' for now)."""
+
+    name: str
+    child: LogicalPlan
+
+    def children(self):
+        return [self.child]
+
+    @property
+    def schema(self) -> Schema:
+        return self.child.schema
+
+    def _node_string(self):
+        return f"Hint({self.name})"
+
+
+@dataclass
 class Union(LogicalPlan):
     plans: list[LogicalPlan]
 

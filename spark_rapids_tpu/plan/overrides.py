@@ -422,8 +422,49 @@ def _join_exprs_of(e):
 
 
 from ..exec.cpu_join import CpuShuffledHashJoinExec as _CpuSHJ  # noqa: E402
+from ..exec.cpu_join import (  # noqa: E402
+    CpuBroadcastExchangeExec as _CpuBE,
+    CpuBroadcastHashJoinExec as _CpuBHJ,
+    CpuNestedLoopJoinExec as _CpuNLJ,
+)
 
 _rule(_CpuSHJ, "ShuffledHashJoinExec", _conv_join, _join_exprs_of)
+
+
+def _conv_bhj(e, ch):
+    from ..exec.tpu_join import TpuBroadcastHashJoinExec
+
+    return TpuBroadcastHashJoinExec(
+        e.join_type,
+        e.left_keys,
+        e.right_keys,
+        e.residual,
+        ch[0],
+        ch[1],
+        e.drop_right_keys,
+    )
+
+
+def _conv_bexchange(e, ch):
+    from ..exec.tpu_join import TpuBroadcastExchangeExec
+
+    return TpuBroadcastExchangeExec(ch[0])
+
+
+def _conv_nlj(e, ch):
+    from ..exec.tpu_join import TpuBroadcastNestedLoopJoinExec
+
+    return TpuBroadcastNestedLoopJoinExec(e.join_type, e.condition, ch[0], ch[1])
+
+
+_rule(_CpuBE, "BroadcastExchangeExec", _conv_bexchange, lambda e: [])
+_rule(_CpuBHJ, "BroadcastHashJoinExec", _conv_bhj, _join_exprs_of)
+_rule(
+    _CpuNLJ,
+    "BroadcastNestedLoopJoinExec",
+    _conv_nlj,
+    lambda e: [e.condition] if e.condition is not None else [],
+)
 
 
 def exec_rules() -> dict[type, ExecRule]:

@@ -10,7 +10,7 @@ partitioning lands with the exchange work).
 from __future__ import annotations
 
 import dataclasses
-from typing import List, Tuple
+from typing import List, Optional, Tuple
 
 from .. import config as cfg
 from ..config import TpuConf
@@ -84,7 +84,41 @@ def plan_physical(lp: L.LogicalPlan, conf: TpuConf) -> Exec:
         return CpuShuffleExchangeExec(part, child)
     if isinstance(lp, L.Join):
         return _plan_join(lp, conf)
+    if isinstance(lp, L.Hint):
+        return plan_physical(lp.child, conf)
     raise NotImplementedError(f"no physical plan for {type(lp).__name__}")
+
+
+def _estimate_size(lp: L.LogicalPlan) -> Optional[int]:
+    """Best-effort logical size estimate in bytes (Spark's statistics
+    sizeInBytes analogue) used only for broadcast-side selection."""
+    if isinstance(lp, L.LocalRelation):
+        return lp.table.nbytes
+    if isinstance(lp, L.FileScan):
+        import os
+
+        try:
+            return sum(os.path.getsize(p) for p in lp.paths)
+        except OSError:
+            return None
+    if isinstance(lp, (L.Project, L.Filter, L.Sort, L.Limit, L.Hint, L.Repartition)):
+        return _estimate_size(lp.children()[0])
+    if isinstance(lp, L.Union):
+        sizes = [_estimate_size(p) for p in lp.plans]
+        return None if any(s is None for s in sizes) else sum(sizes)
+    if isinstance(lp, L.Range):
+        return 8 * max(0, (lp.end - lp.start) // (lp.step or 1))
+    return None  # aggregates/joins: unknown → never auto-broadcast
+
+
+def _has_broadcast_hint(lp: L.LogicalPlan) -> bool:
+    """Hint detection looking through unary nodes (Spark propagates hints
+    up through unary operators)."""
+    if isinstance(lp, L.Hint):
+        return lp.name == "broadcast" or _has_broadcast_hint(lp.child)
+    if isinstance(lp, (L.Project, L.Filter, L.Sort, L.Limit, L.Repartition)):
+        return _has_broadcast_hint(lp.children()[0])
+    return False
 
 
 def _num_partitions_hint(e: Exec) -> int:
@@ -192,15 +226,63 @@ def _plan_aggregate(lp: L.Aggregate, conf: TpuConf) -> Exec:
 
 
 def _plan_join(lp: L.Join, conf: TpuConf) -> Exec:
-    from ..exec.cpu_join import CpuNestedLoopJoinExec, CpuShuffledHashJoinExec
+    from ..exec.cpu_join import (
+        CpuBroadcastExchangeExec,
+        CpuBroadcastHashJoinExec,
+        CpuNestedLoopJoinExec,
+        CpuShuffledHashJoinExec,
+    )
 
-    left = plan_physical(lp.left, conf)
-    right = plan_physical(lp.right, conf)
     nparts = cfg.SHUFFLE_PARTITIONS.get(conf)
     if lp.left_keys:
+        jt = lp.join_type
+        # Build-side selection (hint, or estimated size under the threshold).
+        # The build side must never need null-extension: build-right supports
+        # inner/left/semi/anti; build-left supports inner/right and is
+        # realized by swapping sides + a column-reordering projection.
+        threshold = cfg.AUTO_BROADCAST_THRESHOLD.get(conf)
+        l_hint, r_hint = _has_broadcast_hint(lp.left), _has_broadcast_hint(lp.right)
+
+        def fits(sz):
+            return threshold >= 0 and sz is not None and sz <= threshold
+
+        bc_right_ok = jt in ("inner", "left", "left_semi", "left_anti")
+        bc_left_ok = jt in ("inner", "right") and not lp.using
+        want_right = bc_right_ok and (r_hint or fits(_estimate_size(lp.right)))
+        want_left = bc_left_ok and (l_hint or fits(_estimate_size(lp.left)))
+        if want_left and (not want_right or (l_hint and not r_hint)):
+            names = lp.schema.names
+            if len(set(names)) == len(names):  # unambiguous re-projection
+                swapped = L.Join(
+                    lp.right,
+                    lp.left,
+                    {"inner": "inner", "right": "left"}[jt],
+                    lp.right_keys,
+                    lp.left_keys,
+                    lp.residual,
+                    False,
+                )
+                return plan_physical(
+                    L.Project([UnresolvedAttribute(n) for n in names], swapped),
+                    conf,
+                )
+        if want_right:
+            drop = [output_name(k) for k in lp.right_keys] if lp.using else None
+            return CpuBroadcastHashJoinExec(
+                jt,
+                lp.left_keys,
+                lp.right_keys,
+                lp.residual,
+                plan_physical(lp.left, conf),
+                CpuBroadcastExchangeExec(plan_physical(lp.right, conf)),
+                drop,
+            )
+    left = plan_physical(lp.left, conf)
+    right = plan_physical(lp.right, conf)
+    if lp.left_keys:
+        drop = [output_name(k) for k in lp.right_keys] if lp.using else None
         lex = CpuShuffleExchangeExec(P.HashPartitioning(nparts, lp.left_keys), left)
         rex = CpuShuffleExchangeExec(P.HashPartitioning(nparts, lp.right_keys), right)
-        drop = [output_name(k) for k in lp.right_keys] if lp.using else None
         return CpuShuffledHashJoinExec(
             lp.join_type, lp.left_keys, lp.right_keys, lp.residual, lex, rex, drop
         )

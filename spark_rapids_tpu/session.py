@@ -290,11 +290,27 @@ class DataFrame:
         elif isinstance(on, list) and on and isinstance(on[0], tuple):
             lk = [UnresolvedAttribute(l) for l, _ in on]
             rk = [UnresolvedAttribute(r) for _, r in on]
+        elif isinstance(on, Column):
+            # split a boolean condition into equi keys + residual predicate
+            from .exec.cpu_join import extract_equi_join_keys
+
+            lk, rk, residual = extract_equi_join_keys(
+                on.expr, self.schema, other.schema
+            )
         else:
-            raise TypeError("join on= must be a name, list of names, or list of (l, r) pairs")
+            raise TypeError(
+                "join on= must be a name, list of names, list of (l, r) pairs, "
+                "or a Column condition"
+            )
         return DataFrame(
             self._session,
             L.Join(self._plan, other._plan, how, lk, rk, residual, using),
+        )
+
+    def cross_join(self, other: "DataFrame") -> "DataFrame":
+        return DataFrame(
+            self._session,
+            L.Join(self._plan, other._plan, "cross", [], [], None, False),
         )
 
     # ── actions ─────────────────────────────────────────────────────────

@@ -1,0 +1,132 @@
+"""Broadcast hash join / nested-loop join tests (join_test.py broadcast
+cases; GpuBroadcastHashJoinExec + GpuBroadcastNestedLoopJoinExec analogues)."""
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu.functions import broadcast, col
+from spark_rapids_tpu.types import INT, LONG, STRING
+
+from data_gen import gen_grouped_table, gen_table
+from harness import assert_cpu_and_tpu_equal, tpu_session
+
+BC_TYPES = ["inner", "left", "left_semi", "left_anti"]
+NO_BC = {"spark.sql.autoBroadcastJoinThreshold": "-1"}
+
+
+def _two_tables(seed, n_left=300, n_right=150, groups=20):
+    lt = gen_grouped_table([("lv", LONG)], n_left, num_groups=groups, seed=seed)
+    rt = gen_grouped_table([("rv", LONG)], n_right, num_groups=groups, seed=seed + 1)
+    return lt, rt
+
+
+@pytest.mark.parametrize("how", BC_TYPES)
+def test_broadcast_join_matches_cpu(how):
+    lt, rt = _two_tables(50)
+    assert_cpu_and_tpu_equal(
+        lambda s: s.create_dataframe(lt, num_partitions=3).join(
+            broadcast(s.create_dataframe(rt, num_partitions=2)),
+            on=[("k", "k")],
+            how=how,
+        )
+    )
+
+
+@pytest.mark.parametrize("how", BC_TYPES)
+def test_shuffled_join_when_broadcast_disabled(how):
+    lt, rt = _two_tables(51)
+    assert_cpu_and_tpu_equal(
+        lambda s: s.create_dataframe(lt, num_partitions=3).join(
+            s.create_dataframe(rt, num_partitions=2), on=[("k", "k")], how=how
+        ),
+        conf=NO_BC,
+    )
+
+
+def test_broadcast_join_in_plan():
+    lt, rt = _two_tables(52)
+    s = tpu_session()
+    df = s.create_dataframe(lt).join(s.create_dataframe(rt), on=[("k", "k")])
+    plan = df.explain()
+    assert "BroadcastHashJoin" in plan and "BroadcastExchange" in plan
+    s2 = tpu_session(dict(NO_BC))
+    df2 = s2.create_dataframe(lt).join(s2.create_dataframe(rt), on=[("k", "k")])
+    assert "BroadcastHashJoin" not in df2.explain()
+
+
+def test_right_join_never_broadcast_right():
+    # right/full need build-side null extension → must stay shuffled
+    lt, rt = _two_tables(53)
+    s = tpu_session()
+    df = s.create_dataframe(lt).join(s.create_dataframe(rt), on=[("k", "k")], how="right")
+    assert "BroadcastHashJoin" not in df.explain()
+
+
+def test_broadcast_left_hint_swaps_build_side():
+    # hint on the LEFT side: planner swaps sides (build-left) + reprojects
+    lt, rt = _two_tables(61)
+    rt = rt.rename_columns(["k2", "rv"])
+    assert_cpu_and_tpu_equal(
+        lambda s: broadcast(s.create_dataframe(lt, num_partitions=2)).join(
+            s.create_dataframe(rt), on=[("k", "k2")], how="inner"
+        ),
+        conf=NO_BC,  # size-based selection off: only the hint can broadcast
+    )
+    s = tpu_session(dict(NO_BC))
+    df = broadcast(s.create_dataframe(lt)).join(
+        s.create_dataframe(rt.rename_columns(["k2", "rv"])), on=[("k", "k2")]
+    )
+    assert "BroadcastHashJoin" in df.explain()
+
+
+def test_broadcast_left_right_outer_join():
+    lt, rt = _two_tables(62)
+    rt = rt.rename_columns(["k2", "rv"])
+    assert_cpu_and_tpu_equal(
+        lambda s: broadcast(s.create_dataframe(lt, num_partitions=2)).join(
+            s.create_dataframe(rt), on=[("k", "k2")], how="right"
+        ),
+        conf=NO_BC,
+    )
+
+
+def test_cross_join():
+    lt = gen_table([("a", INT)], 40, seed=54)
+    rt = gen_table([("b", INT)], 30, seed=55)
+    assert_cpu_and_tpu_equal(
+        lambda s: s.create_dataframe(lt, num_partitions=2).cross_join(
+            s.create_dataframe(rt)
+        )
+    )
+
+
+@pytest.mark.parametrize("how", ["inner", "left", "right", "full", "semi", "anti"])
+def test_non_equi_join(how):
+    lt = gen_table([("a", INT)], 60, seed=56)
+    rt = gen_table([("b", INT)], 45, seed=57)
+    assert_cpu_and_tpu_equal(
+        lambda s: s.create_dataframe(lt, num_partitions=2).join(
+            s.create_dataframe(rt), on=col("a") < col("b"), how=how
+        )
+    )
+
+
+def test_equi_plus_residual_condition():
+    lt, rt = _two_tables(58)
+    rt = rt.rename_columns(["k2", "rv"])
+    assert_cpu_and_tpu_equal(
+        lambda s: s.create_dataframe(lt, num_partitions=2).join(
+            s.create_dataframe(rt),
+            on=(col("k") == col("k2")) & (col("lv") < col("rv")),
+            how="inner",
+        )
+    )
+
+
+def test_broadcast_string_key():
+    lt = gen_table([("s", STRING), ("a", INT)], 200, seed=59, str_len=4)
+    rt = gen_table([("s", STRING), ("b", INT)], 100, seed=60, str_len=4)
+    assert_cpu_and_tpu_equal(
+        lambda s: s.create_dataframe(lt, num_partitions=2).join(
+            broadcast(s.create_dataframe(rt)), on="s", how="left"
+        )
+    )

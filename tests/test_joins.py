@@ -6,7 +6,18 @@ from spark_rapids_tpu.functions import col
 from spark_rapids_tpu.types import DOUBLE, INT, LONG, STRING
 
 from data_gen import gen_grouped_table, gen_table
-from harness import assert_cpu_and_tpu_equal
+from harness import assert_cpu_and_tpu_equal as _assert_equal
+
+# This module targets the SHUFFLED hash join path; small local tables would
+# otherwise auto-broadcast (spark.sql.autoBroadcastJoinThreshold default).
+# Broadcast-path coverage lives in test_broadcast_joins.py.
+NO_BC = {"spark.sql.autoBroadcastJoinThreshold": "-1"}
+
+
+def assert_cpu_and_tpu_equal(build_df, conf=None, **kw):
+    merged = dict(NO_BC)
+    merged.update(conf or {})
+    return _assert_equal(build_df, conf=merged, **kw)
 
 
 def _two_tables(seed, n_left=300, n_right=200, groups=25):
