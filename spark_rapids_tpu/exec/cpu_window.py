@@ -1,0 +1,253 @@
+"""CPU window operator — oracle/fallback for the window family.
+
+Reference: GpuWindowExec.scala + GpuWindowExpression.scala. Implemented as a
+per-partition python loop over numpy segments — intentionally a *different*
+algorithm from the device kernel (segmented scans) so differential tests
+cross-check two independent implementations.
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+import pyarrow as pa
+
+from ..columnar.host import arrow_from_np, concat_batches, np_from_arrow
+from ..expr import Expression, bind
+from ..expr.aggregates import Average, Count, Max, Min, Sum
+from ..expr.base import Ctx, Literal
+from ..expr.windows import (
+    CURRENT_ROW,
+    UNBOUNDED_FOLLOWING,
+    UNBOUNDED_PRECEDING,
+    DenseRank,
+    Lag,
+    Lead,
+    Rank,
+    RowNumber,
+    WindowExpression,
+)
+from ..plan.logical import SortOrder
+from ..plan.physical import Exec, ExecContext, PartitionSet
+from ..types import DOUBLE, INT, LONG, Schema, StringType, StructField
+from .cpu import _cpu_ctx, _val_to_np, cpu_sort_indices
+
+
+class CpuWindowExec(Exec):
+    """Appends one column per window expression; all share one spec."""
+
+    def __init__(self, window_cols: list, child: Exec):
+        super().__init__([child])
+        self.window_cols = window_cols  # [(name, WindowExpression)]
+        self.spec = window_cols[0][1].spec
+        fields = list(child.output.fields)
+        for name, we in window_cols:
+            fields.append(StructField(name, we.data_type, we.nullable))
+        self._schema = Schema(fields)
+
+    @property
+    def output(self) -> Schema:
+        return self._schema
+
+    def execute(self, ctx: ExecContext) -> PartitionSet:
+        child = self.children[0]
+        schema = child.output
+
+        def fn(it):
+            rb = concat_batches(schema, list(it))
+            if rb.num_rows == 0:
+                yield pa.RecordBatch.from_arrays(
+                    [pa.nulls(0, f.data_type.to_arrow()) for f in self._schema],
+                    schema=self._schema.to_arrow(),
+                )
+                return
+            yield self._compute(rb, schema)
+
+        return child.execute(ctx).map_partitions(fn)
+
+    # ── the window computation over one coalesced partition ────────────
+    def _compute(self, rb: pa.RecordBatch, schema: Schema) -> pa.RecordBatch:
+        spec = self.spec
+        n = rb.num_rows
+        order = [
+            SortOrder(bind(o.child, schema), o.ascending, o.nulls_first)
+            for o in spec.order_by
+        ]
+        pkeys = [bind(p, schema) for p in spec.partition_by]
+        sort_spec = [SortOrder(p, True, True) for p in pkeys] + order
+        perm = (
+            cpu_sort_indices(rb, schema, sort_spec)
+            if sort_spec
+            else np.arange(n, dtype=np.int64)
+        )
+        srb = rb.take(pa.array(perm))
+        ctx = _cpu_ctx(srb, schema)
+
+        def key_matrix(exprs):
+            from ..ops.sortkeys import np_column_radix_words
+
+            cols = []
+            for e in exprs:
+                d, v = _val_to_np(ctx, e.eval(ctx))
+                cols.extend(np_column_radix_words(e.data_type, d, v))
+            return cols
+
+        pk_words = key_matrix(pkeys)
+        ok_words = key_matrix([o.child for o in order])
+
+        def starts_from(words):
+            s = np.zeros(n, dtype=bool)
+            s[0] = True
+            for w in words:
+                s[1:] |= w[1:] != w[:-1]
+            return s
+
+        seg_start = starts_from(pk_words) if pk_words else _first_only(n)
+        peer_start = seg_start.copy()
+        for w in ok_words:
+            peer_start[1:] |= w[1:] != w[:-1]
+        seg_bounds = np.flatnonzero(seg_start).tolist() + [n]
+
+        out_cols = []
+        for name, we in self.window_cols:
+            data, valid = self._compute_one(we, ctx, schema, seg_bounds, peer_start, n)
+            out_cols.append(
+                arrow_from_np(data, valid, we.data_type)
+                if not isinstance(we.data_type, StringType)
+                else _np_str_to_arrow(data, valid)
+            )
+        arrays = [srb.column(i) for i in range(srb.num_columns)] + out_cols
+        return pa.RecordBatch.from_arrays(arrays, schema=self._schema.to_arrow())
+
+    def _compute_one(self, we, ctx, schema, seg_bounds, peer_start, n):
+        fn = we.function
+        frame = we.spec.resolved_frame()
+
+        if isinstance(fn, (RowNumber, Rank, DenseRank)):
+            out = np.zeros(n, dtype=np.int32)
+            for s, e in zip(seg_bounds[:-1], seg_bounds[1:]):
+                if isinstance(fn, RowNumber):
+                    out[s:e] = np.arange(1, e - s + 1)
+                elif isinstance(fn, Rank):
+                    ranks = np.arange(1, e - s + 1)
+                    firsts = np.maximum.accumulate(
+                        np.where(peer_start[s:e], ranks, 0)
+                    )
+                    out[s:e] = firsts
+                else:  # DenseRank
+                    out[s:e] = np.cumsum(peer_start[s:e].astype(np.int32))
+            return out, np.ones(n, dtype=bool)
+
+        if isinstance(fn, (Lead, Lag)):
+            x = bind(fn.child, schema)
+            d, v = _val_to_np(ctx, x.eval(ctx))
+            dflt = bind(fn.default, schema)
+            dd, dv = _val_to_np(ctx, dflt.eval(ctx))
+            k = fn.offset if isinstance(fn, Lead) else -fn.offset
+            is_str = isinstance(we.data_type, StringType)
+            out = np.empty(n, dtype=object if is_str else we.data_type.np_dtype)
+            if not is_str:
+                out[:] = 0
+            out_set = np.broadcast_to(np.asarray(dd, dtype=out.dtype), (n,))
+            out[:] = out_set
+            ov = np.array(np.broadcast_to(np.asarray(dv).astype(bool), (n,)), copy=True)
+            for s, e in zip(seg_bounds[:-1], seg_bounds[1:]):
+                idx = np.arange(s, e)
+                j = idx + k
+                ok = (j >= s) & (j < e)
+                out[idx[ok]] = np.asarray(d, dtype=out.dtype)[j[ok]]
+                ov[idx[ok]] = v[j[ok]]
+            return out, ov
+
+        # aggregate over frame
+        inner = _agg_input(fn)
+        x = bind(inner, schema)
+        d, v = _val_to_np(ctx, x.eval(ctx))
+        d = np.asarray(d)
+        v = np.asarray(v).astype(bool)
+        is_avg = isinstance(fn, Average)
+        out_dt = we.data_type
+        out = np.zeros(n, dtype=out_dt.np_dtype if not is_avg else np.float64)
+        ov = np.zeros(n, dtype=bool)
+        for s, e in zip(seg_bounds[:-1], seg_bounds[1:]):
+            for i in range(s, e):
+                lo, hi = _frame_bounds(frame, i, s, e, peer_start)
+                if lo > hi:
+                    vals = np.zeros(0, dtype=d.dtype)
+                else:
+                    sel = slice(lo, hi + 1)
+                    vals = d[sel][v[sel]]
+                if isinstance(fn, Count):
+                    out[i] = len(vals)
+                    ov[i] = True
+                elif len(vals) == 0:
+                    ov[i] = False
+                elif isinstance(fn, Sum):
+                    if np.issubdtype(d.dtype, np.integer):
+                        out[i] = np.sum(vals.astype(np.int64), dtype=np.int64)
+                    else:
+                        out[i] = np.sum(vals.astype(np.float64))
+                    ov[i] = True
+                elif isinstance(fn, (Min, Max)):
+                    if np.issubdtype(d.dtype, np.floating):
+                        # Spark: NaN greatest
+                        if isinstance(fn, Max):
+                            out[i] = np.nan if np.isnan(vals).any() else vals.max()
+                        else:
+                            nn = vals[~np.isnan(vals)]
+                            out[i] = nn.min() if len(nn) else np.nan
+                    else:
+                        out[i] = vals.min() if isinstance(fn, Min) else vals.max()
+                    ov[i] = True
+                elif is_avg:
+                    out[i] = np.sum(vals.astype(np.float64)) / len(vals)
+                    ov[i] = True
+        return out, ov
+
+
+def _first_only(n: int) -> np.ndarray:
+    s = np.zeros(n, dtype=bool)
+    if n:
+        s[0] = True
+    return s
+
+
+def _agg_input(fn) -> Expression:
+    if isinstance(fn, Sum):
+        return fn.update_exprs[0]  # cast to result type (wrapping long sums)
+    if isinstance(fn, (Count, Min, Max, Average)):
+        return fn.child
+    raise NotImplementedError(f"window aggregate {type(fn).__name__}")
+
+
+def _frame_bounds(frame, i, s, e, peer_start):
+    """Inclusive [lo, hi] row bounds of the frame for row i in segment [s, e)."""
+    if frame.frame_type == "rows":
+        lo = s if frame.lower == UNBOUNDED_PRECEDING else max(s, i + frame.lower)
+        hi = e - 1 if frame.upper == UNBOUNDED_FOLLOWING else min(e - 1, i + frame.upper)
+        return lo, min(hi, e - 1)
+    # range frame: bounds snap to peer-group boundaries
+    lo = s
+    hi = e - 1
+    if frame.lower == CURRENT_ROW:
+        j = i
+        while j > s and not peer_start[j]:
+            j -= 1
+        lo = j
+    elif frame.lower != UNBOUNDED_PRECEDING:
+        raise NotImplementedError("numeric range bounds")
+    if frame.upper == CURRENT_ROW:
+        j = i + 1
+        while j < e and not peer_start[j]:
+            j += 1
+        hi = j - 1
+    elif frame.upper != UNBOUNDED_FOLLOWING:
+        raise NotImplementedError("numeric range bounds")
+    return lo, hi
+
+
+def _np_str_to_arrow(data, valid):
+    vals = [
+        data[i] if valid[i] else None for i in range(len(valid))
+    ]
+    return pa.array(vals, type=pa.string())

@@ -1,0 +1,381 @@
+"""TPU window operator — one fused XLA kernel per window spec group.
+
+Reference: GpuWindowExec.scala + GpuWindowExpression.scala (cudf
+``groupBy.aggregateWindows`` / ``aggregateWindowsOverRanges``). TPU-first
+design: instead of cudf's per-function window kernels, the whole spec group
+compiles into ONE program over the coalesced partition batch —
+
+1. radix-encode partition + order keys, one variadic stable sort;
+2. segment/peer boundaries by adjacent word difference;
+3. every window function lowers onto *segmented scans*
+   (``lax.associative_scan`` with a reset flag) and gathers:
+   running/unbounded frames = inclusive scan (+ gather at segment/peer end),
+   bounded ROWS sum/count/avg = prefix-sum differences at clamped indices,
+   bounded ROWS min/max = static shift unroll, lead/lag = in-segment gather,
+   ranks = index arithmetic on segment/peer firsts.
+
+Rows come out partition-sorted (Spark's window output order).
+"""
+from __future__ import annotations
+
+from typing import List
+
+import jax
+import jax.numpy as jnp
+
+from ..columnar.device import DeviceBatch, DeviceColumn
+from ..expr import Expression, bind
+from ..expr.aggregates import Average, Count, Max, Min, Sum
+from ..expr.base import Ctx, Val
+from ..expr.windows import (
+    CURRENT_ROW,
+    UNBOUNDED_FOLLOWING,
+    UNBOUNDED_PRECEDING,
+    DenseRank,
+    Lag,
+    Lead,
+    Rank,
+    RowNumber,
+)
+from ..ops.concat import concat_device
+from ..ops.gather import gather_batch
+from ..ops.sortkeys import column_radix_words, sort_permutation
+from ..plan.physical import Exec, ExecContext, PartitionSet
+from ..types import Schema, StringType, StructField
+from .tpu import val_to_column
+
+MAX_UNROLL_FRAME = 256  # widest bounded ROWS min/max frame unrolled on device
+
+
+def _segscan(vals, starts, op):
+    """Inclusive segmented scan: op-accumulate left-to-right, reset where
+    ``starts``. Standard (flag, value) associative combine."""
+
+    def comb(a, b):
+        af, av = a
+        bf, bv = b
+        return (af | bf, jnp.where(bf, bv, op(av, bv)))
+
+    _, v = jax.lax.associative_scan(comb, (starts, vals))
+    return v
+
+
+def _seg_last_idx(idx, starts, cap):
+    """Per-row index of its segment's last row (reverse segmented max)."""
+    end_flags = jnp.concatenate([starts[1:], jnp.ones(1, dtype=bool)])
+    rev = lambda x: x[::-1]
+    return rev(_segscan(rev(idx), rev(end_flags), jnp.maximum))
+
+
+class TpuWindowExec(Exec):
+    def __init__(self, window_cols: list, child: Exec):
+        super().__init__([child])
+        self.window_cols = window_cols
+        self.spec = window_cols[0][1].spec
+        fields = list(child.output.fields)
+        for name, we in window_cols:
+            fields.append(StructField(name, we.data_type, we.nullable))
+        self._schema = Schema(fields)
+
+    @property
+    def output(self) -> Schema:
+        return self._schema
+
+    @property
+    def is_device(self) -> bool:
+        return True
+
+    def execute(self, ctx: ExecContext) -> PartitionSet:
+        from ..mem.spill import with_oom_retry
+
+        child = self.children[0]
+        kernel = self._kernel(child.output)
+        catalog = ctx.catalog
+
+        def run(it):
+            batches = list(it)
+            if not batches:
+                return
+            merged = concat_device(batches)
+            del batches
+            yield with_oom_retry(catalog, kernel, merged)
+
+        return child.execute(ctx).map_partitions(run)
+
+    def _kernel(self, child_schema: Schema):
+        spec = self.spec
+        pkeys = [bind(p, child_schema) for p in spec.partition_by]
+        orders = [
+            (bind(o.child, child_schema), o.ascending, o.resolved_nulls_first())
+            for o in spec.order_by
+        ]
+        window_cols = self.window_cols
+        out_schema = self._schema
+
+        @jax.jit
+        def fn(batch: DeviceBatch) -> DeviceBatch:
+            cap = batch.capacity
+            c = Ctx.for_device(batch)
+            live0 = batch.row_mask()
+
+            def words_of(exprs_dirs):
+                words = []
+                for e, asc, nf in exprs_dirs:
+                    col = val_to_column(c, e.eval(c), e.data_type)
+                    col = DeviceColumn(col.dtype, col.data, col.validity & live0, col.lengths)
+                    words.extend(column_radix_words(col, asc, nf))
+                return words
+
+            pk_words = words_of([(p, True, True) for p in pkeys])
+            ok_words = words_of(orders)
+            perm = sort_permutation(pk_words + ok_words, live0)
+            sorted_batch = gather_batch(batch, perm, batch.num_rows)
+            live = sorted_batch.row_mask()
+            idx = jnp.arange(cap, dtype=jnp.int32)
+
+            def starts_from(words):
+                s = idx == 0
+                for w in words:
+                    sw = w[perm]
+                    prev = jnp.concatenate([sw[:1], sw[:-1]])
+                    s = s | (sw != prev)
+                return s & live
+
+            first_live = (idx == 0) & live
+            seg_start = starts_from(pk_words) if pkeys else first_live
+            peer_start = seg_start
+            for w in ok_words:
+                sw = w[perm]
+                prev = jnp.concatenate([sw[:1], sw[:-1]])
+                peer_start = peer_start | ((sw != prev) & live)
+            # padding is its own segment so the last live segment ends at
+            # num_rows-1, not cap-1 (lead/default, suffix scans, seg_last)
+            pad_start = idx == sorted_batch.num_rows
+            seg_start = seg_start | pad_start
+            peer_start = peer_start | pad_start
+
+            seg_first = _segscan(idx, seg_start, jnp.minimum)
+            seg_last = _seg_last_idx(idx, seg_start, cap)
+            peer_first = _segscan(idx, peer_start, jnp.minimum)
+            peer_last = _seg_last_idx(idx, peer_start, cap)
+
+            sctx = Ctx.for_device(sorted_batch)
+            new_cols: List[DeviceColumn] = []
+            for name, we in window_cols:
+                col = _compute_window_column(
+                    we, sctx, child_schema, cap, live,
+                    seg_start, seg_first, seg_last,
+                    peer_start, peer_first, peer_last, idx,
+                )
+                new_cols.append(col)
+            return DeviceBatch(
+                out_schema, list(sorted_batch.columns) + new_cols, sorted_batch.num_rows
+            )
+
+        return fn
+
+    def node_string(self):
+        names = ", ".join(str(we) for _, we in self.window_cols)
+        return f"TpuWindow [{names}]"
+
+
+def _compute_window_column(
+    we, ctx, schema, cap, live,
+    seg_start, seg_first, seg_last,
+    peer_start, peer_first, peer_last, idx,
+) -> DeviceColumn:
+    fn = we.function
+    frame = we.spec.resolved_frame()
+
+    if isinstance(fn, RowNumber):
+        out = (idx - seg_first + 1).astype(jnp.int32)
+        return DeviceColumn(we.data_type, out, live)
+    if isinstance(fn, Rank):
+        out = (peer_first - seg_first + 1).astype(jnp.int32)
+        return DeviceColumn(we.data_type, out, live)
+    if isinstance(fn, DenseRank):
+        out = _segscan(peer_start.astype(jnp.int32), seg_start, jnp.add)
+        return DeviceColumn(we.data_type, out.astype(jnp.int32), live)
+
+    if isinstance(fn, (Lead, Lag)):
+        from ..types import NullType
+        from ..ops.join import pad_string_column
+
+        x = bind(fn.child, schema)
+        col = val_to_column(ctx, x.eval(ctx), x.data_type)
+        dflt = bind(fn.default, schema)
+        if isinstance(dflt.data_type, NullType):
+            # NULL default: a zeroed, all-invalid column of the input shape
+            dcol = DeviceColumn(
+                x.data_type,
+                jnp.zeros_like(col.data),
+                jnp.zeros(cap, bool),
+                None if col.lengths is None else jnp.zeros(cap, jnp.int32),
+            )
+        else:
+            dcol = val_to_column(ctx, dflt.eval(ctx), x.data_type)
+            if col.data.ndim == 2:  # unify string widths
+                w = max(col.data.shape[1], dcol.data.shape[1])
+                col = pad_string_column(col, w)
+                dcol = pad_string_column(dcol, w)
+        k = fn.offset if isinstance(fn, Lead) else -fn.offset
+        j = idx + k
+        ok = (j >= seg_first) & (j <= seg_last) & live
+        safe = jnp.clip(j, 0, cap - 1)
+        data = jnp.where(
+            ok[:, None] if col.data.ndim == 2 else ok,
+            col.data[safe],
+            dcol.data,
+        )
+        valid = jnp.where(ok, col.validity[safe], dcol.validity) & live
+        lengths = None
+        if col.lengths is not None:
+            dlen = dcol.lengths if dcol.lengths is not None else jnp.zeros(cap, jnp.int32)
+            lengths = jnp.where(ok, col.lengths[safe], dlen)
+        return DeviceColumn(we.data_type, data, valid, lengths)
+
+    # ── aggregates over a frame ─────────────────────────────────────────
+    inner = _agg_input(fn)
+    x = bind(inner, schema)
+    col = val_to_column(ctx, x.eval(ctx), x.data_type)
+    data = col.data
+    valid = col.validity & live
+    is_avg = isinstance(fn, Average)
+    is_count = isinstance(fn, Count)
+
+    # frame endpoints as row indices (ROWS; RANGE snaps to peer bounds)
+    if frame.frame_type == "rows":
+        lo = seg_first if frame.lower == UNBOUNDED_PRECEDING else jnp.maximum(
+            seg_first, idx + frame.lower
+        )
+        hi = seg_last if frame.upper == UNBOUNDED_FOLLOWING else jnp.minimum(
+            seg_last, idx + frame.upper
+        )
+    else:  # range
+        lo = seg_first if frame.lower == UNBOUNDED_PRECEDING else peer_first
+        hi = seg_last if frame.upper == UNBOUNDED_FOLLOWING else peer_last
+    nonempty = (lo <= hi) & live
+
+    if isinstance(fn, (Min, Max)):
+        op = jnp.minimum if isinstance(fn, Min) else jnp.maximum
+        is_float = jnp.issubdtype(data.dtype, jnp.floating)
+        if is_float:
+            ident = jnp.array(jnp.inf if isinstance(fn, Min) else -jnp.inf, data.dtype)
+            # Spark NaN-greatest: +inf sentinel, restored after the scan.
+            # aux flag — max: "frame saw a NaN" (result becomes NaN);
+            # min: "frame saw a non-NaN value" (else the min IS NaN) — this
+            # distinguishes an all-NaN frame from a genuine +inf minimum.
+            aux = (
+                (valid & ~jnp.isnan(data))
+                if isinstance(fn, Min)
+                else (valid & jnp.isnan(data))
+            )
+            work = jnp.where(valid, jnp.where(jnp.isnan(data), jnp.inf, data), ident)
+        else:
+            info = jnp.iinfo(data.dtype)
+            ident = jnp.array(info.max if isinstance(fn, Min) else info.min, data.dtype)
+            aux = jnp.zeros(cap, bool)
+            work = jnp.where(valid, data, ident)
+        bounded = (
+            frame.frame_type == "rows"
+            and frame.lower != UNBOUNDED_PRECEDING
+            and frame.upper != UNBOUNDED_FOLLOWING
+        )
+        if bounded:
+            out, any_valid, any_aux = _make_unrolled(frame.lower, frame.upper)(
+                work, valid, aux, lo, hi, idx, cap, op, ident
+            )
+        else:
+            out, any_valid, any_aux = _scan_window(
+                work, valid, aux, frame, seg_start, lo, hi, seg_last, cap, op
+            )
+        if is_float:
+            if isinstance(fn, Max):
+                out = jnp.where(any_aux, jnp.nan, out)
+            else:
+                out = jnp.where(any_valid & ~any_aux, jnp.nan, out)
+        return DeviceColumn(we.data_type, out.astype(we.data_type.np_dtype), any_valid & nonempty)
+
+    # sum / count / avg via segmented prefix sums + clamped index gathers
+    sum_dt = jnp.float64 if (is_avg or jnp.issubdtype(data.dtype, jnp.floating)) else jnp.int64
+    vals = jnp.where(valid, data.astype(sum_dt), jnp.zeros(cap, sum_dt))
+    cnts = valid.astype(jnp.int64)
+    psum = _segscan(vals, seg_start, jnp.add)
+    pcnt = _segscan(cnts, seg_start, jnp.add)
+
+    def window_total(pref):
+        hi_v = pref[jnp.clip(hi, 0, cap - 1)]
+        lo_prev = jnp.clip(lo - 1, 0, cap - 1)
+        lo_v = jnp.where(lo > seg_first, pref[lo_prev], jnp.zeros_like(pref[0]))
+        return hi_v - lo_v
+
+    total = window_total(psum)
+    count = window_total(pcnt)
+    if is_count:
+        return DeviceColumn(
+            we.data_type,
+            jnp.where(nonempty, count, 0).astype(jnp.int64),
+            live,  # count is never null
+        )
+    if is_avg:
+        out = total / jnp.maximum(count, 1).astype(jnp.float64)
+        return DeviceColumn(we.data_type, out, (count > 0) & nonempty)
+    # sum (wrapping long for integrals, double for floats — Sum.update cast)
+    out = total.astype(we.data_type.np_dtype)
+    return DeviceColumn(we.data_type, out, (count > 0) & nonempty)
+
+
+def _scan_window(work, valid, had_nan, frame, seg_start, lo, hi, seg_last, cap, op):
+    """min/max for frames with at least one unbounded end: gather the
+    inclusive prefix scan at ``hi`` (lower unbounded) or the suffix scan at
+    ``lo`` (upper unbounded). ``lo``/``hi`` are already segment-clamped; an
+    empty frame's garbage gather is masked by the caller's nonempty flag."""
+    rev = lambda x: x[::-1]
+    end_flags = jnp.concatenate([seg_start[1:], jnp.ones(1, dtype=bool)])
+    lower_unb = frame.lower == UNBOUNDED_PRECEDING
+    upper_unb = frame.upper == UNBOUNDED_FOLLOWING
+    if lower_unb and upper_unb:
+        pre = _segscan(work, seg_start, op)
+        pre_valid = _segscan(valid.astype(jnp.int32), seg_start, jnp.add) > 0
+        pre_nan = _segscan(had_nan.astype(jnp.int32), seg_start, jnp.add) > 0
+        last = jnp.clip(seg_last, 0, cap - 1)
+        return pre[last], pre_valid[last], pre_nan[last]
+    if lower_unb:
+        pre = _segscan(work, seg_start, op)
+        pre_valid = _segscan(valid.astype(jnp.int32), seg_start, jnp.add) > 0
+        pre_nan = _segscan(had_nan.astype(jnp.int32), seg_start, jnp.add) > 0
+        end = jnp.clip(hi, 0, cap - 1)
+        return pre[end], pre_valid[end], pre_nan[end]
+    # upper unbounded
+    suf = rev(_segscan(rev(work), rev(end_flags), op))
+    suf_valid = rev(_segscan(rev(valid.astype(jnp.int32)), rev(end_flags), jnp.add)) > 0
+    suf_nan = rev(_segscan(rev(had_nan.astype(jnp.int32)), rev(end_flags), jnp.add)) > 0
+    start = jnp.clip(lo, 0, cap - 1)
+    return suf[start], suf_valid[start], suf_nan[start]
+
+
+def _make_unrolled(a: int, b: int):
+    """Bounded ROWS min/max: static unroll over the frame width (the planner
+    gates widths above MAX_UNROLL_FRAME off the device)."""
+    def unrolled(work, valid, had_nan, lo, hi, idx, cap, op, ident):
+        out = jnp.full(cap, ident, dtype=work.dtype)
+        any_valid = jnp.zeros(cap, bool)
+        any_nan = jnp.zeros(cap, bool)
+        for k in range(a, b + 1):
+            j = idx + k
+            ok = (j >= lo) & (j <= hi)
+            safe = jnp.clip(j, 0, cap - 1)
+            out = jnp.where(ok, op(out, work[safe]), out)
+            any_valid = any_valid | (ok & valid[safe])
+            any_nan = any_nan | (ok & had_nan[safe])
+        return out, any_valid, any_nan
+
+    return unrolled
+
+
+def _agg_input(fn) -> Expression:
+    if isinstance(fn, Sum):
+        return fn.update_exprs[0]
+    if isinstance(fn, (Count, Min, Max, Average)):
+        return fn.child
+    raise NotImplementedError(f"window aggregate {type(fn).__name__}")

@@ -240,6 +240,23 @@ class Column:
     def substr(self, start, length) -> "Column":
         return Column(Substring(self.expr, _e(start), _e(length)))
 
+    # sorting direction markers (consumed by sort()/Window.order_by)
+    def desc(self) -> "Column":
+        c = Column(self.expr)
+        c._sort_desc = True
+        return c
+
+    def asc(self) -> "Column":
+        return Column(self.expr)
+
+    # windowing
+    def over(self, window) -> "Column":
+        from .expr.windows import WindowExpression, WindowSpec
+
+        spec = window.spec if hasattr(window, "spec") else window
+        assert isinstance(spec, WindowSpec)
+        return Column(WindowExpression(self.expr, spec))
+
     def __hash__(self):
         return hash(self.expr)
 
@@ -248,6 +265,36 @@ def _e(v: Union[Column, Any]) -> Expression:
     if isinstance(v, Column):
         return v.expr
     return to_expr(v)
+
+
+def row_number() -> Column:
+    from .expr.windows import RowNumber
+
+    return Column(RowNumber())
+
+
+def rank() -> Column:
+    from .expr.windows import Rank
+
+    return Column(Rank())
+
+
+def dense_rank() -> Column:
+    from .expr.windows import DenseRank
+
+    return Column(DenseRank())
+
+
+def lag(c, offset: int = 1, default=None) -> Column:
+    from .expr.windows import Lag
+
+    return Column(Lag(_e(c), offset, to_expr(default)))
+
+
+def lead(c, offset: int = 1, default=None) -> Column:
+    from .expr.windows import Lead
+
+    return Column(Lead(_e(c), offset, to_expr(default)))
 
 
 def broadcast(df):

@@ -249,6 +249,29 @@ class Expand(LogicalPlan):
 
 
 @dataclass
+class Window(LogicalPlan):
+    """Window-function node (Spark's Window logical operator): appends one
+    column per window expression to the child's output. All expressions in
+    one node share a single (partition_by, order_by) spec."""
+
+    window_cols: list  # [(name, WindowExpression)]
+    child: LogicalPlan
+
+    def children(self):
+        return [self.child]
+
+    @property
+    def schema(self) -> Schema:
+        fields = list(self.child.schema.fields)
+        for name, we in self.window_cols:
+            fields.append(StructField(name, we.data_type, we.nullable))
+        return Schema(fields)
+
+    def _node_string(self):
+        return f"Window [{', '.join(n for n, _ in self.window_cols)}]"
+
+
+@dataclass
 class Hint(LogicalPlan):
     """Planner hint wrapper (Spark's ResolvedHint; only 'broadcast' for now)."""
 

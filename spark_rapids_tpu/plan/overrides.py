@@ -260,6 +260,50 @@ _expr(nx.Greatest, check=_greatest_check)
 _expr(nx.Least, check=_greatest_check)
 
 
+# ── window expressions (GpuWindowExpression gating) ────────────────────────
+def _window_check(e, conf: TpuConf) -> Optional[str]:
+    from ..expr import windows as W
+
+    fn = e.function
+    fr = e.spec.resolved_frame()
+    if isinstance(fn, (W.Rank, W.DenseRank, W.RowNumber)):
+        if not e.spec.order_by:
+            return "ranking window functions require ORDER BY"
+        return None
+    if isinstance(fn, (W.Lead, W.Lag)):
+        return None
+    if isinstance(fn, (agg.Sum, agg.Count, agg.Min, agg.Max, agg.Average)):
+        sentinels = (W.UNBOUNDED_PRECEDING, W.CURRENT_ROW, W.UNBOUNDED_FOLLOWING)
+        if fr.frame_type == "range" and not (
+            fr.lower in sentinels and fr.upper in sentinels
+        ):
+            return "numeric RANGE frame bounds are not supported on device"
+        if isinstance(fn, (agg.Min, agg.Max)):
+            from ..exec.tpu_window import MAX_UNROLL_FRAME
+
+            if isinstance(fn.child.data_type, StringType):
+                return "string min/max over windows is CPU-only"
+            if (
+                fr.frame_type == "rows"
+                and fr.lower != W.UNBOUNDED_PRECEDING
+                and fr.upper != W.UNBOUNDED_FOLLOWING
+                and fr.upper - fr.lower + 1 > MAX_UNROLL_FRAME
+            ):
+                return (
+                    f"bounded ROWS min/max frame wider than {MAX_UNROLL_FRAME} "
+                    "is CPU-only"
+                )
+        return None
+    return f"window function {type(fn).__name__} has no device implementation"
+
+
+from ..expr import windows as _W  # noqa: E402
+
+_expr(_W.WindowExpression, check=_window_check)
+for _cls in (_W.RowNumber, _W.Rank, _W.DenseRank, _W.Lead, _W.Lag):
+    _expr(_cls)
+
+
 def expr_rules() -> dict[type, ExprRule]:
     return dict(_EXPR_RULES)
 
@@ -465,6 +509,26 @@ _rule(
     _conv_nlj,
     lambda e: [e.condition] if e.condition is not None else [],
 )
+
+
+def _conv_window(e, ch):
+    from ..exec.tpu_window import TpuWindowExec
+
+    return TpuWindowExec(e.window_cols, ch[0])
+
+
+def _window_exprs_of(e):
+    out = []
+    for _, we in e.window_cols:
+        out.append(we)
+    out.extend(e.spec.partition_by)
+    out.extend(o.child for o in e.spec.order_by)
+    return out
+
+
+from ..exec.cpu_window import CpuWindowExec as _CpuWin  # noqa: E402
+
+_rule(_CpuWin, "WindowExec", _conv_window, _window_exprs_of)
 
 
 def exec_rules() -> dict[type, ExecRule]:

@@ -86,6 +86,21 @@ def plan_physical(lp: L.LogicalPlan, conf: TpuConf) -> Exec:
         return _plan_join(lp, conf)
     if isinstance(lp, L.Hint):
         return plan_physical(lp.child, conf)
+    if isinstance(lp, L.Window):
+        from ..exec.cpu_window import CpuWindowExec
+
+        child = plan_physical(lp.child, conf)
+        spec = lp.window_cols[0][1].spec
+        if spec.partition_by:
+            child = CpuShuffleExchangeExec(
+                P.HashPartitioning(
+                    cfg.SHUFFLE_PARTITIONS.get(conf), list(spec.partition_by)
+                ),
+                child,
+            )
+        elif _num_partitions_hint(child) != 1:
+            child = CpuCoalescePartitionsExec(child)
+        return CpuWindowExec(lp.window_cols, child)
     raise NotImplementedError(f"no physical plan for {type(lp).__name__}")
 
 

@@ -159,6 +159,52 @@ def _to_exprs(cols: Sequence[Union[str, Column, Expression]]) -> List[Expression
     return out
 
 
+def _extract_windows(
+    exprs: List[Expression], plan: L.LogicalPlan
+) -> tuple[List[Expression], L.LogicalPlan]:
+    """Pull WindowExpressions out of a projection into Window nodes below it
+    (Spark's ExtractWindowExpressions): expressions sharing a
+    (partition_by, order_by) spec land in one Window node; the projection
+    references the appended columns."""
+    from .expr.base import bind as _bind
+    from .expr.base import map_child_exprs
+    from .expr.windows import WindowExpression, WindowOrder, WindowSpec, contains_window
+
+    if not any(contains_window(e) for e in exprs):
+        return exprs, plan
+
+    groups: dict = {}  # (partition_by, order_by) -> list[(name, wexpr)]
+    counter = [0]
+    child_schema = plan.schema
+
+    def pull(e: Expression) -> Expression:
+        if isinstance(e, WindowExpression):
+            # resolve against the child schema now: the Window node's own
+            # schema needs the function's type before planning
+            spec = WindowSpec(
+                tuple(_bind(p, child_schema) for p in e.spec.partition_by),
+                tuple(
+                    WindowOrder(_bind(o.child, child_schema), o.ascending, o.nulls_first)
+                    for o in e.spec.order_by
+                ),
+                e.spec.frame,
+            )
+            e = WindowExpression(_bind(e.function, child_schema), spec)
+            key = (spec.partition_by, spec.order_by)
+            name = f"__w{counter[0]}"
+            counter[0] += 1
+            groups.setdefault(key, []).append((name, e))
+            return UnresolvedAttribute(name)
+        if not e.children():
+            return e
+        return map_child_exprs(e, pull)
+
+    new_exprs = [pull(e) for e in exprs]
+    for cols in groups.values():
+        plan = L.Window(cols, plan)
+    return new_exprs, plan
+
+
 class DataFrame:
     def __init__(self, session: TpuSession, plan: L.LogicalPlan):
         self._session = session
@@ -174,7 +220,8 @@ class DataFrame:
 
     # ── transformations ─────────────────────────────────────────────────
     def select(self, *cols) -> "DataFrame":
-        return DataFrame(self._session, L.Project(_to_exprs(cols), self._plan))
+        exprs, plan = _extract_windows(_to_exprs(cols), self._plan)
+        return DataFrame(self._session, L.Project(exprs, plan))
 
     def with_column(self, name: str, c: Column) -> "DataFrame":
         exprs: List[Expression] = []
@@ -187,7 +234,8 @@ class DataFrame:
                 exprs.append(UnresolvedAttribute(f.name))
         if not replaced:
             exprs.append(Alias(c.expr, name))
-        return DataFrame(self._session, L.Project(exprs, self._plan))
+        exprs, plan = _extract_windows(exprs, self._plan)
+        return DataFrame(self._session, L.Project(exprs, plan))
 
     withColumn = with_column
 
@@ -236,6 +284,11 @@ class DataFrame:
         exprs = _to_exprs(cols)
         if isinstance(ascending, bool):
             ascending = [ascending] * len(exprs)
+        # Column.desc()/asc() markers override the ascending kwarg
+        ascending = [
+            False if (isinstance(c, Column) and getattr(c, "_sort_desc", False)) else a
+            for c, a in zip(cols, ascending)
+        ]
         return [L.SortOrder(e, a) for e, a in zip(exprs, ascending)]
 
     def limit(self, n: int) -> "DataFrame":

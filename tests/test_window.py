@@ -1,0 +1,165 @@
+"""Window function differential tests — WindowFunctionSuite /
+window_function_test.py analogue (SURVEY.md §4)."""
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu import functions as F
+from spark_rapids_tpu.functions import col
+from spark_rapids_tpu.window import Window
+
+from harness import assert_cpu_and_tpu_equal
+
+
+def _table(n=300, groups=12, seed=21, with_ties=True):
+    rng = np.random.default_rng(seed)
+    ts = rng.integers(0, 40 if with_ties else 10_000_000, n).astype(np.int64)
+    v = rng.integers(-100, 100, n).astype(np.int64)
+    vmask = rng.random(n) < 0.1
+    return pa.table(
+        {
+            "k": pa.array(rng.integers(0, groups, n).astype(np.int64)),
+            "ts": pa.array(ts),
+            "v": pa.array(v, mask=vmask),
+            "f": pa.array(np.where(rng.random(n) < 0.05, np.nan, rng.random(n))),
+            "s": pa.array([f"s{int(x)}" for x in rng.integers(0, 25, n)]),
+        }
+    )
+
+
+def _w():
+    return Window.partition_by("k").order_by("ts", "s")
+
+
+def test_row_number():
+    t = _table()
+    assert_cpu_and_tpu_equal(
+        lambda s: s.create_dataframe(t, num_partitions=3).with_column(
+            "rn", F.row_number().over(_w())
+        )
+    )
+
+
+def test_rank_dense_rank_with_ties():
+    t = _table(with_ties=True)
+    w = Window.partition_by("k").order_by("ts")
+    assert_cpu_and_tpu_equal(
+        lambda s: s.create_dataframe(t, num_partitions=3)
+        .with_column("r", F.rank().over(w))
+        .with_column("dr", F.dense_rank().over(w))
+    )
+
+
+def test_running_sum_default_frame_peers():
+    # default frame with ORDER BY = RANGE UNBOUNDED..CURRENT: peers share
+    t = _table(with_ties=True)
+    w = Window.partition_by("k").order_by("ts")
+    assert_cpu_and_tpu_equal(
+        lambda s: s.create_dataframe(t, num_partitions=2).with_column(
+            "rs", F.sum(col("v")).over(w)
+        )
+    )
+
+
+def test_partition_total_no_order():
+    t = _table()
+    w = Window.partition_by("k")
+    assert_cpu_and_tpu_equal(
+        lambda s: s.create_dataframe(t, num_partitions=3)
+        .with_column("tot", F.sum(col("v")).over(w))
+        .with_column("cnt", F.count(col("v")).over(w))
+        .with_column("mean", F.avg(col("v")).over(w))
+    )
+
+
+def test_lead_lag():
+    t = _table(with_ties=False)
+    w = _w()
+    assert_cpu_and_tpu_equal(
+        lambda s: s.create_dataframe(t, num_partitions=2)
+        .with_column("lg", F.lag(col("v"), 1).over(w))
+        .with_column("ld", F.lead(col("v"), 2, -999).over(w))
+        .with_column("sl", F.lag(col("s"), 1, "none").over(w))
+    )
+
+
+@pytest.mark.parametrize("lo,hi", [(-3, 0), (-2, 2), (0, 3), (-5, -1), (1, 4)])
+def test_bounded_rows_sum_min_max(lo, hi):
+    t = _table(with_ties=False)
+    w = _w().rows_between(lo, hi)
+    assert_cpu_and_tpu_equal(
+        lambda s: s.create_dataframe(t, num_partitions=2)
+        .with_column("bs", F.sum(col("v")).over(w))
+        .with_column("bmin", F.min(col("v")).over(w))
+        .with_column("bmax", F.max(col("v")).over(w))
+        .with_column("bc", F.count(col("v")).over(w)),
+    )
+
+
+def test_unbounded_prefix_suffix_min_max():
+    t = _table(with_ties=False)
+    w1 = _w().rows_between(Window.unbounded_preceding, Window.current_row)
+    w2 = _w().rows_between(Window.current_row, Window.unbounded_following)
+    assert_cpu_and_tpu_equal(
+        lambda s: s.create_dataframe(t, num_partitions=2)
+        .with_column("pmin", F.min(col("v")).over(w1))
+        .with_column("smax", F.max(col("v")).over(w2))
+    )
+
+
+def test_float_window_with_nans():
+    t = _table()
+    w = Window.partition_by("k")
+    assert_cpu_and_tpu_equal(
+        lambda s: s.create_dataframe(t, num_partitions=2)
+        .with_column("fmin", F.min(col("f")).over(w))
+        .with_column("fmax", F.max(col("f")).over(w)),
+        approx_float=True,
+    )
+
+
+def test_desc_order_window():
+    t = _table(with_ties=False)
+    w = Window.partition_by("k").order_by(col("ts").desc())
+    assert_cpu_and_tpu_equal(
+        lambda s: s.create_dataframe(t, num_partitions=2).with_column(
+            "rn", F.row_number().over(w)
+        )
+    )
+
+
+def test_no_partition_window():
+    t = _table(n=120)
+    w = Window.order_by("ts", "s")
+    assert_cpu_and_tpu_equal(
+        lambda s: s.create_dataframe(t, num_partitions=3).with_column(
+            "rn", F.row_number().over(w)
+        )
+    )
+
+
+def test_multiple_specs_one_select():
+    t = _table(with_ties=False)
+    w1 = Window.partition_by("k").order_by("ts", "s")
+    w2 = Window.partition_by("s")
+    assert_cpu_and_tpu_equal(
+        lambda s: s.create_dataframe(t, num_partitions=2)
+        .with_column("rn", F.row_number().over(w1))
+        .with_column("tot", F.count(col("v")).over(w2))
+    )
+
+
+def test_window_fallback_wide_minmax_frame():
+    # frame wider than the unroll cap → CPU fallback, results still correct
+    t = _table(n=100, with_ties=False)
+    w = _w().rows_between(-300, 300)
+    assert_cpu_and_tpu_equal(
+        lambda s: s.create_dataframe(t, num_partitions=2).with_column(
+            "m", F.min(col("v")).over(w)
+        ),
+        allowed_non_tpu=[
+            "CpuWindowExec",
+            "CpuCoalescePartitionsExec",
+            "CpuShuffleExchange",
+        ],
+    )
