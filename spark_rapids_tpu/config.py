@@ -239,6 +239,39 @@ OUT_OF_CORE_SORT_THRESHOLD = conf("spark.rapids.tpu.sort.outOfCoreThresholdBytes
 ).bytes_conf(1 << 30)
 
 
+SHUFFLE_COMPRESSION_CODEC = conf("spark.rapids.shuffle.compression.codec").doc(
+    "Codec for shuffle buffers on the inter-host (DCN) path: none, copy, "
+    "lz4, zstd (reference: TableCompressionCodec + nvcomp LZ4)."
+).string_conf("lz4")
+
+SHUFFLE_MAX_RECEIVE_INFLIGHT = conf(
+    "spark.rapids.shuffle.transport.maxReceiveInflightBytes"
+).doc(
+    "Bytes a reduce task may have requested but not yet received "
+    "(reference: RapidsConf.scala:850)."
+).bytes_conf(1 << 30)
+
+SHUFFLE_BOUNCE_BUFFER_SIZE = conf("spark.rapids.shuffle.bounceBufferSize").doc(
+    "Size of each host staging (bounce) buffer used to window large shuffle "
+    "payloads into frames (reference: BounceBufferManager)."
+).bytes_conf(4 << 20)
+
+SHUFFLE_BOUNCE_BUFFER_COUNT = conf("spark.rapids.shuffle.bounceBufferCount").doc(
+    "Number of bounce buffers in the staging pool."
+).int_conf(8)
+
+SHUFFLE_FETCH_TIMEOUT_S = conf("spark.rapids.shuffle.fetchTimeoutSeconds").doc(
+    "Seconds a reduce task waits for shuffle data before raising a fetch "
+    "failure (reference: shuffleFetchTimeoutSeconds)."
+).int_conf(120)
+
+SHUFFLE_MANAGER_ENABLED = conf("spark.rapids.shuffle.manager.enabled").doc(
+    "Route exchanges through the accelerated shuffle manager (device-"
+    "resident spillable map output + transport fetches) instead of the "
+    "in-process default path (reference: RapidsShuffleManager)."
+).boolean_conf(False)
+
+
 class TpuConf:
     """An immutable-ish view over a key→string dict, with typed access.
 

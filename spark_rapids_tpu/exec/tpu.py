@@ -838,6 +838,47 @@ class TpuShuffleExchangeExec(Exec):
             state["buckets"] = buckets
             return buckets
 
+        from .. import config as cfg
+
+        if cfg.SHUFFLE_MANAGER_ENABLED.get(ctx.conf):
+            # Accelerated path: park partition buckets in the spillable
+            # shuffle catalog and read them back through the caching
+            # reader (RapidsShuffleManager writer/reader protocol).
+            mgr_state = {"shuffle_id": None}
+
+            def ensure_written():
+                if mgr_state["shuffle_id"] is not None:
+                    return mgr_state["shuffle_id"]
+                manager = ctx.shuffle_manager
+                sid = ctx.next_shuffle_id()
+                writer = manager.get_writer(sid, map_id=0, num_partitions=nparts)
+                for p, bucket in enumerate(materialize()):
+                    for db in bucket:
+                        if db.row_count():
+                            writer.write(p, db)
+                writer.commit()
+                state["buckets"] = None  # catalog owns the batches now
+                mgr_state["shuffle_id"] = sid
+                return sid
+
+            consumed: set = set()
+
+            def make_managed(p):
+                def it():
+                    sid = ensure_written()
+                    yield from ctx.shuffle_manager.get_reader().read_partitions(
+                        sid, p, p + 1
+                    )
+                    # free catalog-held map output once every partition has
+                    # been drained (ShuffleBufferCatalog unregisterShuffle)
+                    consumed.add(p)
+                    if len(consumed) == nparts:
+                        ctx.shuffle_manager.unregister_shuffle(sid)
+
+                return it
+
+            return PartitionSet([make_managed(p) for p in range(nparts)])
+
         def make(p):
             def it():
                 for db in materialize()[p]:

@@ -1,0 +1,190 @@
+"""ICI device-plane shuffle: hash-partitioned all-to-all of whole batches.
+
+The intra-slice replacement for the reference's UCX data plane
+(shuffle-plugin UCX.scala): instead of tag-matched RDMA sends through bounce
+buffers, every chip buckets its rows by ``murmur3(keys) % n_chips`` and one
+fused ``lax.all_to_all`` moves all buckets over ICI inside a single jitted
+program — no serialization, no host round trip, no per-block handshakes.
+The generic version here exchanges any fixed-width DeviceBatch (strings ride
+as their padded byte matrices); the fused partial→exchange→final aggregate
+specialization lives in distributed.py.
+
+Static-shape contract: each chip sends a ``capacity``-row bucket to every
+other chip (send buffer ``[n, cap, ...]``); live rows per bucket ride as a
+``[n]`` count vector exchanged alongside. After the exchange each chip
+compacts its n received buckets into one batch.
+"""
+from __future__ import annotations
+
+from typing import Callable, List, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..columnar.device import DeviceBatch, DeviceColumn
+from ..ops.hash import murmur3_rows, partition_ids
+
+
+def _bucket_and_scatter(batch: DeviceBatch, key_indices: Sequence[int], n: int):
+    """Per-chip: bucket rows by key hash; returns (per-column send buffers
+    [n, cap, ...], live counts [n])."""
+    cap = batch.capacity
+    cols = []
+    for ki in key_indices:
+        c = batch.columns[ki]
+        cols.append((c.dtype, c.data, c.validity, c.lengths))
+    h = murmur3_rows(jnp, cols, cap)
+    pid = partition_ids(jnp, h, n)
+    pid = jnp.where(batch.row_mask(), pid, n)  # dead rows → dropped
+
+    order = jnp.argsort(pid, stable=True)
+    sorted_pid = pid[order]
+    start = jnp.searchsorted(sorted_pid, jnp.arange(n + 1))
+    rank_sorted = jnp.arange(cap) - start[jnp.clip(sorted_pid, 0, n)]
+    slot = jnp.zeros(cap, jnp.int32).at[order].set(rank_sorted.astype(jnp.int32))
+    counts = (start[1:] - start[:-1]).astype(jnp.int32)
+
+    def scatter(arr):
+        buf_shape = (n,) + arr.shape
+        buf = jnp.zeros(buf_shape, dtype=arr.dtype)
+        return buf.at[pid, slot].set(arr, mode="drop")
+
+    send_cols = []
+    for c in batch.columns:
+        send_cols.append(
+            (
+                scatter(c.data),
+                scatter(c.validity),
+                None if c.lengths is None else scatter(c.lengths),
+            )
+        )
+    return send_cols, counts
+
+
+def _exchange_and_compact(schema, send_cols, counts, axis: str, n: int, cap: int):
+    """all_to_all every buffer, then compact the n received buckets into one
+    prefix-compacted batch."""
+    recv_counts = jax.lax.all_to_all(counts[:, None], axis, 0, 0, tiled=True)[:, 0]
+    # received bucket b occupies rows [b*cap, b*cap + recv_counts[b])
+    row = jnp.arange(n * cap, dtype=jnp.int32)
+    bucket = row // cap
+    within = row % cap
+    live = within < recv_counts[bucket]
+    # destination offsets: exclusive scan of counts
+    offs = jnp.concatenate([jnp.zeros(1, jnp.int32), jnp.cumsum(recv_counts)[:-1].astype(jnp.int32)])
+    dest = jnp.where(live, offs[bucket] + within, n * cap)  # dead → dropped
+
+    def one(buf):
+        r = jax.lax.all_to_all(buf, axis, 0, 0, tiled=True)
+        flat = r.reshape((n * cap,) + r.shape[2:])
+        out = jnp.zeros((cap,) + r.shape[2:], dtype=r.dtype)
+        return out.at[dest].set(flat, mode="drop")
+
+    out_cols = []
+    for f, (d, v, l) in zip(schema, send_cols):
+        out_cols.append(
+            DeviceColumn(
+                f.data_type, one(d), one(v), None if l is None else one(l)
+            )
+        )
+    total = recv_counts.sum().astype(jnp.int32)
+    # total may exceed cap under hash skew; the batch is clamped but the
+    # true total is returned so callers can fail loudly instead of
+    # silently losing rows
+    return DeviceBatch(schema, out_cols, jnp.minimum(total, cap)), total
+
+
+def build_ici_exchange(
+    mesh: Mesh, schema, key_indices: Sequence[int], axis: str = "dp"
+) -> Callable:
+    """Compile a device-plane hash exchange: each chip's rows in, each chip's
+    re-partitioned rows out — one XLA program, collectives on ICI.
+
+    Signature of the returned jitted fn (global views, sharded on dim 0 over
+    ``axis``; ``cap`` = rows per chip):
+      inputs:  flat column leaves ``[n*cap, ...]`` in (data, validity[,
+               lengths]) order per schema field, then ``num_rows [n]``
+      outputs: the same leaf layout re-partitioned, then ``out_rows [n]``
+
+    A chip keeps at most ``cap`` received rows — callers size capacity with
+    hash-skew headroom exactly like the reference sizes batches."""
+    n = mesh.devices.size
+
+    def per_chip(*flat):
+        *leaves, num_rows = flat
+        cols, i = [], 0
+        for f in schema:
+            from ..types import StringType
+
+            if isinstance(f.data_type, StringType):
+                cols.append(DeviceColumn(f.data_type, leaves[i], leaves[i + 1], leaves[i + 2]))
+                i += 3
+            else:
+                cols.append(DeviceColumn(f.data_type, leaves[i], leaves[i + 1]))
+                i += 2
+        cap = cols[0].capacity
+        batch = DeviceBatch(schema, cols, num_rows[0].astype(jnp.int32))
+        send_cols, counts = _bucket_and_scatter(batch, key_indices, n)
+        out, total = _exchange_and_compact(schema, send_cols, counts, axis, n, cap)
+        out_leaves = []
+        for c in out.columns:
+            out_leaves.append(c.data)
+            out_leaves.append(c.validity)
+            if c.lengths is not None:
+                out_leaves.append(c.lengths)
+        # out_rows carries the TRUE received total (possibly > cap) so the
+        # host side can detect overflow
+        return (*out_leaves, total[None])
+
+    n_leaves = sum(3 if f.data_type.__class__.__name__ == "StringType" else 2 for f in schema)
+    in_specs = tuple([P(axis)] * (n_leaves + 1))
+    out_specs = tuple([P(axis)] * (n_leaves + 1))
+    mapped = shard_map(per_chip, mesh=mesh, in_specs=in_specs, out_specs=out_specs)
+    return jax.jit(mapped)
+
+
+def batch_to_global_leaves(batches: List[DeviceBatch]):
+    """Stack one per-chip batch list into the global leaf layout that
+    ``build_ici_exchange`` consumes (host-side test/driver helper)."""
+    import numpy as np
+
+    leaves = []
+    first = batches[0]
+    for ci, c in enumerate(first.columns):
+        leaves.append(jnp.concatenate([b.columns[ci].data for b in batches]))
+        leaves.append(jnp.concatenate([b.columns[ci].validity for b in batches]))
+        if c.lengths is not None:
+            leaves.append(jnp.concatenate([b.columns[ci].lengths for b in batches]))
+    num_rows = jnp.asarray(np.asarray([b.row_count() for b in batches], dtype=np.int32))
+    return (*leaves, num_rows)
+
+
+def global_leaves_to_batches(schema, outs, n: int) -> List[DeviceBatch]:
+    """Split the exchange output back into per-chip DeviceBatches."""
+    from ..types import StringType
+
+    *leaves, out_rows = outs
+    cap = leaves[0].shape[0] // n
+    import numpy as np
+
+    totals = np.asarray(out_rows)
+    if (totals > cap).any():
+        raise ValueError(
+            f"ICI exchange overflow: chip received {int(totals.max())} rows "
+            f"with capacity {cap} — increase per-chip capacity (hash skew)"
+        )
+    result = []
+    for chip in range(n):
+        cols, i = [], 0
+        sl = slice(chip * cap, (chip + 1) * cap)
+        for f in schema:
+            if isinstance(f.data_type, StringType):
+                cols.append(DeviceColumn(f.data_type, leaves[i][sl], leaves[i + 1][sl], leaves[i + 2][sl]))
+                i += 3
+            else:
+                cols.append(DeviceColumn(f.data_type, leaves[i][sl], leaves[i + 1][sl]))
+                i += 2
+        result.append(DeviceBatch(schema, cols, out_rows[chip]))
+    return result

@@ -65,6 +65,38 @@ class ExecContext:
         limit = cfg.DEVICE_POOL_LIMIT.get(conf)
         if limit > 0:
             self.catalog.device_limit = limit
+        import itertools
+
+        self._shuffle_manager = None
+        self._shuffle_ids = itertools.count(1)
+
+    @property
+    def shuffle_manager(self):
+        """Lazily built accelerated shuffle manager (GpuShuffleEnv.init
+        analogue) — one in-process 'executor' per session context."""
+        if self._shuffle_manager is None:
+            from .. import config as cfg
+            from ..shuffle.heartbeat import ShuffleHeartbeatManager
+            from ..shuffle.local import InProcessRegistry, InProcessTransport
+            from ..shuffle.manager import MapOutputRegistry, ShuffleEnv, TpuShuffleManager
+
+            reg = InProcessRegistry()
+            env = ShuffleEnv(
+                "driver-executor",
+                InProcessTransport("driver-executor", reg),
+                self.catalog,
+                ShuffleHeartbeatManager(),
+                codec=cfg.SHUFFLE_COMPRESSION_CODEC.get(self.conf),
+                max_inflight_bytes=cfg.SHUFFLE_MAX_RECEIVE_INFLIGHT.get(self.conf),
+                fetch_timeout_s=cfg.SHUFFLE_FETCH_TIMEOUT_S.get(self.conf),
+                bounce_buffer_size=cfg.SHUFFLE_BOUNCE_BUFFER_SIZE.get(self.conf),
+                bounce_buffer_count=cfg.SHUFFLE_BOUNCE_BUFFER_COUNT.get(self.conf),
+            )
+            self._shuffle_manager = TpuShuffleManager(env, MapOutputRegistry())
+        return self._shuffle_manager
+
+    def next_shuffle_id(self) -> int:
+        return next(self._shuffle_ids)
 
 
 class PartitionSet:

@@ -1,0 +1,66 @@
+"""Accelerated columnar shuffle (SURVEY §2.7).
+
+Three data planes, mirroring the reference's transport split:
+
+* **ICI (intra-slice)** — partitions exchanged device-to-device as one fused
+  ``lax.all_to_all`` inside a jitted step (parallel/ici.py, parallel/
+  distributed.py). Replaces UCX NVLink/RDMA; never serializes.
+* **In-process** — same-host executors share the HBM-resident shuffle
+  catalog; the transport SPI runs over direct calls (local.py).
+* **TCP/DCN (inter-host)** — length-prefixed framed streams (tcp.py), the
+  UCX-over-network replacement, with Arrow-IPC + LZ4/ZSTD payloads staged
+  through bounce buffers.
+
+The SPI (transport.py), metadata schema (meta.py), catalogs (catalog.py),
+client/server protocol (client.py / server.py), heartbeat discovery
+(heartbeat.py) and manager (manager.py) are transport-agnostic, exactly like
+the reference's RapidsShuffleTransport seam.
+"""
+from .catalog import ShuffleBufferCatalog, ShuffleReceivedBufferCatalog
+from .client import ShuffleClient, ShuffleFetchError
+from .compression import get_codec
+from .heartbeat import HeartbeatEndpoint, ShuffleHeartbeatManager
+from .manager import (
+    CachingReader,
+    CachingWriter,
+    MapOutputRegistry,
+    MapStatus,
+    ShuffleEnv,
+    TpuShuffleManager,
+)
+from .server import ShuffleServer
+from .transport import (
+    REQ_METADATA,
+    REQ_TRANSFER,
+    ClientConnection,
+    InflightThrottle,
+    ServerConnection,
+    Transaction,
+    TransactionStatus,
+    Transport,
+)
+
+__all__ = [
+    "ShuffleBufferCatalog",
+    "ShuffleReceivedBufferCatalog",
+    "ShuffleClient",
+    "ShuffleFetchError",
+    "get_codec",
+    "HeartbeatEndpoint",
+    "ShuffleHeartbeatManager",
+    "CachingReader",
+    "CachingWriter",
+    "MapOutputRegistry",
+    "MapStatus",
+    "ShuffleEnv",
+    "TpuShuffleManager",
+    "ShuffleServer",
+    "REQ_METADATA",
+    "REQ_TRANSFER",
+    "ClientConnection",
+    "InflightThrottle",
+    "ServerConnection",
+    "Transaction",
+    "TransactionStatus",
+    "Transport",
+]

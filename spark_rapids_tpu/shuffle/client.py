@@ -1,0 +1,169 @@
+"""Shuffle client — fetches remote map output over the transport SPI.
+
+Reference: shuffle/RapidsShuffleClient.scala:74-120 — metadata request →
+throttled TransferRequests → BufferReceiveState reassembly → received-buffer
+catalog; and shuffle/RapidsShuffleIterator.scala — per-task orchestration
+with fetch timeouts surfacing as fetch failures (stage retry).
+"""
+from __future__ import annotations
+
+import itertools
+import queue
+import threading
+from typing import Iterator, List, Optional, Tuple
+
+from . import meta as M
+from .bounce import BufferReceiveState
+from .catalog import ShuffleReceivedBufferCatalog
+from .server import unpack_frame
+from .transport import (
+    REQ_METADATA,
+    REQ_TRANSFER,
+    ClientConnection,
+    InflightThrottle,
+    TransactionStatus,
+)
+
+
+class ShuffleFetchError(Exception):
+    """Surfaced to the task as a fetch failure (the FetchFailedException
+    analogue → upstream stage retry)."""
+
+
+_tag_counter = itertools.count(0x1000)
+
+
+class ShuffleClient:
+    def __init__(
+        self,
+        conn: ClientConnection,
+        received: ShuffleReceivedBufferCatalog,
+        throttle: Optional[InflightThrottle] = None,
+        fetch_timeout_s: float = 120.0,
+    ):
+        self._conn = conn
+        self._received = received
+        self._throttle = throttle or InflightThrottle(1 << 30)
+        self._timeout = fetch_timeout_s
+        self._lock = threading.Lock()
+        # tag → (BufferReceiveState, TableMeta, completion queue); fetches
+        # from concurrent reduce tasks coexist because tags are globally
+        # unique (the UCX tag-space property the reference relies on)
+        self._inflight_tags: dict = {}
+        conn.set_frame_handler(self._on_frame)
+
+    # ── frame path ──────────────────────────────────────────────────────
+    def _on_frame(self, tag: int, seq: int, data: bytes):
+        # transports hand us the raw framed bytes; unwrap the (tag, seq) header
+        tag, seq, body = unpack_frame(data)
+        # single critical section: whoever pops a tag from _inflight_tags
+        # owns its completion AND its throttle release — the cleanup paths
+        # follow the same claim protocol, so double-release is impossible
+        with self._lock:
+            entry = self._inflight_tags.get(tag)
+            if entry is None:
+                return  # fetch abandoned (timeout) — drop the late frame
+            state, meta, completions = entry
+            payload = state.on_frame(tag, seq, bytes(body))
+            if payload is not None:
+                self._inflight_tags.pop(tag, None)
+        if payload is not None:
+            rid = self._received.add(payload, meta)
+            self._throttle.release(meta.buffer.size)
+            completions.put((rid, meta))
+
+    # ── fetch orchestration ─────────────────────────────────────────────
+    def fetch_blocks(
+        self, blocks: List[M.BlockId]
+    ) -> Iterator[Tuple[int, M.TableMeta]]:
+        """Fetch all batches for the block ranges; yields (received_id, meta)
+        as transfers complete. The caller materializes via the received
+        catalog (RapidsShuffleIterator's batch-per-next loop). Safe to call
+        from concurrent tasks sharing this client."""
+        tx = self._conn.request(REQ_METADATA, M.pack_metadata_request(blocks))
+        tx.wait(self._timeout)
+        if tx.status != TransactionStatus.SUCCESS:
+            raise ShuffleFetchError(f"metadata request failed: {tx.error}")
+        metas = M.unpack_metadata_response(tx.payload)
+        if not metas:
+            return
+        completions: "queue.Queue" = queue.Queue()
+        tags = [next(_tag_counter) for _ in metas]
+        with self._lock:
+            for t, m in zip(tags, metas):
+                self._inflight_tags[t] = (
+                    BufferReceiveState({t: m.buffer.size}),
+                    m,
+                    completions,
+                )
+
+        # issue transfer requests in throttled waves (client-side inflight
+        # bytes bound — RapidsConf maxReceiveInflightBytes)
+        cancelled = threading.Event()
+        acquired_tags: set = set()
+
+        def issue():
+            for i, m in enumerate(metas):
+                if cancelled.is_set():
+                    return
+                self._throttle.acquire(m.buffer.size, self._timeout)
+                acquired_tags.add(tags[i])
+                if cancelled.is_set():
+                    # consumer already gave up: hand the bytes straight back
+                    # (claim the tag first — release only if we own it)
+                    with self._lock:
+                        owned = self._inflight_tags.pop(tags[i], None)
+                    if owned is not None:
+                        self._throttle.release(m.buffer.size)
+                    acquired_tags.discard(tags[i])
+                    return
+                try:
+                    req = M.TransferRequest(tags[i], (m.buffer.buffer_id,))
+                    rtx = self._conn.request(REQ_TRANSFER, req.pack())
+                    rtx.wait(self._timeout)
+                    if rtx.status != TransactionStatus.SUCCESS:
+                        raise ShuffleFetchError(rtx.error)
+                    resp = M.TransferResponse.unpack(rtx.payload)
+                    if any(resp.states):
+                        raise ShuffleFetchError(
+                            f"peer rejected buffers: {resp.states}"
+                        )
+                except Exception as e:  # noqa: BLE001 — surfaced to consumer
+                    # claim-then-release: if the server streamed the frames
+                    # before the response failed, _on_frame already owns the
+                    # tag and released the bytes — don't release twice
+                    with self._lock:
+                        owned = self._inflight_tags.pop(tags[i], None)
+                    if owned is not None:
+                        self._throttle.release(m.buffer.size)
+                    acquired_tags.discard(tags[i])
+                    completions.put(
+                        e if isinstance(e, ShuffleFetchError) else ShuffleFetchError(str(e))
+                    )
+                    return
+
+        issuer = threading.Thread(target=issue, daemon=True)
+        issuer.start()
+        try:
+            for _ in range(len(metas)):
+                try:
+                    item = completions.get(timeout=self._timeout)
+                except queue.Empty:
+                    raise ShuffleFetchError(
+                        f"timed out waiting for shuffle data from "
+                        f"{self._conn.peer_executor_id}"
+                    ) from None
+                if isinstance(item, ShuffleFetchError):
+                    raise item
+                yield item
+        finally:
+            # abandon outstanding tags (error/timeout paths): release the
+            # throttle bytes that were actually acquired so the shared
+            # window can't shrink permanently; un-issued tags just unregister
+            cancelled.set()
+            with self._lock:
+                for t in [t for t in tags if t in self._inflight_tags]:
+                    _state, m, _q = self._inflight_tags.pop(t)
+                    if t in acquired_tags:
+                        self._throttle.release(m.buffer.size)
+            issuer.join(timeout=1.0)

@@ -1,0 +1,74 @@
+"""Shuffle/spill buffer compression codecs.
+
+Reference: TableCompressionCodec.scala (:41 SPI, :137 batched compressor,
+:282 registry) + NvcompLZ4CompressionCodec.scala (GPU LZ4) +
+CopyCompressionCodec.scala. On TPU there is no device-side compression
+engine, so codecs run on host staging buffers (exactly where the DCN path
+stages data anyway); pyarrow's bundled LZ4/ZSTD fill nvcomp's role. The
+codec used for a buffer is recorded in its ``BufferMeta.codec`` so readers
+self-describe (CodecBufferDescriptor pattern).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import pyarrow as pa
+
+from . import meta as M
+
+
+class CompressionCodec:
+    """SPI: bytes→bytes with a wire id (TableCompressionCodec.scala:41)."""
+
+    codec_id: int = M.CODEC_NONE
+    name: str = "none"
+
+    def compress(self, data: bytes) -> bytes:
+        return data
+
+    def decompress(self, data: bytes, uncompressed_size: int) -> bytes:
+        return data
+
+
+class CopyCodec(CompressionCodec):
+    """Identity 'codec' (CopyCompressionCodec.scala) — used to exercise the
+    compressed-buffer plumbing without a real codec."""
+
+    codec_id = M.CODEC_COPY
+    name = "copy"
+
+
+class _ArrowCodec(CompressionCodec):
+    def __init__(self, arrow_name: str, codec_id: int, name: str):
+        self._codec = pa.Codec(arrow_name)
+        self.codec_id = codec_id
+        self.name = name
+
+    def compress(self, data: bytes) -> bytes:
+        return self._codec.compress(data, asbytes=True)
+
+    def decompress(self, data: bytes, uncompressed_size: int) -> bytes:
+        return self._codec.decompress(data, uncompressed_size, asbytes=True)
+
+
+def get_codec(name: Optional[str]) -> CompressionCodec:
+    """Registry lookup (TableCompressionCodec.getCodec :282)."""
+    name = (name or "none").lower()
+    if name in ("none", "off"):
+        return CompressionCodec()
+    if name == "copy":
+        return CopyCodec()
+    if name == "lz4":
+        return _ArrowCodec("lz4", M.CODEC_LZ4, "lz4")
+    if name == "zstd":
+        return _ArrowCodec("zstd", M.CODEC_ZSTD, "zstd")
+    raise ValueError(f"unknown shuffle compression codec {name!r}")
+
+
+def codec_for_id(codec_id: int) -> CompressionCodec:
+    return {
+        M.CODEC_NONE: CompressionCodec(),
+        M.CODEC_COPY: CopyCodec(),
+        M.CODEC_LZ4: _ArrowCodec("lz4", M.CODEC_LZ4, "lz4"),
+        M.CODEC_ZSTD: _ArrowCodec("zstd", M.CODEC_ZSTD, "zstd"),
+    }[codec_id]

@@ -1,0 +1,81 @@
+"""Executor discovery via driver-mediated heartbeats.
+
+Reference: RapidsShuffleHeartbeatManager.scala:51,114 — executors register
+with the driver plugin on startup; each heartbeat returns the peers that
+appeared since the executor last asked, so every executor eventually knows
+every peer's shuffle server address (BlockManagerId topology field →
+here the transport address)."""
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Tuple
+
+
+class ExecutorInfo:
+    def __init__(self, executor_id: str, address: Optional[tuple]):
+        self.executor_id = executor_id
+        self.address = address  # transport dial address (None for in-process)
+
+    def __repr__(self):
+        return f"ExecutorInfo({self.executor_id}, {self.address})"
+
+
+class ShuffleHeartbeatManager:
+    """Driver-side registry (one per 'driver')."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._order: List[ExecutorInfo] = []
+        self._index: Dict[str, int] = {}
+        self._last_seen: Dict[str, int] = {}  # executor -> high-water index
+
+    def register_executor(self, executor_id: str, address: Optional[tuple] = None) -> List[ExecutorInfo]:
+        """First contact: returns ALL currently known peers
+        (RapidsShuffleHeartbeatManager.registerExecutor)."""
+        with self._lock:
+            if executor_id not in self._index:
+                self._index[executor_id] = len(self._order)
+                self._order.append(ExecutorInfo(executor_id, address))
+            peers = [e for e in self._order if e.executor_id != executor_id]
+            self._last_seen[executor_id] = len(self._order)
+            return peers
+
+    def executor_heartbeat(self, executor_id: str) -> List[ExecutorInfo]:
+        """Returns peers registered since this executor last heard
+        (.executorHeartbeat :114)."""
+        with self._lock:
+            start = self._last_seen.get(executor_id, 0)
+            self._last_seen[executor_id] = len(self._order)
+            return [
+                e
+                for e in self._order[start:]
+                if e.executor_id != executor_id
+            ]
+
+    def all_executors(self) -> List[ExecutorInfo]:
+        with self._lock:
+            return list(self._order)
+
+
+class HeartbeatEndpoint:
+    """Executor-side: keeps a local peer table fresh
+    (RapidsShuffleHeartbeatEndpoint in Plugin.scala:197)."""
+
+    def __init__(self, executor_id: str, manager: ShuffleHeartbeatManager, address=None):
+        self.executor_id = executor_id
+        self._manager = manager
+        self._lock = threading.Lock()
+        self.peers: Dict[str, ExecutorInfo] = {}
+        for p in manager.register_executor(executor_id, address):
+            self.peers[p.executor_id] = p
+
+    def heartbeat(self):
+        new = self._manager.executor_heartbeat(self.executor_id)
+        with self._lock:
+            for p in new:
+                self.peers.setdefault(p.executor_id, p)
+        return new
+
+    def peer(self, executor_id: str) -> Optional[ExecutorInfo]:
+        with self._lock:
+            return self.peers.get(executor_id)

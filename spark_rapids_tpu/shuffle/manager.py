@@ -1,0 +1,187 @@
+"""Accelerated shuffle manager — caching writer/reader over the transport.
+
+Reference: RapidsShuffleInternalManagerBase.scala — ``RapidsCachingWriter``
+(:73-194) parks partition batches device-resident in the spillable shuffle
+catalog and reports real sizes in the MapStatus; ``RapidsCachingReader``
+(RapidsCachingReader.scala:49) serves local blocks from the catalog
+(zero-copy) and fetches remote blocks via the ShuffleClient; GpuShuffleEnv
+(GpuShuffleEnv.scala:26-112) owns catalogs + codec per executor. The driver
+side here is ``MapOutputRegistry`` (Spark's MapOutputTracker role): which
+executor holds which map output.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, Iterator, List, Optional, Tuple
+
+from ..columnar.device import DeviceBatch
+from ..mem.spill import BufferCatalog
+from . import meta as M
+from .catalog import ShuffleBufferCatalog, ShuffleReceivedBufferCatalog
+from .client import ShuffleClient
+from .compression import CompressionCodec, get_codec
+from .heartbeat import HeartbeatEndpoint, ShuffleHeartbeatManager
+from .server import ShuffleServer
+from .transport import ClientConnection, InflightThrottle, Transport
+
+
+class MapStatus:
+    """Map-task completion record: where the output lives + per-partition
+    sizes (Spark MapStatus; RapidsShuffleInternalManagerBase:164+)."""
+
+    def __init__(self, executor_id: str, shuffle_id: int, map_id: int, sizes: List[int]):
+        self.executor_id = executor_id
+        self.shuffle_id = shuffle_id
+        self.map_id = map_id
+        self.sizes = sizes
+
+
+class MapOutputRegistry:
+    """Driver-side map-output tracker."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._statuses: Dict[Tuple[int, int], MapStatus] = {}
+
+    def register(self, status: MapStatus):
+        with self._lock:
+            self._statuses[(status.shuffle_id, status.map_id)] = status
+
+    def outputs_for(self, shuffle_id: int) -> List[MapStatus]:
+        with self._lock:
+            return [s for (sid, _m), s in self._statuses.items() if sid == shuffle_id]
+
+    def remove_shuffle(self, shuffle_id: int):
+        with self._lock:
+            for k in [k for k in self._statuses if k[0] == shuffle_id]:
+                del self._statuses[k]
+
+
+class ShuffleEnv:
+    """Per-executor shuffle environment (GpuShuffleEnv analogue)."""
+
+    def __init__(
+        self,
+        executor_id: str,
+        transport: Transport,
+        store: BufferCatalog,
+        heartbeat: ShuffleHeartbeatManager,
+        codec: str = "lz4",
+        max_inflight_bytes: int = 1 << 30,
+        address: Optional[tuple] = None,
+        fetch_timeout_s: float = 120.0,
+        bounce_buffer_size: int = 4 << 20,
+        bounce_buffer_count: int = 8,
+    ):
+        from .bounce import BounceBufferManager
+
+        self.executor_id = executor_id
+        self.transport = transport
+        self.catalog = ShuffleBufferCatalog(store)
+        self.received = ShuffleReceivedBufferCatalog()
+        self.codec: CompressionCodec = get_codec(codec)
+        self.throttle = InflightThrottle(max_inflight_bytes)
+        self.fetch_timeout_s = fetch_timeout_s
+        self.server = ShuffleServer(
+            executor_id,
+            transport.server,
+            self.catalog,
+            self.codec,
+            BounceBufferManager(bounce_buffer_size, bounce_buffer_count),
+        )
+        self.heartbeat = HeartbeatEndpoint(executor_id, heartbeat, address)
+        self._clients: Dict[str, "ShuffleClient"] = {}
+        self._lock = threading.Lock()
+
+    def client_to(self, peer_executor_id: str) -> "ShuffleClient":
+        """One ShuffleClient per peer connection — it owns the connection's
+        frame handler, and concurrent fetches multiplex by tag."""
+        with self._lock:
+            client = self._clients.get(peer_executor_id)
+            if client is None:
+                self.heartbeat.heartbeat()  # refresh peer table
+                peer = self.heartbeat.peer(peer_executor_id)
+                addr = peer.address if peer is not None else None
+                conn = self.transport.connect(peer_executor_id, addr)
+                client = ShuffleClient(
+                    conn, self.received, self.throttle, self.fetch_timeout_s
+                )
+                self._clients[peer_executor_id] = client
+        return client
+
+
+class CachingWriter:
+    """Map-side writer: batches stay device-resident and spillable
+    (RapidsCachingWriter.write)."""
+
+    def __init__(self, env: ShuffleEnv, registry: MapOutputRegistry, shuffle_id: int, map_id: int, num_partitions: int):
+        self._env = env
+        self._registry = registry
+        self.shuffle_id = shuffle_id
+        self.map_id = map_id
+        self._sizes = [0] * num_partitions
+
+    def write(self, partition_id: int, batch: DeviceBatch):
+        size = self._env.catalog.add_batch(
+            self.shuffle_id, self.map_id, partition_id, batch
+        )
+        self._sizes[partition_id] += size
+
+    def commit(self) -> MapStatus:
+        status = MapStatus(
+            self._env.executor_id, self.shuffle_id, self.map_id, self._sizes
+        )
+        self._registry.register(status)
+        return status
+
+
+class CachingReader:
+    """Reduce-side reader: local catalog hits + remote transport fetches
+    (RapidsCachingReader.read)."""
+
+    def __init__(self, env: ShuffleEnv, registry: MapOutputRegistry):
+        self._env = env
+        self._registry = registry
+
+    def read_partitions(
+        self, shuffle_id: int, start_part: int, end_part: int
+    ) -> Iterator[DeviceBatch]:
+        statuses = self._registry.outputs_for(shuffle_id)
+        # group remote requests per peer executor (one metadata round trip
+        # per peer, the RapidsShuffleIterator batching)
+        remote: Dict[str, List[M.BlockId]] = {}
+        for s in statuses:
+            if any(s.sizes[p] for p in range(start_part, min(end_part, len(s.sizes)))):
+                if s.executor_id == self._env.executor_id:
+                    for bid, handle, _rows in self._env.catalog.blocks_for(
+                        shuffle_id, s.map_id, start_part, end_part
+                    ):
+                        yield self._env.catalog.get_batch(bid)
+                else:
+                    remote.setdefault(s.executor_id, []).append(
+                        M.BlockId(shuffle_id, s.map_id, start_part, end_part)
+                    )
+        for peer, blocks in remote.items():
+            client = self._env.client_to(peer)
+            for rid, _meta in client.fetch_blocks(blocks):
+                yield self._env.received.materialize(rid)
+
+
+class TpuShuffleManager:
+    """Ties it together per executor (RapidsShuffleInternalManagerBase:200)."""
+
+    def __init__(self, env: ShuffleEnv, registry: MapOutputRegistry):
+        self.env = env
+        self.registry = registry
+
+    def get_writer(self, shuffle_id: int, map_id: int, num_partitions: int) -> CachingWriter:
+        return CachingWriter(self.env, self.registry, shuffle_id, map_id, num_partitions)
+
+    def get_reader(self) -> CachingReader:
+        return CachingReader(self.env, self.registry)
+
+    def unregister_shuffle(self, shuffle_id: int):
+        # server first: it resolves buffer ids through the catalog
+        self.env.server.remove_shuffle(shuffle_id)
+        self.env.catalog.remove_shuffle(shuffle_id)
+        self.registry.remove_shuffle(shuffle_id)

@@ -1,0 +1,60 @@
+"""Columnar batch (de)serialization for shuffle and broadcast.
+
+Reference: GpuColumnarBatchSerializer.scala:50 (JCudfSerialization host
+round-trip — the default shuffle path) and the `SerializedTableColumn`
+currency (:238). The TPU-native wire format is **Arrow IPC**: one
+RecordBatch per frame, optionally whole-frame compressed by a
+``CompressionCodec`` with the codec recorded in ``BufferMeta`` so the
+receiver self-describes. Device batches cross through the host staging
+seam (`device_to_host`) exactly where the reference's D2H serializer sits.
+"""
+from __future__ import annotations
+
+import io
+from typing import Optional, Tuple
+
+import pyarrow as pa
+
+from ..columnar.device import DeviceBatch, device_to_host, host_to_device
+from . import meta as M
+from .compression import CompressionCodec, codec_for_id
+
+
+def schema_to_bytes(schema: pa.Schema) -> bytes:
+    return schema.serialize().to_pybytes()
+
+
+def schema_from_bytes(data: bytes) -> pa.Schema:
+    return pa.ipc.read_schema(pa.py_buffer(data))
+
+
+def serialize_record_batch(rb: pa.RecordBatch, codec: CompressionCodec) -> Tuple[bytes, int, int]:
+    """RecordBatch → (payload, uncompressed_size, codec_id). The payload is a
+    complete Arrow IPC stream (schema + batch) so a frame is self-contained."""
+    sink = io.BytesIO()
+    with pa.ipc.new_stream(sink, rb.schema) as w:
+        w.write_batch(rb)
+    raw = sink.getvalue()
+    return codec.compress(raw), len(raw), codec.codec_id
+
+
+def deserialize_record_batch(payload: bytes, buffer_meta: M.BufferMeta) -> pa.RecordBatch:
+    codec = codec_for_id(buffer_meta.codec)
+    raw = codec.decompress(payload, buffer_meta.uncompressed_size)
+    with pa.ipc.open_stream(pa.py_buffer(raw)) as r:
+        batches = [b for b in r]
+    if len(batches) == 1:
+        return batches[0]
+    table = pa.Table.from_batches(batches)
+    return table.combine_chunks().to_batches()[0]
+
+
+def serialize_device_batch(db: DeviceBatch, codec: CompressionCodec) -> Tuple[bytes, int, int, pa.Schema]:
+    """DeviceBatch → wire payload via the host staging seam (single D2H)."""
+    rb = device_to_host(db)
+    payload, usize, cid = serialize_record_batch(rb, codec)
+    return payload, usize, cid, rb.schema
+
+
+def deserialize_to_device(payload: bytes, buffer_meta: M.BufferMeta) -> DeviceBatch:
+    return host_to_device(deserialize_record_batch(payload, buffer_meta))

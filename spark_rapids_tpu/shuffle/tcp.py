@@ -1,0 +1,253 @@
+"""TCP transport — the inter-host (DCN) data plane.
+
+Reference: the UCX implementation (shuffle-plugin UCX.scala:55 — jucx worker
++ progress thread, TCP management-port handshake exchanging WorkerAddress,
+tag-matched sends). TPU pods reach peer hosts over DCN, where a stream
+socket is the native primitive: each executor runs one listener; a
+connection handshakes with a HELLO carrying the dialing executor's id (the
+WorkerAddress-exchange analogue), then multiplexes length-prefixed frames:
+
+  REQUEST  (req_id, req_type, payload)  → dispatched to server handlers
+  RESPONSE (req_id, payload | error)    → completes the pending transaction
+  DATA     (tag, payload)               → delivered to the frame handler
+
+A per-socket reader thread is the progress-thread analogue. Intra-slice
+traffic never comes here — it rides XLA collectives (parallel/ici.py).
+"""
+from __future__ import annotations
+
+import itertools
+import socket
+import struct
+import threading
+from concurrent.futures import ThreadPoolExecutor
+from typing import Dict, Optional, Tuple
+
+from .transport import (
+    ClientConnection,
+    ServerConnection,
+    Transaction,
+    TransactionStatus,
+    Transport,
+    new_transaction,
+)
+
+_HELLO = 0
+_REQUEST = 1
+_RESPONSE = 2
+_DATA = 3
+_ERROR = 4
+
+_HEADER = struct.Struct("<bqqi")  # kind, a (req_id|tag), b (req_type|unused), len
+
+
+def _send_frame(sock: socket.socket, lock: threading.Lock, kind: int, a: int, b: int, payload: bytes):
+    with lock:
+        sock.sendall(_HEADER.pack(kind, a, b, len(payload)) + payload)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("peer closed")
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+def _recv_frame(sock: socket.socket) -> Tuple[int, int, int, bytes]:
+    head = _recv_exact(sock, _HEADER.size)
+    kind, a, b, n = _HEADER.unpack(head)
+    payload = _recv_exact(sock, n) if n else b""
+    return kind, a, b, payload
+
+
+class _TcpChannel:
+    """One socket shared by requests (client role) and data frames/responses
+    (server role) — both directions multiplex over it."""
+
+    def __init__(
+        self,
+        transport: "TcpTransport",
+        sock: socket.socket,
+        peer_id: str,
+        wlock: Optional[threading.Lock] = None,
+    ):
+        self.transport = transport
+        self.sock = sock
+        self.peer_id = peer_id
+        self.wlock = wlock or threading.Lock()
+        self.pending: Dict[int, Transaction] = {}
+        self.pending_lock = threading.Lock()
+        self.client_conn: Optional["_TcpClientConnection"] = None
+        self.reader = threading.Thread(target=self._read_loop, daemon=True)
+        self.reader.start()
+
+    def _read_loop(self):
+        try:
+            while True:
+                kind, a, b, payload = _recv_frame(self.sock)
+                if kind == _REQUEST:
+                    self.transport._dispatch_request(self, a, b, payload)
+                elif kind == _RESPONSE or kind == _ERROR:
+                    with self.pending_lock:
+                        tx = self.pending.pop(a, None)
+                    if tx is not None:
+                        if kind == _RESPONSE:
+                            tx.complete(TransactionStatus.SUCCESS, payload=payload)
+                        else:
+                            tx.complete(
+                                TransactionStatus.ERROR, error=payload.decode("utf-8", "replace")
+                            )
+                elif kind == _DATA:
+                    if self.client_conn is not None:
+                        self.client_conn.deliver_frame(a, 0, payload)
+        except (ConnectionError, OSError):
+            with self.pending_lock:
+                for tx in self.pending.values():
+                    tx.complete(TransactionStatus.ERROR, error="connection lost")
+                self.pending.clear()
+
+
+class _TcpClientConnection(ClientConnection):
+    def __init__(self, channel: _TcpChannel):
+        super().__init__(channel.peer_id)
+        self._channel = channel
+        self._req_ids = itertools.count(1)
+
+    def request(self, req_type: int, payload: bytes) -> Transaction:
+        tx = new_transaction()
+        rid = next(self._req_ids)  # pending table is per-channel, so a plain counter is unique
+        with self._channel.pending_lock:
+            self._channel.pending[rid] = tx
+        try:
+            _send_frame(
+                self._channel.sock, self._channel.wlock, _REQUEST, rid, req_type, payload
+            )
+        except OSError as e:
+            with self._channel.pending_lock:
+                self._channel.pending.pop(rid, None)
+            tx.complete(TransactionStatus.ERROR, error=str(e))
+        return tx
+
+    def close(self):
+        try:
+            self._channel.sock.close()
+        except OSError:
+            pass
+
+
+class _TcpServerConnection(ServerConnection):
+    def __init__(self, transport: "TcpTransport"):
+        super().__init__(transport.executor_id)
+        self._transport = transport
+
+    def send(self, peer_executor_id: str, tag: int, data: bytes) -> Transaction:
+        tx = new_transaction()
+        ch = self._transport._peer_channel(peer_executor_id)
+        if ch is None:
+            tx.complete(TransactionStatus.ERROR, error=f"no channel to {peer_executor_id}")
+            return tx
+        try:
+            _send_frame(ch.sock, ch.wlock, _DATA, tag, 0, data)
+            tx.complete(TransactionStatus.SUCCESS)
+        except OSError as e:
+            tx.complete(TransactionStatus.ERROR, error=str(e))
+        return tx
+
+
+class TcpTransport(Transport):
+    """One listener per executor; ``address`` is the (host, port) peers dial
+    — the BlockManagerId topology-info analogue carried by heartbeats."""
+
+    def __init__(self, executor_id: str, host: str = "127.0.0.1", port: int = 0, workers: int = 4):
+        super().__init__(executor_id)
+        self._listener = socket.create_server((host, port))
+        self.address = self._listener.getsockname()
+        self._server = _TcpServerConnection(self)
+        self._channels: Dict[str, _TcpChannel] = {}
+        self._chan_lock = threading.Lock()
+        self._pool = ThreadPoolExecutor(max_workers=workers, thread_name_prefix=f"tcp-{executor_id}")
+        self._accept_thread = threading.Thread(target=self._accept_loop, daemon=True)
+        self._accept_thread.start()
+
+    @property
+    def server(self) -> ServerConnection:
+        return self._server
+
+    def _accept_loop(self):
+        while True:
+            try:
+                sock, _addr = self._listener.accept()
+            except OSError:
+                return
+            # handshake off-thread with a deadline so a stalled or garbage
+            # client can neither block the accept loop nor kill it
+            threading.Thread(
+                target=self._handshake, args=(sock,), daemon=True
+            ).start()
+
+    def _handshake(self, sock: socket.socket):
+        try:
+            sock.settimeout(10.0)
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            kind, _a, _b, payload = _recv_frame(sock)
+            if kind != _HELLO:
+                raise ConnectionError(f"first frame must be HELLO, got {kind}")
+            sock.settimeout(None)
+            peer_id = payload.decode()
+            ch = _TcpChannel(self, sock, peer_id)
+            with self._chan_lock:
+                self._channels[peer_id] = ch
+        except Exception:  # noqa: BLE001 — bad dialers are dropped, not fatal
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+    def connect(self, peer_executor_id: str, address: Optional[tuple] = None) -> ClientConnection:
+        """Dial a peer. ``address`` comes from the heartbeat-gossiped peer
+        table; omitted → the peer was registered locally (tests)."""
+        if address is None:
+            address = _ADDRESSES[peer_executor_id]
+        sock = socket.create_connection(tuple(address))
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        lock = threading.Lock()
+        _send_frame(sock, lock, _HELLO, 0, 0, self.executor_id.encode())
+        ch = _TcpChannel(self, sock, peer_executor_id, wlock=lock)
+        conn = _TcpClientConnection(ch)
+        ch.client_conn = conn
+        return conn
+
+    def _dispatch_request(self, ch: _TcpChannel, req_id: int, req_type: int, payload: bytes):
+        def run():
+            try:
+                resp = self._server.handle(req_type, ch.peer_id, payload)
+                _send_frame(ch.sock, ch.wlock, _RESPONSE, req_id, 0, resp)
+            except Exception as e:  # noqa: BLE001 — surfaced as ERROR frame
+                try:
+                    _send_frame(ch.sock, ch.wlock, _ERROR, req_id, 0, str(e).encode())
+                except OSError:
+                    pass
+
+        self._pool.submit(run)
+
+    def _peer_channel(self, peer_id: str) -> Optional[_TcpChannel]:
+        with self._chan_lock:
+            return self._channels.get(peer_id)
+
+    def register_address(self):
+        """Publish this executor's address for local-process peer discovery
+        (tests; in a cluster the heartbeat manager gossips it)."""
+        _ADDRESSES[self.executor_id] = self.address
+
+    def shutdown(self):
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+        self._pool.shutdown(wait=False)
+
+
+_ADDRESSES: Dict[str, tuple] = {}
