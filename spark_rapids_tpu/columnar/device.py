@@ -62,6 +62,16 @@ def bucket_width(n: int) -> int:
     return w
 
 
+def pad_scalar_bytes(raw: bytes) -> tuple[np.ndarray, int]:
+    """Encode one byte string into the padded scalar-string device layout:
+    (uint8[bucket_width], true length). Shared by string literals and the
+    TaskVals file-name channel."""
+    w = bucket_width(max(len(raw), 1))
+    buf = np.zeros(w, dtype=np.uint8)
+    buf[: len(raw)] = np.frombuffer(raw, dtype=np.uint8)
+    return buf, len(raw)
+
+
 @jax.tree_util.register_pytree_node_class
 @dataclasses.dataclass
 class DeviceColumn:

@@ -18,6 +18,8 @@ from ..columnar.host import arrow_from_np, batch_from_columns, concat_batches, n
 from ..expr import Expression, bind, output_name
 from ..expr.aggregates import AggregateFunction
 from ..expr.base import BoundReference, Ctx
+from ..expr.misc import contains_task_dependent
+from . import task
 from ..ops.hash import murmur3_rows, partition_ids
 from ..plan.logical import SortOrder
 from ..plan.physical import Exec, ExecContext, PartitionSet
@@ -97,10 +99,17 @@ class CpuProjectExec(Exec):
         schema_in = child.output
         schema_out = self._schema
 
+        needs_task = any(contains_task_dependent(e) for e in self.exprs)
+
         def fn(it: Iterator[pa.RecordBatch]):
             for rb in it:
                 c = _cpu_ctx(rb, schema_in)
+                if needs_task:
+                    info = task.get_or_create()
+                    c.task = task.task_vals(np)
                 cols = [_val_to_np(c, e.eval(c)) for e in self.exprs]
+                if needs_task:
+                    info.advance_rows(rb.num_rows)
                 yield batch_from_columns(schema_out, cols)
 
         return child.execute(ctx).map_partitions(fn)
@@ -121,12 +130,19 @@ class CpuFilterExec(Exec):
     def execute(self, ctx: ExecContext) -> PartitionSet:
         schema_in = self.children[0].output
 
+        needs_task = contains_task_dependent(self.condition)
+
         def fn(it):
             for rb in it:
                 c = _cpu_ctx(rb, schema_in)
+                if needs_task:
+                    info = task.get_or_create()
+                    c.task = task.task_vals(np)
                 v = self.condition.eval(c)
                 data, valid = _val_to_np(c, v)
                 keep = data.astype(bool) & valid
+                if needs_task:
+                    info.advance_rows(rb.num_rows)
                 yield rb.filter(pa.array(keep))
 
         return self.children[0].execute(ctx).map_partitions(fn)

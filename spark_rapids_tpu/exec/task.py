@@ -1,0 +1,155 @@
+"""Per-partition task context — the TaskContext / InputFileBlockHolder seam.
+
+Reference: Spark's ``TaskContext.partitionId`` and ``InputFileBlockHolder``
+(thread-locals set by the scheduler/scan), which the reference's
+GpuSparkPartitionID / GpuMonotonicallyIncreasingID / GpuInputFileName read
+(GpuSparkPartitionID.scala, GpuMonotonicallyIncreasingID.scala,
+GpuInputFileBlock.scala). Here the engine runs partitions through
+``PartitionSet`` thunks; each thunk installs a ``TaskInfo`` in a thread-local
+for the duration of the partition's iteration.
+
+Expressions cannot read the thread-local directly on the device path — they
+run inside a traced ``jax.jit`` program. Instead the task-dependent values are
+packaged as ``TaskVals`` (a small pytree of device scalars) and passed as a
+traced input to the compiled kernel; ``Ctx.task`` exposes them to expression
+``eval``. The host-side ``TaskInfo`` is the source of truth the operators
+sample per batch.
+"""
+from __future__ import annotations
+
+import dataclasses
+import threading
+from typing import Optional
+
+import numpy as np
+
+_LOCAL = threading.local()
+
+
+class TaskInfo:
+    """Mutable per-partition state (one per running partition iteration)."""
+
+    def __init__(self, partition_id: int):
+        self.partition_id = partition_id
+        # running live-row count for monotonically_increasing_id
+        self.row_base = 0
+
+    def advance_rows(self, n: int) -> int:
+        base = self.row_base
+        self.row_base += int(n)
+        return base
+
+
+def current() -> Optional[TaskInfo]:
+    return getattr(_LOCAL, "task", None)
+
+
+def set_current(info: Optional[TaskInfo]) -> None:
+    _LOCAL.task = info
+
+
+def get_or_create(partition_id: int = 0) -> TaskInfo:
+    t = current()
+    if t is None:
+        t = TaskInfo(partition_id)
+        _LOCAL.task = t
+    return t
+
+
+def set_input_file(path: str) -> None:
+    """Record the file currently being scanned. A thread-local *separate*
+    from TaskInfo, exactly like Spark's InputFileBlockHolder — every pipeline
+    stage of the partition sees the same value regardless of which nested
+    TaskInfo is active."""
+    _LOCAL.input_file = path
+
+
+def input_file() -> str:
+    return getattr(_LOCAL, "input_file", "")
+
+
+def reset_input_file() -> None:
+    _LOCAL.input_file = ""
+
+
+@dataclasses.dataclass
+class TaskVals:
+    """Task-dependent scalars passed into compiled kernels as traced inputs.
+
+    ``file_bytes``/``file_len`` carry the current input file name as padded
+    utf-8 so ``input_file_name()`` stays a pure device expression.
+    """
+
+    part_id: object  # int32 scalar
+    row_base: object  # int64 scalar
+    file_bytes: object  # uint8[w]
+    file_len: object  # int32 scalar
+
+    def tree_flatten(self):
+        return (self.part_id, self.row_base, self.file_bytes, self.file_len), None
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)
+
+
+try:  # register as pytree so TaskVals can cross the jit boundary
+    import jax
+
+    jax.tree_util.register_pytree_node_class(TaskVals)
+except Exception:  # pragma: no cover - jax always present in this image
+    pass
+
+
+def _encode_file(path: str, xp) -> tuple:
+    from ..columnar.device import pad_scalar_bytes
+
+    buf, n = pad_scalar_bytes(path.encode("utf-8"))
+    return xp.asarray(buf), xp.asarray(n, dtype=xp.int32)
+
+
+def task_vals(xp, row_base: Optional[int] = None) -> TaskVals:
+    """Sample the thread-local TaskInfo into backend arrays (xp is numpy or
+    jax.numpy)."""
+    t = current()
+    pid = t.partition_id if t else 0
+    base = row_base if row_base is not None else (t.row_base if t else 0)
+    fname = input_file()
+    fb, fl = _encode_file(fname, xp)
+    return TaskVals(
+        xp.asarray(pid, dtype=xp.int32),
+        xp.asarray(base, dtype=xp.int64),
+        fb,
+        fl,
+    )
+
+
+DEFAULT_WIDTH = 8
+
+
+def zero_vals(xp) -> TaskVals:
+    return TaskVals(
+        xp.asarray(0, dtype=xp.int32),
+        xp.asarray(0, dtype=xp.int64),
+        xp.zeros(DEFAULT_WIDTH, dtype=xp.uint8),
+        xp.asarray(0, dtype=xp.int32),
+    )
+
+
+def run_device(fn, it, needs_task):
+    """Drive a jitted kernel ``fn(batch, TaskVals)`` over device batches,
+    sampling/advancing the thread-local task state only when the expression
+    tree needs it (shared by TpuProjectExec/TpuFilterExec)."""
+    import jax.numpy as jnp
+
+    if not needs_task:
+        zeros = zero_vals(jnp)
+        for db in it:
+            yield fn(db, zeros)
+        return
+    for db in it:
+        info = get_or_create()
+        tv = task_vals(jnp)
+        out = fn(db, tv)
+        info.advance_rows(db.row_count())
+        yield out

@@ -29,6 +29,8 @@ from ..columnar.host import concat_batches
 from ..expr import Expression, bind, output_name
 from ..expr.aggregates import AggregateFunction
 from ..expr.base import BoundReference, Ctx, Val
+from ..expr.misc import contains_task_dependent
+from . import task
 from ..ops.aggregate import group_aggregate
 from ..ops.concat import concat_device
 from ..ops.gather import compact, gather_batch
@@ -118,10 +120,11 @@ class TpuProjectExec(Exec):
             ]
         )
         schema = self._schema
+        self._needs_task = any(contains_task_dependent(e) for e in self.exprs)
 
         @jax.jit
-        def _project(batch: DeviceBatch) -> DeviceBatch:
-            c = Ctx.for_device(batch)
+        def _project(batch: DeviceBatch, tvals) -> DeviceBatch:
+            c = Ctx.for_device(batch, task=tvals)
             cols = [
                 val_to_column(c, e.eval(c), e.data_type) for e in self.exprs
             ]
@@ -145,10 +148,10 @@ class TpuProjectExec(Exec):
 
     def execute(self, ctx: ExecContext) -> PartitionSet:
         fn = self._fn
+        needs_task = self._needs_task
 
         def run(it):
-            for db in it:
-                yield fn(db)
+            return task.run_device(fn, it, needs_task)
 
         return self.children[0].execute(ctx).map_partitions(run)
 
@@ -161,9 +164,11 @@ class TpuFilterExec(Exec):
         super().__init__([child])
         self.condition = bind(condition, child.output)
 
+        self._needs_task = contains_task_dependent(self.condition)
+
         @jax.jit
-        def _filter(batch: DeviceBatch) -> DeviceBatch:
-            c = Ctx.for_device(batch)
+        def _filter(batch: DeviceBatch, tvals) -> DeviceBatch:
+            c = Ctx.for_device(batch, task=tvals)
             v = self.condition.eval(c)
             keep = c.broadcast_bool(v.data) & v.full_valid(c)
             return compact(batch, keep)
@@ -180,10 +185,10 @@ class TpuFilterExec(Exec):
 
     def execute(self, ctx: ExecContext) -> PartitionSet:
         fn = self._fn
+        needs_task = self._needs_task
 
         def run(it):
-            for db in it:
-                yield fn(db)
+            return task.run_device(fn, it, needs_task)
 
         return self.children[0].execute(ctx).map_partitions(run)
 

@@ -67,12 +67,13 @@ class Val:
 class Ctx:
     """Evaluation context over one batch for one backend."""
 
-    def __init__(self, xp, n: int, is_device: bool, columns, num_rows=None):
+    def __init__(self, xp, n: int, is_device: bool, columns, num_rows=None, task=None):
         self.xp = xp
         self.n = n  # capacity (device) or row count (cpu)
         self.is_device = is_device
         self.columns = columns  # list of Val
         self.num_rows = num_rows  # device scalar when is_device
+        self.task = task  # TaskVals (traced) for task-dependent expressions
 
     def broadcast(self, data):
         xp = self.xp
@@ -89,18 +90,18 @@ class Ctx:
         return arr.astype(bool)
 
     @staticmethod
-    def for_device(batch) -> "Ctx":
+    def for_device(batch, task=None) -> "Ctx":
         import jax.numpy as jnp
 
         cols = [
             Val(c.data, c.validity, c.lengths) for c in batch.columns
         ]
-        return Ctx(jnp, batch.capacity, True, cols, batch.num_rows)
+        return Ctx(jnp, batch.capacity, True, cols, batch.num_rows, task)
 
     @staticmethod
-    def for_cpu(columns: list[tuple[np.ndarray, np.ndarray]], n: int) -> "Ctx":
+    def for_cpu(columns: list[tuple[np.ndarray, np.ndarray]], n: int, task=None) -> "Ctx":
         cols = [Val(d, v) for d, v in columns]
-        return Ctx(np, n, False, cols)
+        return Ctx(np, n, False, cols, task=task)
 
 
 @dataclass(frozen=True)
@@ -202,13 +203,11 @@ class Literal(Expression):
         if isinstance(self.dtype, StringType):
             raw = self.value.encode("utf-8")
             if ctx.is_device:
-                from ..columnar.device import bucket_width
+                from ..columnar.device import pad_scalar_bytes
 
-                w = bucket_width(max(len(raw), 1))
-                buf = np.zeros(w, dtype=np.uint8)
-                buf[: len(raw)] = np.frombuffer(raw, dtype=np.uint8)
+                buf, n = pad_scalar_bytes(raw)
                 data = xp.asarray(buf)  # [w] — scalar-like string
-                return Val(data, xp.asarray(True), xp.asarray(len(raw), dtype=xp.int32))
+                return Val(data, xp.asarray(True), xp.asarray(n, dtype=xp.int32))
             return Val(np.asarray(self.value, dtype=object), np.asarray(True))
         if isinstance(self.dtype, DecimalType):
             import decimal as _dec

@@ -618,3 +618,41 @@ def nvl2(a, b, c) -> Column:
 def grouping_id() -> Column:
     """The grouping-set id column inside rollup/cube aggregates."""
     return Column(UnresolvedAttribute("__grouping_id"))
+
+
+# hash / task-context functions (HashFunctions.scala, GpuSparkPartitionID,
+# GpuMonotonicallyIncreasingID, GpuInputFileBlock, GpuRand)
+def hash(*cols) -> Column:  # noqa: A001 - pyspark parity
+    from .expr.misc import Murmur3Hash
+
+    return Column(Murmur3Hash(tuple(_e(c) for c in cols)))
+
+
+def md5(c) -> Column:
+    from .expr.misc import Md5
+
+    return Column(Md5(_e(c)))
+
+
+def spark_partition_id() -> Column:
+    from .expr.misc import SparkPartitionID
+
+    return Column(SparkPartitionID())
+
+
+def monotonically_increasing_id() -> Column:
+    from .expr.misc import MonotonicallyIncreasingID
+
+    return Column(MonotonicallyIncreasingID())
+
+
+def input_file_name() -> Column:
+    from .expr.misc import InputFileName
+
+    return Column(InputFileName())
+
+
+def rand(seed: int = 0) -> Column:
+    from .expr.misc import Rand
+
+    return Column(Rand(seed))

@@ -30,6 +30,7 @@ import pyarrow.parquet as papq
 
 from .. import config as cfg
 from ..config import TpuConf
+from ..exec import task
 from ..plan.physical import Exec, ExecContext, PartitionSet
 from ..types import Schema
 
@@ -143,6 +144,7 @@ class CpuFileScanExec(Exec):
         for path in self.files:
             def make(path=path):
                 def it():
+                    task.set_input_file(path)  # InputFileBlockHolder analogue
                     yield from _iter_file(
                         path, self.fmt, self._schema, self.options, self.batch_rows
                     )
@@ -164,6 +166,7 @@ class CpuFileScanExec(Exec):
                     )
                 )
                 def it():
+                    task.set_input_file(path)
                     for rb in fut.result():
                         yield rb
                 return it()

@@ -304,6 +304,32 @@ for _cls in (_W.RowNumber, _W.Rank, _W.DenseRank, _W.Lead, _W.Lag):
     _expr(_cls)
 
 
+# ── hash / task-context expressions (HashFunctions.scala, GpuSparkPartitionID,
+#    GpuMonotonicallyIncreasingID, GpuInputFileBlock, GpuRand) ───────────────
+from ..expr import misc as msc  # noqa: E402
+
+
+def _rand_check(e, conf: TpuConf) -> Optional[str]:
+    if not cfg.INCOMPATIBLE_OPS.get(conf):
+        return (
+            "rand() on device is not bit-identical to Spark's XORShiftRandom "
+            "stream; enable spark.rapids.sql.incompatibleOps.enabled"
+        )
+    return None
+
+
+for _cls in (
+    msc.Murmur3Hash,
+    msc.Md5,
+    msc.SparkPartitionID,
+    msc.MonotonicallyIncreasingID,
+    msc.InputFileName,
+    msc.NormalizeNaNAndZero,
+):
+    _expr(_cls)
+_expr(msc.Rand, check=_rand_check)
+
+
 def expr_rules() -> dict[type, ExprRule]:
     return dict(_EXPR_RULES)
 

@@ -99,11 +99,49 @@ class ExecContext:
         return next(self._shuffle_ids)
 
 
+def _scoped_part(index: int, thunk):
+    """Wrap a partition thunk so a TaskInfo (TaskContext analogue) is the
+    active thread-local whenever this partition's frames execute. Nested
+    PartitionSets re-assert their own TaskInfo before each pull, so each
+    operator's loop body sees the TaskInfo of the stage directly beneath it
+    (stable across batches — what row counters need)."""
+
+    def run():
+        from ..exec import task as _task
+
+        info = _task.TaskInfo(index)
+
+        def gen():
+            _task.set_current(info)
+            _task.reset_input_file()
+            it = thunk()
+            while True:
+                try:
+                    x = next(it)
+                except StopIteration:
+                    return
+                # Re-assert AFTER the pull: deeper stages set their own info
+                # while producing x; the consumer's loop body must run under
+                # THIS stage's info (the stage directly beneath the consumer),
+                # not the deepest one — otherwise stacked task-dependent
+                # operators would share and double-advance one row counter.
+                _task.set_current(info)
+                yield x
+
+        return gen()
+
+    return run
+
+
 class PartitionSet:
-    """Lazily computable partitions (the RDD[ColumnarBatch] analogue)."""
+    """Lazily computable partitions (the RDD[ColumnarBatch] analogue).
+
+    Each partition thunk is wrapped with a task scope carrying the partition
+    index (Spark's TaskContext.partitionId analogue) — see exec/task.py.
+    """
 
     def __init__(self, parts: List[Callable[[], Iterator]]):
-        self.parts = parts
+        self.parts = [_scoped_part(i, t) for i, t in enumerate(parts)]
 
     @property
     def num_partitions(self) -> int:
