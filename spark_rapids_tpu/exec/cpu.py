@@ -79,6 +79,70 @@ class CpuScanExec(Exec):
         return f"CpuScan{self._schema.names}"
 
 
+class CpuRangeExec(Exec):
+    """``spark.range()`` — sequence generation (Spark's RangeExec; the
+    reference replaces it with GpuRangeExec, basicPhysicalOperators.scala).
+    Spark splits the range into ``num_partitions`` contiguous slices."""
+
+    def __init__(self, start: int, end: int, step: int, num_partitions: int):
+        super().__init__([])
+        self.start = start
+        self.end = end
+        self.step = step
+        self.num_partitions = max(1, num_partitions)
+        from ..types import LONG, StructField as SF
+
+        self._schema = Schema([SF("id", LONG, False)])
+
+    @property
+    def output(self) -> Schema:
+        return self._schema
+
+    def total_rows(self) -> int:
+        if self.step == 0:
+            raise ValueError("range step cannot be 0")
+        n = (self.end - self.start + self.step - (1 if self.step > 0 else -1)) // self.step
+        return max(0, n)
+
+    def partition_bounds(self) -> list[tuple[int, int]]:
+        """[(first_row_index, row_count)] per partition — contiguous slices."""
+        n = self.total_rows()
+        per = -(-n // self.num_partitions) if n else 0
+        out = []
+        for p in range(self.num_partitions):
+            lo = min(p * per, n)
+            hi = min(lo + per, n)
+            out.append((lo, hi - lo))
+        return out
+
+    def execute(self, ctx: ExecContext) -> PartitionSet:
+        from .. import config as cfg
+
+        batch_rows = cfg.BATCH_SIZE_ROWS.get(ctx.conf)
+        start, step = self.start, self.step
+        parts = []
+        for lo, cnt in self.partition_bounds():
+            def make(lo=lo, cnt=cnt):
+                def it():
+                    done = 0
+                    while done < cnt:
+                        m = min(batch_rows, cnt - done)
+                        first = start + (lo + done) * step
+                        ids = first + step * np.arange(m, dtype=np.int64)
+                        yield pa.RecordBatch.from_arrays(
+                            [pa.array(ids, type=pa.int64())], names=["id"]
+                        )
+                        done += m
+
+                return it()
+
+            parts.append(make)
+        return PartitionSet(parts)
+
+    def node_string(self):
+        return f"CpuRange ({self.start}, {self.end}, step={self.step}, splits={self.num_partitions})"
+
+
 class CpuProjectExec(Exec):
     def __init__(self, exprs: List[Expression], child: Exec):
         super().__init__([child])

@@ -109,6 +109,72 @@ class DeviceToHostExec(Exec):
 # ── compute execs ───────────────────────────────────────────────────────────
 
 
+class TpuRangeExec(Exec):
+    """Device-side sequence generation (GpuRangeExec,
+    basicPhysicalOperators.scala) — ids are born on device, no H2D copy."""
+
+    def __init__(self, cpu_range):
+        super().__init__([])
+        self._cpu = cpu_range
+        self._schema = cpu_range.output
+        self._fns = {}
+
+    @property
+    def output(self) -> Schema:
+        return self._schema
+
+    @property
+    def is_device(self) -> bool:
+        return True
+
+    def _fn(self, cap: int):
+        if cap not in self._fns:
+            schema = self._schema
+            step = self._cpu.step
+
+            @jax.jit
+            def gen(first, m):
+                ids = first + step * jnp.arange(cap, dtype=jnp.int64)
+                valid = jnp.arange(cap, dtype=jnp.int32) < m
+                from ..types import LONG
+
+                col = DeviceColumn(LONG, jnp.where(valid, ids, 0), valid)
+                return DeviceBatch(schema, [col], m.astype(jnp.int32))
+
+            self._fns[cap] = gen
+        return self._fns[cap]
+
+    def execute(self, ctx: ExecContext) -> PartitionSet:
+        from .. import config as cfg
+
+        batch_rows = cfg.BATCH_SIZE_ROWS.get(ctx.conf)
+        start, step = self._cpu.start, self._cpu.step
+        parts = []
+        for lo, cnt in self._cpu.partition_bounds():
+            def make(lo=lo, cnt=cnt):
+                def it():
+                    ctx.semaphore.acquire_if_necessary()
+                    done = 0
+                    while done < cnt:
+                        m = min(batch_rows, cnt - done)
+                        cap = bucket_capacity(max(m, 1))
+                        first = start + (lo + done) * step
+                        yield self._fn(cap)(
+                            jnp.asarray(first, dtype=jnp.int64),
+                            jnp.asarray(m, dtype=jnp.int32),
+                        )
+                        done += m
+
+                return it()
+
+            parts.append(make)
+        return PartitionSet(parts)
+
+    def node_string(self):
+        c = self._cpu
+        return f"TpuRange ({c.start}, {c.end}, step={c.step}, splits={c.num_partitions})"
+
+
 class TpuProjectExec(Exec):
     def __init__(self, exprs: List[Expression], child: Exec):
         super().__init__([child])

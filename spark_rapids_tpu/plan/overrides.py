@@ -456,6 +456,13 @@ _rule(
     lambda e: [],
 )
 _rule(C.CpuLimitExec, "CollectLimitExec", _conv_limit, lambda e: [])
+
+
+def _conv_range(e: C.CpuRangeExec, ch):
+    return T.TpuRangeExec(e)
+
+
+_rule(C.CpuRangeExec, "RangeExec", _conv_range, lambda e: [])
 _rule(
     C.CpuTakeOrderedAndProjectExec,
     "TakeOrderedAndProjectExec",

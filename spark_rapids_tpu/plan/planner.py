@@ -43,6 +43,10 @@ def plan_physical(lp: L.LogicalPlan, conf: TpuConf) -> Exec:
         from ..io.files import CpuFileScanExec
 
         return CpuFileScanExec(lp.paths, lp.file_format, lp.schema, lp.options, conf)
+    if isinstance(lp, L.Range):
+        from ..exec.cpu import CpuRangeExec
+
+        return CpuRangeExec(lp.start, lp.end, lp.step, lp.num_partitions)
     if isinstance(lp, L.Project):
         return CpuProjectExec(lp.exprs, plan_physical(lp.child, conf))
     if isinstance(lp, L.Filter):
@@ -137,7 +141,9 @@ def _has_broadcast_hint(lp: L.LogicalPlan) -> bool:
 
 
 def _num_partitions_hint(e: Exec) -> int:
-    if isinstance(e, CpuScanExec):
+    from ..exec.cpu import CpuRangeExec
+
+    if isinstance(e, (CpuScanExec, CpuRangeExec)):
         return e.num_partitions
     if isinstance(e, CpuShuffleExchangeExec):
         return e.num_partitions

@@ -59,10 +59,7 @@ class TpuSession:
     def range(self, start: int, end: Optional[int] = None, step: int = 1, num_partitions: int = 1):
         if end is None:
             start, end = 0, start
-        import numpy as np
-
-        ids = np.arange(start, end, step, dtype=np.int64)
-        return self.create_dataframe(pa.table({"id": ids}), num_partitions=num_partitions)
+        return DataFrame(self, L.Range(start, end, step, num_partitions))
 
     def set_conf(self, key: str, value: Any):
         self.conf = self.conf.set(key, value)

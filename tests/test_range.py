@@ -1,0 +1,36 @@
+"""Range exec tests — reference: GpuRangeExec (basicPhysicalOperators.scala)."""
+import pytest
+
+from spark_rapids_tpu.functions import col, sum as sum_
+from harness import assert_cpu_and_tpu_equal, tpu_session
+
+
+@pytest.mark.parametrize(
+    "start,end,step,parts",
+    [
+        (0, 100, 1, 1),
+        (0, 1000, 3, 4),
+        (10, 0, -2, 2),
+        (5, 5, 1, 3),  # empty
+        (-10, 10, 4, 3),
+    ],
+)
+def test_range_differential(start, end, step, parts):
+    assert_cpu_and_tpu_equal(
+        lambda s: s.range(start, end, step, num_partitions=parts),
+    )
+
+
+def test_range_is_device_born():
+    s = tpu_session()
+    plan = s.range(100).filter(col("id") > 5).explain()
+    assert "TpuRange" in plan
+    assert "HostToDevice" not in plan  # ids born on device, no H2D
+
+
+def test_range_pipeline():
+    assert_cpu_and_tpu_equal(
+        lambda s: s.range(0, 5000, 7, num_partitions=3)
+        .filter(col("id") % 2 == 0)
+        .agg(sum_(col("id")).alias("s")),
+    )
