@@ -221,11 +221,14 @@ def host_to_device(
     str_widths: Optional[dict[int, int]] = None,
 ) -> DeviceBatch:
     """Arrow RecordBatch (host currency) → DeviceBatch, padded to a bucketed
-    capacity. One H2D transfer per buffer; XLA sees static shapes."""
+    capacity. Every buffer ships in ONE batched ``jax.device_put`` call —
+    PJRT coalesces the transfers, so a slow link pays one round trip per
+    batch instead of one per buffer."""
     n = rb.num_rows
     cap = capacity or bucket_capacity(max(n, 1))
     schema = Schema.from_arrow(rb.schema)
-    cols: list[DeviceColumn] = []
+    host_bufs: list = [np.asarray(n, dtype=np.int32)]
+    specs: list = []  # (dtype, has_lengths) per column, mirrors host_bufs order
     for i, field in enumerate(schema):
         arr = rb.column(i)
         if isinstance(arr, pa.ChunkedArray):  # pragma: no cover - RecordBatch cols are flat
@@ -240,33 +243,141 @@ def host_to_device(
             plen[:n] = lengths
             pval = np.zeros(cap, dtype=bool)
             pval[:n] = valid
-            cols.append(
-                DeviceColumn(dt, jnp.asarray(pdata), jnp.asarray(pval), jnp.asarray(plen))
-            )
+            host_bufs += [pdata, pval, plen]
+            specs.append((dt, True))
         elif isinstance(dt, NullType):
-            cols.append(
-                DeviceColumn(
-                    dt,
-                    jnp.zeros(cap, dtype=jnp.int8),
-                    jnp.zeros(cap, dtype=bool),
-                )
-            )
+            host_bufs += [np.zeros(cap, dtype=np.int8), np.zeros(cap, dtype=bool)]
+            specs.append((dt, False))
         else:
             data, valid = _np_from_arrow_fixed(arr, dt)
             pdata = np.zeros(cap, dtype=dt.np_dtype)
             pdata[:n] = data
             pval = np.zeros(cap, dtype=bool)
             pval[:n] = valid
-            cols.append(DeviceColumn(dt, jnp.asarray(pdata), jnp.asarray(pval)))
-    return DeviceBatch(schema, cols, jnp.asarray(n, dtype=jnp.int32))
+            host_bufs += [pdata, pval]
+            specs.append((dt, False))
+    dev = jax.device_put(host_bufs)
+    num_rows, rest = dev[0], dev[1:]
+    cols: list[DeviceColumn] = []
+    i = 0
+    for dt, has_len in specs:
+        if has_len:
+            cols.append(DeviceColumn(dt, rest[i], rest[i + 1], rest[i + 2]))
+            i += 3
+        else:
+            cols.append(DeviceColumn(dt, rest[i], rest[i + 1]))
+            i += 2
+    return DeviceBatch(schema, cols, num_rows)
+
+
+def _pad8(nbytes: int) -> int:
+    return (nbytes + 7) & ~7
+
+
+def _pack_kernel(schema: Schema, cap: int, widths: tuple):
+    """Cached device kernel: flatten a whole batch (row count + every data/
+    validity/lengths buffer, each 8-byte aligned) into ONE uint8 vector —
+    the contiguous-buffer D2H currency (reference: JCudfSerialization /
+    GpuColumnVectorFromBuffer; here it buys one PJRT transfer per batch).
+
+    float64 data buffers ride as separate raw leaves beside the flat vector:
+    the TPU X64 emulation cannot bitcast 64-bit floats and recovering their
+    bits arithmetically would canonicalize values the emulation flushes —
+    a raw PJRT transfer is exact for whatever the device holds."""
+    from .. import kernels as K
+
+    def make():
+        def to_bytes(flat):
+            """1-D array → little-endian uint8 bytes. 64-bit ints split into
+            (lo, hi) uint32 halves arithmetically (ops/bits.py): the TPU X64
+            emulation can't width-change bitcast 64-bit types."""
+            from ..ops.bits import i64_bytes_le
+
+            if flat.dtype == jnp.bool_:
+                return flat.astype(jnp.uint8)
+            if flat.dtype in (jnp.dtype(jnp.int64), jnp.dtype(jnp.uint64)):
+                return i64_bytes_le(flat)
+            if flat.dtype != jnp.uint8:
+                return jax.lax.bitcast_convert_type(flat, jnp.uint8).reshape(-1)
+            return flat
+
+        def pack(batch: DeviceBatch):
+            parts = [to_bytes(batch.num_rows.astype(jnp.int64).reshape(1))]
+            side: list[jax.Array] = []
+
+            def add(arr):
+                flat = to_bytes(arr.reshape(-1))
+                pad = _pad8(flat.shape[0]) - flat.shape[0]
+                if pad:
+                    flat = jnp.concatenate([flat, jnp.zeros(pad, jnp.uint8)])
+                parts.append(flat)
+
+            for col in batch.columns:
+                if col.data.dtype == jnp.dtype(jnp.float64):
+                    side.append(col.data)
+                else:
+                    add(col.data)
+                add(col.validity.astype(jnp.uint8))
+                if col.lengths is not None:
+                    add(col.lengths)
+            return jnp.concatenate(parts), tuple(side)
+
+        return jax.jit(pack)
+
+    return K.kernel(("pack_d2h", schema, cap, widths), make)
 
 
 def device_to_host(batch: DeviceBatch) -> pa.RecordBatch:
-    """DeviceBatch → Arrow RecordBatch sliced to live rows (single D2H)."""
-    n = batch.row_count()
+    """DeviceBatch → Arrow RecordBatch sliced to live rows.
+
+    The whole batch is packed on device into one flat buffer and fetched
+    with a single transfer — a slow PJRT link pays one round trip, not one
+    per buffer (per-column ``np.asarray`` was the top cost on a tunneled
+    TPU)."""
+    cap = batch.capacity
+    if cap == 0:
+        return pa.RecordBatch.from_arrays(
+            [pa.array([], type=f.data_type.to_arrow()) for f in batch.schema],
+            schema=batch.schema.to_arrow(),
+        )
+    if cap > MIN_CAPACITY:
+        # never ship padding over a slow link: re-bucket to the live rows
+        # first (one row-count round trip buys skipping up to cap-n rows
+        # of every buffer)
+        from ..ops.gather import shrink_one
+
+        batch = shrink_one(batch, batch.row_count())
+        cap = batch.capacity
+    widths = tuple(
+        c.data.shape[1] if c.data.ndim == 2 else None for c in batch.columns
+    )
+    flat, side = jax.device_get(_pack_kernel(batch.schema, cap, widths)(batch))
+    flat = np.asarray(flat)
+    n = int(flat[:8].view(np.int64)[0])
+    off = 8
+    side_i = 0
+    host_cols: list[DeviceColumn] = []
+    for f, col, w in zip(batch.schema, batch.columns, widths):
+        if col.data.dtype == jnp.dtype(jnp.float64):
+            data = np.asarray(side[side_i])
+            side_i += 1
+        else:
+            itemsize = np.dtype(col.data.dtype).itemsize
+            count = cap * (w or 1)
+            nbytes = count * itemsize
+            data = flat[off : off + nbytes].view(col.data.dtype)
+            data = data.reshape(cap, w) if w else data
+            off += _pad8(nbytes)
+        validity = flat[off : off + cap].view(np.bool_)
+        off += _pad8(cap)
+        lengths = None
+        if col.lengths is not None:
+            lengths = flat[off : off + cap * 4].view(np.int32)
+            off += _pad8(cap * 4)
+        host_cols.append(DeviceColumn(f.data_type, data, validity, lengths))
     arrays: list[pa.Array] = []
     fields: list[pa.Field] = []
-    for f, col in zip(batch.schema, batch.columns):
+    for f, col in zip(batch.schema, host_cols):
         dt = f.data_type
         valid = np.asarray(col.validity)[: max(n, 0)].astype(bool)
         if isinstance(dt, StringType):

@@ -143,8 +143,9 @@ MAX_READER_BATCH_SIZE_BYTES = conf("spark.rapids.sql.reader.batchSizeBytes").doc
 
 CONCURRENT_TPU_TASKS = conf("spark.rapids.sql.concurrentGpuTasks").doc(
     "Number of concurrent tasks that may hold the device at once — admission "
-    "control via the device semaphore (reference: GpuSemaphore.scala)."
-).int_conf(2)
+    "control via the device semaphore (reference: GpuSemaphore.scala), and "
+    "the size of the session's partition-task thread pool."
+).int_conf(4)
 
 HAS_NANS = conf("spark.rapids.sql.hasNans").doc(
     "Assume floating point values may contain NaNs (gates some operators, "

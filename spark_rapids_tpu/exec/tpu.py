@@ -7,6 +7,10 @@ GpuShuffleExchangeExec + GpuPartitioning, GpuTransitionOverrides' transitions.
 Each operator compiles ONE fused XLA program per (expression tree, schema,
 capacity) via jax.jit over DeviceBatch pytrees; the device semaphore gates
 first touch of the device per partition-task (GpuSemaphore protocol).
+
+Kernels live in the module-level ``kernels`` cache keyed by bound expression
+trees + schemas — NOT on exec instances — so re-running a query (which
+rebuilds the exec tree) reuses every compiled program. See kernels.py.
 """
 from __future__ import annotations
 
@@ -33,12 +37,13 @@ from ..expr.misc import contains_task_dependent
 from . import task
 from ..ops.aggregate import group_aggregate
 from ..ops.concat import concat_device
-from ..ops.gather import compact, gather_batch
+from ..ops.gather import bulk_shrink, compact, gather_batch
 from ..ops.hash import murmur3_rows, partition_ids
 from ..ops.sortkeys import batch_radix_words, sort_permutation
 from ..plan.logical import SortOrder
 from ..plan.physical import Exec, ExecContext, PartitionSet
 from ..types import Schema, StringType, StructField
+from .. import kernels as K
 
 
 def val_to_column(ctx: Ctx, val: Val, dtype) -> DeviceColumn:
@@ -117,7 +122,6 @@ class TpuRangeExec(Exec):
         super().__init__([])
         self._cpu = cpu_range
         self._schema = cpu_range.output
-        self._fns = {}
 
     @property
     def output(self) -> Schema:
@@ -128,11 +132,10 @@ class TpuRangeExec(Exec):
         return True
 
     def _fn(self, cap: int):
-        if cap not in self._fns:
-            schema = self._schema
-            step = self._cpu.step
+        schema = self._schema
+        step = self._cpu.step
 
-            @jax.jit
+        def make():
             def gen(first, m):
                 ids = first + step * jnp.arange(cap, dtype=jnp.int64)
                 valid = jnp.arange(cap, dtype=jnp.int32) < m
@@ -141,8 +144,9 @@ class TpuRangeExec(Exec):
                 col = DeviceColumn(LONG, jnp.where(valid, ids, 0), valid)
                 return DeviceBatch(schema, [col], m.astype(jnp.int32))
 
-            self._fns[cap] = gen
-        return self._fns[cap]
+            return gen
+
+        return K.jit_kernel(("range", step, cap, schema), make)
 
     def execute(self, ctx: ExecContext) -> PartitionSet:
         from .. import config as cfg
@@ -175,6 +179,39 @@ class TpuRangeExec(Exec):
         return f"TpuRange ({c.start}, {c.end}, step={c.step}, splits={c.num_partitions})"
 
 
+def project_kernel(exprs: tuple, schema: Schema):
+    """Fused projection kernel, cached by (bound exprs, output schema)."""
+
+    def make():
+        def _project(batch: DeviceBatch, tvals) -> DeviceBatch:
+            c = Ctx.for_device(batch, task=tvals)
+            cols = [val_to_column(c, e.eval(c), e.data_type) for e in exprs]
+            # keep padding rows inert
+            live = batch.row_mask()
+            cols = [
+                DeviceColumn(col.dtype, col.data, col.validity & live, col.lengths)
+                for col in cols
+            ]
+            return DeviceBatch(schema, cols, batch.num_rows)
+
+        return _project
+
+    return K.jit_kernel(("project", exprs, schema), make)
+
+
+def filter_kernel(condition: Expression):
+    def make():
+        def _filter(batch: DeviceBatch, tvals) -> DeviceBatch:
+            c = Ctx.for_device(batch, task=tvals)
+            v = condition.eval(c)
+            keep = c.broadcast_bool(v.data) & v.full_valid(c)
+            return compact(batch, keep)
+
+        return _filter
+
+    return K.jit_kernel(("filter", condition), make)
+
+
 class TpuProjectExec(Exec):
     def __init__(self, exprs: List[Expression], child: Exec):
         super().__init__([child])
@@ -185,24 +222,8 @@ class TpuProjectExec(Exec):
                 for e0, e in zip(exprs, self.exprs)
             ]
         )
-        schema = self._schema
         self._needs_task = any(contains_task_dependent(e) for e in self.exprs)
-
-        @jax.jit
-        def _project(batch: DeviceBatch, tvals) -> DeviceBatch:
-            c = Ctx.for_device(batch, task=tvals)
-            cols = [
-                val_to_column(c, e.eval(c), e.data_type) for e in self.exprs
-            ]
-            # keep padding rows inert
-            live = batch.row_mask()
-            cols = [
-                DeviceColumn(col.dtype, col.data, col.validity & live, col.lengths)
-                for col in cols
-            ]
-            return DeviceBatch(schema, cols, batch.num_rows)
-
-        self._fn = _project
+        self._fn = project_kernel(tuple(self.exprs), self._schema)
 
     @property
     def output(self) -> Schema:
@@ -231,15 +252,7 @@ class TpuFilterExec(Exec):
         self.condition = bind(condition, child.output)
 
         self._needs_task = contains_task_dependent(self.condition)
-
-        @jax.jit
-        def _filter(batch: DeviceBatch, tvals) -> DeviceBatch:
-            c = Ctx.for_device(batch, task=tvals)
-            v = self.condition.eval(c)
-            keep = c.broadcast_bool(v.data) & v.full_valid(c)
-            return compact(batch, keep)
-
-        self._fn = _filter
+        self._fn = filter_kernel(self.condition)
 
     @property
     def output(self) -> Schema:
@@ -323,11 +336,10 @@ class TpuHashAggregateExec(Exec):
         super().__init__([child])
         self.mode = mode
         self.grouping = [bind(g, child.output) for g in grouping]
-        self.agg_fns = agg_fns
-        self.result_exprs = result_exprs
-        self.result_names = result_names
+        self.agg_fns = list(agg_fns)
+        self.result_exprs = None if result_exprs is None else list(result_exprs)
+        self.result_names = None if result_names is None else list(result_names)
         self._schema = self._compute_schema(child)
-        self._agg_fn_cache: dict = {}
 
     def _compute_schema(self, child: Exec) -> Schema:
         fields = []
@@ -355,22 +367,110 @@ class TpuHashAggregateExec(Exec):
         return True
 
     def _buffer_ordinal(self, f: AggregateFunction, j: int) -> int:
-        base = len(self.grouping)
-        for g in self.agg_fns:
-            if g is f:
-                return base + j
-            base += len(g.buffer_types)
-        raise KeyError
+        return _buffer_ordinal(self.grouping, self.agg_fns, f, j)
 
-    def _make_kernel(self, child_schema: Schema):
-        mode = self.mode
-        out_schema = self._schema
-        grouping = self.grouping
-        agg_fns = self.agg_fns
+    def _make_kernel(self, child_schema: Schema, pre_filter=None):
+        return aggregate_kernel(
+            self.mode,
+            tuple(self.grouping),
+            tuple(self.agg_fns),
+            None if self.result_exprs is None else tuple(self.result_exprs),
+            self._schema,
+            child_schema,
+            pre_filter,
+        )
 
+    def execute(self, ctx: ExecContext) -> PartitionSet:
+        child = self.children[0]
+        pre_filter = None
+        if (
+            self.mode in ("partial", "complete")
+            and isinstance(child, TpuFilterExec)
+            and not child._needs_task
+        ):
+            # fuse the filter predicate into the aggregate as a liveness
+            # mask: a filter's schema equals its child's, so bindings hold,
+            # and the compaction gather of every column is skipped entirely
+            pre_filter = child.condition
+            child = child.children[0]
+        child_schema = child.output
+        kernel = self._make_kernel(child_schema, pre_filter)
+        merge_jit = self._merge_jit()
+
+        def run(it):
+            if self.mode == "partial":
+                # per-batch update aggregate, then concat + merge — the
+                # reference's hot loop (aggregate.scala:406-468). Multi-batch
+                # partitions shrink outputs to the live-group bucket before
+                # the merge concat; single-batch outputs are shrunk by the
+                # consumer (exchange) in one cross-partition bulk sync.
+                partials = [kernel(db) for db in it]
+                if not partials:
+                    if self.grouping:
+                        return
+                    partials = [kernel(empty_batch(child_schema))]
+                if len(partials) == 1:
+                    yield partials[0]
+                else:
+                    partials = bulk_shrink(partials)
+                    yield merge_jit(concat_device(partials))
+                return
+            # final/complete: single merge+evaluate over the whole partition
+            batches = list(it)
+            if not batches:
+                if self.grouping:
+                    return
+                batches = [empty_batch(child_schema)]
+            merged = batches[0] if len(batches) == 1 else concat_device(batches)
+            yield kernel(merged)
+
+        return child.execute(ctx).map_partitions(run)
+
+    def _merge_jit(self):
+        return aggregate_merge_kernel(
+            tuple(self.grouping), tuple(self.agg_fns), self._schema
+        )
+
+    def node_string(self):
+        return (
+            f"TpuHashAggregate({self.mode}) keys={[str(g) for g in self.grouping]} "
+            f"aggs={[str(a) for a in self.agg_fns]}"
+        )
+
+
+
+
+def _buffer_ordinal(grouping, agg_fns, f: AggregateFunction, j: int) -> int:
+    """Ordinal of buffer ``j`` of ``f`` in the keys ++ buffers layout."""
+    base = len(grouping)
+    for g in agg_fns:
+        if g is f:
+            return base + j
+        base += len(g.buffer_types)
+    raise KeyError
+
+
+def aggregate_kernel(
+    mode: str,
+    grouping: tuple,
+    agg_fns: tuple,
+    result_exprs,
+    out_schema: Schema,
+    child_schema: Schema,
+    pre_filter: Optional[Expression] = None,
+):
+    """The fused group-aggregate program (update or merge+evaluate), cached
+    by the full aggregation signature. ``pre_filter`` fuses a child filter's
+    predicate in as a liveness mask — no compaction (a full gather of every
+    column, slow on TPU) between the filter and the aggregate."""
+
+    def make():
         def _aggregate(batch: DeviceBatch) -> DeviceBatch:
             c = Ctx.for_device(batch)
             live = batch.row_mask()
+            if pre_filter is not None:
+                fv = pre_filter.eval(c)
+                live = live & c.broadcast_bool(fv.data) & fv.full_valid(c)
             # materialize grouping keys + agg inputs as columns
             key_cols = [
                 val_to_column(c, g.eval(c), g.data_type) for g in grouping
@@ -392,7 +492,7 @@ class TpuHashAggregateExec(Exec):
                         ops.append(op)
                 else:
                     for j, op in enumerate(f.merge_ops):
-                        in_cols.append(batch.columns[self._buffer_ordinal(f, j)])
+                        in_cols.append(batch.columns[_buffer_ordinal(grouping, agg_fns, f, j)])
                         ops.append(op)
             tmp_schema = Schema(
                 [StructField(f"k{i}", k.dtype, True) for i, k in enumerate(key_cols)]
@@ -408,6 +508,7 @@ class TpuHashAggregateExec(Exec):
                 in_cols,
                 ops,
                 min_groups=0 if grouping else 1,
+                live_mask=live if pre_filter is not None else None,
             )
             if mode == "partial":
                 cols = out_keys + out_aggs
@@ -434,75 +535,53 @@ class TpuHashAggregateExec(Exec):
             )
             glive = jnp.arange(cap, dtype=jnp.int32) < num_groups
             cols = []
-            for e in self.result_exprs:
+            for e in result_exprs:
                 col = val_to_column(rctx, e.eval(rctx), e.data_type)
                 cols.append(
                     DeviceColumn(col.dtype, col.data, col.validity & glive, col.lengths)
                 )
             return DeviceBatch(out_schema, cols, num_groups)
 
-        return jax.jit(_aggregate)
+        return _aggregate
 
-    def execute(self, ctx: ExecContext) -> PartitionSet:
-        child = self.children[0]
-        child_schema = child.output
-        kernel = self._make_kernel(child_schema)
-        merge_jit = self._merge_jit()
+    key = (
+        "agg",
+        mode,
+        grouping,
+        agg_fns,
+        result_exprs,
+        out_schema,
+        child_schema,
+        pre_filter,
+    )
+    return K.jit_kernel(key, make)
 
-        def run(it):
-            if self.mode == "partial":
-                # per-batch update aggregate, then concat + merge — the
-                # reference's hot loop (aggregate.scala:406-468)
-                partials = [kernel(db) for db in it]
-                if not partials:
-                    if self.grouping:
-                        return
-                    partials = [kernel(empty_batch(child_schema))]
-                if len(partials) == 1:
-                    yield partials[0]
-                else:
-                    yield merge_jit(concat_device(partials))
-                return
-            # final/complete: single merge+evaluate over the whole partition
-            batches = list(it)
-            if not batches:
-                if self.grouping:
-                    return
-                batches = [empty_batch(child_schema)]
-            merged = batches[0] if len(batches) == 1 else concat_device(batches)
-            yield kernel(merged)
 
-        return child.execute(ctx).map_partitions(run)
+def aggregate_merge_kernel(grouping: tuple, agg_fns: tuple, out_schema: Schema):
+    """Merge-mode aggregation kernel over (concatenated) partial batches.
+    The partial-output layout is keys ++ buffers, so key ordinals and
+    _buffer_ordinal line up with the final layout."""
 
-    def _merge_jit(self):
-        """Merge-mode aggregation kernel over (concatenated) partial batches.
-        The partial-output layout is keys ++ buffers, so key ordinals and
-        _buffer_ordinal line up with self's layout."""
-
-        @jax.jit
+    def make():
         def _m(batch: DeviceBatch) -> DeviceBatch:
             in_cols = []
             ops = []
-            for f in self.agg_fns:
+            for f in agg_fns:
                 for j, op in enumerate(f.merge_ops):
-                    in_cols.append(batch.columns[self._buffer_ordinal(f, j)])
+                    in_cols.append(batch.columns[_buffer_ordinal(grouping, agg_fns, f, j)])
                     ops.append(op)
             out_keys, out_aggs, num_groups = group_aggregate(
                 batch,
-                list(range(len(self.grouping))),
+                list(range(len(grouping))),
                 in_cols,
                 ops,
-                min_groups=0 if self.grouping else 1,
+                min_groups=0 if grouping else 1,
             )
-            return DeviceBatch(self._schema, out_keys + out_aggs, num_groups)
+            return DeviceBatch(out_schema, out_keys + out_aggs, num_groups)
 
         return _m
 
-    def node_string(self):
-        return (
-            f"TpuHashAggregate({self.mode}) keys={[str(g) for g in self.grouping]} "
-            f"aggs={[str(a) for a in self.agg_fns]}"
-        )
+    return K.jit_kernel(("agg_merge", grouping, agg_fns, out_schema), make)
 
 
 class TpuSortExec(Exec):
@@ -597,26 +676,46 @@ class TpuSortExec(Exec):
         return f"TpuSort [{', '.join(map(str, self.order))}]"
 
 
+def _order_key(order: List[SortOrder]) -> tuple:
+    return tuple((o.child, o.ascending, o.resolved_nulls_first()) for o in order)
+
+
 def device_sort_fn(order: List[SortOrder]):
     """Jitted whole-batch sort kernel shared by TpuSortExec and TopN."""
+    order = list(order)
 
-    @jax.jit
-    def _sort(batch: DeviceBatch) -> DeviceBatch:
-        c = Ctx.for_device(batch)
-        live = batch.row_mask()
-        words = []
-        for o in order:
-            col = val_to_column(c, o.child.eval(c), o.child.data_type)
-            col = DeviceColumn(col.dtype, col.data, col.validity & live, col.lengths)
-            from ..ops.sortkeys import column_radix_words
+    def make():
+        def _sort(batch: DeviceBatch) -> DeviceBatch:
+            c = Ctx.for_device(batch)
+            live = batch.row_mask()
+            words = []
+            for o in order:
+                col = val_to_column(c, o.child.eval(c), o.child.data_type)
+                col = DeviceColumn(col.dtype, col.data, col.validity & live, col.lengths)
+                from ..ops.sortkeys import column_radix_words
 
-            words.extend(
-                column_radix_words(col, o.ascending, o.resolved_nulls_first())
-            )
-        perm = sort_permutation(words, live)
-        return gather_batch(batch, perm, batch.num_rows)
+                words.extend(
+                    column_radix_words(col, o.ascending, o.resolved_nulls_first())
+                )
+            perm = sort_permutation(words, live)
+            return gather_batch(batch, perm, batch.num_rows)
 
-    return _sort
+        return _sort
+
+    return K.jit_kernel(("sort", _order_key(order)), make)
+
+
+@jax.jit
+def slice_head(batch: DeviceBatch, take) -> DeviceBatch:
+    """First min(num_rows, take) rows — shared by limit and TopN (module-
+    level jit: one program per batch signature, cached for the process)."""
+    take = jnp.minimum(batch.num_rows, take)
+    live = jnp.arange(batch.capacity, dtype=jnp.int32) < take
+    cols = [
+        DeviceColumn(c.dtype, c.data, c.validity & live, c.lengths)
+        for c in batch.columns
+    ]
+    return DeviceBatch(batch.schema, cols, take.astype(jnp.int32))
 
 
 class TpuTakeOrderedAndProjectExec(Exec):
@@ -640,24 +739,14 @@ class TpuTakeOrderedAndProjectExec(Exec):
         return True
 
     def execute(self, ctx: ExecContext) -> PartitionSet:
-        n = self.n
+        n = jnp.asarray(self.n, jnp.int32)
         sort_fn = device_sort_fn(self.order)
-
-        @jax.jit
-        def _head(batch: DeviceBatch) -> DeviceBatch:
-            take = jnp.minimum(batch.num_rows, n)
-            live = jnp.arange(batch.capacity, dtype=jnp.int32) < take
-            cols = [
-                DeviceColumn(c.dtype, c.data, c.validity & live, c.lengths)
-                for c in batch.columns
-            ]
-            return DeviceBatch(batch.schema, cols, take)
 
         def topn(batches):
             if not batches:
                 return None
             merged = batches[0] if len(batches) == 1 else concat_device(batches)
-            return _head(sort_fn(merged))
+            return slice_head(sort_fn(merged), n)
 
         child_parts = self.children[0].execute(ctx)
 
@@ -699,24 +788,26 @@ class TpuExpandExec(Exec):
             fields.append(StructField(name, dt, any(e.nullable for e in es)))
         self._schema = Schema(fields)
         schema = self._schema
-        projections = self.projections
+        projections = tuple(tuple(p) for p in self.projections)
 
-        @jax.jit
-        def _expand(batch: DeviceBatch) -> list[DeviceBatch]:
-            c = Ctx.for_device(batch)
-            live = batch.row_mask()
-            out = []
-            for proj in projections:
-                cols = []
-                for e, f in zip(proj, schema):
-                    col = val_to_column(c, e.eval(c), f.data_type)
-                    cols.append(
-                        DeviceColumn(f.data_type, col.data, col.validity & live, col.lengths)
-                    )
-                out.append(DeviceBatch(schema, cols, batch.num_rows))
-            return out
+        def make():
+            def _expand(batch: DeviceBatch) -> list[DeviceBatch]:
+                c = Ctx.for_device(batch)
+                live = batch.row_mask()
+                out = []
+                for proj in projections:
+                    cols = []
+                    for e, f in zip(proj, schema):
+                        col = val_to_column(c, e.eval(c), f.data_type)
+                        cols.append(
+                            DeviceColumn(f.data_type, col.data, col.validity & live, col.lengths)
+                        )
+                    out.append(DeviceBatch(schema, cols, batch.num_rows))
+                return out
 
-        self._fn = _expand
+            return _expand
+
+        self._fn = K.jit_kernel(("expand", projections, schema), make)
 
     @property
     def output(self) -> Schema:
@@ -778,64 +869,81 @@ class TpuShuffleExchangeExec(Exec):
         part = self.partitioning
 
         if isinstance(part, HashPartitioning) and part.keys:
-            keys = part.keys
+            keys = tuple(part.keys)
 
-            @jax.jit
-            def hash_slice(batch: DeviceBatch) -> list[DeviceBatch]:
-                c = Ctx.for_device(batch)
-                cols = []
-                for k in keys:
-                    col = val_to_column(c, k.eval(c), k.data_type)
-                    cols.append((k.data_type, col.data, col.validity, col.lengths))
-                h = murmur3_rows(jnp, cols, batch.capacity)
-                pids = partition_ids(jnp, h, nparts)
-                return [
-                    compact(batch, (pids == p) & batch.row_mask())
-                    for p in range(nparts)
-                ]
+            def make_hash():
+                def hash_slice(batch: DeviceBatch) -> list[DeviceBatch]:
+                    c = Ctx.for_device(batch)
+                    cols = []
+                    for k in keys:
+                        col = val_to_column(c, k.eval(c), k.data_type)
+                        cols.append((k.data_type, col.data, col.validity, col.lengths))
+                    h = murmur3_rows(jnp, cols, batch.capacity)
+                    pids = partition_ids(jnp, h, nparts)
+                    return [
+                        compact(batch, (pids == p) & batch.row_mask())
+                        for p in range(nparts)
+                    ]
 
-            return ("hash", hash_slice)
+                return hash_slice
+
+            return (
+                "hash",
+                K.jit_kernel(("exchange_hash", keys, nparts), make_hash),
+            )
 
         if isinstance(part, RoundRobinPartitioning):
 
-            @jax.jit
-            def rr_slice(batch: DeviceBatch, start) -> list[DeviceBatch]:
-                pids = (start + jnp.arange(batch.capacity, dtype=jnp.int32)) % nparts
-                return [
-                    compact(batch, (pids == p) & batch.row_mask())
-                    for p in range(nparts)
-                ]
+            def make_rr():
+                def rr_slice(batch: DeviceBatch, start) -> list[DeviceBatch]:
+                    pids = (start + jnp.arange(batch.capacity, dtype=jnp.int32)) % nparts
+                    return [
+                        compact(batch, (pids == p) & batch.row_mask())
+                        for p in range(nparts)
+                    ]
 
-            return ("roundrobin", rr_slice)
+                return rr_slice
+
+            return ("roundrobin", K.jit_kernel(("exchange_rr", nparts), make_rr))
 
         if isinstance(part, RangePartitioning):
             order = part.order
 
-            def batch_word_groups(batch: DeviceBatch):
-                """Per-order-column radix word lists (aligned later)."""
-                from ..ops.sortkeys import column_radix_words
+            def make_words():
+                def batch_word_groups(batch: DeviceBatch):
+                    """Per-order-column radix word lists (aligned later)."""
+                    from ..ops.sortkeys import column_radix_words
 
-                c = Ctx.for_device(batch)
-                return [
-                    column_radix_words(
-                        val_to_column(c, o.child.eval(c), o.child.data_type),
-                        o.ascending,
-                        o.resolved_nulls_first(),
-                    )
-                    for o in order
-                ]
+                    c = Ctx.for_device(batch)
+                    return [
+                        column_radix_words(
+                            val_to_column(c, o.child.eval(c), o.child.data_type),
+                            o.ascending,
+                            o.resolved_nulls_first(),
+                        )
+                        for o in order
+                    ]
 
-            words_jit = jax.jit(batch_word_groups)
+                return batch_word_groups
 
-            @jax.jit
-            def range_slice(batch: DeviceBatch, words, bounds) -> list[DeviceBatch]:
-                pids = words_partition_ids(jnp, words, bounds)
-                return [
-                    compact(batch, (pids == p) & batch.row_mask())
-                    for p in range(nparts)
-                ]
+            words_jit = K.jit_kernel(
+                ("exchange_range_words", _order_key(order)), make_words
+            )
 
-            return ("range", (words_jit, range_slice))
+            def make_range():
+                def range_slice(batch: DeviceBatch, words, bounds) -> list[DeviceBatch]:
+                    pids = words_partition_ids(jnp, words, bounds)
+                    return [
+                        compact(batch, (pids == p) & batch.row_mask())
+                        for p in range(nparts)
+                    ]
+
+                return range_slice
+
+            return (
+                "range",
+                (words_jit, K.jit_kernel(("exchange_range_slice", nparts), make_range)),
+            )
 
         return ("single", None)
 
@@ -843,13 +951,20 @@ class TpuShuffleExchangeExec(Exec):
         from ..mem.spill import with_oom_retry
         from ..plan.partitioning import SAMPLE_PER_BATCH, compute_range_bounds
 
+        import threading
+
         nparts = self.num_partitions
         kind, fn = self._scatter_fns(nparts)
         catalog = ctx.catalog
         child_parts = self.children[0].execute(ctx)
         state = {"buckets": None}
+        mat_lock = threading.Lock()
 
         def materialize():
+            with mat_lock:
+                return _materialize_locked()
+
+        def _materialize_locked():
             if state["buckets"] is not None:
                 return state["buckets"]
             buckets = [[] for _ in range(nparts)]
@@ -861,26 +976,37 @@ class TpuShuffleExchangeExec(Exec):
                 batches, group_lists = [], []
                 for t in child_parts.parts:
                     for db in t():
-                        if db.row_count() == 0:
-                            continue
                         batches.append(db)
                         group_lists.append(with_oom_retry(catalog, words_jit, db))
                 # string columns may encode to different word counts per
                 # batch (bucketed widths) — align before sampling/bucketing
                 all_words = align_word_groups(group_lists, order, jnp)
                 del group_lists
-                samples = []
+                # Sample on device, then fetch everything in ONE transfer —
+                # per-batch np.asarray syncs are lethal over slow PJRT links.
+                dev_samples, dev_valid = [], []
                 for db, words in zip(batches, all_words):
-                    n = db.row_count()
-                    idx = np.arange(0, n, max(1, n // SAMPLE_PER_BATCH))
-                    samples.append([np.asarray(w[:n])[idx] for w in words])
+                    s_idx = (
+                        jnp.arange(SAMPLE_PER_BATCH, dtype=jnp.int32)
+                        * jnp.maximum(db.num_rows, 1)
+                    ) // SAMPLE_PER_BATCH
+                    dev_samples.append(jnp.stack([w[s_idx] for w in words]))
+                    # duplicates (n < SAMPLE_PER_BATCH) just weight the
+                    # sample; only an empty batch must be excluded outright
+                    dev_valid.append(
+                        jnp.broadcast_to(db.num_rows > 0, (SAMPLE_PER_BATCH,))
+                    )
                 bounds = None
-                if samples:
+                if batches:
+                    host_samples, host_valid = jax.device_get((dev_samples, dev_valid))
                     sample_words = [
-                        np.concatenate([s[i] for s in samples])
-                        for i in range(len(samples[0]))
+                        np.concatenate(
+                            [s[i][v] for s, v in zip(host_samples, host_valid)]
+                        )
+                        for i in range(len(all_words[0]))
                     ]
-                    bounds = compute_range_bounds(sample_words, nparts)
+                    if sample_words[0].size:
+                        bounds = compute_range_bounds(sample_words, nparts)
                 jb = None if bounds is None else [jnp.asarray(b) for b in bounds]
                 for db, words in zip(batches, all_words):
                     if jb is None:
@@ -890,22 +1016,35 @@ class TpuShuffleExchangeExec(Exec):
                         with_oom_retry(catalog, range_slice, db, words, jb)
                     ):
                         buckets[p].append(s)
-            else:
+            elif kind == "hash":
+                # Drain every partition first (dispatches all upstream work
+                # asynchronously), then ONE bulk shrink sync, then slice —
+                # partitions overlap on device instead of serializing. The
+                # cost is holding the drained inputs concurrently; slices
+                # consume the list destructively so inputs free as we go.
+                drained = bulk_shrink(
+                    [db for t in child_parts.parts for db in t()]
+                )
+                while drained:
+                    db = drained.pop(0)
+                    for p, s in enumerate(with_oom_retry(catalog, fn, db)):
+                        buckets[p].append(s)
+                    del db
+            elif kind == "single":
+                # coalesce to one partition; shrink sparse batches (e.g.
+                # ungrouped partial aggregates: 1 live row in a huge cap)
+                drained = [db for t in child_parts.parts for db in t()]
+                buckets[0].extend(bulk_shrink(drained))
+            else:  # roundrobin
                 for pi, t in enumerate(child_parts.parts):
-                    offset = 0
+                    # device-resident running offset: no host sync per batch
+                    offset = jnp.asarray(pi % nparts, jnp.int32)
                     for db in t():
-                        if kind == "hash":
-                            for p, s in enumerate(with_oom_retry(catalog, fn, db)):
-                                buckets[p].append(s)
-                        elif kind == "roundrobin":
-                            start = jnp.asarray((pi + offset) % nparts, jnp.int32)
-                            offset += db.row_count()
-                            for p, s in enumerate(
-                                with_oom_retry(catalog, fn, db, start)
-                            ):
-                                buckets[p].append(s)
-                        else:
-                            buckets[0].append(db)
+                        for p, s in enumerate(
+                            with_oom_retry(catalog, fn, db, offset % nparts)
+                        ):
+                            buckets[p].append(s)
+                        offset = offset + db.num_rows
             state["buckets"] = buckets
             return buckets
 
@@ -916,21 +1055,23 @@ class TpuShuffleExchangeExec(Exec):
             # shuffle catalog and read them back through the caching
             # reader (RapidsShuffleManager writer/reader protocol).
             mgr_state = {"shuffle_id": None}
+            mgr_lock = threading.Lock()
 
             def ensure_written():
-                if mgr_state["shuffle_id"] is not None:
-                    return mgr_state["shuffle_id"]
-                manager = ctx.shuffle_manager
-                sid = ctx.next_shuffle_id()
-                writer = manager.get_writer(sid, map_id=0, num_partitions=nparts)
-                for p, bucket in enumerate(materialize()):
-                    for db in bucket:
-                        if db.row_count():
-                            writer.write(p, db)
-                writer.commit()
-                state["buckets"] = None  # catalog owns the batches now
-                mgr_state["shuffle_id"] = sid
-                return sid
+                with mgr_lock:
+                    if mgr_state["shuffle_id"] is not None:
+                        return mgr_state["shuffle_id"]
+                    manager = ctx.shuffle_manager
+                    sid = ctx.next_shuffle_id()
+                    writer = manager.get_writer(sid, map_id=0, num_partitions=nparts)
+                    for p, bucket in enumerate(materialize()):
+                        for db in bucket:
+                            if db.row_count():
+                                writer.write(p, db)
+                    writer.commit()
+                    state["buckets"] = None  # catalog owns the batches now
+                    mgr_state["shuffle_id"] = sid
+                    return sid
 
             consumed: set = set()
 
@@ -942,8 +1083,10 @@ class TpuShuffleExchangeExec(Exec):
                     )
                     # free catalog-held map output once every partition has
                     # been drained (ShuffleBufferCatalog unregisterShuffle)
-                    consumed.add(p)
-                    if len(consumed) == nparts:
+                    with mgr_lock:
+                        consumed.add(p)
+                        done = len(consumed) == nparts
+                    if done:
                         ctx.shuffle_manager.unregister_shuffle(sid)
 
                 return it
@@ -980,23 +1123,13 @@ class TpuLimitExec(Exec):
         limit = self.n
         child_parts = self.children[0].execute(ctx)
 
-        @jax.jit
-        def _head(batch: DeviceBatch, remaining) -> DeviceBatch:
-            take = jnp.minimum(batch.num_rows, remaining)
-            live = jnp.arange(batch.capacity, dtype=jnp.int32) < take
-            cols = [
-                DeviceColumn(c.dtype, c.data, c.validity & live, c.lengths)
-                for c in batch.columns
-            ]
-            return DeviceBatch(batch.schema, cols, take)
-
         def it():
             remaining = limit
             for t in child_parts.parts:
                 for db in t():
                     if remaining <= 0:
                         return
-                    out = _head(db, jnp.asarray(remaining, jnp.int32))
+                    out = slice_head(db, jnp.asarray(remaining, jnp.int32))
                     n = out.row_count()
                     remaining -= n
                     if n:

@@ -24,6 +24,7 @@ from ..ops.join import gather_pairs, join_bounds, join_output_schema, pad_string
 from ..plan.physical import Exec, ExecContext, PartitionSet
 from ..types import Schema, StringType, StructField
 from .tpu import val_to_column
+from .. import kernels as K
 
 
 class TpuShuffledHashJoinExec(Exec):
@@ -70,33 +71,17 @@ class TpuShuffledHashJoinExec(Exec):
     # ── kernels ─────────────────────────────────────────────────────────
     def _phase1(self):
         """counts per probe row (+ build order/lower for phase 2)."""
-        left_keys, right_keys = self.left_keys, self.right_keys
+        left_keys, right_keys = tuple(self.left_keys), tuple(self.right_keys)
 
-        @jax.jit
-        def fn(build: DeviceBatch, probe: DeviceBatch):
-            bctx = Ctx.for_device(build)
-            pctx = Ctx.for_device(probe)
-            bcols = [val_to_column(bctx, k.eval(bctx), k.data_type) for k in right_keys]
-            pcols = [val_to_column(pctx, k.eval(pctx), k.data_type) for k in left_keys]
-            # unify string widths across sides per key position
-            for i, (b, p) in enumerate(zip(bcols, pcols)):
-                if isinstance(b.dtype, StringType):
-                    w = max(b.data.shape[1], p.data.shape[1])
-                    bcols[i] = pad_string_column(b, w)
-                    pcols[i] = pad_string_column(p, w)
-            build_order, lower, upper = join_bounds(
-                bcols, build.row_mask(), pcols, probe.row_mask()
-            )
-            counts = upper - lower
-            return build_order, lower, counts
+        def make():
+            return _make_phase1(left_keys, right_keys)
 
-        return fn
-
+        return K.jit_kernel(("join_p1", left_keys, right_keys), make)
     def _phase2(self):
         """Gather matched pairs into a static-capacity output batch."""
         out_schema = self._schema
         left_exec, right_exec = self.children
-        right_ords = self._right_ordinals()
+        right_ords = tuple(self._right_ordinals())
         jt = self.join_type
         residual = self.residual
         if residual is not None:
@@ -105,64 +90,10 @@ class TpuShuffledHashJoinExec(Exec):
             )
             residual = bind(residual, pair_schema)
 
-        @jax.jit
-        def fn(
-            build: DeviceBatch,
-            probe: DeviceBatch,
-            build_order,
-            lower,
-            counts,
-            out_cap_arr,
-        ):
-            out_cap = out_cap_arr.shape[0]
-            probe_idx, build_idx, pair_live, total = gather_pairs(
-                build_order, lower, counts, probe.row_mask(), out_cap
-            )
-            lcols = [gather_column(c, probe_idx, pair_live) for c in probe.columns]
-            rcols_all = [gather_column(c, build_idx, pair_live) for c in build.columns]
-            live = pair_live
-            if residual is not None:
-                rctx = Ctx(
-                    jnp,
-                    out_cap,
-                    True,
-                    [Val(c.data, c.validity, c.lengths) for c in lcols + rcols_all],
-                    total,
-                )
-                rv = residual.eval(rctx)
-                keep = rctx.broadcast_bool(rv.data) & rv.full_valid(rctx) & pair_live
-                live = keep
-            # per-probe / per-build matched flags (for outer joins)
-            npr = probe.capacity
-            nb = build.capacity
-            probe_matched = (
-                jnp.zeros(npr, bool).at[jnp.where(live, probe_idx, npr)].set(True, mode="drop")
-            )
-            build_matched = (
-                jnp.zeros(nb, bool).at[jnp.where(live, build_idx, nb)].set(True, mode="drop")
-            )
-            rcols = [rcols_all[i] for i in right_ords]
-            if jt in ("left_semi", "left_anti"):
-                want = probe_matched if jt == "left_semi" else (
-                    ~probe_matched & probe.row_mask()
-                )
-                return compact(probe, want), probe_matched, build_matched
-            cols = lcols + rcols
-            # num_rows = full capacity: live pairs are scattered across the
-            # pair grid, so compact must see every slot (its keep mask is
-            # intersected with row_mask)
-            out = DeviceBatch(
-                out_schema,
-                [
-                    DeviceColumn(c.dtype, c.data, c.validity & live, c.lengths)
-                    for c in cols
-                ],
-                jnp.asarray(out_cap, jnp.int32),
-            )
-            out = compact(out, live)
-            return out, probe_matched, build_matched
-
-        return fn
+        key = ("join_p2", jt, residual, right_ords, out_schema)
+        return K.jit_kernel(
+            key, lambda: _make_phase2(out_schema, right_ords, jt, residual)
+        )
 
     def _null_extend(self, batch: DeviceBatch, keep: jax.Array, side: str) -> DeviceBatch:
         """Rows of one side with the other side's columns as NULLs."""
@@ -246,6 +177,9 @@ class TpuBroadcastExchangeExec(Exec):
     def __init__(self, child: Exec):
         super().__init__([child])
         self._cache = None
+        import threading
+
+        self._lock = threading.Lock()
 
     @property
     def output(self) -> Schema:
@@ -256,13 +190,14 @@ class TpuBroadcastExchangeExec(Exec):
         return True
 
     def broadcast_batch(self, ctx: ExecContext) -> DeviceBatch:
-        if self._cache is None:
-            parts = self.children[0].execute(ctx)
-            batches = [b for t in parts.parts for b in t()]
-            self._cache = (
-                concat_device(batches) if batches else empty_batch(self.output)
-            )
-        return self._cache
+        with self._lock:
+            if self._cache is None:
+                parts = self.children[0].execute(ctx)
+                batches = [b for t in parts.parts for b in t()]
+                self._cache = (
+                    concat_device(batches) if batches else empty_batch(self.output)
+                )
+            return self._cache
 
     def execute(self, ctx: ExecContext) -> PartitionSet:
         def it():
@@ -366,49 +301,9 @@ class TpuBroadcastNestedLoopJoinExec(Exec):
         out_schema = self._schema
         condition = self.condition
         jt = self.join_type
+        key = ("join_pair", jt, condition, out_schema)
+        return K.jit_kernel(key, lambda: _make_pair_kernel(out_schema, condition, jt))
 
-        @jax.jit
-        def fn(lb: DeviceBatch, rb: DeviceBatch):
-            n, m = lb.capacity, rb.capacity
-            cap = n * m
-            li = jnp.arange(cap, dtype=jnp.int32) // m
-            ri = jnp.arange(cap, dtype=jnp.int32) % m
-            pair_live = (li < lb.num_rows) & (ri < rb.num_rows)
-            lcols = [gather_column(c, li, pair_live) for c in lb.columns]
-            rcols = [gather_column(c, ri, pair_live) for c in rb.columns]
-            live = pair_live
-            if condition is not None:
-                cctx = Ctx(
-                    jnp,
-                    cap,
-                    True,
-                    [Val(c.data, c.validity, c.lengths) for c in lcols + rcols],
-                    live.sum().astype(jnp.int32),
-                )
-                cv = condition.eval(cctx)
-                live = cctx.broadcast_bool(cv.data) & cv.full_valid(cctx) & pair_live
-            # matched flags per side row (outer/semi/anti bookkeeping)
-            left_matched = (
-                jnp.zeros(n, bool).at[jnp.where(live, li, n)].set(True, mode="drop")
-            )
-            right_matched = (
-                jnp.zeros(m, bool).at[jnp.where(live, ri, m)].set(True, mode="drop")
-            )
-            if jt in ("left_semi", "left_anti"):
-                return None, left_matched, right_matched
-            # num_rows = cap: live pairs are scattered over the [n x m] grid
-            # and compact intersects its keep mask with row_mask
-            out = DeviceBatch(
-                out_schema,
-                [
-                    DeviceColumn(c.dtype, c.data, c.validity & live, c.lengths)
-                    for c in lcols + rcols
-                ],
-                jnp.asarray(cap, jnp.int32),
-            )
-            return compact(out, live), left_matched, right_matched
-
-        return fn
 
     def _null_extend(self, batch: DeviceBatch, keep: jax.Array, side: str) -> DeviceBatch:
         left_exec, right_exec = self.children
@@ -497,7 +392,28 @@ def null_extend_batch(
     right_ordinals=None,
 ) -> DeviceBatch:
     """Rows of one join side with the other side's columns as NULLs — shared
-    by the hash and nested-loop joins' outer-extension paths."""
+    by the hash and nested-loop joins' outer-extension paths. Cached fused
+    kernel (one compact + null-column splice per call, not eager ops)."""
+    lf, rf = tuple(left_fields), tuple(right_fields)
+    ro = None if right_ordinals is None else tuple(right_ordinals)
+    fn = K.kernel(
+        ("null_extend", out_schema, side, lf, rf, ro),
+        lambda: jax.jit(
+            lambda b, k: _null_extend_impl(out_schema, b, k, side, lf, rf, ro)
+        ),
+    )
+    return fn(batch, keep)
+
+
+def _null_extend_impl(
+    out_schema: Schema,
+    batch: DeviceBatch,
+    keep: jax.Array,
+    side: str,
+    left_fields,
+    right_fields,
+    right_ordinals=None,
+) -> DeviceBatch:
     sub = compact(batch, keep)
     cap = sub.capacity
     if side == "left":  # left rows + null right
@@ -529,3 +445,127 @@ def _null_column(f: StructField, cap: int) -> DeviceColumn:
         jnp.zeros(cap, f.data_type.np_dtype),
         jnp.zeros(cap, bool),
     )
+
+def _make_phase1(left_keys: tuple, right_keys: tuple):
+    def fn(build: DeviceBatch, probe: DeviceBatch):
+        bctx = Ctx.for_device(build)
+        pctx = Ctx.for_device(probe)
+        bcols = [val_to_column(bctx, k.eval(bctx), k.data_type) for k in right_keys]
+        pcols = [val_to_column(pctx, k.eval(pctx), k.data_type) for k in left_keys]
+        # unify string widths across sides per key position
+        for i, (b, p) in enumerate(zip(bcols, pcols)):
+            if isinstance(b.dtype, StringType):
+                w = max(b.data.shape[1], p.data.shape[1])
+                bcols[i] = pad_string_column(b, w)
+                pcols[i] = pad_string_column(p, w)
+        build_order, lower, upper = join_bounds(
+            bcols, build.row_mask(), pcols, probe.row_mask()
+        )
+        counts = upper - lower
+        return build_order, lower, counts
+
+    return fn
+
+
+def _make_phase2(out_schema: Schema, right_ords: tuple, jt: str, residual):
+    def fn(
+            build: DeviceBatch,
+            probe: DeviceBatch,
+            build_order,
+            lower,
+            counts,
+            out_cap_arr,
+        ):
+            out_cap = out_cap_arr.shape[0]
+            probe_idx, build_idx, pair_live, total = gather_pairs(
+                build_order, lower, counts, probe.row_mask(), out_cap
+            )
+            lcols = [gather_column(c, probe_idx, pair_live) for c in probe.columns]
+            rcols_all = [gather_column(c, build_idx, pair_live) for c in build.columns]
+            live = pair_live
+            if residual is not None:
+                rctx = Ctx(
+                    jnp,
+                    out_cap,
+                    True,
+                    [Val(c.data, c.validity, c.lengths) for c in lcols + rcols_all],
+                    total,
+                )
+                rv = residual.eval(rctx)
+                keep = rctx.broadcast_bool(rv.data) & rv.full_valid(rctx) & pair_live
+                live = keep
+            # per-probe / per-build matched flags (for outer joins)
+            npr = probe.capacity
+            nb = build.capacity
+            probe_matched = (
+                jnp.zeros(npr, bool).at[jnp.where(live, probe_idx, npr)].set(True, mode="drop")
+            )
+            build_matched = (
+                jnp.zeros(nb, bool).at[jnp.where(live, build_idx, nb)].set(True, mode="drop")
+            )
+            rcols = [rcols_all[i] for i in right_ords]
+            if jt in ("left_semi", "left_anti"):
+                want = probe_matched if jt == "left_semi" else (
+                    ~probe_matched & probe.row_mask()
+                )
+                return compact(probe, want), probe_matched, build_matched
+            cols = lcols + rcols
+            # num_rows = full capacity: live pairs are scattered across the
+            # pair grid, so compact must see every slot (its keep mask is
+            # intersected with row_mask)
+            out = DeviceBatch(
+                out_schema,
+                [
+                    DeviceColumn(c.dtype, c.data, c.validity & live, c.lengths)
+                    for c in cols
+                ],
+                jnp.asarray(out_cap, jnp.int32),
+            )
+            out = compact(out, live)
+            return out, probe_matched, build_matched
+
+    return fn
+
+
+def _make_pair_kernel(out_schema: Schema, condition, jt: str):
+    def fn(lb: DeviceBatch, rb: DeviceBatch):
+            n, m = lb.capacity, rb.capacity
+            cap = n * m
+            li = jnp.arange(cap, dtype=jnp.int32) // m
+            ri = jnp.arange(cap, dtype=jnp.int32) % m
+            pair_live = (li < lb.num_rows) & (ri < rb.num_rows)
+            lcols = [gather_column(c, li, pair_live) for c in lb.columns]
+            rcols = [gather_column(c, ri, pair_live) for c in rb.columns]
+            live = pair_live
+            if condition is not None:
+                cctx = Ctx(
+                    jnp,
+                    cap,
+                    True,
+                    [Val(c.data, c.validity, c.lengths) for c in lcols + rcols],
+                    live.sum().astype(jnp.int32),
+                )
+                cv = condition.eval(cctx)
+                live = cctx.broadcast_bool(cv.data) & cv.full_valid(cctx) & pair_live
+            # matched flags per side row (outer/semi/anti bookkeeping)
+            left_matched = (
+                jnp.zeros(n, bool).at[jnp.where(live, li, n)].set(True, mode="drop")
+            )
+            right_matched = (
+                jnp.zeros(m, bool).at[jnp.where(live, ri, m)].set(True, mode="drop")
+            )
+            if jt in ("left_semi", "left_anti"):
+                return None, left_matched, right_matched
+            # num_rows = cap: live pairs are scattered over the [n x m] grid
+            # and compact intersects its keep mask with row_mask
+            out = DeviceBatch(
+                out_schema,
+                [
+                    DeviceColumn(c.dtype, c.data, c.validity & live, c.lengths)
+                    for c in lcols + rcols
+                ],
+                jnp.asarray(cap, jnp.int32),
+            )
+            return compact(out, live), left_matched, right_matched
+
+    return fn

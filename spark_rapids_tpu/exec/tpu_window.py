@@ -104,16 +104,30 @@ class TpuWindowExec(Exec):
 
     def _kernel(self, child_schema: Schema):
         spec = self.spec
-        pkeys = [bind(p, child_schema) for p in spec.partition_by]
-        orders = [
+        pkeys = tuple(bind(p, child_schema) for p in spec.partition_by)
+        orders = tuple(
             (bind(o.child, child_schema), o.ascending, o.resolved_nulls_first())
             for o in spec.order_by
-        ]
-        window_cols = self.window_cols
+        )
+        window_cols = tuple((name, we) for name, we in self.window_cols)
         out_schema = self._schema
+        from .. import kernels as K
 
-        @jax.jit
-        def fn(batch: DeviceBatch) -> DeviceBatch:
+        key = ("window", pkeys, orders, window_cols, out_schema, child_schema)
+        return K.jit_kernel(
+            key,
+            lambda: _make_window_kernel(
+                pkeys, orders, window_cols, out_schema, child_schema
+            ),
+        )
+
+    def node_string(self):
+        names = ", ".join(str(we) for _, we in self.window_cols)
+        return f"TpuWindow [{names}]"
+
+
+def _make_window_kernel(pkeys, orders, window_cols, out_schema, child_schema):
+    def fn(batch: DeviceBatch) -> DeviceBatch:
             cap = batch.capacity
             c = Ctx.for_device(batch)
             live0 = batch.row_mask()
@@ -172,11 +186,7 @@ class TpuWindowExec(Exec):
                 out_schema, list(sorted_batch.columns) + new_cols, sorted_batch.num_rows
             )
 
-        return fn
-
-    def node_string(self):
-        names = ", ".join(str(we) for _, we in self.window_cols)
-        return f"TpuWindow [{names}]"
+    return fn
 
 
 def _compute_window_column(

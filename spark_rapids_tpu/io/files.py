@@ -85,11 +85,15 @@ def _iter_file(path: str, fmt: str, schema: Schema, options: dict, batch_rows: i
     target = schema.to_arrow()
     if fmt == "parquet":
         pf = papq.ParquetFile(path)
-        for rb in pf.iter_batches(batch_size=batch_rows):
+        want = [n for n in schema.names if n in pf.schema_arrow.names]
+        # pruned schema ⇒ pruned decode (pushed-down column projection)
+        for rb in pf.iter_batches(batch_size=batch_rows, columns=want):
             yield _conform(rb, target)
         pf.close()
     elif fmt == "orc":
-        table = paorc.ORCFile(path).read()
+        f = paorc.ORCFile(path)
+        want = [n for n in schema.names if n in f.schema.names]
+        table = f.read(columns=want)
         for rb in table.to_batches(max_chunksize=batch_rows):
             yield _conform(rb, target)
     elif fmt == "csv":

@@ -1,0 +1,110 @@
+"""Module-level jitted-kernel cache — compile once per query *shape*, not per
+query execution.
+
+The reference relies on cuDF's pre-compiled kernel library: planning a query
+never compiles GPU code, so running the same query twice costs the same both
+times. The TPU engine compiles its kernels with XLA at first use instead —
+which is only acceptable if compiled kernels are reused across `collect()`
+calls. Exec instances are rebuilt per query (session._execute), so jitted
+closures must NOT live on exec instances; they live here, keyed by the
+semantic identity of the kernel:
+
+    (kernel kind, bound expression tree(s), schema signature, static config)
+
+Bound expressions are frozen dataclasses (hashable by structure — expr/base),
+and schemas/types are value objects, so the key is a plain tuple. XLA's own
+per-function tracing cache then handles shape/dtype specialization beneath
+each entry (capacity bucketing keeps that logarithmic).
+
+A persistent on-disk compilation cache (enable_persistent_cache) additionally
+reuses XLA binaries across *processes* — the analogue of shipping cuDF's
+pre-built kernels. Reference framing: SURVEY.md §7 "recompilation management"
+(the #1 perf trap); RapidsConf.scala has no analogue because cuDF never
+recompiles.
+"""
+from __future__ import annotations
+
+import os
+import threading
+from typing import Callable
+
+import jax
+
+_LOCK = threading.Lock()
+_KERNELS: dict = {}
+_BUILDS = 0  # number of distinct kernels built (cache misses)
+
+
+def kernel(key: tuple, builder: Callable):
+    """Return the cached kernel for ``key``, building it on first use.
+
+    ``builder`` returns the (usually jitted) callable; it must close over
+    nothing whose lifetime matters — everything semantic belongs in the key.
+    """
+    global _BUILDS
+    fn = _KERNELS.get(key)
+    if fn is None:
+        with _LOCK:
+            fn = _KERNELS.get(key)
+            if fn is None:
+                fn = builder()
+                _KERNELS[key] = fn
+                _BUILDS += 1
+    return fn
+
+
+def jit_kernel(key: tuple, make_fn: Callable):
+    """Shorthand: cache ``jax.jit(make_fn())`` under ``key``."""
+    return kernel(key, lambda: jax.jit(make_fn()))
+
+
+def schema_key(schema) -> tuple:
+    """Hashable identity of a Schema (names participate: they are pytree aux
+    metadata on DeviceBatch, so two name-sets are two trace entries)."""
+    return tuple((f.name, f.data_type, f.nullable) for f in schema)
+
+
+def build_count() -> int:
+    """Distinct kernels built so far (monotonic; cache misses)."""
+    return _BUILDS
+
+
+def trace_count() -> int:
+    """Total jit specializations across cached kernels — grows only when a
+    kernel is traced/compiled for a new shape signature. Flat between two
+    identical queries ⇔ zero recompilation."""
+    total = 0
+    for fn in _KERNELS.values():
+        cs = getattr(fn, "_cache_size", None)
+        if callable(cs):
+            try:
+                total += cs()
+            except Exception:
+                pass
+    return total
+
+
+def clear() -> None:
+    _KERNELS.clear()
+
+
+_PERSISTENT_ENABLED = False
+
+
+def enable_persistent_cache(path: str | None = None) -> None:
+    """Turn on JAX's on-disk compilation cache so separate processes (bench
+    runs, test sessions) reuse XLA executables."""
+    global _PERSISTENT_ENABLED
+    if _PERSISTENT_ENABLED:
+        return
+    cache_dir = path or os.environ.get(
+        "SPARK_RAPIDS_TPU_COMPILE_CACHE",
+        os.path.join(os.path.expanduser("~"), ".cache", "spark_rapids_tpu_xla"),
+    )
+    try:
+        os.makedirs(cache_dir, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+        _PERSISTENT_ENABLED = True
+    except Exception:  # cache is an optimization; never fail a query over it
+        pass

@@ -3,15 +3,22 @@ TpuHashAggregateExec.
 
 Reference: aggregate.scala's ``Table.groupBy(...).aggregate`` hot loop
 (:345-520). cudf hash-aggregates; the TPU-first equivalent is ONE fused XLA
-program per (schema, capacity): radix-encode keys → variadic ``lax.sort`` →
-segment-ids by adjacent-difference → scatter/segment reductions. Everything is
-static-shape (output capacity == input capacity; live groups prefix-compacted
-with a device-resident count), so the whole update/merge pipeline stays on
-device with no host syncs.
+program per (schema, capacity): radix-encode keys → LSD radix ``lax.sort`` →
+segment boundaries by adjacent-difference → **segmented scans** over the
+sorted runs, with group outputs gathered at segment boundaries through a
+compaction permutation. Everything is static-shape (output capacity == input
+capacity; live groups prefix-compacted with a device-resident count), so the
+whole update/merge pipeline stays on device with no host syncs.
+
+No scatters anywhere: ``jax.ops.segment_*`` lowers to a serial per-element
+scatter loop on TPU (~µs/row — seconds/batch); scans + gathers are log-depth
+and vectorized. Ungrouped reductions skip the sort entirely and lower to
+plain masked ``jnp.sum``/``min``/``max``.
 
 Spark semantics: NULL keys form a group; float keys are normalized
 (-0.0 → 0.0, canonical NaN) as Spark's NormalizeFloatingNumbers does; sums
-wrap for longs; min/max/first/last are NULL on all-null groups.
+wrap for longs; min/max/first/last are NULL on all-null groups; float
+min/max treat NaN as the greatest value.
 """
 from __future__ import annotations
 
@@ -21,6 +28,7 @@ import jax.numpy as jnp
 from ..columnar.device import DeviceBatch, DeviceColumn
 from ..types import DoubleType, FloatType, StringType
 from .gather import gather_column
+from .scan import first_k_positions, seg_end_flags, segscan
 from .sortkeys import batch_radix_words, segment_starts, sort_permutation
 
 _BIG = jnp.int32(2**31 - 1)
@@ -35,73 +43,55 @@ def _normalize_float(col: DeviceColumn) -> DeviceColumn:
     return col
 
 
-def _segment_reduce(op: str, data, valid, seg_ids, idx, cap, is_string: bool):
-    """One reduction over sorted rows.
+def _minmax_fill(op: str, dtype):
+    if jnp.issubdtype(dtype, jnp.floating):
+        return jnp.array(jnp.inf if op == "min" else -jnp.inf, dtype=dtype)
+    info = jnp.iinfo(dtype)
+    return jnp.array(info.max if op == "min" else info.min, dtype=dtype)
 
-    Returns ``(data[cap], valid[cap], pick)`` where ``pick`` is the per-group
-    source-row index for index-pick ops (first/last) and None otherwise —
-    callers gather auxiliary buffers (string lengths) by it."""
-    live_valid = valid  # caller already masked by row liveness
-    any_valid = jax.ops.segment_max(
-        live_valid.astype(jnp.int32), seg_ids, num_segments=cap
-    ).astype(bool)
+
+def _scan_reduce(op: str, data, valid, starts, idx, cap):
+    """Per-row inclusive segmented reduction over sorted rows. Returns
+    (scan_vals, scan_valid, pick) where values at each segment's END row are
+    the segment totals; ``pick`` (per-row running pick index) is set for
+    first/last ops."""
     if op == "sum":
-        out = jax.ops.segment_sum(
-            jnp.where(live_valid, data, jnp.zeros_like(data)), seg_ids, num_segments=cap
-        )
-        return out, any_valid, None
+        vals = jnp.where(valid, data, jnp.zeros_like(data))
+        return segscan(vals, starts, jnp.add), segscan(
+            valid.astype(jnp.int32), starts, jnp.add
+        ) > 0, None
     if op == "count":
-        out = jax.ops.segment_sum(
-            live_valid.astype(jnp.int64), seg_ids, num_segments=cap
-        )
+        out = segscan(valid.astype(jnp.int64), starts, jnp.add)
         return out, jnp.ones(cap, dtype=bool), None
     if op in ("min", "max"):
-        assert not is_string, "string min/max handled by re-sort strategy"
-        if jnp.issubdtype(data.dtype, jnp.floating):
-            fill = jnp.array(jnp.inf if op == "min" else -jnp.inf, dtype=data.dtype)
-        else:
-            info = jnp.iinfo(data.dtype)
-            fill = jnp.array(info.max if op == "min" else info.min, dtype=data.dtype)
-        masked = jnp.where(live_valid, data, fill)
-        # Spark NaN ordering: NaN is the greatest value. Use a +inf sentinel so
-        # min never picks NaN and max treats NaN as greatest, then restore NaN.
-        if jnp.issubdtype(data.dtype, jnp.floating):
+        fill = _minmax_fill(op, data.dtype)
+        masked = jnp.where(valid, data, fill)
+        is_float = jnp.issubdtype(data.dtype, jnp.floating)
+        if is_float:
+            # Spark NaN ordering: NaN is the greatest value. +inf sentinel so
+            # the scan never propagates NaN; restored by the caller.
             masked = jnp.where(jnp.isnan(masked), jnp.inf, masked)
-        fn = jax.ops.segment_min if op == "min" else jax.ops.segment_max
-        out = fn(masked, seg_ids, num_segments=cap)
-        if jnp.issubdtype(data.dtype, jnp.floating):
-            had_nan = jax.ops.segment_max(
-                (live_valid & jnp.isnan(data)).astype(jnp.int32),
-                seg_ids,
-                num_segments=cap,
-            ).astype(bool)
-            if op == "max":
-                out = jnp.where(had_nan, jnp.nan, out)
-            else:
-                # all-NaN group: min is NaN (every value is NaN)
-                all_nan = had_nan & (out == jnp.inf)
-                out = jnp.where(all_nan, jnp.nan, out)
+        fn = jnp.minimum if op == "min" else jnp.maximum
+        out = segscan(masked, starts, fn)
+        any_valid = segscan(valid.astype(jnp.int32), starts, jnp.add) > 0
         return out, any_valid, None
-    # first/last family: pick a row index per segment, then gather
+    # first/last family: running pick of a row index per segment
     if op == "first":
-        pick = jax.ops.segment_min(idx, seg_ids, num_segments=cap)
+        pick = segscan(idx, starts, jnp.minimum)
     elif op == "last":
-        pick = jax.ops.segment_max(idx, seg_ids, num_segments=cap)
+        pick = segscan(idx, starts, jnp.maximum)
     elif op == "first_ignore_nulls":
-        pick = jax.ops.segment_min(
-            jnp.where(live_valid, idx, _BIG), seg_ids, num_segments=cap
-        )
+        pick = segscan(jnp.where(valid, idx, _BIG), starts, jnp.minimum)
     elif op == "last_ignore_nulls":
-        pick = jax.ops.segment_max(
-            jnp.where(live_valid, idx, jnp.int32(-1)), seg_ids, num_segments=cap
-        )
+        pick = segscan(jnp.where(valid, idx, jnp.int32(-1)), starts, jnp.maximum)
     else:  # pragma: no cover
         raise ValueError(f"unknown reduce op {op}")
-    ok = (pick != _BIG) & (pick >= 0)
-    safe = jnp.clip(pick, 0, data.shape[0] - 1)
-    out = data[safe]
-    out_valid = valid[safe] & ok
-    return out, out_valid, safe
+    return pick, None, pick
+
+
+def _had_nan_scan(data, valid, starts):
+    """Per-row 'segment saw a valid NaN' flag (Spark: NaN greatest)."""
+    return segscan((valid & jnp.isnan(data)).astype(jnp.int32), starts, jnp.add) > 0
 
 
 def group_aggregate(
@@ -110,61 +100,179 @@ def group_aggregate(
     agg_columns: list[DeviceColumn],
     ops: list[str],
     min_groups: int = 0,
+    live_mask=None,
 ) -> tuple[list[DeviceColumn], list[DeviceColumn], jax.Array]:
     """Group ``batch`` rows by key columns; reduce ``agg_columns[i]`` with
     ``ops[i]``. Returns (key cols, agg cols, num_groups) — all [capacity]
     with live groups in the prefix. ``min_groups=1`` gives ungrouped
     reductions their one output row even on empty input (Spark: global
-    count() over nothing is 0, not no-rows)."""
+    count() over nothing is 0, not no-rows).
+
+    ``live_mask`` (bool[cap]) restricts which rows participate — the fused
+    pre-filter path: a filter feeding an aggregate contributes a mask here
+    instead of compacting its output (saving a full gather of every column).
+    """
     cap = batch.capacity
     if not batch.columns and agg_columns:
         cap = agg_columns[0].capacity  # ungrouped: key-less work batch
     keys = [_normalize_float(batch.columns[i]) for i in key_ordinals]
-    words = batch_radix_words(keys)
-    row_mask = batch.row_mask()
-    live = jnp.arange(cap, dtype=jnp.int32) < batch.num_rows  # live rows sort first
     if not keys:
-        # ungrouped reduction: no sort, all live rows form one segment
-        perm = jnp.arange(cap, dtype=jnp.int32)
-        starts = (jnp.arange(cap, dtype=jnp.int32) == 0) & (batch.num_rows > 0)
-    else:
-        perm = sort_permutation(words, row_mask)
-        s_words = [w[perm] for w in words]
-        starts = segment_starts(s_words, live)
-    seg_ids = jnp.cumsum(starts.astype(jnp.int32)) - 1
-    seg_ids = jnp.clip(seg_ids, 0, cap - 1)
-    num_groups = jnp.maximum(starts.sum().astype(jnp.int32), min_groups)
+        return _ungrouped_aggregate(batch, agg_columns, ops, cap, live_mask)
 
-    # representative keys: scatter the first row of each segment
+    words = batch_radix_words(keys)
+    row_mask = batch.row_mask() if live_mask is None else live_mask
+    n_live = (
+        batch.num_rows if live_mask is None else live_mask.sum().astype(jnp.int32)
+    )
+    perm = sort_permutation(words, row_mask)
+    # live rows sort first, so the sorted live mask is a prefix of n_live
+    live = jnp.arange(cap, dtype=jnp.int32) < n_live
+    s_words = [w[perm] for w in words]
+    starts = segment_starts(s_words, live)
+    num_groups = jnp.maximum(starts.sum().astype(jnp.int32), min_groups)
+    group_live = jnp.arange(cap, dtype=jnp.int32) < num_groups
+    idx = jnp.arange(cap, dtype=jnp.int32)
+    # the first padding row "starts a segment" so the LAST live segment's
+    # end lands on row n_live-1, not cap-1
+    ends = seg_end_flags(starts | (idx == n_live)) & live
+
+    # group-ordered positions of segment starts/ends (no scatters: one
+    # single-key compaction sort each)
+    start_pos = first_k_positions(starts)
+    end_pos = first_k_positions(ends)
+
+    # representative keys: the first sorted row of each segment
     out_keys: list[DeviceColumn] = []
     for k in keys:
         sk = gather_column(k, perm)
-        tgt = jnp.where(starts, seg_ids, cap - 1)  # dead rows collide harmlessly
-        kdata = jnp.zeros_like(sk.data)
-        if sk.data.ndim == 2:
-            kdata = kdata.at[tgt].set(jnp.where(starts[:, None], sk.data, 0), mode="drop")
-        else:
-            kdata = kdata.at[tgt].set(jnp.where(starts, sk.data, jnp.zeros_like(sk.data)), mode="drop")
-        kvalid = jnp.zeros_like(sk.validity).at[tgt].set(starts & sk.validity, mode="drop")
-        klen = None
-        if sk.lengths is not None:
-            klen = jnp.zeros_like(sk.lengths).at[tgt].set(
-                jnp.where(starts, sk.lengths, 0), mode="drop"
+        gk = gather_column(sk, start_pos, group_live)
+        out_keys.append(
+            DeviceColumn(
+                k.dtype,
+                _mask_data(gk.data, group_live),
+                gk.validity & group_live,
+                None if gk.lengths is None else jnp.where(group_live, gk.lengths, 0),
             )
-        group_live = jnp.arange(cap, dtype=jnp.int32) < num_groups
-        out_keys.append(DeviceColumn(k.dtype, kdata, kvalid & group_live, klen))
+        )
 
-    idx = jnp.arange(cap, dtype=jnp.int32)
-    group_live = jnp.arange(cap, dtype=jnp.int32) < num_groups
     out_aggs: list[DeviceColumn] = []
     for col, op in zip(agg_columns, ops):
         sc = gather_column(col, perm)
         v = sc.validity & live
         is_str = isinstance(col.dtype, StringType)
-        data, valid, pick = _segment_reduce(op, sc.data, v, seg_ids, idx, cap, is_str)
-        lengths = None
-        if is_str:
-            assert pick is not None, f"string op {op} requires an index-pick"
-            lengths = sc.lengths[pick]
-        out_aggs.append(DeviceColumn(col.dtype, data, valid & group_live, lengths))
+        scan_vals, scan_valid, pick = _scan_reduce(op, sc.data, v, starts, idx, cap)
+        if pick is not None:
+            # first/last: gather the picked row's value per group
+            gpick = scan_vals[end_pos]  # pick at each segment's end
+            ok = (gpick != _BIG) & (gpick >= 0) & group_live
+            safe = jnp.clip(gpick, 0, cap - 1)
+            data = sc.data[safe]
+            valid_out = sc.validity[safe] & ok
+            lengths = sc.lengths[safe] if is_str else None
+            if data.ndim == 2:
+                data = jnp.where(ok[:, None], data, 0)
+            else:
+                data = jnp.where(ok, data, jnp.zeros_like(data))
+            out_aggs.append(DeviceColumn(col.dtype, data, valid_out, lengths))
+            continue
+        assert not is_str, f"string op {op} requires an index-pick"
+        data = scan_vals[end_pos]
+        valid_out = scan_valid[end_pos] & group_live
+        if op in ("min", "max") and jnp.issubdtype(sc.data.dtype, jnp.floating):
+            had_nan = _had_nan_scan(sc.data, v, starts)[end_pos]
+            if op == "max":
+                data = jnp.where(had_nan, jnp.nan, data)
+            else:
+                # min is NaN only when EVERY valid value was NaN — a real
+                # +inf minimum alongside a NaN must stay +inf (NaN greatest)
+                has_nonnan = (
+                    segscan(
+                        (v & ~jnp.isnan(sc.data)).astype(jnp.int32), starts, jnp.add
+                    )
+                    > 0
+                )[end_pos]
+                data = jnp.where(had_nan & ~has_nonnan, jnp.nan, data)
+        if op == "count":
+            valid_out = group_live  # count is never null
+        data = _mask_data(data, group_live)
+        out_aggs.append(DeviceColumn(col.dtype, data, valid_out, None))
     return out_keys, out_aggs, num_groups
+
+
+def _mask_data(data, group_live):
+    if data.ndim == 2:
+        return jnp.where(group_live[:, None], data, 0)
+    return jnp.where(group_live, data, jnp.zeros_like(data))
+
+
+def _ungrouped_aggregate(batch, agg_columns, ops, cap, live_mask=None):
+    """No keys: one output group; plain masked whole-array reductions."""
+    if live_mask is not None:
+        live = live_mask
+    else:
+        live = jnp.arange(cap, dtype=jnp.int32) < batch.num_rows
+    idx = jnp.arange(cap, dtype=jnp.int32)
+    out_aggs: list[DeviceColumn] = []
+    one_live = jnp.arange(cap, dtype=jnp.int32) < 1
+    for col, op in zip(agg_columns, ops):
+        data, valid = col.data, col.validity & live
+        is_str = isinstance(col.dtype, StringType)
+
+        def place(scalar, ok, lengths_scalar=None):
+            """Put the scalar into row 0 of a [cap] column."""
+            if getattr(scalar, "ndim", 0) == 1:  # string bytes [w]
+                out = jnp.zeros((cap, scalar.shape[0]), dtype=scalar.dtype)
+                out = jnp.where(one_live[:, None], scalar[None, :], out)
+            else:
+                out = jnp.where(one_live, scalar, jnp.zeros(cap, dtype=scalar.dtype))
+            vout = one_live & ok
+            lout = None
+            if lengths_scalar is not None:
+                lout = jnp.where(one_live, lengths_scalar, 0).astype(jnp.int32)
+            return DeviceColumn(col.dtype, out, vout, lout)
+
+        any_valid = valid.any()
+        if op == "sum":
+            total = jnp.where(valid, data, jnp.zeros_like(data)).sum()
+            out_aggs.append(place(total, any_valid))
+        elif op == "count":
+            out_aggs.append(place(valid.sum().astype(jnp.int64), jnp.bool_(True)))
+        elif op in ("min", "max"):
+            assert not is_str, "string min/max handled via first/last picks"
+            fill = _minmax_fill(op, data.dtype)
+            masked = jnp.where(valid, data, fill)
+            is_float = jnp.issubdtype(data.dtype, jnp.floating)
+            if is_float:
+                masked = jnp.where(jnp.isnan(masked), jnp.inf, masked)
+            total = masked.min() if op == "min" else masked.max()
+            if is_float:
+                had_nan = (valid & jnp.isnan(data)).any()
+                if op == "max":
+                    total = jnp.where(had_nan, jnp.nan, total)
+                else:
+                    # NaN only when every valid value was NaN (NaN greatest)
+                    has_nonnan = (valid & ~jnp.isnan(data)).any()
+                    total = jnp.where(had_nan & ~has_nonnan, jnp.nan, total)
+            out_aggs.append(place(total, any_valid))
+        else:  # first/last family
+            if op == "first":
+                pick = jnp.where(live, idx, _BIG).min()
+            elif op == "last":
+                pick = jnp.where(live, idx, jnp.int32(-1)).max()
+            elif op == "first_ignore_nulls":
+                pick = jnp.where(valid, idx, _BIG).min()
+            elif op == "last_ignore_nulls":
+                pick = jnp.where(valid, idx, jnp.int32(-1)).max()
+            else:  # pragma: no cover
+                raise ValueError(f"unknown reduce op {op}")
+            ok = (pick != _BIG) & (pick >= 0)
+            safe = jnp.clip(pick, 0, cap - 1)
+            out_aggs.append(
+                place(
+                    data[safe],
+                    col.validity[safe] & ok,
+                    None if col.lengths is None else col.lengths[safe],
+                )
+            )
+    num_groups = jnp.int32(1)
+    return [], out_aggs, num_groups

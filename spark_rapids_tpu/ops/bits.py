@@ -1,0 +1,141 @@
+"""IEEE-754 double bit-pattern extraction without 64-bit bitcasts.
+
+The v5e's XLA X64 rewriter (64-bit types are emulated on TPU) does not
+implement ``bitcast-convert`` involving 64-bit element types, so
+``lax.bitcast_convert_type(f64, i64)`` — the obvious way to get sort keys
+and murmur3 input bits for doubles — fails to compile on TPU. This module
+computes the exact bit pattern arithmetically (sign/exponent/mantissa
+decomposition using only ops the rewriter supports: abs, log2, floor,
+mul/add, integer converts, shifts). NaNs collapse to the canonical quiet
+NaN (0x7ff8000000000000) — exactly ``Double.doubleToLongBits`` semantics,
+which is also what Spark's murmur3 hashes (HashExpressions) and what the
+engine's NaN normalization produces anyway.
+
+On CPU the plain bitcast is used (faster, and preserves NaN payloads).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+_CANONICAL_NAN = (0x7FF8 << 48)
+_INF_BITS = 0x7FF << 52
+
+# 2^(2^j) for j in [0, 9]: enough to build any power of two up to 2^1023.
+_POW2_SQUARES = [2.0 ** (1 << j) for j in range(10)]
+
+
+def _exact_pow2(e):
+    """2.0**e for integer-valued ``e`` in [-1023, 1023], bit-exact (binary
+    exponentiation over exact power-of-two constants; no pow/exp2, whose TPU
+    lowering is approximate)."""
+    mag = jnp.abs(e).astype(jnp.int32)
+    p = jnp.ones_like(e, dtype=jnp.float64)
+    for j in range(10):
+        bit = (mag >> j) & 1
+        p = jnp.where(bit == 1, p * _POW2_SQUARES[j], p)
+    return jnp.where(e < 0, 1.0 / p, p)
+
+
+def f64_bits_arith(x: jax.Array) -> jax.Array:
+    """uint64 IEEE-754 bits of a float64 array, computed arithmetically."""
+    x = x.astype(jnp.float64)
+    ax = jnp.abs(x)
+    # sign, including -0.0 (1/x -> -inf distinguishes it)
+    inv = 1.0 / jnp.where(x == 0.0, x, jnp.float64(1.0))
+    negative = (x < 0) | ((x == 0.0) & (inv < 0))
+    sign = jnp.where(negative, jnp.int64(-(2**63)), jnp.int64(0))  # top bit
+
+    finite = jnp.isfinite(x)
+    is_nan = jnp.isnan(x)
+    min_normal = jnp.float64(2.0) ** -1022
+    is_sub = finite & (ax < min_normal) & (ax > 0)
+
+    # ── normal path ────────────────────────────────────────────────────
+    safe_ax = jnp.where(finite & (ax >= min_normal), ax, jnp.float64(1.0))
+    e = jnp.floor(jnp.log2(safe_ax))
+    e = jnp.clip(e, -1022.0, 1023.0)
+    # scale by 2^-e in two half-steps: a single factor 2^-1023 would be
+    # subnormal and flushed to zero under XLA's FTZ/DAZ float handling
+    e1 = jnp.floor(e * 0.5)
+    e2 = e - e1
+    m = (safe_ax * _exact_pow2(-e1)) * _exact_pow2(-e2)  # exact scaling
+    # log2 rounds near powers of two: nudge m back into [1, 2)
+    too_big = m >= 2.0
+    e = jnp.where(too_big, e + 1, e)
+    m = jnp.where(too_big, m * 0.5, m)
+    too_small = m < 1.0
+    e = jnp.where(too_small, e - 1, e)
+    m = jnp.where(too_small, m * 2.0, m)
+    exp_field = (e + 1023.0).astype(jnp.int64)
+    mant = ((m - 1.0) * (2.0 ** 52)).astype(jnp.int64)  # exact: ulp(m)=2^-52
+    normal_bits = (exp_field << 52) | mant
+
+    # ── subnormal path: bits = ax * 2^1074 (split to stay in range).
+    # NOTE: backends running FTZ/DAZ (XLA CPU; the TPU f64 emulation, where
+    # sub-f32-range values are already flushed on device) read subnormal
+    # inputs as zero, so there this maps subnormals to ±0 bits — consistent
+    # with how every other arithmetic op on such backends treats them.
+    sub_mant = ((ax * (2.0 ** 537)) * (2.0 ** 537)).astype(jnp.int64)
+
+    bits = jnp.where(is_sub, sub_mant, normal_bits)
+    bits = jnp.where(ax == 0.0, jnp.int64(0), bits)
+    bits = jnp.where(finite, bits, jnp.int64(_INF_BITS))
+    bits = jnp.where(is_nan, jnp.int64(_CANONICAL_NAN), bits)
+    return (bits | sign).astype(jnp.uint64)
+
+
+def f64_bits(x: jax.Array) -> jax.Array:
+    """uint64 bits of float64 — bitcast where supported, arithmetic on TPU."""
+    if jax.default_backend() == "tpu":
+        return f64_bits_arith(x)
+    return jax.lax.bitcast_convert_type(x.astype(jnp.float64), jnp.uint64)
+
+
+def bits_to_f64_arith(u: jax.Array) -> jax.Array:
+    """int64 IEEE-754 bit patterns → float64 values, arithmetically (the
+    inverse of f64_bits_arith; same TPU no-64-bit-bitcast constraint).
+    Values outside the emulated range (|x| > f32 range on TPU) become inf —
+    which is what any arithmetic op on them would produce there anyway."""
+    u = u.astype(jnp.int64)
+    sign = jnp.where((u >> 63) & 1 == 1, jnp.float64(-1.0), jnp.float64(1.0))
+    exp_field = (u >> 52) & jnp.int64(0x7FF)
+    mant = u & jnp.int64((1 << 52) - 1)
+    mant_f = mant.astype(jnp.float64) * (2.0 ** -52)  # exact: mant < 2^53
+    # normal: (1 + m) * 2^(E-1023); subnormal: m * 2^-1022
+    e = jnp.where(exp_field == 0, jnp.int64(-1022), exp_field - 1023).astype(
+        jnp.float64
+    )
+    frac = jnp.where(exp_field == 0, mant_f, 1.0 + mant_f)
+    e1 = jnp.floor(e * 0.5)
+    val = (frac * _exact_pow2(e1)) * _exact_pow2(e - e1)
+    val = jnp.where(exp_field == 2047, jnp.where(mant == 0, jnp.inf, jnp.nan), val)
+    return sign * val
+
+
+def bits_to_f64(u: jax.Array) -> jax.Array:
+    if jax.default_backend() == "tpu":
+        return bits_to_f64_arith(u)
+    return jax.lax.bitcast_convert_type(u.astype(jnp.int64), jnp.float64)
+
+
+def le_bytes_to_i64(raw: jax.Array) -> jax.Array:
+    """uint8[n*8] little-endian bytes → int64[n] without a 64-bit bitcast."""
+    words = jax.lax.bitcast_convert_type(raw.reshape(-1, 2, 4), jnp.uint32)
+    lo = words[:, 0].astype(jnp.int64)
+    hi = words[:, 1].astype(jnp.int64)
+    return lo | (hi << 32)
+
+
+def i64_bytes_le(flat: jax.Array) -> jax.Array:
+    """1-D 64-bit array → little-endian uint8 bytes [n*8] without a 64-bit
+    bitcast: split into (lo, hi) uint32 words arithmetically, then bitcast
+    32→8 (supported everywhere)."""
+    if flat.dtype == jnp.dtype(jnp.float64):
+        u = f64_bits(flat).astype(jnp.int64)
+    else:
+        u = flat.astype(jnp.int64)
+    lo = (u & jnp.int64(0xFFFFFFFF)).astype(jnp.uint32)
+    hi = ((u >> jnp.int64(32)) & jnp.int64(0xFFFFFFFF)).astype(jnp.uint32)
+    pairs = jnp.stack([lo, hi], axis=-1)  # [n, 2] little-endian word order
+    return jax.lax.bitcast_convert_type(pairs, jnp.uint8).reshape(-1)

@@ -13,6 +13,7 @@ import jax.numpy as jnp
 
 from ..columnar.device import DeviceBatch, DeviceColumn, bucket_capacity
 from ..types import StringType
+from .. import kernels as K
 
 
 def _pad_width(data: jax.Array, w: int) -> jax.Array:
@@ -22,12 +23,25 @@ def _pad_width(data: jax.Array, w: int) -> jax.Array:
 
 
 def concat_device(batches: list[DeviceBatch], capacity: int | None = None) -> DeviceBatch:
-    """Concatenate device batches (same schema) into one batch."""
+    """Concatenate device batches (same schema) into one batch — ONE fused
+    jitted program per (schema, input shapes, output capacity), cached
+    module-wide; eager per-column scatters would dispatch hundreds of tiny
+    ops per call."""
     assert batches, "concat of zero batches"
     if len(batches) == 1 and (capacity is None or batches[0].capacity == capacity):
         return batches[0]
     schema = batches[0].schema
     cap = capacity or bucket_capacity(sum(b.capacity for b in batches))
+    shapes = tuple(tuple(c.data.shape for c in b.columns) for b in batches)
+    fn = K.kernel(
+        ("concat", schema, shapes, cap),
+        lambda: jax.jit(lambda bs: _concat_impl(list(bs), cap)),
+    )
+    return fn(tuple(batches))
+
+
+def _concat_impl(batches: list[DeviceBatch], cap: int) -> DeviceBatch:
+    schema = batches[0].schema
     ncols = len(schema)
     widths = []
     for i, f in enumerate(schema):

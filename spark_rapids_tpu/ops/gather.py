@@ -28,6 +28,40 @@ def gather_batch(batch: DeviceBatch, idx: jax.Array, new_num_rows) -> DeviceBatc
     return DeviceBatch(batch.schema, cols, jnp.asarray(new_num_rows, jnp.int32))
 
 
+def shrink_one(batch: DeviceBatch, n: int) -> DeviceBatch:
+    """Re-bucket a batch to the capacity its ``n`` live rows need (no-op when
+    already tight). Cached fused kernel per (schema, in-cap, out-cap)."""
+    from ..columnar.device import bucket_capacity
+    from .. import kernels as K
+
+    cap2 = bucket_capacity(max(n, 1))
+    if cap2 >= batch.capacity:
+        return batch
+    fn = K.kernel(
+        ("shrink", batch.schema, batch.capacity, cap2),
+        lambda: jax.jit(
+            lambda b: gather_batch(b, jnp.arange(cap2, dtype=jnp.int32), b.num_rows)
+        ),
+    )
+    return fn(batch)
+
+
+def bulk_shrink(batches: list[DeviceBatch]) -> list[DeviceBatch]:
+    """Re-bucket batches whose live prefix is much smaller than capacity
+    (partial-aggregate outputs, selective filters). ONE bulk row-count fetch
+    for the whole list — the work feeding every batch is already dispatched
+    asynchronously, so the wait overlaps all of it instead of serializing
+    per batch. Downstream kernels (exchange slicing, concat, merge sort,
+    D2H packing) then compile and run at the small capacities."""
+    import numpy as np
+
+    if not batches:
+        return batches
+    # stack the device scalars so the host fetch is ONE array transfer
+    counts = np.asarray(jnp.stack([b.num_rows for b in batches]))
+    return [shrink_one(b, int(n)) for b, n in zip(batches, counts)]
+
+
 def compact(batch: DeviceBatch, keep: jax.Array) -> DeviceBatch:
     """Stable-compact rows where ``keep`` (bool[cap]) into the prefix."""
     keep = keep & batch.row_mask()

@@ -181,9 +181,9 @@ def hash_column(xp, dt: DataType, data, valid, lengths, seed_u32):
         if xp is np:
             bits = x.view(np.int64)
         else:
-            import jax.lax as lax
+            from .bits import f64_bits  # no 64-bit bitcast on TPU
 
-            bits = lax.bitcast_convert_type(x, xp.int64)
+            bits = f64_bits(x).astype(xp.int64)
         h = hash_long(xp, bits, seed_u32)
     else:  # byte/short/int/date
         h = hash_int(xp, data.astype(xp.int32), seed_u32)

@@ -1,0 +1,41 @@
+"""Segmented-scan primitives shared by the aggregate and window kernels.
+
+TPU-first: ``jax.ops.segment_sum``-style scatter reductions execute as a
+serial per-element scatter loop on TPU (microseconds per row — seconds per
+batch). Over SORTED runs the same reductions are log-depth
+``lax.associative_scan``s with a reset flag, plus gathers at segment
+boundaries — fully vectorized on the VPU.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def segscan(vals, starts, op):
+    """Inclusive segmented scan: op-accumulate left-to-right, resetting at
+    rows where ``starts`` is True. Standard (flag, value) combine."""
+
+    def comb(a, b):
+        af, av = a
+        bf, bv = b
+        return (af | bf, jnp.where(bf, bv, op(av, bv)))
+
+    _, v = jax.lax.associative_scan(comb, (starts, vals))
+    return v
+
+
+def seg_end_flags(starts: jax.Array) -> jax.Array:
+    """Row i ends its segment iff row i+1 starts one (last row always ends)."""
+    return jnp.concatenate([starts[1:], jnp.ones(1, dtype=bool)])
+
+
+def first_k_positions(flags: jax.Array) -> jax.Array:
+    """Positions of True flags, in order, compacted to the front (argsort of
+    the negated mask — one cheap single-key sort, no scatter). Position k of
+    the result is the row index of the k-th flagged row."""
+    cap = flags.shape[0]
+    iota = jnp.arange(cap, dtype=jnp.int32)
+    key = jnp.where(flags, jnp.uint32(0), jnp.uint32(1))
+    _, pos = jax.lax.sort((key, iota), num_keys=1, is_stable=True)
+    return pos

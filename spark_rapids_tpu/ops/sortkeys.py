@@ -42,11 +42,14 @@ def _float_bits_ordered(data: jax.Array, dt: DataType) -> jax.Array:
         b = jax.lax.bitcast_convert_type(x, jnp.int32).astype(jnp.int64)
         flipped = jnp.where(b < 0, ~b, b | jnp.int64(1 << 31))
         return flipped.astype(jnp.uint64)
+    from .bits import f64_bits
+
     x = data.astype(jnp.float64)
     x = jnp.where(x == 0.0, jnp.float64(0.0), x)
     x = jnp.where(jnp.isnan(x), jnp.float64(jnp.nan), x)
-    b = jax.lax.bitcast_convert_type(x, jnp.int64)
-    flipped = jnp.where(b < 0, ~b.astype(jnp.uint64), b.astype(jnp.uint64) | _SIGN64)
+    u = f64_bits(x)  # no 64-bit bitcast on TPU (ops/bits.py)
+    b = u.astype(jnp.int64)
+    flipped = jnp.where(b < 0, ~u, u | _SIGN64)
     return flipped
 
 
@@ -108,15 +111,37 @@ def sort_permutation(
     row_mask: jax.Array,
     live_first: bool = True,
 ) -> jax.Array:
-    """Stable sort permutation over radix words; padding rows sort last."""
+    """Stable sort permutation over radix words; padding rows sort last.
+
+    Implemented as an LSD radix sort: a ``lax.scan`` of stable SINGLE-key
+    ``lax.sort`` passes from the least- to the most-significant word. XLA's
+    TPU sort lowering compiles a full sorting network whose compile time
+    grows sharply with both array size and operand count — a variadic
+    ``lax.sort`` over k words compiled in O(minutes) at 2^16+ rows, while
+    this form embeds exactly ONE two-operand sort in the program regardless
+    of key count (the scan reuses it per word), with identical ordering
+    semantics (stable passes ⇒ lexicographic).
+    """
     cap = words[0].shape[0]
     keys = []
     if live_first:
         keys.append(jnp.where(row_mask, jnp.uint64(0), jnp.uint64(1)))
     keys.extend(words)
     iota = jnp.arange(cap, dtype=jnp.int32)
-    sorted_ops = jax.lax.sort(tuple(keys) + (iota,), num_keys=len(keys), is_stable=True)
-    return sorted_ops[-1]
+    if len(keys) == 1:
+        _, perm = jax.lax.sort((keys[0], iota), num_keys=1, is_stable=True)
+        return perm
+    stacked = jnp.stack(keys[::-1])  # least-significant word first
+    # inherit the data's varying-axis type so the scan carry matches inside
+    # shard_map (a plain iota is replicated; the sorted perm is varying)
+    iota = iota + (stacked[0] * jnp.uint64(0)).astype(jnp.int32)
+
+    def one_pass(perm, w):
+        _, perm = jax.lax.sort((w[perm], perm), num_keys=1, is_stable=True)
+        return perm, None
+
+    perm, _ = jax.lax.scan(one_pass, iota, stacked)
+    return perm
 
 
 def np_column_radix_words(

@@ -67,13 +67,22 @@ class ExecContext:
             self.catalog.device_limit = limit
         import itertools
 
+        import threading
+
         self._shuffle_manager = None
+        self._shuffle_mgr_lock = threading.Lock()
         self._shuffle_ids = itertools.count(1)
 
     @property
     def shuffle_manager(self):
         """Lazily built accelerated shuffle manager (GpuShuffleEnv.init
-        analogue) — one in-process 'executor' per session context."""
+        analogue) — one in-process 'executor' per session context.
+        Lock-guarded: partition tasks run on a thread pool and sibling
+        exchanges may first-touch this concurrently."""
+        with self._shuffle_mgr_lock:
+            return self._shuffle_manager_locked()
+
+    def _shuffle_manager_locked(self):
         if self._shuffle_manager is None:
             from .. import config as cfg
             from ..shuffle.heartbeat import ShuffleHeartbeatManager

@@ -1,0 +1,85 @@
+"""Logical column pruning — Catalyst's ColumnPruning analogue.
+
+The reference receives plans that Spark has already pruned (scans carry
+pushed-down schemas — GpuParquetScan reads only requested columns); running
+standalone, this pass provides that: projections and aggregates propagate
+the set of referenced column names down to the scan, which then neither
+decodes nor uploads unused columns. On TPU this matters doubly — every
+pruned column saves host decode, H2D transfer bytes, and padded-string
+packing work.
+
+Pruning is deliberately conservative: only node types whose column flow is
+fully modeled participate; anything else (joins, expands, windows…) resets
+the requirement to "all columns" beneath it.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Set
+
+from ..expr import Expression, UnresolvedAttribute
+from ..types import Schema
+from . import logical as L
+
+
+def _expr_names(e: Expression, out: Set[str]) -> None:
+    if isinstance(e, UnresolvedAttribute):
+        out.add(e.name)
+    for c in e.children():
+        _expr_names(c, out)
+
+
+def _names_of(exprs) -> Set[str]:
+    out: Set[str] = set()
+    for e in exprs:
+        _expr_names(e, out)
+    return out
+
+
+def prune_columns(plan: L.LogicalPlan, required: Optional[Set[str]] = None):
+    """Rewrite ``plan`` so scans materialize only referenced columns.
+    ``required=None`` means every column of the subtree's output is needed
+    (the top of the query, or beneath an unmodeled node)."""
+    if isinstance(plan, (L.LocalRelation, L.FileScan)):
+        if required is None:
+            return plan
+        names = [n for n in plan.schema.names if n in required]
+        if not names or len(names) == len(plan.schema.names):
+            return plan
+        sub = Schema([plan.schema[n] for n in names])
+        if isinstance(plan, L.LocalRelation):
+            return L.LocalRelation(plan.table.select(names), sub, plan.num_partitions)
+        return L.FileScan(plan.paths, plan.file_format, sub, dict(plan.options))
+    if isinstance(plan, L.Project):
+        child = prune_columns(plan.child, _names_of(plan.exprs))
+        return dataclasses.replace(plan, child=child)
+    if isinstance(plan, L.Aggregate):
+        child = prune_columns(
+            plan.child, _names_of(plan.grouping) | _names_of(plan.aggregates)
+        )
+        return dataclasses.replace(plan, child=child)
+    if isinstance(plan, L.Filter):
+        req = None
+        if required is not None:
+            req = set(required)
+            _expr_names(plan.condition, req)
+        return dataclasses.replace(plan, child=prune_columns(plan.child, req))
+    if isinstance(plan, L.Sort):
+        req = None
+        if required is not None:
+            req = set(required) | _names_of(o.child for o in plan.order)
+        return dataclasses.replace(plan, child=prune_columns(plan.child, req))
+    if isinstance(plan, L.Limit):
+        return dataclasses.replace(plan, child=prune_columns(plan.child, required))
+    # unmodeled node: recurse with "all columns" required beneath it
+    kids = list(plan.children())
+    if not kids:
+        return plan
+    fields = {}
+    for f in dataclasses.fields(plan):
+        v = getattr(plan, f.name)
+        if isinstance(v, L.LogicalPlan):
+            fields[f.name] = prune_columns(v, None)
+        elif isinstance(v, list) and v and isinstance(v[0], L.LogicalPlan):
+            fields[f.name] = [prune_columns(c, None) for c in v]
+    return dataclasses.replace(plan, **fields) if fields else plan

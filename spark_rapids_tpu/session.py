@@ -28,6 +28,9 @@ from .columnar.host import concat_batches
 
 class TpuSession:
     def __init__(self, conf: Optional[dict] = None):
+        from . import kernels as K
+
+        K.enable_persistent_cache()  # reuse XLA binaries across processes
         self.conf = TpuConf(conf or {})
         self.read = DataFrameReader(self)
         self._last_plan: Optional[Exec] = None
@@ -66,6 +69,9 @@ class TpuSession:
 
     # ── execution ───────────────────────────────────────────────────────
     def _execute(self, lp: L.LogicalPlan) -> pa.Table:
+        from .plan.pruning import prune_columns
+
+        lp = prune_columns(lp)
         cpu_plan = plan_physical(lp, self.conf)
         overrides = TpuOverrides(self.conf)
         final_plan = overrides.apply(cpu_plan)
@@ -75,10 +81,22 @@ class TpuSession:
         ctx = ExecContext(self.conf, self)
         parts = final_plan.execute(ctx)
         batches: List[pa.RecordBatch] = []
-        for thunk in parts.parts:
-            for rb in thunk():
-                if rb.num_rows:
-                    batches.append(rb)
+        n_threads = min(len(parts.parts), cfg.CONCURRENT_TPU_TASKS.get(self.conf))
+        if n_threads > 1:
+            # Run partition tasks concurrently (the reference's executor task
+            # slots + GpuSemaphore model): device dispatch and D2H waits of
+            # different partitions overlap instead of serializing per
+            # partition; jax releases the GIL while blocking on transfers.
+            from concurrent.futures import ThreadPoolExecutor
+
+            with ThreadPoolExecutor(max_workers=n_threads) as pool:
+                results = list(pool.map(lambda t: list(t()), parts.parts))
+            batches = [rb for rbs in results for rb in rbs if rb.num_rows]
+        else:
+            for thunk in parts.parts:
+                for rb in thunk():
+                    if rb.num_rows:
+                        batches.append(rb)
         schema = final_plan.output
         if not batches:
             return pa.table(

@@ -291,6 +291,9 @@ class Schema:
     def __eq__(self, other):
         return isinstance(other, Schema) and self.fields == other.fields
 
+    def __hash__(self):
+        return hash(tuple(self.fields))
+
     def __repr__(self):
         inner = ", ".join(f"{f.name}:{f.data_type}" for f in self.fields)
         return f"Schema({inner})"

@@ -115,3 +115,30 @@ def test_count_dataframe():
         return _df(s, t).filter(col("v").is_not_null()).agg(count("*").alias("c"))
 
     assert_cpu_and_tpu_equal(q)
+
+
+def test_min_inf_with_nan_is_inf():
+    """Spark NaN-greatest: min(+inf, NaN) = +inf; NaN only on all-NaN groups
+    (regression: the scan-based kernel rewrote any inf-min-with-NaN to NaN)."""
+    import math
+
+    import pyarrow as pa
+
+    from spark_rapids_tpu import TpuSession
+    from spark_rapids_tpu.functions import col, min as min_
+
+    t = pa.table(
+        {"g": [1, 1, 2, 2], "v": [float("inf"), float("nan"), float("nan"), float("nan")]}
+    )
+    tpu = TpuSession({"spark.rapids.sql.enabled": True})
+    rows = sorted(
+        tpu.create_dataframe(t).group_by("g").agg(min_(col("v")).alias("m")).collect()
+    )
+    assert rows[0][1] == float("inf")
+    assert math.isnan(rows[1][1])
+    ung = (
+        tpu.create_dataframe(pa.table({"v": [float("inf"), float("nan")]}))
+        .agg(min_(col("v")).alias("m"))
+        .collect()
+    )
+    assert ung[0][0] == float("inf")

@@ -1,0 +1,78 @@
+"""ops/bits.py: arithmetic IEEE-754 decomposition must be bit-exact with the
+bitcast (modulo NaN payload canonicalization) — it replaces 64-bit bitcasts
+on TPUs whose X64 emulation lacks them."""
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from spark_rapids_tpu.ops.bits import f64_bits_arith, i64_bytes_le
+
+
+EDGE = np.array(
+    [
+        0.0,
+        -0.0,
+        1.0,
+        -1.0,
+        2.0,
+        0.5,
+        1.5,
+        np.pi,
+        -np.pi,
+        1e308,
+        -1e308,
+        np.finfo(np.float64).max,
+        np.finfo(np.float64).tiny,  # min normal
+        np.finfo(np.float64).tiny / 2,  # subnormal
+        5e-324,  # min subnormal
+        -5e-324,
+        np.inf,
+        -np.inf,
+        np.nan,
+        1.0 + 2**-52,  # 1 + ulp
+        2.0 - 2**-52,
+        2**-1022 * (1 + 2**-52),
+    ],
+    dtype=np.float64,
+)
+
+
+def _expected_bits(x: np.ndarray) -> np.ndarray:
+    """doubleToLongBits semantics + DAZ: NaNs canonicalize; subnormal inputs
+    read as signed zero (XLA runs with denormals-are-zero — on the TPU f64
+    emulation such values cannot exist on device at all)."""
+    want = x.view(np.uint64)
+    want = np.where(np.isnan(x), np.uint64(0x7FF8 << 48), want)
+    subnormal = (x != 0) & (np.abs(x) < np.finfo(np.float64).tiny)
+    sign = want & np.uint64(1 << 63)
+    return np.where(subnormal, sign, want)
+
+
+def test_f64_bits_edge_cases():
+    got = np.asarray(f64_bits_arith(EDGE))
+    want = _expected_bits(EDGE)
+    bad = got != want
+    assert not bad.any(), [
+        (EDGE[i], hex(got[i]), hex(want[i])) for i in np.nonzero(bad)[0]
+    ]
+
+
+def test_f64_bits_random():
+    rng = np.random.default_rng(3)
+    # random bit patterns → random doubles incl. denormals/infs/nans
+    raw = rng.integers(0, 2**64, 2000, dtype=np.uint64)
+    x = raw.view(np.float64)
+    got = np.asarray(f64_bits_arith(x))
+    want = _expected_bits(x)
+    assert (got == want).all(), hex(got[(got != want).argmax()])
+
+
+def test_i64_bytes_le_roundtrip():
+    rng = np.random.default_rng(4)
+    ints = rng.integers(-(2**63), 2**63 - 1, 100, dtype=np.int64)
+    got = np.asarray(i64_bytes_le(np.asarray(ints))).view(np.int64)
+    assert (got == ints).all()
+    dbl = rng.random(100) * 1e12 - 5e11
+    got = np.asarray(i64_bytes_le(np.asarray(dbl))).view(np.float64)
+    assert (got == dbl).all()
