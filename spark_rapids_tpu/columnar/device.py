@@ -75,25 +75,39 @@ def pad_scalar_bytes(raw: bytes) -> tuple[np.ndarray, int]:
 @jax.tree_util.register_pytree_node_class
 @dataclasses.dataclass
 class DeviceColumn:
-    """One column of a device batch. ``dtype`` is static pytree metadata."""
+    """One column of a device batch. ``dtype`` is static pytree metadata.
+
+    Layouts by type:
+    * fixed-width: ``data``: dtype[cap]; ``validity``: bool[cap]
+    * string: ``data``: uint8[cap, w]; ``lengths``: int32[cap]
+    * array<e>: ``data`` None; ``lengths``: int32[cap] (list sizes);
+      ``children`` = (element column,) whose planes carry a second padded
+      axis: element data [cap, W(, w)], element validity [cap, W]
+    * struct: ``data`` None; ``children`` = per-field columns [cap]
+    * map<k,v>: like array with ``children`` = (keys, values) planes
+    """
 
     dtype: DataType
-    data: jax.Array  # fixed-width: [cap]; string: uint8[cap, width]
+    data: Optional[jax.Array]
     validity: jax.Array  # bool[cap]
-    lengths: Optional[jax.Array] = None  # string only: int32[cap]
+    lengths: Optional[jax.Array] = None  # string/array/map: int32[cap]
+    children: Optional[tuple] = None  # nested columns (array/struct/map)
 
     def tree_flatten(self):
-        children = (self.data, self.validity, self.lengths)
-        return children, self.dtype
+        return (self.data, self.validity, self.lengths, self.children), self.dtype
 
     @classmethod
     def tree_unflatten(cls, aux, children):
-        data, validity, lengths = children
-        return cls(aux, data, validity, lengths)
+        data, validity, lengths, kids = children
+        if kids is not None:
+            kids = tuple(kids)
+        return cls(aux, data, validity, lengths, kids)
 
     @property
     def capacity(self) -> int:
-        return int(self.data.shape[0])
+        if self.data is not None:
+            return int(self.data.shape[0])
+        return int(self.validity.shape[0])
 
     @property
     def is_string(self) -> bool:
@@ -103,6 +117,17 @@ class DeviceColumn:
     def str_width(self) -> int:
         assert self.is_string
         return int(self.data.shape[1])
+
+    @property
+    def list_width(self) -> int:
+        """Padded element count per row (array/map columns)."""
+        return int(self.children[0].data.shape[1])
+
+
+def dc_replace(col: DeviceColumn, **kw) -> DeviceColumn:
+    """dataclasses.replace for DeviceColumn — the way to rebuild a column
+    with a changed field WITHOUT dropping nested children planes."""
+    return dataclasses.replace(col, **kw)
 
 
 @jax.tree_util.register_pytree_node_class
@@ -215,6 +240,91 @@ def _padded_to_string(data: np.ndarray, lengths: np.ndarray, valid: np.ndarray, 
     )
 
 
+def _np_col_from_arrow(arr: pa.Array, dt: DataType, cap: int, width: Optional[int] = None) -> DeviceColumn:
+    """Arrow array → host-side DeviceColumn (numpy leaves), padded to cap.
+    Recursive over array/struct/map nesting."""
+    from ..types import ArrayType, MapType, StructType
+
+    n = len(arr)
+    if isinstance(dt, StringType):
+        data, lengths, valid, w = _string_to_padded(arr, width)
+        pdata = np.zeros((cap, w), dtype=np.uint8)
+        pdata[:n] = data
+        plen = np.zeros(cap, dtype=np.int32)
+        plen[:n] = lengths
+        pval = np.zeros(cap, dtype=bool)
+        pval[:n] = valid
+        return DeviceColumn(dt, pdata, pval, plen)
+    if isinstance(dt, NullType):
+        return DeviceColumn(dt, np.zeros(cap, np.int8), np.zeros(cap, bool))
+    if isinstance(dt, StructType):
+        arr = arr.combine_chunks() if isinstance(arr, pa.ChunkedArray) else arr
+        pval = np.zeros(cap, dtype=bool)
+        pval[:n] = ~np.asarray(arr.is_null())
+        kids = tuple(
+            _np_col_from_arrow(arr.field(i), f.data_type, cap)
+            for i, f in enumerate(dt.fields)
+        )
+        return DeviceColumn(dt, None, pval, None, kids)
+    if isinstance(dt, (ArrayType, MapType)):
+        return _np_list_from_arrow(arr, dt, cap)
+    data, valid = _np_from_arrow_fixed(arr, dt)
+    pdata = np.zeros(cap, dtype=dt.np_dtype)
+    pdata[:n] = data
+    pval = np.zeros(cap, dtype=bool)
+    pval[:n] = valid
+    return DeviceColumn(dt, pdata, pval)
+
+
+def _list_offsets(arr) -> np.ndarray:
+    off_buf = arr.buffers()[1]
+    off_dt = np.int64 if pa.types.is_large_list(arr.type) else np.int32
+    return np.frombuffer(off_buf, dtype=off_dt)[arr.offset : arr.offset + len(arr) + 1]
+
+
+def _np_list_from_arrow(arr, dt, cap: int) -> DeviceColumn:
+    """List/Map arrow array → padded element-plane layout. The element plane
+    is built by converting the (flat) child values, then gathering them into
+    [cap, W] rows — the strings recipe generalized."""
+    from ..types import ArrayType, MapType
+
+    arr = arr.combine_chunks() if isinstance(arr, pa.ChunkedArray) else arr
+    n = len(arr)
+    offsets = _list_offsets(arr)
+    valid = np.zeros(cap, dtype=bool)
+    valid[:n] = ~np.asarray(arr.is_null())
+    lengths = np.zeros(cap, dtype=np.int32)
+    lengths[:n] = np.where(valid[:n], offsets[1:] - offsets[:-1], 0)
+    W = bucket_width(max(int(lengths.max()) if n else 0, 1))
+
+    def plane(values: pa.Array, vdt) -> DeviceColumn:
+        # child values carry the parent's slice offset via `offsets`
+        vcap = bucket_capacity(max(len(values), 1))
+        flat = _np_col_from_arrow(values, vdt, vcap)
+        starts = offsets[:-1].astype(np.int64)
+        cols_ix = np.arange(W, dtype=np.int64)[None, :]
+        idx = np.zeros((cap, W), dtype=np.int64)
+        idx[:n] = starts[:, None] + cols_ix
+        mask = np.arange(W)[None, :] < lengths[:, None]
+        idx = np.where(mask, np.clip(idx, 0, max(len(values) - 1, 0)), 0)
+        d = flat.data[idx]  # [cap, W(, w)]
+        if d.ndim == 3:
+            d = np.where(mask[:, :, None], d, 0)
+        else:
+            d = np.where(mask, d, 0)
+        v = np.where(mask, flat.validity[idx], False)
+        ln = None
+        if flat.lengths is not None:
+            ln = np.where(mask, flat.lengths[idx], 0).astype(np.int32)
+        return DeviceColumn(vdt, d, v, ln)
+
+    if isinstance(dt, MapType):
+        kids = (plane(arr.keys, dt.key_type), plane(arr.items, dt.value_type))
+    else:
+        kids = (plane(arr.values, dt.element_type),)
+    return DeviceColumn(dt, None, valid, lengths, kids)
+
+
 def host_to_device(
     rb: pa.RecordBatch,
     capacity: Optional[int] = None,
@@ -227,47 +337,16 @@ def host_to_device(
     n = rb.num_rows
     cap = capacity or bucket_capacity(max(n, 1))
     schema = Schema.from_arrow(rb.schema)
-    host_bufs: list = [np.asarray(n, dtype=np.int32)]
-    specs: list = []  # (dtype, has_lengths) per column, mirrors host_bufs order
+    host_cols = []
     for i, field in enumerate(schema):
         arr = rb.column(i)
         if isinstance(arr, pa.ChunkedArray):  # pragma: no cover - RecordBatch cols are flat
             arr = arr.combine_chunks()
-        dt = field.data_type
-        if isinstance(dt, StringType):
-            want = (str_widths or {}).get(i)
-            data, lengths, valid, width = _string_to_padded(arr, want)
-            pdata = np.zeros((cap, width), dtype=np.uint8)
-            pdata[:n] = data
-            plen = np.zeros(cap, dtype=np.int32)
-            plen[:n] = lengths
-            pval = np.zeros(cap, dtype=bool)
-            pval[:n] = valid
-            host_bufs += [pdata, pval, plen]
-            specs.append((dt, True))
-        elif isinstance(dt, NullType):
-            host_bufs += [np.zeros(cap, dtype=np.int8), np.zeros(cap, dtype=bool)]
-            specs.append((dt, False))
-        else:
-            data, valid = _np_from_arrow_fixed(arr, dt)
-            pdata = np.zeros(cap, dtype=dt.np_dtype)
-            pdata[:n] = data
-            pval = np.zeros(cap, dtype=bool)
-            pval[:n] = valid
-            host_bufs += [pdata, pval]
-            specs.append((dt, False))
-    dev = jax.device_put(host_bufs)
-    num_rows, rest = dev[0], dev[1:]
-    cols: list[DeviceColumn] = []
-    i = 0
-    for dt, has_len in specs:
-        if has_len:
-            cols.append(DeviceColumn(dt, rest[i], rest[i + 1], rest[i + 2]))
-            i += 3
-        else:
-            cols.append(DeviceColumn(dt, rest[i], rest[i + 1]))
-            i += 2
-    return DeviceBatch(schema, cols, num_rows)
+        host_cols.append(
+            _np_col_from_arrow(arr, field.data_type, cap, (str_widths or {}).get(i))
+        )
+    num_rows, cols = jax.device_put((np.asarray(n, np.int32), host_cols))
+    return DeviceBatch(schema, list(cols), num_rows)
 
 
 def _pad8(nbytes: int) -> int:
@@ -348,6 +427,17 @@ def device_to_host(batch: DeviceBatch) -> pa.RecordBatch:
 
         batch = shrink_one(batch, batch.row_count())
         cap = batch.capacity
+    if any(c.children is not None for c in batch.columns):
+        # nested columns: fetch the whole pytree in one device_get and
+        # rebuild arrow recursively (the flat pack layout is for the common
+        # primitive/string case)
+        num_rows, host_cols = jax.device_get((batch.num_rows, batch.columns))
+        n = int(num_rows)
+        arrays = [
+            _arrow_from_np_col(c, f.data_type, n)
+            for f, c in zip(batch.schema, host_cols)
+        ]
+        return pa.RecordBatch.from_arrays(arrays, schema=batch.schema.to_arrow())
     widths = tuple(
         c.data.shape[1] if c.data.ndim == 2 else None for c in batch.columns
     )
@@ -406,25 +496,102 @@ def device_to_host(batch: DeviceBatch) -> pa.RecordBatch:
     return pa.RecordBatch.from_arrays(arrays, schema=pa.schema(fields))
 
 
+def _arrow_from_np_col(col: DeviceColumn, dt: DataType, n: int) -> pa.Array:
+    """Host-side (numpy-leaf) DeviceColumn → arrow array of n rows.
+    Recursive inverse of _np_col_from_arrow."""
+    from ..types import ArrayType, MapType, StructType
+
+    valid = np.asarray(col.validity)[:n].astype(bool)
+    null_mask = None if valid.all() else ~valid
+    if isinstance(dt, StringType):
+        return _padded_to_string(
+            np.asarray(col.data), np.asarray(col.lengths), np.asarray(col.validity), n
+        )
+    if isinstance(dt, NullType):
+        return pa.nulls(n)
+    if isinstance(dt, StructType):
+        kids = [
+            _arrow_from_np_col(c, f.data_type, n)
+            for c, f in zip(col.children, dt.fields)
+        ]
+        return pa.StructArray.from_arrays(
+            kids,
+            fields=[pa.field(f.name, f.data_type.to_arrow(), f.nullable) for f in dt.fields],
+            mask=pa.array(~valid) if null_mask is not None else None,
+        )
+    if isinstance(dt, (ArrayType, MapType)):
+        lengths = np.where(valid, np.asarray(col.lengths)[:n], 0).astype(np.int64)
+        offsets = np.zeros(n + 1, dtype=np.int32)
+        offsets[1:] = np.cumsum(lengths)
+        W = col.children[0].data.shape[1] if col.children[0].data is not None else 0
+        take = np.arange(W)[None, :] < lengths[:, None]
+
+        def flatten_plane(plane: DeviceColumn, vdt) -> pa.Array:
+            total = int(lengths.sum())
+            d = np.asarray(plane.data)[:n]
+            v = np.asarray(plane.validity)[:n]
+            fdata = d[take]  # [total(, w)]
+            fvalid = v[take]
+            flen = (
+                np.asarray(plane.lengths)[:n][take]
+                if plane.lengths is not None
+                else None
+            )
+            fcol = DeviceColumn(vdt, fdata, fvalid, flen)
+            return _arrow_from_np_col(fcol, vdt, total)
+
+        # a null offset marks a null list (arrow from_arrays convention)
+        offs = pa.array(
+            offsets,
+            type=pa.int32(),
+            mask=np.append(~valid, False) if null_mask is not None else None,
+        )
+        if isinstance(dt, MapType):
+            keys = flatten_plane(col.children[0], dt.key_type)
+            items = flatten_plane(col.children[1], dt.value_type)
+            return pa.MapArray.from_arrays(offs, keys, items)
+        values = flatten_plane(col.children[0], dt.element_type)
+        return pa.ListArray.from_arrays(offs, values)
+    data = np.asarray(col.data)[:n]
+    if isinstance(dt, DecimalType):
+        import decimal as _dec
+
+        scale = dt.scale
+        py = [
+            None if not v else _dec.Decimal(int(x)).scaleb(-scale)
+            for x, v in zip(data.tolist(), valid.tolist())
+        ]
+        return pa.array(py, type=pa.decimal128(dt.precision, dt.scale))
+    return pa.array(data, type=dt.to_arrow(), from_pandas=False, mask=null_mask)
+
+
+def _empty_col(dt: DataType, capacity: int, plane_w: Optional[int] = None) -> DeviceColumn:
+    from ..types import ArrayType, MapType, StructType
+
+    shape = (capacity,) if plane_w is None else (capacity, plane_w)
+    valid = jnp.zeros(shape, dtype=bool)
+    if isinstance(dt, StringType):
+        return DeviceColumn(
+            dt,
+            jnp.zeros(shape + (MIN_STR_WIDTH,), dtype=jnp.uint8),
+            valid,
+            jnp.zeros(shape, dtype=jnp.int32),
+        )
+    if isinstance(dt, StructType):
+        kids = tuple(_empty_col(f.data_type, capacity, plane_w) for f in dt.fields)
+        return DeviceColumn(dt, None, valid, None, kids)
+    if isinstance(dt, ArrayType):
+        kid = _empty_col(dt.element_type, capacity, 1)
+        return DeviceColumn(dt, None, valid, jnp.zeros(shape, jnp.int32), (kid,))
+    if isinstance(dt, MapType):
+        kids = (
+            _empty_col(dt.key_type, capacity, 1),
+            _empty_col(dt.value_type, capacity, 1),
+        )
+        return DeviceColumn(dt, None, valid, jnp.zeros(shape, jnp.int32), kids)
+    return DeviceColumn(dt, jnp.zeros(shape, dtype=dt.np_dtype), valid)
+
+
 def empty_batch(schema: Schema, capacity: int = MIN_CAPACITY) -> DeviceBatch:
-    cols = []
-    for f in schema:
-        dt = f.data_type
-        if isinstance(dt, StringType):
-            cols.append(
-                DeviceColumn(
-                    dt,
-                    jnp.zeros((capacity, MIN_STR_WIDTH), dtype=jnp.uint8),
-                    jnp.zeros(capacity, dtype=bool),
-                    jnp.zeros(capacity, dtype=jnp.int32),
-                )
-            )
-        else:
-            cols.append(
-                DeviceColumn(
-                    dt,
-                    jnp.zeros(capacity, dtype=dt.np_dtype),
-                    jnp.zeros(capacity, dtype=bool),
-                )
-            )
+    cols = [_empty_col(f.data_type, capacity) for f in schema]
     return DeviceBatch(schema, cols, jnp.asarray(0, dtype=jnp.int32))

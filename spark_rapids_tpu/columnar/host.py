@@ -14,7 +14,7 @@ from typing import Optional
 import numpy as np
 import pyarrow as pa
 
-from ..types import DataType, DecimalType, NullType, Schema, StringType
+from ..types import DataType, DecimalType, NullType, Schema, StringType, is_complex
 
 
 def fixed_np(arr: pa.Array, np_dtype: np.dtype) -> np.ndarray:
@@ -45,6 +45,12 @@ def np_from_arrow(arr: pa.Array, dt: DataType) -> tuple[np.ndarray, np.ndarray]:
     if isinstance(dt, StringType):
         data = np.empty(n, dtype=object)
         data[:] = arr.cast(pa.string()).to_pylist()
+        return data, valid
+    if is_complex(dt):
+        # CPU oracle representation: object ndarray of python values
+        # (lists / dicts-as-lists-of-pairs / structs-as-dicts)
+        data = np.empty(n, dtype=object)
+        data[:] = arr.to_pylist()
         return data, valid
     if isinstance(dt, NullType):
         return np.zeros(n, dtype=np.int8), np.zeros(n, dtype=bool)
@@ -80,6 +86,9 @@ def arrow_from_np(data: np.ndarray, valid: np.ndarray, dt: DataType) -> pa.Array
             for i in range(n)
         ]
         return pa.array(py, type=pa.decimal128(dt.precision, dt.scale))
+    if is_complex(dt):
+        py = [data[i] if valid[i] else None for i in range(n)]
+        return pa.array(py, type=dt.to_arrow())
     mask = None if valid.all() else ~valid
     return pa.array(data, type=dt.to_arrow(), mask=mask)
 

@@ -215,6 +215,90 @@ class CpuFilterExec(Exec):
         return f"CpuFilter {self.condition}"
 
 
+class CpuGenerateExec(Exec):
+    """explode/posexplode over arrays and maps (Spark GenerateExec; the
+    reference replaces it with GpuGenerateExec.scala). Each input row fans
+    out to one output row per element; null/empty collections yield no
+    rows (non-outer semantics)."""
+
+    def __init__(self, generator: Expression, out_names: List[str], child: Exec):
+        super().__init__([child])
+        from ..expr.complex import Explode
+
+        self.generator: Explode = bind(generator, child.output)
+        self.out_names = list(out_names)
+        self._schema = self._compute_schema(child)
+
+    def _compute_schema(self, child: Exec) -> Schema:
+        from ..types import INT, MapType
+
+        g = self.generator
+        ct = g.child.data_type
+        fields = list(child.output.fields)
+        i = 0
+        if g.position:
+            fields.append(StructField(self.out_names[i], INT, False))
+            i += 1
+        if isinstance(ct, MapType):
+            fields.append(StructField(self.out_names[i], ct.key_type, False))
+            fields.append(StructField(self.out_names[i + 1], ct.value_type, True))
+        else:
+            fields.append(StructField(self.out_names[i], ct.element_type, True))
+        return Schema(fields)
+
+    @property
+    def output(self) -> Schema:
+        return self._schema
+
+    def execute(self, ctx: ExecContext) -> PartitionSet:
+        from ..types import MapType
+
+        schema_in = self.children[0].output
+        schema_out = self._schema
+        g = self.generator
+        is_map = isinstance(g.child.data_type, MapType)
+
+        def fn(it):
+            for rb in it:
+                c = _cpu_ctx(rb, schema_in)
+                v = g.child.eval(c)
+                data = c.broadcast(v.data)
+                valid = c.broadcast_bool(v.valid)
+                take: List[int] = []
+                pos: List[int] = []
+                elems: List = []
+                for i in range(rb.num_rows):
+                    if not valid[i] or data[i] is None:
+                        continue
+                    for j, el in enumerate(data[i]):
+                        take.append(i)
+                        pos.append(j)
+                        elems.append(el)
+                base = rb.take(pa.array(take, type=pa.int64()))
+                arrays = list(base.columns)
+                if g.position:
+                    arrays.append(pa.array(pos, type=pa.int32()))
+                if is_map:
+                    arrays.append(
+                        pa.array([k for k, _ in elems],
+                                 type=g.child.data_type.key_type.to_arrow())
+                    )
+                    arrays.append(
+                        pa.array([x for _, x in elems],
+                                 type=g.child.data_type.value_type.to_arrow())
+                    )
+                else:
+                    arrays.append(
+                        pa.array(elems, type=g.child.data_type.element_type.to_arrow())
+                    )
+                yield pa.RecordBatch.from_arrays(arrays, schema=schema_out.to_arrow())
+
+        return self.children[0].execute(ctx).map_partitions(fn)
+
+    def node_string(self):
+        return f"CpuGenerate {self.generator}"
+
+
 class CpuUnionExec(Exec):
     def __init__(self, children: List[Exec]):
         super().__init__(children)

@@ -30,15 +30,26 @@ def encode_group_key(dt: DataType, data: np.ndarray, valid: np.ndarray):
     """Encode one key column into int64 word columns such that equal words ⇔
     same Spark group (nulls one group, NaNs one group, -0.0 == 0.0).
     Returns a list of int64 arrays (validity word + value word)."""
+    from ..types import is_complex
+
     n = len(valid)
     vw = valid.astype(np.int64)
-    if isinstance(dt, StringType):
+    if isinstance(dt, StringType) or is_complex(dt):
+        def canon(v):
+            if isinstance(v, list):
+                return tuple(canon(x) for x in v)
+            if isinstance(v, dict):
+                return tuple((k, canon(x)) for k, x in sorted(v.items()))
+            if isinstance(v, tuple):
+                return tuple(canon(x) for x in v)
+            return v
+
         vocab: dict = {}
         codes = np.zeros(n, dtype=np.int64)
         for i in range(n):
             if not valid[i]:
                 continue
-            key = data[i]
+            key = canon(data[i])
             code = vocab.get(key)
             if code is None:
                 code = len(vocab) + 1

@@ -25,6 +25,7 @@ from ..columnar.device import (
     DeviceBatch,
     DeviceColumn,
     bucket_capacity,
+    dc_replace,
     device_to_host,
     empty_batch,
     host_to_device,
@@ -37,7 +38,7 @@ from ..expr.misc import contains_task_dependent
 from . import task
 from ..ops.aggregate import group_aggregate
 from ..ops.concat import concat_device
-from ..ops.gather import bulk_shrink, compact, gather_batch
+from ..ops.gather import bulk_shrink, compact, gather_batch, gather_column
 from ..ops.hash import murmur3_rows, partition_ids
 from ..ops.sortkeys import batch_radix_words, sort_permutation
 from ..plan.logical import SortOrder
@@ -48,6 +49,13 @@ from .. import kernels as K
 
 def val_to_column(ctx: Ctx, val: Val, dtype) -> DeviceColumn:
     """Materialize an expression result into a full DeviceColumn."""
+    from ..types import ArrayType, MapType, StructType
+
+    if isinstance(dtype, (ArrayType, MapType)):
+        lengths = ctx.broadcast(val.lengths).astype(jnp.int32)
+        return DeviceColumn(dtype, None, val.full_valid(ctx), lengths, val.children)
+    if isinstance(dtype, StructType):
+        return DeviceColumn(dtype, None, val.full_valid(ctx), None, val.children)
     if isinstance(dtype, StringType):
         data = val.data
         if data.ndim == 1:  # scalar string literal [w]
@@ -189,7 +197,7 @@ def project_kernel(exprs: tuple, schema: Schema):
             # keep padding rows inert
             live = batch.row_mask()
             cols = [
-                DeviceColumn(col.dtype, col.data, col.validity & live, col.lengths)
+                dc_replace(col, validity=col.validity & live)
                 for col in cols
             ]
             return DeviceBatch(schema, cols, batch.num_rows)
@@ -476,7 +484,7 @@ def aggregate_kernel(
                 val_to_column(c, g.eval(c), g.data_type) for g in grouping
             ]
             key_cols = [
-                DeviceColumn(k.dtype, k.data, k.validity & live, k.lengths)
+                dc_replace(k, validity=k.validity & live)
                 for k in key_cols
             ]
             in_cols: list[DeviceColumn] = []
@@ -487,7 +495,7 @@ def aggregate_kernel(
                     for e, op in zip(exprs, f.update_ops):
                         col = val_to_column(c, e.eval(c), e.data_type)
                         in_cols.append(
-                            DeviceColumn(col.dtype, col.data, col.validity & live, col.lengths)
+                            dc_replace(col, validity=col.validity & live)
                         )
                         ops.append(op)
                 else:
@@ -691,7 +699,7 @@ def device_sort_fn(order: List[SortOrder]):
             words = []
             for o in order:
                 col = val_to_column(c, o.child.eval(c), o.child.data_type)
-                col = DeviceColumn(col.dtype, col.data, col.validity & live, col.lengths)
+                col = dc_replace(col, validity=col.validity & live)
                 from ..ops.sortkeys import column_radix_words
 
                 words.extend(
@@ -712,7 +720,7 @@ def slice_head(batch: DeviceBatch, take) -> DeviceBatch:
     take = jnp.minimum(batch.num_rows, take)
     live = jnp.arange(batch.capacity, dtype=jnp.int32) < take
     cols = [
-        DeviceColumn(c.dtype, c.data, c.validity & live, c.lengths)
+        dc_replace(c, validity=c.validity & live)
         for c in batch.columns
     ]
     return DeviceBatch(batch.schema, cols, take.astype(jnp.int32))
@@ -800,7 +808,7 @@ class TpuExpandExec(Exec):
                     for e, f in zip(proj, schema):
                         col = val_to_column(c, e.eval(c), f.data_type)
                         cols.append(
-                            DeviceColumn(f.data_type, col.data, col.validity & live, col.lengths)
+                            dc_replace(col, dtype=f.data_type, validity=col.validity & live)
                         )
                     out.append(DeviceBatch(schema, cols, batch.num_rows))
                 return out
@@ -828,6 +836,125 @@ class TpuExpandExec(Exec):
 
     def node_string(self):
         return f"TpuExpand x{len(self.projections)}"
+
+
+class TpuGenerateExec(Exec):
+    """explode/posexplode on device (GpuGenerateExec.scala analogue).
+
+    TPU-first: instead of cudf's Table.explode, output slot j maps to
+    (row r_j, element p_j) via a vectorized ``searchsorted`` over the
+    cumulative element counts — log-depth, no scatters, static output
+    capacity bucketed from one host sync of the total element count."""
+
+    def __init__(self, cpu_gen, child: Exec):
+        super().__init__([child])
+        self.generator = cpu_gen.generator  # bound against same schema
+        self.out_names = cpu_gen.out_names
+        self._schema = cpu_gen.output
+
+    @property
+    def output(self) -> Schema:
+        return self._schema
+
+    @property
+    def is_device(self) -> bool:
+        return True
+
+    def _lengths_kernel(self):
+        g = self.generator
+
+        def make():
+            def fn(batch: DeviceBatch):
+                c = Ctx.for_device(batch)
+                v = g.child.eval(c)
+                live = batch.row_mask() & c.broadcast_bool(v.valid)
+                lengths = jnp.where(live, c.broadcast(v.lengths), 0).astype(jnp.int32)
+                return lengths, lengths.sum()
+
+            return fn
+
+        return K.jit_kernel(("gen_lengths", g), make)
+
+    def _explode_kernel(self, out_cap: int):
+        from ..types import MapType
+
+        g = self.generator
+        out_schema = self._schema
+        is_map = isinstance(g.child.data_type, MapType)
+        position = g.position
+
+        def make():
+            def fn(batch: DeviceBatch, lengths, total):
+                c = Ctx.for_device(batch)
+                v = g.child.eval(c)
+                coff = jnp.cumsum(lengths)
+                j = jnp.arange(out_cap, dtype=jnp.int32)
+                r = jnp.searchsorted(coff, j, side="right").astype(jnp.int32)
+                live = j < total
+                r = jnp.clip(r, 0, batch.capacity - 1)
+                prev = jnp.where(r > 0, coff[jnp.clip(r - 1, 0, None)], 0)
+                p = (j - prev).astype(jnp.int32)
+                out_cols = [gather_column(col, r, live) for col in batch.columns]
+                if position:
+                    from ..types import INT
+
+                    out_cols.append(
+                        DeviceColumn(INT, jnp.where(live, p, 0), live)
+                    )
+                planes = v.children
+                gctx = Ctx(jnp, out_cap, True, [], total)
+                if is_map:
+                    for plane, dt in (
+                        (planes[0], g.child.data_type.key_type),
+                        (planes[1], g.child.data_type.value_type),
+                    ):
+                        ev = _plane_element(plane, r, p, live)
+                        out_cols.append(val_to_column(gctx, ev, dt))
+                else:
+                    ev = _plane_element(planes[0], r, p, live)
+                    out_cols.append(
+                        val_to_column(gctx, ev, g.child.data_type.element_type)
+                    )
+                return DeviceBatch(out_schema, out_cols, total.astype(jnp.int32))
+
+            return fn
+
+        return K.jit_kernel(("gen_explode", g, out_schema, out_cap), make)
+
+    def execute(self, ctx: ExecContext) -> PartitionSet:
+        lk = self._lengths_kernel()
+
+        def run(it):
+            for db in it:
+                lengths, total_dev = lk(db)
+                total = int(total_dev)
+                if total == 0:
+                    continue
+                out_cap = bucket_capacity(total)
+                yield self._explode_kernel(out_cap)(
+                    db, lengths, jnp.asarray(total, jnp.int32)
+                )
+
+        return self.children[0].execute(ctx).map_partitions(run)
+
+    def node_string(self):
+        return f"TpuGenerate {self.generator}"
+
+
+def _plane_element(plane: DeviceColumn, r, p, live):
+    """Element (r_j, p_j) of a padded element plane as a Val."""
+    W = plane.data.shape[1]
+    safe = jnp.clip(p, 0, W - 1)
+    data = plane.data[r, safe]
+    valid = plane.validity[r, safe] & live
+    lengths = None
+    if plane.lengths is not None:
+        lengths = jnp.where(live, plane.lengths[r, safe], 0)
+    if data.ndim == 2:
+        data = jnp.where(live[:, None], data, 0)
+    else:
+        data = jnp.where(live, data, jnp.zeros_like(data))
+    return Val(data, valid, lengths)
 
 
 class TpuShuffleExchangeExec(Exec):

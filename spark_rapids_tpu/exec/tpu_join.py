@@ -15,7 +15,7 @@ from typing import List, Optional
 import jax
 import jax.numpy as jnp
 
-from ..columnar.device import DeviceBatch, DeviceColumn, bucket_capacity, empty_batch
+from ..columnar.device import DeviceBatch, DeviceColumn, bucket_capacity, dc_replace, empty_batch
 from ..expr import Expression, bind
 from ..expr.base import Ctx, Val
 from ..ops.concat import concat_device
@@ -516,7 +516,7 @@ def _make_phase2(out_schema: Schema, right_ords: tuple, jt: str, residual):
             out = DeviceBatch(
                 out_schema,
                 [
-                    DeviceColumn(c.dtype, c.data, c.validity & live, c.lengths)
+                    dc_replace(c, validity=c.validity & live)
                     for c in cols
                 ],
                 jnp.asarray(out_cap, jnp.int32),
@@ -561,7 +561,7 @@ def _make_pair_kernel(out_schema: Schema, condition, jt: str):
             out = DeviceBatch(
                 out_schema,
                 [
-                    DeviceColumn(c.dtype, c.data, c.validity & live, c.lengths)
+                    dc_replace(c, validity=c.validity & live)
                     for c in lcols + rcols
                 ],
                 jnp.asarray(cap, jnp.int32),

@@ -50,12 +50,15 @@ class Val:
     """An evaluation result: data + validity, each either scalar or length-n.
 
     Device strings carry ``lengths`` (see columnar.device); CPU strings use an
-    object ndarray in ``data`` with ``lengths is None``.
+    object ndarray in ``data`` with ``lengths is None``. Device complex values
+    (array/struct/map) carry ``children`` — nested DeviceColumn planes — with
+    ``data`` None; the CPU engine stores python objects in ``data`` instead.
     """
 
     data: Any
     valid: Any
     lengths: Any = None
+    children: Any = None  # tuple[DeviceColumn] for device complex values
 
     def full_data(self, ctx: "Ctx"):
         return ctx.broadcast(self.data)
@@ -94,7 +97,7 @@ class Ctx:
         import jax.numpy as jnp
 
         cols = [
-            Val(c.data, c.validity, c.lengths) for c in batch.columns
+            Val(c.data, c.validity, c.lengths, c.children) for c in batch.columns
         ]
         return Ctx(jnp, batch.capacity, True, cols, batch.num_rows, task)
 

@@ -125,4 +125,18 @@ def coerce(e: Expression) -> Expression:
             c=_cast_to(e.c, ct),
             values=tuple(_cast_to(v, ct) for v in e.values),
         )
+    from .complex import CreateArray, UnresolvedExtractValue
+
+    if isinstance(e, UnresolvedExtractValue):
+        return e.resolve()  # struct field / array index / map key dispatch
+    if isinstance(e, CreateArray) and e.items:
+        ct = e.items[0].data_type
+        for v in e.items[1:]:
+            if not isinstance(v.data_type, NullType):
+                ct = (
+                    v.data_type
+                    if isinstance(ct, NullType)
+                    else _common_type(ct, v.data_type)
+                )
+        return dataclasses.replace(e, items=tuple(_cast_to(v, ct) for v in e.items))
     return e

@@ -198,6 +198,16 @@ class Column:
     def cast(self, dt: DataType) -> "Column":
         return Column(Cast(self.expr, dt))
 
+    def getItem(self, key) -> "Column":
+        from .expr.complex import UnresolvedExtractValue
+
+        return Column(UnresolvedExtractValue(self.expr, _e(key)))
+
+    getField = getItem
+
+    def __getitem__(self, key) -> "Column":
+        return self.getItem(key)
+
     def isin(self, *values) -> "Column":
         return Column(In(self.expr, tuple(_e(v) for v in values)))
 
@@ -656,3 +666,62 @@ def rand(seed: int = 0) -> Column:
     from .expr.misc import Rand
 
     return Column(Rand(seed))
+
+
+# ── complex types (complexTypeCreator/Extractors, collectionOperations) ────
+
+
+def array(*cols) -> Column:
+    from .expr.complex import CreateArray
+
+    return Column(CreateArray(tuple(_e(c) for c in cols)))
+
+
+def struct(*cols) -> Column:
+    from .expr.base import Alias as _Alias
+    from .expr.base import UnresolvedAttribute as _UA
+    from .expr.complex import CreateNamedStruct
+
+    names, values = [], []
+    for i, c in enumerate(cols):
+        e = _e(c)
+        if isinstance(e, _Alias):
+            names.append(e.name)
+            values.append(e.child)
+        elif isinstance(e, _UA):
+            names.append(e.name)
+            values.append(e)
+        else:
+            names.append(f"col{i + 1}")
+            values.append(e)
+    return Column(CreateNamedStruct(tuple(names), tuple(values)))
+
+
+def size(c) -> Column:
+    from .expr.complex import Size
+
+    return Column(Size(_e(c)))
+
+
+def element_at(c, key) -> Column:
+    from .expr.complex import ElementAt
+
+    return Column(ElementAt(_e(c), _e(key)))
+
+
+def array_contains(c, value) -> Column:
+    from .expr.complex import ArrayContains
+
+    return Column(ArrayContains(_e(c), _e(value)))
+
+
+def explode(c) -> Column:
+    from .expr.complex import Explode
+
+    return Column(Explode(_e(c)))
+
+
+def posexplode(c) -> Column:
+    from .expr.complex import Explode
+
+    return Column(Explode(_e(c), position=True))

@@ -101,6 +101,12 @@ def enable_persistent_cache(path: str | None = None) -> None:
         "SPARK_RAPIDS_TPU_COMPILE_CACHE",
         os.path.join(os.path.expanduser("~"), ".cache", "spark_rapids_tpu_xla"),
     )
+    # separate per backend: CPU AOT artifacts encode host ISA features and
+    # must not be shared with entries written under another target
+    try:
+        cache_dir = f"{cache_dir}-{jax.default_backend()}"
+    except Exception:
+        pass
     try:
         os.makedirs(cache_dir, exist_ok=True)
         jax.config.update("jax_compilation_cache_dir", cache_dir)

@@ -4,7 +4,9 @@ GpuCoalesceBatches.scala:133-455, aggregate.scala:451).
 
 Static shapes: the output capacity is the bucketed sum of input capacities
 (a trace-time constant); live rows from each input are packed at offsets
-carried as device scalars via ``lax.dynamic_update_slice`` — no host syncs.
+carried as device scalars via index scatters — no host syncs. Nested
+columns (arrays/structs/maps) concatenate recursively along the row axis
+with padded-plane width alignment.
 """
 from __future__ import annotations
 
@@ -12,14 +14,74 @@ import jax
 import jax.numpy as jnp
 
 from ..columnar.device import DeviceBatch, DeviceColumn, bucket_capacity
-from ..types import StringType
 from .. import kernels as K
 
 
-def _pad_width(data: jax.Array, w: int) -> jax.Array:
-    if data.shape[1] < w:
-        return jnp.pad(data, ((0, 0), (0, w - data.shape[1])))
+def _pad_axes(data: jax.Array, shape: tuple) -> jax.Array:
+    """Zero-pad trailing axes of ``data`` (beyond axis 0) up to ``shape``."""
+    pads = [(0, 0)]
+    for have, want in zip(data.shape[1:], shape):
+        pads.append((0, want - have))
+    if any(p[1] for p in pads):
+        return jnp.pad(data, pads)
     return data
+
+
+def _plane_shape(cols: list[jax.Array]) -> tuple:
+    """Max trailing-axes shape across inputs (W / string width alignment)."""
+    ndim = cols[0].ndim
+    return tuple(
+        max(c.shape[ax] for c in cols) for ax in range(1, ndim)
+    )
+
+
+def _scatter_rows(dst: jax.Array, src: jax.Array, offset) -> jax.Array:
+    """Place src rows into dst starting at (traced) offset. Capacities are
+    bucketed so offset + rows can exceed dst; mode='drop' clips."""
+    idx = jnp.arange(src.shape[0], dtype=jnp.int32) + offset
+    return dst.at[idx].set(src, mode="drop")
+
+
+def _concat_plane(planes: list[jax.Array], lives: list[jax.Array], offsets, cap):
+    """Concat one leaf plane (data/validity/lengths, any trailing shape)."""
+    trail = _plane_shape(planes)
+    dst = jnp.zeros((cap,) + trail, dtype=planes[0].dtype)
+    for p, live, off in zip(planes, lives, offsets):
+        p = _pad_axes(p, trail)
+        mask = live.reshape((-1,) + (1,) * (p.ndim - 1))
+        p = jnp.where(mask, p, jnp.zeros_like(p))
+        dst = _scatter_rows(dst, p, off)
+    return dst
+
+
+def _concat_col(cols: list[DeviceColumn], lives, offsets, cap) -> DeviceColumn:
+    dt = cols[0].dtype
+    data = (
+        _concat_plane([c.data for c in cols], lives, offsets, cap)
+        if cols[0].data is not None
+        else None
+    )
+    validity = _concat_plane([c.validity for c in cols], lives, offsets, cap)
+    lengths = (
+        _concat_plane([c.lengths for c in cols], lives, offsets, cap)
+        if cols[0].lengths is not None
+        else None
+    )
+    children = None
+    if cols[0].children is not None:
+        children = tuple(
+            _concat_col([c.children[k] for c in cols], lives, offsets, cap)
+            for k in range(len(cols[0].children))
+        )
+    return DeviceColumn(dt, data, validity, lengths, children)
+
+
+def _col_shape_sig(c: DeviceColumn):
+    return (
+        None if c.data is None else c.data.shape,
+        None if c.lengths is None else True,
+        None if c.children is None else tuple(_col_shape_sig(k) for k in c.children),
+    )
 
 
 def concat_device(batches: list[DeviceBatch], capacity: int | None = None) -> DeviceBatch:
@@ -32,7 +94,7 @@ def concat_device(batches: list[DeviceBatch], capacity: int | None = None) -> De
         return batches[0]
     schema = batches[0].schema
     cap = capacity or bucket_capacity(sum(b.capacity for b in batches))
-    shapes = tuple(tuple(c.data.shape for c in b.columns) for b in batches)
+    shapes = tuple(tuple(_col_shape_sig(c) for c in b.columns) for b in batches)
     fn = K.kernel(
         ("concat", schema, shapes, cap),
         lambda: jax.jit(lambda bs: _concat_impl(list(bs), cap)),
@@ -42,61 +104,19 @@ def concat_device(batches: list[DeviceBatch], capacity: int | None = None) -> De
 
 def _concat_impl(batches: list[DeviceBatch], cap: int) -> DeviceBatch:
     schema = batches[0].schema
-    ncols = len(schema)
-    widths = []
-    for i, f in enumerate(schema):
-        if isinstance(f.data_type, StringType):
-            widths.append(max(b.columns[i].data.shape[1] for b in batches))
-        else:
-            widths.append(None)
-    out_cols = []
-    for i, f in enumerate(schema):
-        w = widths[i]
-        if w is not None:
-            data = jnp.zeros((cap, w), dtype=jnp.uint8)
-            lengths = jnp.zeros(cap, dtype=jnp.int32)
-        else:
-            data = jnp.zeros(cap, dtype=f.data_type.np_dtype)
-            lengths = None
-        validity = jnp.zeros(cap, dtype=bool)
-        offset = jnp.asarray(0, dtype=jnp.int32)
-        for b in batches:
-            c = b.columns[i]
-            src = _pad_width(c.data, w) if w is not None else c.data
-            # live-prefix invariant: rows >= b.num_rows are inert (validity
-            # False, zeroed); writing them past the offset is harmless as the
-            # final live count masks them out — but they'd collide with the
-            # next batch's slot, so mask the tail to zero before placing.
-            live = (jnp.arange(b.capacity, dtype=jnp.int32) < b.num_rows)
-            if w is not None:
-                src = jnp.where(live[:, None], src, 0)
-            else:
-                src = jnp.where(live, src, jnp.zeros_like(src))
-            v = c.validity & live
-            if w is not None:
-                data = _dus_rows(data, src, offset)
-                lengths = _dus_rows(lengths, jnp.where(live, c.lengths, 0), offset)
-            else:
-                data = _dus_rows(data, src, offset)
-            validity = _dus_or(validity, v, offset)
-            offset = offset + b.num_rows
-        out_cols.append(DeviceColumn(f.data_type, data, validity, lengths))
+    lives = [
+        jnp.arange(b.capacity, dtype=jnp.int32) < b.num_rows for b in batches
+    ]
+    offsets = []
+    off = jnp.asarray(0, jnp.int32)
+    for b in batches:
+        offsets.append(off)
+        off = off + b.num_rows
+    out_cols = [
+        _concat_col([b.columns[i] for b in batches], lives, offsets, cap)
+        for i in range(len(schema))
+    ]
     total = jnp.asarray(0, jnp.int32)
     for b in batches:
         total = total + b.num_rows
     return DeviceBatch(schema, out_cols, total)
-
-
-def _dus_rows(dst: jax.Array, src: jax.Array, offset) -> jax.Array:
-    """Scatter src rows into dst starting at (traced) offset.
-
-    dynamic_update_slice would clamp at the end; capacities are bucketed so
-    offset + src rows can exceed dst — use an explicit scatter instead.
-    """
-    idx = jnp.arange(src.shape[0], dtype=jnp.int32) + offset
-    return dst.at[idx].set(src, mode="drop")
-
-
-def _dus_or(dst: jax.Array, src: jax.Array, offset) -> jax.Array:
-    idx = jnp.arange(src.shape[0], dtype=jnp.int32) + offset
-    return dst.at[idx].set(src, mode="drop")

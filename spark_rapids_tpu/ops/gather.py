@@ -11,16 +11,19 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-from ..columnar.device import DeviceBatch, DeviceColumn
+from ..columnar.device import DeviceBatch, DeviceColumn, dc_replace
 
 
 def gather_column(col: DeviceColumn, idx: jax.Array, idx_valid=None) -> DeviceColumn:
-    data = col.data[idx]
+    data = col.data[idx] if col.data is not None else None
     validity = col.validity[idx]
     if idx_valid is not None:
         validity = validity & idx_valid
     lengths = col.lengths[idx] if col.lengths is not None else None
-    return DeviceColumn(col.dtype, data, validity, lengths)
+    children = None
+    if col.children is not None:  # nested planes share the row axis
+        children = tuple(gather_column(c, idx) for c in col.children)
+    return DeviceColumn(col.dtype, data, validity, lengths, children)
 
 
 def gather_batch(batch: DeviceBatch, idx: jax.Array, new_num_rows) -> DeviceBatch:
@@ -71,7 +74,7 @@ def compact(batch: DeviceBatch, keep: jax.Array) -> DeviceBatch:
     # zero validity in the tail so padding rows are inert and deterministic
     live = jnp.arange(batch.capacity, dtype=jnp.int32) < n
     cols = [
-        DeviceColumn(c.dtype, c.data, c.validity & live, c.lengths)
+        dc_replace(c, validity=c.validity & live)
         for c in out.columns
     ]
     return DeviceBatch(out.schema, cols, n)

@@ -151,9 +151,50 @@ def np_strings_to_padded(data, valid):
     return out, lengths
 
 
+def _hash_scalar_np(dt: DataType, value, seed_u32: np.uint32) -> np.uint32:
+    """Spark-exact murmur3 of ONE python value (CPU oracle path for nested
+    types: Spark's HashExpression folds element hashes recursively)."""
+    from ..types import ArrayType, MapType, StructType
+
+    if value is None:
+        return seed_u32
+    if isinstance(dt, ArrayType):
+        h = seed_u32
+        for el in value:
+            h = _hash_scalar_np(dt.element_type, el, h)
+        return h
+    if isinstance(dt, StructType):
+        h = seed_u32
+        for f in dt.fields:
+            h = _hash_scalar_np(f.data_type, value.get(f.name), h)
+        return h
+    if isinstance(dt, MapType):  # Spark: hashing maps is disallowed
+        raise TypeError("hash of map type is not supported (Spark semantics)")
+    one = hash_column(
+        np,
+        dt,
+        np.asarray([value], dtype=object if isinstance(dt, StringType) else dt.np_dtype),
+        np.asarray([True]),
+        None,
+        np.asarray([seed_u32], dtype=np.uint32),
+    )
+    return np.uint32(one[0])
+
+
 def hash_column(xp, dt: DataType, data, valid, lengths, seed_u32):
     """One column's contribution: returns the new running hash (uint32[n]),
     leaving rows with NULL unchanged (Spark semantics)."""
+    from ..types import is_complex
+
+    if is_complex(dt):
+        assert xp is np, "complex hash keys are gated off the device path"
+        v = np.asarray(valid).astype(bool)
+        seeds = np.broadcast_to(np.asarray(seed_u32, dtype=np.uint32), (len(v),)).copy()
+        out = seeds.copy()
+        for i in range(len(v)):
+            if v[i] and data[i] is not None:
+                out[i] = _hash_scalar_np(dt, data[i], seeds[i])
+        return out
     if isinstance(dt, StringType):
         if xp is np and (getattr(data, "ndim", 1) != 2 or lengths is None):
             data, lengths = np_strings_to_padded(data, np.asarray(valid).astype(bool))

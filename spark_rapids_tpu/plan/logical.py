@@ -134,6 +134,44 @@ class Aggregate(LogicalPlan):
 
 
 @dataclass
+class Generate(LogicalPlan):
+    """explode/posexplode over an array/map column (Spark's Generate;
+    reference GpuGenerateExec.scala). Output = child columns ++ generator
+    columns (pos?, col | key, value)."""
+
+    generator: Expression  # expr.complex.Explode
+    out_names: list  # generator output column names
+    child: LogicalPlan
+
+    def children(self):
+        return [self.child]
+
+    @property
+    def schema(self) -> Schema:
+        from ..expr.complex import Explode
+        from ..types import MapType, StructType
+
+        g: Explode = _bound(self.generator, self.child.schema)
+        ct = g.child.data_type
+        fields = list(self.child.schema.fields)
+        i = 0
+        if g.position:
+            from ..types import INT
+
+            fields.append(StructField(self.out_names[i], INT, False))
+            i += 1
+        if isinstance(ct, MapType):
+            fields.append(StructField(self.out_names[i], ct.key_type, False))
+            fields.append(StructField(self.out_names[i + 1], ct.value_type, True))
+        else:
+            fields.append(StructField(self.out_names[i], ct.element_type, True))
+        return Schema(fields)
+
+    def _node_string(self):
+        return f"Generate {self.generator}"
+
+
+@dataclass
 class SortOrder:
     child: Expression
     ascending: bool = True

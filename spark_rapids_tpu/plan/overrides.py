@@ -330,6 +330,29 @@ for _cls in (
 _expr(msc.Rand, check=_rand_check)
 
 
+# ── complex-type expressions (complexTypeCreator/Extractors,
+#    collectionOperations.scala) ──────────────────────────────────────────
+from ..expr import complex as cx  # noqa: E402
+
+
+def _complex_child_check(e, conf: TpuConf) -> Optional[str]:
+    dt = e.child.data_type
+    if not _device_type_ok(dt):
+        return f"{dt.simple_string} exceeds the device nesting support"
+    return None
+
+
+for _cls in (cx.CreateArray, cx.CreateNamedStruct):
+    _expr(_cls)
+_expr(cx.Size, check=_complex_child_check)
+_expr(cx.GetStructField, check=_complex_child_check)
+_expr(cx.GetArrayItem, check=_complex_child_check)
+_expr(cx.ElementAt, check=_complex_child_check)
+_expr(cx.GetMapValue, check=_complex_child_check)
+_expr(cx.ArrayContains, check=_complex_child_check)
+_expr(cx.Explode, check=_complex_child_check)
+
+
 def expr_rules() -> dict[type, ExprRule]:
     return dict(_EXPR_RULES)
 
@@ -357,6 +380,24 @@ def _check_expr_tree(e: Expression, conf: TpuConf, reasons: List[str]) -> bool:
 # ── type gating (TypeChecks analogue) ──────────────────────────────────────
 
 
+def _device_type_ok(dt: DataType) -> bool:
+    """Types with a device layout: primitives/strings/decimal64, plus ONE
+    level of array/struct/map nesting over them (deeper nesting has no
+    padded-plane encoding yet — those plans stay on CPU)."""
+    from ..types import ArrayType, MapType, StructType, is_complex
+
+    def scalar_ok(t: DataType) -> bool:
+        return not is_complex(t)
+
+    if isinstance(dt, ArrayType):
+        return scalar_ok(dt.element_type)
+    if isinstance(dt, MapType):
+        return scalar_ok(dt.key_type) and scalar_ok(dt.value_type)
+    if isinstance(dt, StructType):
+        return all(scalar_ok(f.data_type) for f in dt.fields)
+    return True
+
+
 def _check_schema(schema: Schema, conf: TpuConf, reasons: List[str], where: str) -> bool:
     ok = True
     for f in schema:
@@ -364,27 +405,48 @@ def _check_schema(schema: Schema, conf: TpuConf, reasons: List[str], where: str)
         if isinstance(dt, DecimalType) and not conf.is_enabled(cfg.DECIMAL_ENABLED):
             reasons.append(f"{where}: decimal disabled by {cfg.DECIMAL_ENABLED.key}")
             ok = False
+        if not _device_type_ok(dt):
+            reasons.append(
+                f"{where}: {dt.simple_string} exceeds the device nesting support"
+            )
+            ok = False
         # every other supported type maps to the device layout
     return ok
+
+
+def _no_complex_keys(exprs, what: str):
+    """Exec-level check: complex types cannot be sort/group/join/partition
+    keys on device (no radix-word encoding — reference gates these the same
+    way via TypeSig key signatures)."""
+    from ..types import is_complex
+
+    def check(e, conf: TpuConf) -> Optional[str]:
+        for k in exprs(e):
+            if is_complex(k.data_type):
+                return f"{what} of type {k.data_type.simple_string} is not supported on device"
+        return None
+
+    return check
 
 
 # ── exec rules ─────────────────────────────────────────────────────────────
 
 
 class ExecRule:
-    def __init__(self, cls, name: str, convert, exprs_of, note: str = ""):
+    def __init__(self, cls, name: str, convert, exprs_of, note: str = "", check=None):
         self.cls = cls
         self.name = name
         self.conf_key = f"spark.rapids.sql.exec.{name}"
         self.convert = convert  # (cpu_exec, children) -> Exec
         self.exprs_of = exprs_of  # (cpu_exec) -> list[Expression]
+        self.check = check  # (cpu_exec, conf) -> Optional[str]
 
 
 _EXEC_RULES: dict[type, ExecRule] = {}
 
 
-def _rule(cls, name, convert, exprs_of):
-    _EXEC_RULES[cls] = ExecRule(cls, name, convert, exprs_of)
+def _rule(cls, name, convert, exprs_of, check=None):
+    _EXEC_RULES[cls] = ExecRule(cls, name, convert, exprs_of, check=check)
 
 
 def _conv_project(e: C.CpuProjectExec, ch):
@@ -440,13 +502,21 @@ _rule(
     "HashAggregateExec",
     _conv_agg,
     lambda e: e.grouping + list(e.agg_fns) + (e.result_exprs or []),
+    check=_no_complex_keys(lambda e: e.grouping, "grouping key"),
 )
-_rule(C.CpuSortExec, "SortExec", _conv_sort, lambda e: [o.child for o in e.order])
+_rule(
+    C.CpuSortExec,
+    "SortExec",
+    _conv_sort,
+    lambda e: [o.child for o in e.order],
+    check=_no_complex_keys(lambda e: [o.child for o in e.order], "sort key"),
+)
 _rule(
     C.CpuShuffleExchangeExec,
     "ShuffleExchangeExec",
     _conv_exchange,
     lambda e: e.partitioning.exprs(),
+    check=_no_complex_keys(lambda e: e.partitioning.exprs(), "partition key"),
 )
 _rule(C.CpuUnionExec, "UnionExec", _conv_union, lambda e: [])
 _rule(
@@ -468,6 +538,7 @@ _rule(
     "TakeOrderedAndProjectExec",
     _conv_topn,
     lambda e: [o.child for o in e.order],
+    check=_no_complex_keys(lambda e: [o.child for o in e.order], "sort key"),
 )
 _rule(
     C.CpuExpandExec,
@@ -505,7 +576,10 @@ from ..exec.cpu_join import (  # noqa: E402
     CpuNestedLoopJoinExec as _CpuNLJ,
 )
 
-_rule(_CpuSHJ, "ShuffledHashJoinExec", _conv_join, _join_exprs_of)
+_join_key_check = _no_complex_keys(
+    lambda e: list(e.left_keys) + list(e.right_keys), "join key"
+)
+_rule(_CpuSHJ, "ShuffledHashJoinExec", _conv_join, _join_exprs_of, check=_join_key_check)
 
 
 def _conv_bhj(e, ch):
@@ -535,7 +609,7 @@ def _conv_nlj(e, ch):
 
 
 _rule(_CpuBE, "BroadcastExchangeExec", _conv_bexchange, lambda e: [])
-_rule(_CpuBHJ, "BroadcastHashJoinExec", _conv_bhj, _join_exprs_of)
+_rule(_CpuBHJ, "BroadcastHashJoinExec", _conv_bhj, _join_exprs_of, check=_join_key_check)
 _rule(
     _CpuNLJ,
     "BroadcastNestedLoopJoinExec",
@@ -561,7 +635,28 @@ def _window_exprs_of(e):
 
 from ..exec.cpu_window import CpuWindowExec as _CpuWin  # noqa: E402
 
-_rule(_CpuWin, "WindowExec", _conv_window, _window_exprs_of)
+_rule(
+    _CpuWin,
+    "WindowExec",
+    _conv_window,
+    _window_exprs_of,
+    check=_no_complex_keys(
+        lambda e: list(e.spec.partition_by) + [o.child for o in e.spec.order_by],
+        "window key",
+    ),
+)
+
+
+def _conv_generate(e: C.CpuGenerateExec, ch):
+    return T.TpuGenerateExec(e, ch[0])
+
+
+_rule(
+    C.CpuGenerateExec,
+    "GenerateExec",
+    _conv_generate,
+    lambda e: [e.generator],
+)
 
 
 def exec_rules() -> dict[type, ExecRule]:
@@ -611,6 +706,10 @@ class TpuOverrides:
             reasons.append(f"disabled by {rule.conf_key}")
         else:
             _check_schema(plan.output, self.conf, reasons, rule.name)
+            if rule.check is not None:
+                why = rule.check(plan, self.conf)
+                if why:
+                    reasons.append(why)
             for e in rule.exprs_of(plan):
                 _check_expr_tree(e, self.conf, reasons)
         if reasons:

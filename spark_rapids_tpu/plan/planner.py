@@ -77,6 +77,12 @@ def plan_physical(lp: L.LogicalPlan, conf: TpuConf) -> Exec:
         return CpuLimitExec(lp.n, plan_physical(lp.child, conf))
     if isinstance(lp, L.Expand):
         return CpuExpandExec(lp.projections, lp.names, plan_physical(lp.child, conf))
+    if isinstance(lp, L.Generate):
+        from ..exec.cpu import CpuGenerateExec
+
+        return CpuGenerateExec(
+            lp.generator, lp.out_names, plan_physical(lp.child, conf)
+        )
     if isinstance(lp, L.Union):
         return CpuUnionExec([plan_physical(p, conf) for p in lp.plans])
     if isinstance(lp, L.Repartition):

@@ -220,6 +220,54 @@ def _extract_windows(
     return new_exprs, plan
 
 
+def _extract_generators(
+    exprs: List[Expression], plan: L.LogicalPlan
+) -> tuple[List[Expression], L.LogicalPlan]:
+    """Pull a top-level explode/posexplode out of a projection into a
+    Generate node below it (Spark's ExtractGenerator); the projection then
+    references the generator's output columns by name."""
+    from .expr.complex import Explode, contains_generator
+    from .types import MapType
+
+    if not any(contains_generator(e) for e in exprs):
+        return exprs, plan
+    new_exprs: List[Expression] = []
+    generator = None
+    internal: List[str] = []  # collision-proof Generate output names
+    for e in exprs:
+        alias = e.name if isinstance(e, Alias) else None
+        target = e.child if isinstance(e, Alias) else e
+        if isinstance(target, Explode):
+            if generator is not None:
+                raise ValueError("only one generator per select is supported")
+            generator = target
+            from .expr import bind as _bind
+
+            ct = _bind(target.child, plan.schema).data_type
+            public: List[str] = []
+            if target.position:
+                public.append("pos")
+            if isinstance(ct, MapType):
+                if alias is not None:
+                    raise ValueError(
+                        "explode of a map produces two columns (key, value); "
+                        "select them by name instead of aliasing the explode"
+                    )
+                public.extend(["key", "value"])
+            else:
+                public.append(alias or "col")
+            internal = [f"__gen{i}" for i in range(len(public))]
+            new_exprs.extend(
+                Alias(UnresolvedAttribute(g), p)
+                for g, p in zip(internal, public)
+            )
+        elif contains_generator(e):
+            raise ValueError("explode() must be a top-level select expression")
+        else:
+            new_exprs.append(e)
+    return new_exprs, L.Generate(generator, internal, plan)
+
+
 class DataFrame:
     def __init__(self, session: TpuSession, plan: L.LogicalPlan):
         self._session = session
@@ -236,6 +284,7 @@ class DataFrame:
     # ── transformations ─────────────────────────────────────────────────
     def select(self, *cols) -> "DataFrame":
         exprs, plan = _extract_windows(_to_exprs(cols), self._plan)
+        exprs, plan = _extract_generators(exprs, plan)
         return DataFrame(self._session, L.Project(exprs, plan))
 
     def with_column(self, name: str, c: Column) -> "DataFrame":

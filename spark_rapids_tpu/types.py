@@ -176,6 +176,97 @@ class DecimalType(FractionalType):
         return hash((DecimalType, self.precision, self.scale))
 
 
+@dataclasses.dataclass(frozen=True, eq=False)
+class ArrayType(DataType):
+    """Variable-length list. Device layout mirrors strings: a padded
+    element buffer [capacity, width] + per-row lengths, with a per-element
+    validity plane (reference: TypeChecks.scala ARRAY; cudf LIST columns)."""
+
+    element_type: DataType
+    contains_null: bool = True
+
+    @property
+    def np_dtype(self) -> np.dtype:  # element storage dtype
+        return self.element_type.np_dtype
+
+    @property
+    def simple_string(self) -> str:
+        return f"array<{self.element_type.simple_string}>"
+
+    def to_arrow(self) -> pa.DataType:
+        return pa.list_(self.element_type.to_arrow())
+
+    def __eq__(self, other: Any) -> bool:
+        return (
+            isinstance(other, ArrayType)
+            and other.element_type == self.element_type
+        )
+
+    def __hash__(self) -> int:
+        return hash((ArrayType, self.element_type))
+
+
+@dataclasses.dataclass(frozen=True, eq=False)
+class StructType(DataType):
+    """Nested record: a bundle of named child columns sharing the row axis
+    (reference: TypeChecks.scala STRUCT; complexTypeCreator.scala)."""
+
+    fields: tuple = ()
+
+    @property
+    def simple_string(self) -> str:
+        inner = ",".join(f"{f.name}:{f.data_type.simple_string}" for f in self.fields)
+        return f"struct<{inner}>"
+
+    def to_arrow(self) -> pa.DataType:
+        return pa.struct(
+            [pa.field(f.name, f.data_type.to_arrow(), f.nullable) for f in self.fields]
+        )
+
+    def field_index(self, name: str) -> int:
+        for i, f in enumerate(self.fields):
+            if f.name == name:
+                return i
+        raise KeyError(name)
+
+    def __eq__(self, other: Any) -> bool:
+        return isinstance(other, StructType) and other.fields == self.fields
+
+    def __hash__(self) -> int:
+        return hash((StructType, self.fields))
+
+
+@dataclasses.dataclass(frozen=True, eq=False)
+class MapType(DataType):
+    """Key→value map, stored as parallel padded key/value element buffers
+    (Spark: MapType; arrow: map<k, v>). Keys are non-null by construction."""
+
+    key_type: DataType = None  # type: ignore
+    value_type: DataType = None  # type: ignore
+    value_contains_null: bool = True
+
+    @property
+    def simple_string(self) -> str:
+        return f"map<{self.key_type.simple_string},{self.value_type.simple_string}>"
+
+    def to_arrow(self) -> pa.DataType:
+        return pa.map_(self.key_type.to_arrow(), self.value_type.to_arrow())
+
+    def __eq__(self, other: Any) -> bool:
+        return (
+            isinstance(other, MapType)
+            and other.key_type == self.key_type
+            and other.value_type == self.value_type
+        )
+
+    def __hash__(self) -> int:
+        return hash((MapType, self.key_type, self.value_type))
+
+
+def is_complex(dt: DataType) -> bool:
+    return isinstance(dt, (ArrayType, StructType, MapType))
+
+
 # Singletons (Spark convention).
 BOOLEAN = BooleanType()
 BYTE = ByteType()
@@ -249,6 +340,17 @@ def from_arrow(at: pa.DataType) -> DataType:
         return DecimalType(at.precision, at.scale)
     if pa.types.is_null(at):
         return NULL
+    if pa.types.is_map(at):
+        return MapType(from_arrow(at.key_type), from_arrow(at.item_type))
+    if pa.types.is_list(at) or pa.types.is_large_list(at):
+        return ArrayType(from_arrow(at.value_type))
+    if pa.types.is_struct(at):
+        return StructType(
+            tuple(
+                StructField(f.name, from_arrow(f.type), f.nullable)
+                for f in at
+            )
+        )
     raise TypeError(f"unsupported arrow type {at}")
 
 

@@ -1,0 +1,193 @@
+"""Complex types (array/struct/map) + Generate/explode — differential tests
+against the CPU oracle (reference: GpuGenerateExec.scala,
+complexTypeCreator.scala, complexTypeExtractors.scala,
+collectionOperations.scala)."""
+from __future__ import annotations
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu import TpuSession
+from spark_rapids_tpu.functions import (
+    array,
+    array_contains,
+    col,
+    count,
+    element_at,
+    explode,
+    lit,
+    posexplode,
+    size,
+    struct,
+    sum as sum_,
+)
+
+from harness import assert_cpu_and_tpu_equal
+
+
+def _nested_table(n: int = 200) -> pa.Table:
+    rng = np.random.default_rng(11)
+    arrs, structs, maps, sarrs = [], [], [], []
+    for i in range(n):
+        k = rng.integers(0, 5)
+        arrs.append(None if rng.random() < 0.1 else [
+            None if rng.random() < 0.15 else int(rng.integers(-100, 100))
+            for _ in range(k)
+        ])
+        structs.append(
+            None
+            if rng.random() < 0.1
+            else {
+                "x": int(rng.integers(-50, 50)),
+                "y": None if rng.random() < 0.2 else f"s{rng.integers(0, 9)}",
+            }
+        )
+        maps.append(
+            None
+            if rng.random() < 0.1
+            else [
+                (f"k{j}", None if rng.random() < 0.2 else float(rng.integers(0, 9)))
+                for j in range(rng.integers(0, 3))
+            ]
+        )
+        sarrs.append(
+            None if rng.random() < 0.1 else [f"w{rng.integers(0, 99)}" for _ in range(rng.integers(0, 4))]
+        )
+    return pa.table(
+        {
+            "id": pa.array(range(n), type=pa.int64()),
+            "a": pa.array(arrs, type=pa.list_(pa.int64())),
+            "s": pa.array(
+                structs, type=pa.struct([("x", pa.int64()), ("y", pa.string())])
+            ),
+            "m": pa.array(maps, type=pa.map_(pa.string(), pa.float64())),
+            "sa": pa.array(sarrs, type=pa.list_(pa.string())),
+        }
+    )
+
+
+TABLE = _nested_table()
+
+
+def test_size():
+    assert_cpu_and_tpu_equal(
+        lambda s: s.create_dataframe(TABLE, num_partitions=2).select(
+            col("id"), size(col("a")).alias("n"), size(col("m")).alias("nm")
+        )
+    )
+
+
+def test_element_at_and_get_item():
+    assert_cpu_and_tpu_equal(
+        lambda s: s.create_dataframe(TABLE, num_partitions=2).select(
+            col("id"),
+            element_at(col("a"), 1).alias("first"),
+            element_at(col("a"), -1).alias("last"),
+            col("a").getItem(0).alias("zeroth"),
+            element_at(col("sa"), 2).alias("s2"),
+        )
+    )
+
+
+def test_struct_field_access():
+    assert_cpu_and_tpu_equal(
+        lambda s: s.create_dataframe(TABLE, num_partitions=2).select(
+            col("id"), col("s")["x"].alias("x"), col("s").getItem("y").alias("y")
+        )
+    )
+
+
+def test_map_lookup():
+    assert_cpu_and_tpu_equal(
+        lambda s: s.create_dataframe(TABLE, num_partitions=2).select(
+            col("id"), element_at(col("m"), "k0").alias("v0"),
+            element_at(col("m"), "k1").alias("v1"),
+        )
+    )
+
+
+def test_array_contains():
+    assert_cpu_and_tpu_equal(
+        lambda s: s.create_dataframe(TABLE, num_partitions=2).select(
+            col("id"), array_contains(col("a"), 7).alias("c7"),
+            array_contains(col("sa"), "w3").alias("cw"),
+        )
+    )
+
+
+def test_create_array_and_struct():
+    assert_cpu_and_tpu_equal(
+        lambda s: s.create_dataframe(TABLE, num_partitions=2)
+        .select(
+            col("id"),
+            array(col("id"), lit(5)).alias("arr"),
+            struct(col("id").alias("i"), col("s")["y"].alias("w")).alias("st"),
+        )
+        .select(
+            col("id"),
+            size(col("arr")).alias("k"),
+            element_at(col("arr"), 2).alias("e2"),
+            col("st")["i"].alias("sti"),
+            col("st")["w"].alias("stw"),
+        )
+    )
+
+
+def test_explode_basic():
+    assert_cpu_and_tpu_equal(
+        lambda s: s.create_dataframe(TABLE, num_partitions=2).select(
+            col("id"), explode(col("a")).alias("e")
+        )
+    )
+
+
+def test_posexplode_strings():
+    assert_cpu_and_tpu_equal(
+        lambda s: s.create_dataframe(TABLE, num_partitions=2).select(
+            col("id"), posexplode(col("sa"))
+        )
+    )
+
+
+def test_explode_map():
+    assert_cpu_and_tpu_equal(
+        lambda s: s.create_dataframe(TABLE, num_partitions=2).select(
+            col("id"), explode(col("m"))
+        )
+    )
+
+
+def test_explode_then_aggregate():
+    """explode → group-by pipeline (the VERDICT's 'done =' shape)."""
+    assert_cpu_and_tpu_equal(
+        lambda s: s.create_dataframe(TABLE, num_partitions=2)
+        .select(col("id"), explode(col("a")).alias("e"))
+        .group_by("id")
+        .agg(sum_(col("e")).alias("se"), count("*").alias("n"))
+        .sort("id"),
+    )
+
+
+def test_complex_group_key_falls_back():
+    """Complex grouping keys have no device radix encoding: the aggregate
+    must fall back to CPU (and still produce correct results)."""
+    tpu = TpuSession({"spark.rapids.sql.enabled": True})
+    df = (
+        tpu.create_dataframe(TABLE, num_partitions=2)
+        .group_by("a")
+        .agg(count("*").alias("n"))
+    )
+    rows = df.collect()
+    assert sum(r[-1] for r in rows) == TABLE.num_rows
+    assert any(
+        "grouping key" in r for e in tpu._last_overrides.explain for r in e.reasons
+    )
+
+
+def test_roundtrip_identity():
+    assert_cpu_and_tpu_equal(
+        lambda s: s.create_dataframe(TABLE, num_partitions=2).select(
+            col("id"), col("a"), col("s"), col("m"), col("sa")
+        )
+    )
