@@ -194,8 +194,12 @@ def _np_from_arrow_fixed(arr: pa.Array, dt: DataType) -> tuple[np.ndarray, np.nd
     return np_from_arrow(arr, dt)
 
 
-def _string_to_padded(arr: pa.Array, width: Optional[int]) -> tuple[np.ndarray, np.ndarray, np.ndarray, int]:
-    """Arrow string array → (bytes[n, width], lengths[n], validity[n], width)."""
+def _string_to_padded(
+    arr: pa.Array, width: Optional[int], max_str_bytes: Optional[int] = None
+) -> tuple[np.ndarray, np.ndarray, np.ndarray, int]:
+    """Arrow string array → (bytes[n, width], lengths[n], validity[n], width).
+    ``max_str_bytes`` (spark.rapids.tpu.string.maxBytes) caps the inferred
+    width — longer values raise, surfacing the configured ceiling."""
     arr = arr.cast(pa.string())
     n = len(arr)
     valid = ~np.asarray(arr.is_null())
@@ -207,6 +211,11 @@ def _string_to_padded(arr: pa.Array, width: Optional[int]) -> tuple[np.ndarray, 
     lengths = np.where(valid, lengths, 0).astype(np.int32)
     maxlen = int(lengths.max()) if n else 0
     if width is None:
+        if max_str_bytes is not None and maxlen > max_str_bytes:
+            raise ValueError(
+                f"string length {maxlen} exceeds "
+                f"spark.rapids.tpu.string.maxBytes={max_str_bytes}"
+            )
         width = bucket_width(max(maxlen, 1))
     if maxlen > width:
         raise ValueError(f"string length {maxlen} exceeds device width {width}")
@@ -240,14 +249,20 @@ def _padded_to_string(data: np.ndarray, lengths: np.ndarray, valid: np.ndarray, 
     )
 
 
-def _np_col_from_arrow(arr: pa.Array, dt: DataType, cap: int, width: Optional[int] = None) -> DeviceColumn:
+def _np_col_from_arrow(
+    arr: pa.Array,
+    dt: DataType,
+    cap: int,
+    width: Optional[int] = None,
+    max_str_bytes: Optional[int] = None,
+) -> DeviceColumn:
     """Arrow array → host-side DeviceColumn (numpy leaves), padded to cap.
     Recursive over array/struct/map nesting."""
     from ..types import ArrayType, MapType, StructType
 
     n = len(arr)
     if isinstance(dt, StringType):
-        data, lengths, valid, w = _string_to_padded(arr, width)
+        data, lengths, valid, w = _string_to_padded(arr, width, max_str_bytes)
         pdata = np.zeros((cap, w), dtype=np.uint8)
         pdata[:n] = data
         plen = np.zeros(cap, dtype=np.int32)
@@ -329,11 +344,14 @@ def host_to_device(
     rb: pa.RecordBatch,
     capacity: Optional[int] = None,
     str_widths: Optional[dict[int, int]] = None,
+    max_str_bytes: Optional[int] = None,
 ) -> DeviceBatch:
     """Arrow RecordBatch (host currency) → DeviceBatch, padded to a bucketed
     capacity. Every buffer ships in ONE batched ``jax.device_put`` call —
     PJRT coalesces the transfers, so a slow link pays one round trip per
-    batch instead of one per buffer."""
+    batch instead of one per buffer. ``max_str_bytes``
+    (spark.rapids.tpu.string.maxBytes) caps the padded string width the
+    fixed-width layout will materialize."""
     n = rb.num_rows
     cap = capacity or bucket_capacity(max(n, 1))
     schema = Schema.from_arrow(rb.schema)
@@ -343,7 +361,13 @@ def host_to_device(
         if isinstance(arr, pa.ChunkedArray):  # pragma: no cover - RecordBatch cols are flat
             arr = arr.combine_chunks()
         host_cols.append(
-            _np_col_from_arrow(arr, field.data_type, cap, (str_widths or {}).get(i))
+            _np_col_from_arrow(
+                arr,
+                field.data_type,
+                cap,
+                (str_widths or {}).get(i),
+                max_str_bytes,
+            )
         )
     num_rows, cols = jax.device_put((np.asarray(n, np.int32), host_cols))
     return DeviceBatch(schema, list(cols), num_rows)
@@ -401,7 +425,7 @@ def _pack_kernel(schema: Schema, cap: int, widths: tuple):
                     add(col.lengths)
             return jnp.concatenate(parts), tuple(side)
 
-        return jax.jit(pack)
+        return K.GuardedJit(pack)
 
     return K.kernel(("pack_d2h", schema, cap, widths), make)
 

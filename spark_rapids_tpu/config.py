@@ -165,6 +165,38 @@ CAST_STRING_TO_FLOAT = conf("spark.rapids.sql.castStringToFloat.enabled").doc(
     "Enable string→float casts, which may differ from Spark in corner cases."
 ).boolean_conf(False)
 
+CAST_STRING_TO_TIMESTAMP = conf(
+    "spark.rapids.sql.castStringToTimestamp.enabled"
+).doc(
+    "Enable string→timestamp casts on device; the device grammar is the "
+    "UTC-only subset of Spark's (no zone offsets), matching the reference's "
+    "gated support (GpuCast.scala castStringToTimestamp)."
+).boolean_conf(False)
+
+ADAPTIVE_ENABLED = conf("spark.sql.adaptive.enabled").doc(
+    "Adaptive query execution (Spark's key, honored here): exchanges "
+    "coalesce small output partitions at runtime from measured sizes "
+    "(the GpuCustomShuffleReaderExec analogue)."
+).boolean_conf(False)
+
+ADVISORY_PARTITION_SIZE = conf(
+    "spark.sql.adaptive.advisoryPartitionSizeInBytes"
+).doc(
+    "Target post-shuffle partition size for adaptive coalescing."
+).bytes_conf(64 << 20)
+
+CBO_ENABLED = conf("spark.rapids.sql.optimizer.enabled").doc(
+    "Cost-based un-conversion: device islands whose estimated compute is "
+    "too small to pay for their H2D/D2H transitions revert to the CPU "
+    "engine (reference: CostBasedOptimizer.scala, default off there too)."
+).boolean_conf(False)
+
+ANSI_ENABLED = conf("spark.sql.ansi.enabled").doc(
+    "Spark's ANSI mode (honored here): casts raise on overflow or malformed "
+    "input instead of returning NULL, and integral narrowing range-checks "
+    "instead of wrapping."
+).boolean_conf(False)
+
 STRING_MAX_BYTES = conf("spark.rapids.tpu.string.maxBytes").doc(
     "Maximum per-value string width the fixed-width device representation "
     "pads to before the column falls back to the CPU."
@@ -212,11 +244,6 @@ MULTITHREADED_READ_NUM_THREADS = conf(
     "Thread pool size for the multithreaded (cloud) file reader "
     "(reference: RapidsConf.scala:624-671)."
 ).int_conf(20)
-
-IMPROVED_FLOAT_OPS = conf("spark.rapids.sql.improvedFloatOps.enabled").doc(
-    "Enable float ops (e.g. nearly-integral double→long) that round "
-    "differently from Spark in the last ulp."
-).boolean_conf(False)
 
 DECIMAL_ENABLED = conf("spark.rapids.sql.decimalType.enabled").doc(
     "Enable decimal (64-bit) processing on device."

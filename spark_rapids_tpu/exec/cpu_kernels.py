@@ -80,6 +80,30 @@ def group_inverse(encoded_cols: list[np.ndarray], n: int):
 _NULL_SENTINEL_F = -(2**62)
 
 
+def _canon_value(v):
+    """Hashable canonical form with Spark value equality (NaN == NaN)."""
+    import math
+
+    if isinstance(v, float) and math.isnan(v):
+        return "__NaN__"
+    if isinstance(v, list):
+        return tuple(_canon_value(x) for x in v)
+    if isinstance(v, dict):
+        return tuple((k, _canon_value(x)) for k, x in sorted(v.items()))
+    return v
+
+
+def _dedup_spark(items: list) -> list:
+    seen = set()
+    out = []
+    for v in items:
+        k = _canon_value(v)
+        if k not in seen:
+            seen.add(k)
+            out.append(v)
+    return out
+
+
 def reduce_groups(
     op: str,
     dt: DataType,
@@ -137,6 +161,23 @@ def reduce_groups(
         out = np.full(G, fill, dtype=data.dtype)
         (np.minimum if op == "min" else np.maximum).at(out, inv, x)
         return out, any_valid
+    if op in ("collect_list", "collect_set", "merge_lists", "merge_sets"):
+        out = np.empty(G, dtype=object)
+        for g in range(G):
+            out[g] = []
+        merging = op.startswith("merge")
+        for i in range(len(inv)):
+            if not valid[i]:
+                continue
+            if merging:
+                out[inv[i]].extend(data[i])
+            else:
+                out[inv[i]].append(data[i])
+        if op.endswith("set"):
+            for g in range(G):
+                out[g] = _dedup_spark(out[g])
+        # collect results are never null — empty array for all-null groups
+        return out, np.ones(G, dtype=bool)
     idx = np.arange(len(inv), dtype=np.int64)
     big = np.int64(2**62)
     if op == "first":

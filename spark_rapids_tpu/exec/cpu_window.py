@@ -169,9 +169,23 @@ class CpuWindowExec(Exec):
         out_dt = we.data_type
         out = np.zeros(n, dtype=out_dt.np_dtype if not is_avg else np.float64)
         ov = np.zeros(n, dtype=bool)
+        order_info = None
+        sentinels = (UNBOUNDED_PRECEDING, CURRENT_ROW, UNBOUNDED_FOLLOWING)
+        if frame.frame_type == "range" and not (
+            frame.lower in sentinels and frame.upper in sentinels
+        ):
+            o = we.spec.order_by[0]
+            od, ovv = _val_to_np(ctx, bind(o.child, schema).eval(ctx))
+            od = np.asarray(od)
+            if not np.issubdtype(od.dtype, np.floating):
+                od = od.astype(np.int64)
+            order_info = (
+                od if o.ascending else -od,
+                np.asarray(ovv).astype(bool),
+            )
         for s, e in zip(seg_bounds[:-1], seg_bounds[1:]):
             for i in range(s, e):
-                lo, hi = _frame_bounds(frame, i, s, e, peer_start)
+                lo, hi = _frame_bounds(frame, i, s, e, peer_start, order_info)
                 if lo > hi:
                     vals = np.zeros(0, dtype=d.dtype)
                 else:
@@ -220,29 +234,64 @@ def _agg_input(fn) -> Expression:
     raise NotImplementedError(f"window aggregate {type(fn).__name__}")
 
 
-def _frame_bounds(frame, i, s, e, peer_start):
-    """Inclusive [lo, hi] row bounds of the frame for row i in segment [s, e)."""
+def _frame_bounds(frame, i, s, e, peer_start, order_info=None):
+    """Inclusive [lo, hi] row bounds of the frame for row i in segment [s, e).
+    ``order_info`` = (sign-adjusted values, validity) of the single ORDER BY
+    key, required for numeric RANGE bounds; NULL order rows frame over their
+    peer group (Spark semantics — incomparable to numeric offsets)."""
     if frame.frame_type == "rows":
         lo = s if frame.lower == UNBOUNDED_PRECEDING else max(s, i + frame.lower)
         hi = e - 1 if frame.upper == UNBOUNDED_FOLLOWING else min(e - 1, i + frame.upper)
         return lo, min(hi, e - 1)
-    # range frame: bounds snap to peer-group boundaries
-    lo = s
-    hi = e - 1
-    if frame.lower == CURRENT_ROW:
+
+    def peer_lo():
         j = i
         while j > s and not peer_start[j]:
             j -= 1
-        lo = j
-    elif frame.lower != UNBOUNDED_PRECEDING:
-        raise NotImplementedError("numeric range bounds")
-    if frame.upper == CURRENT_ROW:
+        return j
+
+    def peer_hi():
         j = i + 1
         while j < e and not peer_start[j]:
             j += 1
-        hi = j - 1
-    elif frame.upper != UNBOUNDED_FOLLOWING:
-        raise NotImplementedError("numeric range bounds")
+        return j - 1
+
+    sentinels = (UNBOUNDED_PRECEDING, CURRENT_ROW, UNBOUNDED_FOLLOWING)
+    if frame.lower in sentinels and frame.upper in sentinels:
+        lo = s
+        hi = e - 1
+        if frame.lower == CURRENT_ROW:
+            lo = peer_lo()
+        if frame.upper == CURRENT_ROW:
+            hi = peer_hi()
+        return lo, hi
+    # numeric RANGE: value-space scan (the device does binary searches —
+    # deliberately different algorithm, same semantics)
+    sval, ovalid = order_info
+    if frame.lower == UNBOUNDED_PRECEDING:
+        lo = s
+    elif not ovalid[i]:
+        lo = peer_lo()
+    else:
+        delta = 0 if frame.lower == CURRENT_ROW else frame.lower
+        target = sval[i] + delta
+        lo = e  # empty unless found
+        for j in range(s, e):
+            if ovalid[j] and sval[j] >= target:
+                lo = j
+                break
+    if frame.upper == UNBOUNDED_FOLLOWING:
+        hi = e - 1
+    elif not ovalid[i]:
+        hi = peer_hi()
+    else:
+        delta = 0 if frame.upper == CURRENT_ROW else frame.upper
+        target = sval[i] + delta
+        hi = s - 1
+        for j in range(e - 1, s - 1, -1):
+            if ovalid[j] and sval[j] <= target:
+                hi = j
+                break
     return lo, hi
 
 

@@ -71,8 +71,28 @@ def val_to_column(ctx: Ctx, val: Val, dtype) -> DeviceColumn:
 # ── transitions ─────────────────────────────────────────────────────────────
 
 
+def _row_bytes(schema: Schema) -> int:
+    """Rough per-row device footprint for batch-size targeting."""
+    total = 0
+    for f in schema:
+        dt = f.data_type
+        if isinstance(dt, StringType):
+            total += 64  # padded bytes + lengths, typical bucket
+        else:
+            try:
+                total += dt.np_dtype.itemsize
+            except Exception:
+                total += 16
+        total += 1  # validity
+    return max(total, 1)
+
+
 class HostToDeviceExec(Exec):
-    """Host Arrow batches → device batches (HostColumnarToGpu analogue)."""
+    """Host Arrow batches → device batches (HostColumnarToGpu analogue).
+
+    Incoming batches are re-chunked to ``spark.rapids.sql.batchSizeBytes``
+    (the CoalesceGoal TargetSize contract — GpuExec.scala:173-188) so one
+    oversized host batch cannot blow the device working set."""
 
     def __init__(self, child: Exec):
         super().__init__([child])
@@ -86,14 +106,36 @@ class HostToDeviceExec(Exec):
         return True
 
     def execute(self, ctx: ExecContext) -> PartitionSet:
+        from .. import config as cfg
+
         schema = self.output
+        max_rows = max(
+            1, cfg.BATCH_SIZE_BYTES.get(ctx.conf) // _row_bytes(schema)
+        )
+        max_str = cfg.STRING_MAX_BYTES.get(ctx.conf)
+        rows_m = self.metric("numInputRows", "ESSENTIAL")
+        time_m = self.metric("hostToDeviceTime", "MODERATE")
+        timing = self.metrics_on(ctx, "MODERATE")
 
         def fn(it):
             for rb in it:
-                ctx.semaphore.acquire_if_necessary()
                 if rb.num_rows == 0:
                     continue
-                yield host_to_device(rb)
+                rows_m.add(rb.num_rows)
+                for off in range(0, rb.num_rows, max_rows):
+                    chunk = (
+                        rb
+                        if rb.num_rows <= max_rows
+                        else rb.slice(off, max_rows)
+                    )
+                    ctx.semaphore.acquire_if_necessary()
+                    if timing:
+                        with time_m.timed():
+                            yield host_to_device(chunk, max_str_bytes=max_str)
+                    else:
+                        yield host_to_device(chunk, max_str_bytes=max_str)
+                    if rb.num_rows <= max_rows:
+                        break
 
         return self.children[0].execute(ctx).map_partitions(fn)
 
@@ -109,11 +151,20 @@ class DeviceToHostExec(Exec):
         return self.children[0].output
 
     def execute(self, ctx: ExecContext) -> PartitionSet:
+        rows_m = self.metric("numOutputRows", "ESSENTIAL")
+        time_m = self.metric("deviceToHostTime", "MODERATE")
+        timing = self.metrics_on(ctx, "MODERATE")
+
         def fn(it):
             for db in it:
-                rb = device_to_host(db)
+                if timing:
+                    with time_m.timed():
+                        rb = device_to_host(db)
+                else:
+                    rb = device_to_host(db)
                 ctx.semaphore.release_if_necessary()
                 if rb.num_rows:
+                    rows_m.add(rb.num_rows)
                     yield rb
 
         return self.children[0].execute(ctx).map_partitions(fn)
@@ -187,11 +238,54 @@ class TpuRangeExec(Exec):
         return f"TpuRange ({c.start}, {c.end}, step={c.step}, splits={c.num_partitions})"
 
 
+class _ErrorCheckingKernel:
+    """Wraps a jitted kernel returning ``(out, err_flags)``: raises
+    ``AnsiError`` host-side when a flag fires (one sync per batch, and only
+    for kernels whose expression tree registered error sites — non-ANSI
+    queries return a statically-empty flag vector and never sync)."""
+
+    def __init__(self, fn, sites: list):
+        self._fn = fn
+        self._sites = sites
+
+    def __call__(self, batch, tvals):
+        out, errs = self._fn(batch, tvals)
+        if errs.shape[0]:
+            import numpy as np
+
+            from ..expr.base import AnsiError
+
+            flags = np.asarray(errs)
+            if flags.any():
+                raise AnsiError(self._sites[int(np.argmax(flags))])
+        return out
+
+    def _cache_size(self):
+        cs = getattr(self._fn, "_cache_size", None)
+        return cs() if callable(cs) else 0
+
+
+def _error_flags(ctx: Ctx, live, sites: list):
+    """Collect ANSI error sites registered during tracing into a flag vector
+    (and capture their messages — tracing runs this Python code, so the
+    closure list is filled before the first batch result is consumed)."""
+    import jax.numpy as jnp
+
+    sites[:] = [m for m, _ in ctx.errors]
+    if not ctx.errors:
+        return jnp.zeros((0,), dtype=bool)
+    return jnp.stack([(mask & live).any() for _, mask in ctx.errors])
+
+
 def project_kernel(exprs: tuple, schema: Schema):
     """Fused projection kernel, cached by (bound exprs, output schema)."""
 
     def make():
-        def _project(batch: DeviceBatch, tvals) -> DeviceBatch:
+        import jax
+
+        sites: list = []
+
+        def _project(batch: DeviceBatch, tvals):
             c = Ctx.for_device(batch, task=tvals)
             cols = [val_to_column(c, e.eval(c), e.data_type) for e in exprs]
             # keep padding rows inert
@@ -200,31 +294,45 @@ def project_kernel(exprs: tuple, schema: Schema):
                 dc_replace(col, validity=col.validity & live)
                 for col in cols
             ]
-            return DeviceBatch(schema, cols, batch.num_rows)
+            errs = _error_flags(c, live, sites)
+            return DeviceBatch(schema, cols, batch.num_rows), errs
 
-        return _project
+        return _ErrorCheckingKernel(K.GuardedJit(_project), sites)
 
-    return K.jit_kernel(("project", exprs, schema), make)
+    return K.kernel(("project", exprs, schema), make)
 
 
 def filter_kernel(condition: Expression):
     def make():
-        def _filter(batch: DeviceBatch, tvals) -> DeviceBatch:
+        import jax
+
+        sites: list = []
+
+        def _filter(batch: DeviceBatch, tvals):
             c = Ctx.for_device(batch, task=tvals)
             v = condition.eval(c)
             keep = c.broadcast_bool(v.data) & v.full_valid(c)
-            return compact(batch, keep)
+            errs = _error_flags(c, batch.row_mask(), sites)
+            return compact(batch, keep), errs
 
-        return _filter
+        return _ErrorCheckingKernel(K.GuardedJit(_filter), sites)
 
-    return K.jit_kernel(("filter", condition), make)
+    return K.kernel(("filter", condition), make)
 
 
 class TpuProjectExec(Exec):
-    def __init__(self, exprs: List[Expression], child: Exec):
+    def __init__(
+        self,
+        exprs: List[Expression],
+        child: Exec,
+        schema: Optional[Schema] = None,
+    ):
         super().__init__([child])
         self.exprs = [bind(e, child.output) for e in exprs]
-        self._schema = Schema(
+        # converted plans pass the CPU exec's schema: their exprs are already
+        # bound, so output_name() would yield colN placeholders — and the
+        # kernel bakes the schema into the DeviceBatch it emits
+        self._schema = schema or Schema(
             [
                 StructField(output_name(e0), e.data_type, e.nullable)
                 for e0, e in zip(exprs, self.exprs)
@@ -377,7 +485,7 @@ class TpuHashAggregateExec(Exec):
     def _buffer_ordinal(self, f: AggregateFunction, j: int) -> int:
         return _buffer_ordinal(self.grouping, self.agg_fns, f, j)
 
-    def _make_kernel(self, child_schema: Schema, pre_filter=None):
+    def _make_kernel(self, child_schema: Schema, pre_filter=None, has_nans=True):
         return aggregate_kernel(
             self.mode,
             tuple(self.grouping),
@@ -386,24 +494,39 @@ class TpuHashAggregateExec(Exec):
             self._schema,
             child_schema,
             pre_filter,
+            has_nans,
         )
 
     def execute(self, ctx: ExecContext) -> PartitionSet:
         child = self.children[0]
         pre_filter = None
+
+        def _has_ansi(e) -> bool:
+            from ..expr.cast import Cast as _Cast
+
+            if isinstance(e, _Cast) and e.ansi:
+                return True
+            return any(_has_ansi(c) for c in e.children())
+
         if (
             self.mode in ("partial", "complete")
             and isinstance(child, TpuFilterExec)
             and not child._needs_task
+            # fusing would bypass the filter kernel's ANSI error channel —
+            # keep the filter standalone so cast errors still raise
+            and not _has_ansi(child.condition)
         ):
             # fuse the filter predicate into the aggregate as a liveness
             # mask: a filter's schema equals its child's, so bindings hold,
             # and the compaction gather of every column is skipped entirely
             pre_filter = child.condition
             child = child.children[0]
+        from .. import config as cfg
+
         child_schema = child.output
-        kernel = self._make_kernel(child_schema, pre_filter)
-        merge_jit = self._merge_jit()
+        has_nans = cfg.HAS_NANS.get(ctx.conf)
+        kernel = self._make_kernel(child_schema, pre_filter, has_nans)
+        merge_jit = self._merge_jit(has_nans)
 
         def run(it):
             if self.mode == "partial":
@@ -434,9 +557,9 @@ class TpuHashAggregateExec(Exec):
 
         return child.execute(ctx).map_partitions(run)
 
-    def _merge_jit(self):
+    def _merge_jit(self, has_nans=True):
         return aggregate_merge_kernel(
-            tuple(self.grouping), tuple(self.agg_fns), self._schema
+            tuple(self.grouping), tuple(self.agg_fns), self._schema, has_nans
         )
 
     def node_string(self):
@@ -466,6 +589,7 @@ def aggregate_kernel(
     out_schema: Schema,
     child_schema: Schema,
     pre_filter: Optional[Expression] = None,
+    has_nans: bool = True,
 ):
     """The fused group-aggregate program (update or merge+evaluate), cached
     by the full aggregation signature. ``pre_filter`` fuses a child filter's
@@ -517,6 +641,7 @@ def aggregate_kernel(
                 ops,
                 min_groups=0 if grouping else 1,
                 live_mask=live if pre_filter is not None else None,
+                has_nans=has_nans,
             )
             if mode == "partial":
                 cols = out_keys + out_aggs
@@ -561,11 +686,14 @@ def aggregate_kernel(
         out_schema,
         child_schema,
         pre_filter,
+        has_nans,
     )
     return K.jit_kernel(key, make)
 
 
-def aggregate_merge_kernel(grouping: tuple, agg_fns: tuple, out_schema: Schema):
+def aggregate_merge_kernel(
+    grouping: tuple, agg_fns: tuple, out_schema: Schema, has_nans: bool = True
+):
     """Merge-mode aggregation kernel over (concatenated) partial batches.
     The partial-output layout is keys ++ buffers, so key ordinals and
     _buffer_ordinal line up with the final layout."""
@@ -584,12 +712,15 @@ def aggregate_merge_kernel(grouping: tuple, agg_fns: tuple, out_schema: Schema):
                 in_cols,
                 ops,
                 min_groups=0 if grouping else 1,
+                has_nans=has_nans,
             )
             return DeviceBatch(out_schema, out_keys + out_aggs, num_groups)
 
         return _m
 
-    return K.jit_kernel(("agg_merge", grouping, agg_fns, out_schema), make)
+    return K.jit_kernel(
+        ("agg_merge", grouping, agg_fns, out_schema, has_nans), make
+    )
 
 
 class TpuSortExec(Exec):
@@ -713,8 +844,7 @@ def device_sort_fn(order: List[SortOrder]):
     return K.jit_kernel(("sort", _order_key(order)), make)
 
 
-@jax.jit
-def slice_head(batch: DeviceBatch, take) -> DeviceBatch:
+def _slice_head_impl(batch: DeviceBatch, take) -> DeviceBatch:
     """First min(num_rows, take) rows — shared by limit and TopN (module-
     level jit: one program per batch signature, cached for the process)."""
     take = jnp.minimum(batch.num_rows, take)
@@ -724,6 +854,9 @@ def slice_head(batch: DeviceBatch, take) -> DeviceBatch:
         for c in batch.columns
     ]
     return DeviceBatch(batch.schema, cols, take.astype(jnp.int32))
+
+
+slice_head = K.GuardedJit(_slice_head_impl)
 
 
 class TpuTakeOrderedAndProjectExec(Exec):
@@ -1219,6 +1352,48 @@ class TpuShuffleExchangeExec(Exec):
                 return it
 
             return PartitionSet([make_managed(p) for p in range(nparts)])
+
+        if cfg.ADAPTIVE_ENABLED.get(ctx.conf):
+            # AQE partition coalescing (GpuCustomShuffleReaderExec +
+            # CoalescedPartitionSpec analogue): measured output sizes group
+            # adjacent small partitions into one reduce task; the remaining
+            # group heads yield the merged data, other members yield nothing.
+            # The partition COUNT stays static (this engine's PartitionSets
+            # are fixed-arity) — the win is fewer tiny downstream batches
+            # and idle sibling tasks, the same effect the reference gets.
+            advisory = cfg.ADVISORY_PARTITION_SIZE.get(ctx.conf)
+            aqe_state = {"assign": None}
+
+            def assignment():
+                if aqe_state["assign"] is None:
+                    buckets = materialize()
+                    sizes = [
+                        sum(db.size_bytes() for db in b) for b in buckets
+                    ]
+                    assign: list = [[] for _ in range(nparts)]
+                    group: list = []
+                    gbytes = 0
+                    for p in range(nparts):
+                        if group and gbytes + sizes[p] > advisory:
+                            assign[group[0]] = list(group)
+                            group, gbytes = [], 0
+                        group.append(p)
+                        gbytes += sizes[p]
+                    if group:
+                        assign[group[0]] = list(group)
+                    self.aqe_groups = sum(1 for a in assign if a)
+                    aqe_state["assign"] = assign
+                return aqe_state["assign"]
+
+            def make_aqe(p):
+                def it():
+                    buckets = materialize()
+                    for src in assignment()[p]:
+                        yield from buckets[src]
+
+                return it
+
+            return PartitionSet([make_aqe(p) for p in range(nparts)])
 
         def make(p):
             def it():

@@ -398,7 +398,7 @@ def null_extend_batch(
     ro = None if right_ordinals is None else tuple(right_ordinals)
     fn = K.kernel(
         ("null_extend", out_schema, side, lf, rf, ro),
-        lambda: jax.jit(
+        lambda: K.GuardedJit(
             lambda b, k: _null_extend_impl(out_schema, b, k, side, lf, rf, ro)
         ),
     )

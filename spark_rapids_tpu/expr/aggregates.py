@@ -62,6 +62,10 @@ class AggregateFunction(Expression):
 @dataclass(frozen=True)
 class Sum(AggregateFunction):
     child: Expression
+    # DISTINCT is planned away before execution (planner._rewrite_distinct:
+    # group by keys+child first, then re-aggregate — AggUtils
+    # planAggregateWithOneDistinct analogue), so execution never sees it
+    distinct: bool = False
 
     @property
     def data_type(self) -> DataType:
@@ -102,6 +106,7 @@ class Count(AggregateFunction):
     """count(expr) — counts non-null; count(*) via Count(Literal(1))."""
 
     child: Expression
+    distinct: bool = False
 
     @property
     def data_type(self) -> DataType:
@@ -190,6 +195,7 @@ class Max(AggregateFunction):
 @dataclass(frozen=True)
 class Average(AggregateFunction):
     child: Expression
+    distinct: bool = False
 
     @property
     def data_type(self) -> DataType:
@@ -282,7 +288,155 @@ class Last(AggregateFunction):
         return ("last_ignore_nulls" if self.ignore_nulls else "last",)
 
 
+@dataclass(frozen=True)
+class _CentralMoment(AggregateFunction):
+    """Variance/stddev over (count, sum, sum-of-squares) buffers — all plain
+    segment reductions, so the same fused device kernel serves them.
+
+    Reference: AggregateFunctions.scala GpuStddevSamp/GpuVariancePop family.
+    Spark merges Welford M2 terms; the sum-of-squares formulation here can
+    differ from Spark in low-order float bits for ill-conditioned inputs
+    (both engines here share it, so the differential harness is exact).
+    """
+
+    child: Expression
+
+    sample = False  # n-1 divisor + NaN at n == 1
+    sqrt = False
+
+    @property
+    def data_type(self) -> DataType:
+        return DOUBLE
+
+    @property
+    def update_exprs(self):
+        from .arithmetic import Multiply
+        from .cast import Cast
+
+        c = self.child
+        if not isinstance(c.data_type, DoubleType):
+            c = Cast(c, DOUBLE)
+        return (self.child, c, Multiply(c, c))
+
+    @property
+    def buffer_types(self):
+        return (LONG, DOUBLE, DOUBLE)
+
+    @property
+    def update_ops(self):
+        return ("count", "sum", "sum")
+
+    @property
+    def merge_ops(self):
+        return ("sum", "sum", "sum")
+
+    def evaluate(self, ctx: Ctx, buffers: Sequence[Val]) -> Val:
+        xp = ctx.xp
+        cnt = ctx.broadcast(buffers[0].data).astype(xp.float64)
+        s = ctx.broadcast(buffers[1].data)
+        ss = ctx.broadcast(buffers[2].data)
+        nz = cnt > 0
+        safe_n = xp.where(nz, cnt, 1.0)
+        m = s / safe_n
+        m2 = ss - s * m  # Σ(x−μ)² up to rounding
+        div = (cnt - 1.0) if self.sample else cnt
+        safe_div = xp.where(div > 0, div, 1.0)
+        var = xp.where(div > 0, m2 / safe_div, xp.nan)
+        out = xp.sqrt(xp.maximum(var, 0.0)) if self.sqrt else xp.where(
+            xp.isnan(var), var, xp.maximum(var, 0.0)
+        )
+        return Val(out, nz)
+
+    def __str__(self):
+        return f"{type(self).__name__.lower()}({self.child})"
+
+
+@dataclass(frozen=True)
+class VariancePop(_CentralMoment):
+    sample = False
+    sqrt = False
+
+
+@dataclass(frozen=True)
+class VarianceSamp(_CentralMoment):
+    sample = True
+    sqrt = False
+
+
+@dataclass(frozen=True)
+class StddevPop(_CentralMoment):
+    sample = False
+    sqrt = True
+
+
+@dataclass(frozen=True)
+class StddevSamp(_CentralMoment):
+    sample = True
+    sqrt = True
+
+
+@dataclass(frozen=True)
+class CollectList(AggregateFunction):
+    """collect_list — gathers non-null values per group into an array
+    (reference: AggregateFunctions.scala GpuCollectList). Runs on the CPU
+    engine; the planner falls back (TypeSig-style gate in overrides)."""
+
+    child: Expression
+
+    @property
+    def data_type(self) -> DataType:
+        from ..types import ArrayType
+
+        return ArrayType(self.child.data_type, contains_null=False)
+
+    @property
+    def nullable(self) -> bool:
+        return False  # empty array, never null (Spark semantics)
+
+    @property
+    def update_exprs(self):
+        return (self.child,)
+
+    @property
+    def buffer_types(self):
+        return (self.data_type,)
+
+    @property
+    def update_ops(self):
+        return ("collect_list",)
+
+    @property
+    def merge_ops(self):
+        return ("merge_lists",)
+
+    def __str__(self):
+        return f"collect_list({self.child})"
+
+
+@dataclass(frozen=True)
+class CollectSet(CollectList):
+    """collect_set — collect_list with duplicates removed at evaluation
+    (reference: GpuCollectSet)."""
+
+    @property
+    def update_ops(self):
+        return ("collect_set",)
+
+    @property
+    def merge_ops(self):
+        return ("merge_sets",)
+
+    def __str__(self):
+        return f"collect_set({self.child})"
+
+
 def is_aggregate(e: Expression) -> bool:
     if isinstance(e, AggregateFunction):
         return True
     return any(is_aggregate(c) for c in e.children())
+
+
+def contains_distinct(e: Expression) -> bool:
+    if isinstance(e, AggregateFunction) and getattr(e, "distinct", False):
+        return True
+    return any(contains_distinct(c) for c in e.children())

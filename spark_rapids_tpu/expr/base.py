@@ -45,6 +45,13 @@ from ..types import (
 )
 
 
+class AnsiError(ArithmeticError):
+    """Raised when an ANSI-mode expression (cast overflow, malformed parse)
+    hits invalid input — Spark's SparkArithmeticException/DateTimeException
+    family under ``spark.sql.ansi.enabled`` (reference: ansiEnabled branches
+    in GpuCast.scala and AnsiCastOpSuite)."""
+
+
 @dataclass
 class Val:
     """An evaluation result: data + validity, each either scalar or length-n.
@@ -77,6 +84,41 @@ class Ctx:
         self.columns = columns  # list of Val
         self.num_rows = num_rows  # device scalar when is_device
         self.task = task  # TaskVals (traced) for task-dependent expressions
+        # ANSI error sites: (message, per-row bool mask) accumulated during
+        # device tracing; the project/filter kernels return the masked
+        # any-flags and the exec raises AnsiError host-side after the run
+        self.errors: list = []
+        # rows for which the currently-evaluating expression is actually
+        # selected (vectorized eval runs ALL conditional branches; Spark
+        # evaluates per-row, so errors in untaken branches must not fire)
+        self._err_mask = None
+
+    def error_scope(self, mask):
+        """Context manager: AND ``mask`` into the branch-liveness mask that
+        gates ANSI error sites (If/CaseWhen/Coalesce branch evaluation)."""
+        from contextlib import contextmanager
+
+        @contextmanager
+        def scope():
+            prev = self._err_mask
+            m = self.broadcast_bool(mask)
+            self._err_mask = m if prev is None else (prev & m)
+            try:
+                yield
+            finally:
+                self._err_mask = prev
+
+        return scope()
+
+    def register_error(self, message: str, row_mask) -> None:
+        row_mask = self.broadcast_bool(row_mask)
+        if self._err_mask is not None:
+            row_mask = row_mask & self._err_mask
+        if self.is_device:
+            self.errors.append((message, row_mask))
+        else:
+            if bool(np.any(row_mask)):
+                raise AnsiError(message)
 
     def broadcast(self, data):
         xp = self.xp

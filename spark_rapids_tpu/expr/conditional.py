@@ -51,7 +51,13 @@ class If(Expression):
     def eval(self, ctx: Ctx) -> Val:
         p = self.pred.eval(ctx)
         cond = ctx.broadcast_bool(p.data) & p.full_valid(ctx)  # NULL pred → else
-        return _select(ctx, cond, self.t.eval(ctx), self.f.eval(ctx), self.data_type)
+        # branch evals are scoped so ANSI error sites in the untaken branch
+        # don't fire (Spark evaluates branches per-row)
+        with ctx.error_scope(cond):
+            tv = self.t.eval(ctx)
+        with ctx.error_scope(~cond):
+            fv = self.f.eval(ctx)
+        return _select(ctx, cond, tv, fv, self.data_type)
 
     def __str__(self):
         return f"if({self.pred}, {self.t}, {self.f})"
@@ -77,11 +83,26 @@ class CaseWhen(Expression):
         return self.else_value.data_type
 
     def eval(self, ctx: Ctx) -> Val:
-        result = self.else_value.eval(ctx)
-        for cond_e, val_e in reversed(self.branches):
-            p = cond_e.eval(ctx)
-            cond = ctx.broadcast_bool(p.data) & p.full_valid(ctx)
-            result = _select(ctx, cond, val_e.eval(ctx), result, self.data_type)
+        # effective (disjoint) branch masks, with conditions themselves
+        # scoped by "no earlier branch matched" — Spark's per-row laziness
+        # for ANSI error sites
+        not_prev = None
+        effs = []
+        for cond_e, _ in self.branches:
+            if not_prev is None:
+                p = cond_e.eval(ctx)
+            else:
+                with ctx.error_scope(not_prev):
+                    p = cond_e.eval(ctx)
+            c = ctx.broadcast_bool(p.data) & p.full_valid(ctx)
+            effs.append(c if not_prev is None else (c & not_prev))
+            not_prev = ~c if not_prev is None else (not_prev & ~c)
+        with ctx.error_scope(not_prev):
+            result = self.else_value.eval(ctx)
+        for eff, (_, val_e) in reversed(list(zip(effs, self.branches))):
+            with ctx.error_scope(eff):
+                v = val_e.eval(ctx)
+            result = _select(ctx, eff, v, result, self.data_type)
         return result
 
 
@@ -101,8 +122,20 @@ class Coalesce(Expression):
         return all(e.nullable for e in self.exprs)
 
     def eval(self, ctx: Ctx) -> Val:
-        result = self.exprs[-1].eval(ctx)
-        for e in reversed(self.exprs[:-1]):
-            v = e.eval(ctx)
+        # expr i is only consulted where all earlier exprs were null — scope
+        # ANSI error sites accordingly (Spark short-circuits per-row)
+        prev_null = None
+        vals = []
+        for e in self.exprs:
+            if prev_null is None:
+                v = e.eval(ctx)
+            else:
+                with ctx.error_scope(prev_null):
+                    v = e.eval(ctx)
+            vals.append(v)
+            nv = ~v.full_valid(ctx)
+            prev_null = nv if prev_null is None else (prev_null & nv)
+        result = vals[-1]
+        for v in reversed(vals[:-1]):
             result = _select(ctx, v.full_valid(ctx), v, result, self.data_type)
         return result

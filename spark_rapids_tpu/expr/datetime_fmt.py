@@ -1,0 +1,378 @@
+"""Datetime pattern formatting/parsing — date_format, from_unixtime,
+unix_timestamp, to_date(fmt).
+
+Reference: datetimeExpressions.scala (GpuFromUnixTime, GpuDateFormatClass,
+GpuToUnixTimestamp — cuDF strftime backed, with the plugin gating the
+pattern to a supported subset via DateUtils.tagAndGetCudfFormat; unsupported
+patterns fall back). Same architecture: the Java SimpleDateFormat subset
+below compiles into ONE device byte-layout kernel (digit extraction from
+cast.py) or a fixed-offset parse; patterns outside the subset raise at
+construction so the planner can fall back per-node. UTC session zone only,
+like the reference requires.
+
+Supported tokens: ``yyyy MM dd HH mm ss`` plus literal separators.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Tuple
+
+import numpy as np
+
+from ..types import DataType, LONG, STRING, DateType, TimestampType
+from .base import Ctx, Expression, Literal, Val
+from .cast import (
+    MICROS_PER_DAY,
+    US_PER_SECOND,
+    _digits_msd,
+    _dev_trim,
+    _pack,
+    _parse_digits,
+)
+from .datetime import civil_from_days, days_from_civil
+
+_TOKENS = {"yyyy": 4, "MM": 2, "dd": 2, "HH": 2, "mm": 2, "ss": 2}
+
+
+def parse_pattern(fmt: str) -> Tuple[Tuple[str, str], ...]:
+    """Pattern → ((kind, text)…); kind is 'tok' or 'lit'. Raises ValueError
+    for tokens outside the supported subset (planner check catches it)."""
+    out = []
+    i = 0
+    while i < len(fmt):
+        matched = False
+        for tok in sorted(_TOKENS, key=len, reverse=True):
+            if fmt.startswith(tok, i):
+                out.append(("tok", tok))
+                i += len(tok)
+                matched = True
+                break
+        if matched:
+            continue
+        ch = fmt[i]
+        if ch.isalpha():
+            raise ValueError(
+                f"datetime pattern token at {i!r} in {fmt!r} is outside the "
+                f"supported subset {sorted(_TOKENS)}"
+            )
+        out.append(("lit", ch))
+        i += 1
+    return tuple(out)
+
+
+def pattern_supported(fmt: str) -> bool:
+    try:
+        parse_pattern(fmt)
+        return True
+    except ValueError:
+        return False
+
+
+def _fields_from_micros(xp, micros):
+    micros = micros.astype(xp.int64)
+    days = xp.floor_divide(micros, MICROS_PER_DAY)
+    tod = micros - days * MICROS_PER_DAY
+    y, mo, d = civil_from_days(xp, days.astype(xp.int32))
+    secs = tod // US_PER_SECOND
+    return {
+        "yyyy": y.astype(xp.int64),
+        "MM": mo.astype(xp.int64),
+        "dd": d.astype(xp.int64),
+        "HH": secs // 3600,
+        "mm": (secs // 60) % 60,
+        "ss": secs % 60,
+    }
+
+
+def _format_device(ctx: Ctx, micros, pattern) -> tuple:
+    xp = ctx.xp
+    fields = _fields_from_micros(xp, micros)
+    n = micros.shape[0]
+    slots = []
+    width = 0
+    for kind, text in pattern:
+        if kind == "tok":
+            k = _TOKENS[text]
+            slots.append((_digits_msd(xp, fields[text], k) + 48).astype(xp.uint8))
+            width += k
+        else:
+            slots.append(
+                xp.full((n, 1), ord(text), dtype=xp.uint8)
+            )
+            width += 1
+    mat = xp.concatenate(slots, axis=1)
+    keep = xp.ones(mat.shape, dtype=bool)
+    return _pack(ctx, mat, keep, width)
+
+
+def _format_cpu(micros: int, pattern) -> str:
+    days, tod = divmod(int(micros), MICROS_PER_DAY)
+    z = days + 719468
+    era = z // 146097
+    doe = z - era * 146097
+    yoe = (doe - doe // 1460 + doe // 36524 - doe // 146096) // 365
+    y = yoe + era * 400
+    doy = doe - (365 * yoe + yoe // 4 - yoe // 100)
+    mp = (5 * doy + 2) // 153
+    d = doy - (153 * mp + 2) // 5 + 1
+    mo = mp + (3 if mp < 10 else -9)
+    y += mo <= 2
+    secs = tod // US_PER_SECOND
+    vals = {
+        "yyyy": y,
+        "MM": mo,
+        "dd": d,
+        "HH": secs // 3600,
+        "mm": (secs // 60) % 60,
+        "ss": secs % 60,
+    }
+    out = []
+    for kind, text in pattern:
+        if kind == "tok":
+            out.append(f"{vals[text] % (10 ** _TOKENS[text]):0{_TOKENS[text]}d}")
+        else:
+            out.append(text)
+    return "".join(out)
+
+
+@dataclass(frozen=True)
+class DateFormatClass(Expression):
+    """``date_format(ts, fmt)`` — UTC."""
+
+    child: Expression
+    fmt: Expression  # literal
+
+    @property
+    def data_type(self) -> DataType:
+        return STRING
+
+    @property
+    def nullable(self) -> bool:
+        return self.child.nullable
+
+    def _micros(self, ctx, v):
+        xp = ctx.xp
+        data = ctx.broadcast(v.data)
+        if isinstance(self.child.data_type, DateType):
+            return data.astype(xp.int64) * MICROS_PER_DAY
+        return data.astype(xp.int64)
+
+    def eval(self, ctx: Ctx) -> Val:
+        v = self.child.eval(ctx)
+        pattern = parse_pattern(self.fmt.value)
+        micros = self._micros(ctx, v)
+        if ctx.is_device:
+            data, lens = _format_device(ctx, micros, pattern)
+            return Val(data, v.valid, lens)
+        out = np.asarray(
+            [_format_cpu(m, pattern) for m in micros], dtype=object
+        )
+        return Val(out, v.valid)
+
+    def __str__(self):
+        return f"date_format({self.child}, {self.fmt})"
+
+
+@dataclass(frozen=True)
+class FromUnixTime(Expression):
+    """``from_unixtime(seconds, fmt)`` — UTC."""
+
+    child: Expression
+    fmt: Expression
+
+    @property
+    def data_type(self) -> DataType:
+        return STRING
+
+    @property
+    def nullable(self) -> bool:
+        return self.child.nullable
+
+    def eval(self, ctx: Ctx) -> Val:
+        v = self.child.eval(ctx)
+        pattern = parse_pattern(self.fmt.value)
+        xp = ctx.xp
+        micros = ctx.broadcast(v.data).astype(xp.int64) * US_PER_SECOND
+        if ctx.is_device:
+            data, lens = _format_device(ctx, micros, pattern)
+            return Val(data, v.valid, lens)
+        out = np.asarray(
+            [_format_cpu(m, pattern) for m in micros], dtype=object
+        )
+        return Val(out, v.valid)
+
+
+def _parse_device(ctx: Ctx, val: Val, pattern):
+    """Fixed-offset parse of the pattern → (micros, ok). Tokens sit at
+    static byte offsets (all supported tokens are fixed-width)."""
+    from .strings import dev_str
+
+    xp = ctx.xp
+    ch, lengths = dev_str(ctx, val)
+    start, end, has_any = _dev_trim(ctx, ch, lengths)
+    total = sum(_TOKENS[t] if k == "tok" else 1 for k, t in pattern)
+    ok = has_any & ((end - start) == total)
+    # tokens absent from the pattern default like Java: month/day 1, rest 0
+    fields = {
+        t: xp.full(ctx.n, 1 if t in ("MM", "dd") else 0, dtype=xp.int64)
+        for t in _TOKENS
+    }
+    off = 0
+    for kind, text in pattern:
+        if kind == "tok":
+            k = _TOKENS[text]
+            v, seg_ok = _parse_digits(ctx, ch, start + off, start + off + k)
+            fields[text] = v
+            ok = ok & seg_ok
+            off += k
+        else:
+            from .cast import _char_at
+
+            ok = ok & (_char_at(ctx, ch, start + off) == ord(text))
+            off += 1
+    y = fields["yyyy"].astype(xp.int32)
+    mo = xp.clip(fields["MM"], 1, 12).astype(xp.int32)
+    d = xp.clip(fields["dd"], 1, 31).astype(xp.int32)
+    ok = (
+        ok
+        & (fields["MM"] >= 1)
+        & (fields["MM"] <= 12)
+        & (fields["dd"] >= 1)
+        & (fields["dd"] <= 31)
+        & (fields["HH"] < 24)
+        & (fields["mm"] < 60)
+        & (fields["ss"] < 60)
+    )
+    days = days_from_civil(xp, y, mo, d).astype(xp.int64)
+    micros = days * MICROS_PER_DAY + (
+        fields["HH"] * 3600 + fields["mm"] * 60 + fields["ss"]
+    ) * US_PER_SECOND
+    return micros, ok
+
+
+def _parse_cpu(s, pattern):
+    if s is None:
+        return None
+    s = s.strip()
+    total = sum(_TOKENS[t] if k == "tok" else 1 for k, t in pattern)
+    if len(s) != total:
+        return None
+    fields = {t: (1 if t in ("MM", "dd") else 0) for t in _TOKENS}
+    off = 0
+    for kind, text in pattern:
+        if kind == "tok":
+            k = _TOKENS[text]
+            seg = s[off : off + k]
+            if not (seg.isascii() and seg.isdigit()):
+                return None
+            fields[text] = int(seg)
+            off += k
+        else:
+            if s[off] != text:
+                return None
+            off += 1
+    if not (
+        1 <= fields["MM"] <= 12
+        and 1 <= fields["dd"] <= 31
+        and fields["HH"] < 24
+        and fields["mm"] < 60
+        and fields["ss"] < 60
+    ):
+        return None
+
+    def dfc(y, m, d):
+        y -= m <= 2
+        era = y // 400
+        yoe = y - era * 400
+        doy = (153 * (m + (-3 if m > 2 else 9)) + 2) // 5 + d - 1
+        doe = yoe * 365 + yoe // 4 - yoe // 100 + doy
+        return era * 146097 + doe - 719468
+
+    days = dfc(fields["yyyy"], fields["MM"], fields["dd"])
+    return days * MICROS_PER_DAY + (
+        fields["HH"] * 3600 + fields["mm"] * 60 + fields["ss"]
+    ) * US_PER_SECOND
+
+
+@dataclass(frozen=True)
+class ToUnixTimestamp(Expression):
+    """``unix_timestamp(str, fmt)`` → seconds (LONG), null on mismatch."""
+
+    child: Expression
+    fmt: Expression
+
+    @property
+    def data_type(self) -> DataType:
+        return LONG
+
+    def eval(self, ctx: Ctx) -> Val:
+        v = self.child.eval(ctx)
+        pattern = parse_pattern(self.fmt.value)
+        if isinstance(self.child.data_type, (DateType, TimestampType)):
+            from .cast import Cast
+
+            tv = Cast(self.child, TimestampType()).eval(ctx)
+            xp = ctx.xp
+            return Val(
+                xp.floor_divide(ctx.broadcast(tv.data).astype(xp.int64), US_PER_SECOND),
+                tv.valid,
+            )
+        if ctx.is_device:
+            micros, ok = _parse_device(ctx, v, pattern)
+            xp = ctx.xp
+            return Val(
+                xp.floor_divide(micros, US_PER_SECOND),
+                v.full_valid(ctx) & ok,
+            )
+        from .strings import _cpu_strs
+
+        s = _cpu_strs(ctx, v)
+        valid = ctx.broadcast_bool(v.valid)
+        out = np.zeros(ctx.n, dtype=np.int64)
+        ok = np.zeros(ctx.n, dtype=bool)
+        for i in range(ctx.n):
+            if not valid[i]:
+                continue
+            m = _parse_cpu(s[i], pattern)
+            if m is not None:
+                out[i] = m // US_PER_SECOND
+                ok[i] = True
+        return Val(out, valid & ok)
+
+
+@dataclass(frozen=True)
+class ParseToDate(Expression):
+    """``to_date(str, fmt)`` with an explicit pattern (without one, the
+    planner emits a plain Cast to DATE)."""
+
+    child: Expression
+    fmt: Expression
+
+    @property
+    def data_type(self) -> DataType:
+        from ..types import DATE
+
+        return DATE
+
+    def eval(self, ctx: Ctx) -> Val:
+        v = self.child.eval(ctx)
+        pattern = parse_pattern(self.fmt.value)
+        xp = ctx.xp
+        if ctx.is_device:
+            micros, ok = _parse_device(ctx, v, pattern)
+            days = xp.floor_divide(micros, MICROS_PER_DAY).astype(xp.int32)
+            return Val(days, v.full_valid(ctx) & ok)
+        from .strings import _cpu_strs
+
+        s = _cpu_strs(ctx, v)
+        valid = ctx.broadcast_bool(v.valid)
+        out = np.zeros(ctx.n, dtype=np.int32)
+        ok = np.zeros(ctx.n, dtype=bool)
+        for i in range(ctx.n):
+            if not valid[i]:
+                continue
+            m = _parse_cpu(s[i], pattern)
+            if m is not None:
+                out[i] = m // MICROS_PER_DAY
+                ok[i] = True
+        return Val(out, valid & ok)

@@ -1,0 +1,112 @@
+"""User-defined functions — the L7 interop layer.
+
+Reference: GpuArrowEvalPythonExec.scala:391 (Arrow-streamed pandas UDFs,
+CPU-side python workers), GpuUserDefinedFunction/GpuScalaUDF + the RapidsUDF
+interface (user code that produces device columns directly), and the
+udf-compiler (bytecode → Catalyst, so simple UDFs run as normal expressions).
+
+TPU-first mapping:
+
+* ``JaxUdf`` — the RapidsUDF analogue, strictly better on this stack: the
+  user supplies a jax-traceable ``fn(*arrays) -> array`` and it is traced
+  INTO the enclosing fused projection kernel — zero interop cost, fuses with
+  surrounding expressions, compiles to the same XLA program. (The reference's
+  RapidsUDF merely calls back into cuDF; here the UDF body joins the fusion.)
+* ``PythonUdf`` — arbitrary per-row python; runs on the CPU engine over the
+  host Arrow batches (the Arrow-eval seam without a separate worker process —
+  this engine IS python). The planner falls back per-node with a reason,
+  exactly like rows the reference can't translate via its udf-compiler.
+
+Null semantics: both are null-propagating over their inputs (Spark UDFs see
+None instead — ``PythonUdf`` passes None through to the callable like
+pyspark; ``JaxUdf`` uses validity masks, so the fn sees zero-filled slots
+and must be total).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Callable, Tuple
+
+import numpy as np
+
+from ..types import DataType
+from .base import Ctx, Expression, Val, and_valid
+
+
+@dataclass(frozen=True)
+class JaxUdf(Expression):
+    """Device-capable UDF: ``fn`` maps backend arrays → one backend array.
+
+    Identity-hashed via the function object: the kernel cache treats each
+    registered UDF as its own operator (re-registering recompiles, same as
+    cuDF treats distinct native UDF instances)."""
+
+    fn: Callable
+    return_type: DataType
+    args: Tuple[Expression, ...]
+    name: str = "jax_udf"
+
+    @property
+    def data_type(self) -> DataType:
+        return self.return_type
+
+    def eval(self, ctx: Ctx) -> Val:
+        vals = [a.eval(ctx) for a in self.args]
+        arrays = [v.full_data(ctx) for v in vals]
+        out = self.fn(*arrays)
+        if not ctx.is_device:
+            out = np.asarray(out)  # jnp-written fns return jax arrays
+            if out.dtype != self.return_type.np_dtype:
+                out = out.astype(self.return_type.np_dtype)
+        else:
+            out = ctx.broadcast(out).astype(self.return_type.np_dtype)
+        valid = and_valid(ctx, *[v.valid for v in vals]) if vals else None
+        if valid is None:
+            valid = ctx.broadcast_bool(True)
+        return Val(out, valid)
+
+    def __str__(self):
+        return f"{self.name}({', '.join(map(str, self.args))})"
+
+
+@dataclass(frozen=True)
+class PythonUdf(Expression):
+    """Row-at-a-time python UDF (CPU engine; planner falls back)."""
+
+    fn: Callable
+    return_type: DataType
+    args: Tuple[Expression, ...]
+    name: str = "udf"
+
+    @property
+    def data_type(self) -> DataType:
+        return self.return_type
+
+    def eval(self, ctx: Ctx) -> Val:
+        assert not ctx.is_device, "python UDFs execute on the CPU engine"
+        from ..types import StringType
+
+        vals = [a.eval(ctx) for a in self.args]
+        cols = []
+        for v in vals:
+            d = np.broadcast_to(np.asarray(v.data), (ctx.n,))
+            m = ctx.broadcast_bool(v.valid)
+            cols.append((d, m))
+        is_str = isinstance(self.return_type, StringType)
+        out = np.empty(ctx.n, dtype=object if is_str else self.return_type.np_dtype)
+        if not is_str:
+            out[:] = 0
+        ok = np.zeros(ctx.n, dtype=bool)
+        for i in range(ctx.n):
+            row = [
+                (d[i].item() if hasattr(d[i], "item") else d[i]) if m[i] else None
+                for d, m in cols
+            ]
+            r = self.fn(*row)
+            if r is not None:
+                out[i] = r
+                ok[i] = True
+        return Val(out, ok)
+
+    def __str__(self):
+        return f"{self.name}({', '.join(map(str, self.args))})"

@@ -235,6 +235,11 @@ class Column:
         return Column(BitwiseXor(self.expr, _e(o)))
 
     # strings (pyspark Column API)
+    def rlike(self, pattern: str) -> "Column":
+        from .expr.strings_ext import RLike
+
+        return Column(RLike(self.expr, _e(pattern)))
+
     def like(self, pattern: str) -> "Column":
         return Column(Like(self.expr, _e(pattern)))
 
@@ -362,6 +367,62 @@ def last(c, ignorenulls: bool = False) -> Column:
     return Column(Last(_e(c), ignorenulls))
 
 
+def count_distinct(c) -> Column:
+    return Column(Count(_e(c), distinct=True))
+
+
+countDistinct = count_distinct
+
+
+def sum_distinct(c) -> Column:
+    return Column(Sum(_e(c), distinct=True))
+
+
+sumDistinct = sum_distinct
+
+
+def stddev(c) -> Column:
+    from .expr.aggregates import StddevSamp
+
+    return Column(StddevSamp(_e(c)))
+
+
+stddev_samp = stddev
+
+
+def stddev_pop(c) -> Column:
+    from .expr.aggregates import StddevPop
+
+    return Column(StddevPop(_e(c)))
+
+
+def variance(c) -> Column:
+    from .expr.aggregates import VarianceSamp
+
+    return Column(VarianceSamp(_e(c)))
+
+
+var_samp = variance
+
+
+def var_pop(c) -> Column:
+    from .expr.aggregates import VariancePop
+
+    return Column(VariancePop(_e(c)))
+
+
+def collect_list(c) -> Column:
+    from .expr.aggregates import CollectList
+
+    return Column(CollectList(_e(c)))
+
+
+def collect_set(c) -> Column:
+    from .expr.aggregates import CollectSet
+
+    return Column(CollectSet(_e(c)))
+
+
 def when(condition: Column, value) -> "WhenBuilder":
     return WhenBuilder([(condition.expr, _e(value))])
 
@@ -449,8 +510,76 @@ def repeat(c, n: int) -> Column:
     return Column(StringRepeat(_e(c), _e(n)))
 
 
-def regexp_replace(c, search: str, replacement: str) -> Column:
-    raise NotImplementedError("regex replace is not supported (reference gates it too)")
+def regexp_replace(c, pattern: str, replacement: str) -> Column:
+    from .expr.strings_ext import RegExpReplace
+
+    return Column(RegExpReplace(_e(c), _e(pattern), _e(replacement)))
+
+
+def regexp_extract(c, pattern: str, idx: int = 1) -> Column:
+    from .expr.strings_ext import RegExpExtract
+
+    return Column(RegExpExtract(_e(c), _e(pattern), idx))
+
+
+def split(c, pattern: str, limit: int = -1) -> Column:
+    from .expr.strings_ext import StringSplit
+
+    return Column(StringSplit(_e(c), _e(pattern), limit))
+
+
+def concat_ws(sep: str, *cols) -> Column:
+    from .expr.strings_ext import ConcatWs
+    from .types import STRING
+
+    # Spark coerces concat_ws args to string (a string→string cast is the
+    # identity at eval time, so wrapping unconditionally is free)
+    args = tuple(Cast(_e(c), STRING) for c in cols)
+    return Column(ConcatWs(_e(sep), args))
+
+
+def translate(c, matching: str, replace_: str) -> Column:
+    from .expr.strings_ext import StringTranslate
+
+    return Column(StringTranslate(_e(c), _e(matching), _e(replace_)))
+
+
+def get_json_object(c, path: str) -> Column:
+    from .expr.strings_ext import GetJsonObject
+
+    return Column(GetJsonObject(_e(c), _e(path)))
+
+
+def date_format(c, fmt: str) -> Column:
+    from .expr.datetime_fmt import DateFormatClass
+
+    return Column(DateFormatClass(_e(c), _e(fmt)))
+
+
+def from_unixtime(c, fmt: str = "yyyy-MM-dd HH:mm:ss") -> Column:
+    from .expr.datetime_fmt import FromUnixTime
+
+    return Column(FromUnixTime(_e(c), _e(fmt)))
+
+
+def to_date(c, fmt=None) -> Column:
+    from .types import DATE
+
+    if fmt is None:
+        return Column(Cast(_e(c), DATE))
+    from .expr.datetime_fmt import ParseToDate
+
+    return Column(ParseToDate(_e(c), _e(fmt)))
+
+
+def to_timestamp(c, fmt=None) -> Column:
+    from .types import TIMESTAMP
+
+    if fmt is None:
+        return Column(Cast(_e(c), TIMESTAMP))
+    from .expr.datetime_fmt import ToUnixTimestamp
+
+    return Column(Cast(ToUnixTimestamp(_e(c), _e(fmt)), TIMESTAMP))
 
 
 def replace(c, search, replacement) -> Column:
@@ -526,8 +655,17 @@ def second(c) -> Column:
     return Column(Second(_e(c)))
 
 
-def unix_timestamp(c) -> Column:
-    return Column(UnixTimestamp(_e(c)))
+def unix_timestamp(c=None, fmt: str = None) -> Column:
+    if c is None:
+        raise NotImplementedError(
+            "unix_timestamp() of the current time is not supported; pass a "
+            "timestamp/string column"
+        )
+    if fmt is None:
+        return Column(UnixTimestamp(_e(c)))
+    from .expr.datetime_fmt import ToUnixTimestamp
+
+    return Column(ToUnixTimestamp(_e(c), _e(fmt)))
 
 
 # math functions
@@ -725,3 +863,51 @@ def posexplode(c) -> Column:
     from .expr.complex import Explode
 
     return Column(Explode(_e(c), position=True))
+
+
+# ── user-defined functions (L7; reference GpuArrowEvalPythonExec/RapidsUDF) ─
+def udf(f=None, returnType=None):
+    """Row-at-a-time python UDF (CPU engine; the plan falls back per-node).
+    Usable directly or as a decorator: ``@udf(returnType=DOUBLE)``."""
+    from .types import STRING as _S
+
+    rt = returnType if returnType is not None else _S
+
+    def wrap(fn):
+        from .expr.udf import PythonUdf
+
+        def call(*cols) -> Column:
+            return Column(
+                PythonUdf(fn, rt, tuple(_e(c) for c in cols), fn.__name__)
+            )
+
+        call.__name__ = fn.__name__
+        return call
+
+    if f is None:
+        return wrap
+    return wrap(f)
+
+
+def jax_udf(f=None, returnType=None):
+    """Device UDF: ``fn(*arrays) -> array`` written with jax.numpy; traced
+    into the enclosing fused kernel (the RapidsUDF analogue — but the body
+    joins XLA fusion instead of calling out to a native library)."""
+    from .types import DOUBLE as _D
+
+    rt = returnType if returnType is not None else _D
+
+    def wrap(fn):
+        from .expr.udf import JaxUdf
+
+        def call(*cols) -> Column:
+            return Column(
+                JaxUdf(fn, rt, tuple(_e(c) for c in cols), fn.__name__)
+            )
+
+        call.__name__ = fn.__name__
+        return call
+
+    if f is None:
+        return wrap
+    return wrap(f)

@@ -1,21 +1,221 @@
-"""File writers — reference: GpuParquetFileFormat.scala, GpuOrcFileFormat
-.scala, GpuFileFormatWriter.scala (single-directory writer; dynamic-partition
-writing follows with the writer rework)."""
+"""File write path — the L5 write layer as PLAN NODES, not a driver-side
+collect.
+
+Reference: GpuDataWritingCommandExec.scala, GpuFileFormatWriter.scala (345),
+GpuFileFormatDataWriter.scala (419: SingleDirectoryDataWriter and
+DynamicPartitionDataWriter), GpuParquetFileFormat/GpuOrcFileFormat. The
+reference encodes batches on device via cudf TableWriter; here the columnar
+data is Arrow on the host side of the D2H transition and pyarrow encodes —
+the same split as the scan layer (no device Parquet codec on TPU).
+
+``CpuWriteFilesExec`` consumes each child partition *inside the partition
+task* (concurrently across partitions, never funneled through the driver),
+writing ``part-{pid}-{uuid}`` files; with ``partition_by`` each task splits
+its batches by partition-value tuple and appends to per-directory writers
+(``key=value/`` Hive layout — DynamicPartitionDataWriter). The exec's output
+is one stats row per written file (filename, rows) — the write-stats tracker
+(BasicColumnarWriteStatsTracker analogue)."""
 from __future__ import annotations
 
 import os
+import threading
 import uuid
+from typing import List, Optional
 
 import pyarrow as pa
 import pyarrow.csv as pacsv
 import pyarrow.orc as paorc
 import pyarrow.parquet as papq
 
+from ..plan.physical import Exec, ExecContext, PartitionSet
+from ..types import LONG, STRING, Schema, StructField
+
+STATS_SCHEMA = Schema(
+    [StructField("filename", STRING, False), StructField("num_rows", LONG, False)]
+)
+
+_NAN = float("nan")  # canonical NaN for partition-combo dedup
+
+
+class _FormatWriter:
+    """One open output file, append-able batch by batch."""
+
+    def __init__(self, fmt: str, path: str, schema: pa.Schema, options: dict):
+        self.path = path
+        self.fmt = fmt
+        self.rows = 0
+        if fmt == "parquet":
+            self._w = papq.ParquetWriter(path, schema)
+        elif fmt == "orc":
+            self._w = paorc.ORCWriter(path)
+        elif fmt == "csv":
+            include_header = str(options.get("header", "false")).lower() in (
+                "true",
+                "1",
+            )
+            self._w = pacsv.CSVWriter(
+                path, schema, write_options=pacsv.WriteOptions(include_header=include_header)
+            )
+        else:
+            raise ValueError(fmt)
+
+    def write(self, rb: pa.RecordBatch):
+        self.rows += rb.num_rows
+        if self.fmt == "orc":
+            self._w.write(pa.Table.from_batches([rb]))
+        else:
+            self._w.write_batch(rb)
+
+    def close(self):
+        self._w.close()
+
+
+def _fmt_value(v) -> str:
+    """Hive partition-directory encoding of one value (escaped like Spark's
+    PartitioningUtils.escapePathName so read-back round-trips)."""
+    from .files import escape_path_name
+
+    if v is None:
+        return "__HIVE_DEFAULT_PARTITION__"
+    if isinstance(v, bool):
+        return "true" if v else "false"
+    if isinstance(v, float) and v != v:
+        return "NaN"  # java Double.toString
+    return escape_path_name(str(v))
+
+
+class CpuWriteFilesExec(Exec):
+    """The write plan node (GpuDataWritingCommandExec analogue)."""
+
+    def __init__(
+        self,
+        child: Exec,
+        path: str,
+        fmt: str,
+        partition_by: List[str],
+        options: dict,
+    ):
+        super().__init__([child])
+        self.path = path
+        self.fmt = fmt
+        self.partition_by = list(partition_by)
+        self.w_options = dict(options)
+        child_schema = child.output
+        missing = [c for c in self.partition_by if c not in child_schema.names]
+        if missing:
+            raise ValueError(f"partitionBy columns not in schema: {missing}")
+        self._data_names = [
+            n for n in child_schema.names if n not in self.partition_by
+        ]
+
+    @property
+    def output(self) -> Schema:
+        return STATS_SCHEMA
+
+    def execute(self, ctx: ExecContext) -> PartitionSet:
+        child_parts = self.children[0].execute(ctx)
+        ext = {"parquet": ".parquet", "orc": ".orc", "csv": ".csv"}[self.fmt]
+
+        def make(pid: int, thunk):
+            def it():
+                writers: dict = {}
+                run_id = uuid.uuid4().hex[:12]
+
+                def writer_for(subdir: str, schema: pa.Schema) -> _FormatWriter:
+                    w = writers.get(subdir)
+                    if w is None:
+                        d = os.path.join(self.path, subdir) if subdir else self.path
+                        os.makedirs(d, exist_ok=True)
+                        fname = f"part-{pid:05d}-{run_id}{ext}"
+                        w = _FormatWriter(
+                            self.fmt, os.path.join(d, fname), schema, self.w_options
+                        )
+                        writers[subdir] = w
+                    return w
+
+                for rb in thunk():
+                    if rb.num_rows == 0:
+                        continue
+                    if not self.partition_by:
+                        writer_for("", rb.schema).write(rb)
+                        continue
+                    # dynamic partitioning: group rows by partition tuple
+                    # (DynamicPartitionDataWriter's sorted-loop analogue)
+                    tbl = pa.Table.from_batches([rb])
+                    keys = [rb.column(rb.schema.get_field_index(c)) for c in self.partition_by]
+
+                    def canon(v):
+                        # one canonical NaN object so set-dedup of combos
+                        # works (fresh as_py() NaNs are !=-distinct)
+                        if isinstance(v, float) and v != v:
+                            return _NAN
+                        return v
+
+                    combos = set(
+                        tuple(canon(k[i].as_py()) for k in keys)
+                        for i in range(rb.num_rows)
+                    )
+                    import pyarrow.compute as pc
+
+                    data_tbl = tbl.select(self._data_names)
+                    for combo in sorted(
+                        combos, key=lambda c: tuple((x is None, str(x)) for x in c)
+                    ):
+                        mask = None
+                        for cname, v in zip(self.partition_by, combo):
+                            colarr = tbl.column(cname)
+                            if v is None:
+                                m = pc.is_null(colarr)
+                            elif isinstance(v, float) and v != v:
+                                # NaN != NaN under pc.equal — match explicitly
+                                m = pc.is_nan(colarr)
+                            else:
+                                m = pc.equal(colarr, pa.scalar(v, type=colarr.type))
+                            m = pc.fill_null(m, False)
+                            mask = m if mask is None else pc.and_(mask, m)
+                        sub = data_tbl.filter(mask)
+                        subdir = os.path.join(
+                            *[
+                                f"{c}={_fmt_value(v)}"
+                                for c, v in zip(self.partition_by, combo)
+                            ]
+                        )
+                        for srb in sub.combine_chunks().to_batches():
+                            if srb.num_rows:
+                                writer_for(subdir, srb.schema).write(srb)
+                for w in writers.values():
+                    w.close()
+                stats = pa.record_batch(
+                    {
+                        "filename": pa.array(
+                            [w.path for w in writers.values()], type=pa.string()
+                        ),
+                        "num_rows": pa.array(
+                            [w.rows for w in writers.values()], type=pa.int64()
+                        ),
+                    }
+                )
+                yield stats
+
+            return it
+
+        return PartitionSet(
+            [make(i, t) for i, t in enumerate(child_parts.parts)]
+        )
+
+    def node_string(self):
+        pb = f" partitionBy={self.partition_by}" if self.partition_by else ""
+        return f"WriteFiles {self.fmt} {self.path}{pb}"
+
 
 class DataFrameWriter:
+    """df.write — executes a write PLAN (scan→…→WriteFilesExec), with the
+    encode work running per-partition in executor tasks."""
+
     def __init__(self, df):
         self._df = df
         self._mode = "error"
+        self._partition_by: List[str] = []
         self._options: dict = {}
 
     def mode(self, m: str) -> "DataFrameWriter":
@@ -26,7 +226,13 @@ class DataFrameWriter:
         self._options[k] = v
         return self
 
-    def _prep(self, path: str):
+    def partition_by(self, *cols: str) -> "DataFrameWriter":
+        self._partition_by = list(cols)
+        return self
+
+    partitionBy = partition_by
+
+    def _write(self, path: str, fmt: str):
         if os.path.exists(path):
             if self._mode in ("error", "errorifexists"):
                 raise FileExistsError(path)
@@ -35,29 +241,24 @@ class DataFrameWriter:
 
                 shutil.rmtree(path)
             elif self._mode == "ignore":
-                return None
+                return
         os.makedirs(path, exist_ok=True)
-        return os.path.join(path, f"part-00000-{uuid.uuid4().hex}")
+        session = self._df._session
+        from ..plan import logical as L
+
+        lp = L.WriteFiles(
+            self._df._plan, path, fmt, list(self._partition_by), dict(self._options)
+        )
+        stats = session._execute(lp)
+        # driver commit marker (FileFormatWriter's _SUCCESS)
+        open(os.path.join(path, "_SUCCESS"), "w").close()
+        return stats
 
     def parquet(self, path: str):
-        f = self._prep(path)
-        if f is None:
-            return
-        papq.write_table(self._df.to_arrow(), f + ".parquet")
+        return self._write(path, "parquet")
 
     def orc(self, path: str):
-        f = self._prep(path)
-        if f is None:
-            return
-        paorc.write_table(self._df.to_arrow(), f + ".orc")
+        return self._write(path, "orc")
 
     def csv(self, path: str):
-        f = self._prep(path)
-        if f is None:
-            return
-        include_header = str(self._options.get("header", "false")).lower() in ("true", "1")
-        pacsv.write_csv(
-            self._df.to_arrow(),
-            f + ".csv",
-            pacsv.WriteOptions(include_header=include_header),
-        )
+        return self._write(path, "csv")

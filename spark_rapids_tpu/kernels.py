@@ -53,9 +53,55 @@ def kernel(key: tuple, builder: Callable):
     return fn
 
 
+_COMPILE_LOCK = threading.Lock()
+
+
+class GuardedJit:
+    """``jax.jit`` wrapper that serializes first-time compilations.
+
+    The session runs partition tasks on a thread pool; concurrent XLA-CPU
+    compilations from those worker threads segfault once enough compiled
+    state has accumulated (deterministic SIGSEGV inside
+    ``backend_compile_and_load`` on full-suite runs). First call per input
+    signature takes a global compile lock; the compiled fast path stays
+    lock-free."""
+
+    __slots__ = ("_fn", "_seen")
+
+    def __init__(self, fn):
+        self._fn = jax.jit(fn)
+        self._seen = set()
+
+    def __call__(self, *args):
+        leaves, treedef = jax.tree_util.tree_flatten(args)
+        sig = (
+            treedef,
+            tuple(
+                (tuple(x.shape), str(x.dtype))
+                if hasattr(x, "shape")
+                else repr(x)
+                for x in leaves
+            ),
+        )
+        if sig in self._seen:
+            return self._fn(*args)
+        with _COMPILE_LOCK:
+            out = self._fn(*args)
+        self._seen.add(sig)
+        return out
+
+    def _cache_size(self):
+        cs = getattr(self._fn, "_cache_size", None)
+        return cs() if callable(cs) else 0
+
+
+def guarded_jit(fn) -> GuardedJit:
+    return GuardedJit(fn)
+
+
 def jit_kernel(key: tuple, make_fn: Callable):
-    """Shorthand: cache ``jax.jit(make_fn())`` under ``key``."""
-    return kernel(key, lambda: jax.jit(make_fn()))
+    """Shorthand: cache ``GuardedJit(make_fn())`` under ``key``."""
+    return kernel(key, lambda: GuardedJit(make_fn()))
 
 
 def schema_key(schema) -> tuple:
@@ -96,6 +142,8 @@ def enable_persistent_cache(path: str | None = None) -> None:
     runs, test sessions) reuse XLA executables."""
     global _PERSISTENT_ENABLED
     if _PERSISTENT_ENABLED:
+        return
+    if os.environ.get("SPARK_RAPIDS_TPU_NO_PERSISTENT_CACHE"):
         return
     cache_dir = path or os.environ.get(
         "SPARK_RAPIDS_TPU_COMPILE_CACHE",

@@ -26,7 +26,7 @@ import jax
 import jax.numpy as jnp
 
 from ..columnar.device import DeviceBatch, DeviceColumn
-from ..types import DoubleType, FloatType, StringType
+from ..types import LONG, DoubleType, FloatType, StringType
 from .gather import gather_column
 from .scan import first_k_positions, seg_end_flags, segscan
 from .sortkeys import batch_radix_words, segment_starts, sort_permutation
@@ -34,11 +34,12 @@ from .sortkeys import batch_radix_words, segment_starts, sort_permutation
 _BIG = jnp.int32(2**31 - 1)
 
 
-def _normalize_float(col: DeviceColumn) -> DeviceColumn:
+def _normalize_float(col: DeviceColumn, has_nans: bool = True) -> DeviceColumn:
     if isinstance(col.dtype, (FloatType, DoubleType)):
         x = col.data
         x = jnp.where(x == 0, jnp.zeros_like(x), x)
-        x = jnp.where(jnp.isnan(x), jnp.full_like(x, jnp.nan), x)
+        if has_nans:  # spark.rapids.sql.hasNans=false skips canonicalization
+            x = jnp.where(jnp.isnan(x), jnp.full_like(x, jnp.nan), x)
         return DeviceColumn(col.dtype, x, col.validity, col.lengths)
     return col
 
@@ -101,6 +102,7 @@ def group_aggregate(
     ops: list[str],
     min_groups: int = 0,
     live_mask=None,
+    has_nans: bool = True,
 ) -> tuple[list[DeviceColumn], list[DeviceColumn], jax.Array]:
     """Group ``batch`` rows by key columns; reduce ``agg_columns[i]`` with
     ``ops[i]``. Returns (key cols, agg cols, num_groups) — all [capacity]
@@ -115,7 +117,7 @@ def group_aggregate(
     cap = batch.capacity
     if not batch.columns and agg_columns:
         cap = agg_columns[0].capacity  # ungrouped: key-less work batch
-    keys = [_normalize_float(batch.columns[i]) for i in key_ordinals]
+    keys = [_normalize_float(batch.columns[i], has_nans) for i in key_ordinals]
     if not keys:
         return _ungrouped_aggregate(batch, agg_columns, ops, cap, live_mask)
 
@@ -175,10 +177,17 @@ def group_aggregate(
                 data = jnp.where(ok, data, jnp.zeros_like(data))
             out_aggs.append(DeviceColumn(col.dtype, data, valid_out, lengths))
             continue
-        assert not is_str, f"string op {op} requires an index-pick"
+        # count only reads validity, so string inputs are fine there
+        assert not (is_str and op != "count"), (
+            f"string op {op} requires an index-pick"
+        )
         data = scan_vals[end_pos]
         valid_out = scan_valid[end_pos] & group_live
-        if op in ("min", "max") and jnp.issubdtype(sc.data.dtype, jnp.floating):
+        if (
+            op in ("min", "max")
+            and jnp.issubdtype(sc.data.dtype, jnp.floating)
+            and has_nans
+        ):
             had_nan = _had_nan_scan(sc.data, v, starts)[end_pos]
             if op == "max":
                 data = jnp.where(had_nan, jnp.nan, data)
@@ -195,7 +204,9 @@ def group_aggregate(
         if op == "count":
             valid_out = group_live  # count is never null
         data = _mask_data(data, group_live)
-        out_aggs.append(DeviceColumn(col.dtype, data, valid_out, None))
+        # count's output is a LONG regardless of the input column's type
+        out_dtype = LONG if op == "count" else col.dtype
+        out_aggs.append(DeviceColumn(out_dtype, data, valid_out, None))
     return out_keys, out_aggs, num_groups
 
 
@@ -218,7 +229,7 @@ def _ungrouped_aggregate(batch, agg_columns, ops, cap, live_mask=None):
         data, valid = col.data, col.validity & live
         is_str = isinstance(col.dtype, StringType)
 
-        def place(scalar, ok, lengths_scalar=None):
+        def place(scalar, ok, lengths_scalar=None, out_dtype=None):
             """Put the scalar into row 0 of a [cap] column."""
             if getattr(scalar, "ndim", 0) == 1:  # string bytes [w]
                 out = jnp.zeros((cap, scalar.shape[0]), dtype=scalar.dtype)
@@ -229,14 +240,16 @@ def _ungrouped_aggregate(batch, agg_columns, ops, cap, live_mask=None):
             lout = None
             if lengths_scalar is not None:
                 lout = jnp.where(one_live, lengths_scalar, 0).astype(jnp.int32)
-            return DeviceColumn(col.dtype, out, vout, lout)
+            return DeviceColumn(out_dtype or col.dtype, out, vout, lout)
 
         any_valid = valid.any()
         if op == "sum":
             total = jnp.where(valid, data, jnp.zeros_like(data)).sum()
             out_aggs.append(place(total, any_valid))
         elif op == "count":
-            out_aggs.append(place(valid.sum().astype(jnp.int64), jnp.bool_(True)))
+            out_aggs.append(
+                place(valid.sum().astype(jnp.int64), jnp.bool_(True), out_dtype=LONG)
+            )
         elif op in ("min", "max"):
             assert not is_str, "string min/max handled via first/last picks"
             fill = _minmax_fill(op, data.dtype)

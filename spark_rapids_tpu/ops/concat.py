@@ -97,7 +97,7 @@ def concat_device(batches: list[DeviceBatch], capacity: int | None = None) -> De
     shapes = tuple(tuple(_col_shape_sig(c) for c in b.columns) for b in batches)
     fn = K.kernel(
         ("concat", schema, shapes, cap),
-        lambda: jax.jit(lambda bs: _concat_impl(list(bs), cap)),
+        lambda: K.GuardedJit(lambda bs: _concat_impl(list(bs), cap)),
     )
     return fn(tuple(batches))
 

@@ -42,7 +42,7 @@ def shrink_one(batch: DeviceBatch, n: int) -> DeviceBatch:
         return batch
     fn = K.kernel(
         ("shrink", batch.schema, batch.capacity, cap2),
-        lambda: jax.jit(
+        lambda: K.GuardedJit(
             lambda b: gather_batch(b, jnp.arange(cap2, dtype=jnp.int32), b.num_rows)
         ),
     )

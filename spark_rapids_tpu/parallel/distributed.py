@@ -125,7 +125,9 @@ def distributed_group_sum_step(mesh: Mesh, axis: str = "dp") -> Callable:
         in_specs=(P(axis), P(axis), P(axis), P(axis), P(axis)),
         out_specs=(P(axis), P(axis), P(axis), P(axis), P(axis)),
     )
-    return jax.jit(mapped)
+    from .. import kernels as K
+
+    return K.GuardedJit(mapped)
 
 
 def _mini_batch(cols, num_rows) -> DeviceBatch:

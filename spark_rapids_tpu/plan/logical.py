@@ -376,3 +376,63 @@ class Range(LogicalPlan):
 def _bound(e: Expression, schema: Schema) -> Expression:
     """Resolve an expression against a child schema (idempotent)."""
     return bind(e, schema)
+
+
+@dataclass
+class WriteFiles(LogicalPlan):
+    """Write command node (GpuDataWritingCommandExec analogue); output is
+    the per-file write stats."""
+
+    child: LogicalPlan
+    path: str
+    file_format: str
+    partition_by: list
+    options: dict
+
+    def children(self):
+        return [self.child]
+
+    @property
+    def schema(self) -> Schema:
+        from ..io.writer import STATS_SCHEMA
+
+        return STATS_SCHEMA
+
+    def _node_string(self):
+        return f"WriteFiles {self.file_format} {self.path}"
+
+
+def transform_expressions(lp: LogicalPlan, f) -> LogicalPlan:
+    """Rebuild the plan tree with ``f`` applied bottom-up to every expression
+    (the analogue of Catalyst's ``transformAllExpressions``); used by the
+    session's ANSI rewrite and the column-pruning pass."""
+    import dataclasses as _dc
+
+    from ..expr.base import Expression, map_child_exprs
+
+    def fe(e):
+        return f(map_child_exprs(e, fe))
+
+    def conv(v):
+        if isinstance(v, Expression):
+            return fe(v)
+        if isinstance(v, LogicalPlan):
+            return walk(v)
+        if isinstance(v, SortOrder):
+            return _dc.replace(v, child=fe(v.child))
+        if isinstance(v, (list, tuple)):
+            return type(v)(conv(x) for x in v)
+        return v
+
+    def walk(node: LogicalPlan) -> LogicalPlan:
+        kw = {}
+        changed = False
+        for fld in _dc.fields(node):
+            v = getattr(node, fld.name)
+            nv = conv(v)
+            kw[fld.name] = nv
+            if nv is not v:
+                changed = True
+        return _dc.replace(node, **kw) if changed else node
+
+    return walk(lp)

@@ -62,9 +62,31 @@ def _cast_check(e: Cast, conf: TpuConf) -> Optional[str]:
     return None
 
 
+def _contains_ansi_cast(e: Expression) -> bool:
+    if isinstance(e, Cast) and e.ansi:
+        return True
+    return any(_contains_ansi_cast(c) for c in e.children())
+
+
 def _agg_minmax_check(e, conf: TpuConf) -> Optional[str]:
     if isinstance(e.child.data_type, StringType):
         return "string min/max on device requires the re-sort strategy (not yet implemented)"
+    return None
+
+
+def _float_agg_check(e, conf: TpuConf) -> Optional[str]:
+    """variableFloatAgg gate (reference RapidsConf.scala): float sums/avgs
+    are evaluation-order dependent; when disabled they stay on CPU so the
+    row-order result is Spark's."""
+    from ..types import DoubleType, FloatType
+
+    if isinstance(e.child.data_type, (FloatType, DoubleType)) and not conf.is_enabled(
+        cfg.VARIABLE_FLOAT_AGG
+    ):
+        return (
+            "float/double sum/avg varies with evaluation order; disabled by "
+            f"{cfg.VARIABLE_FLOAT_AGG.key}"
+        )
     return None
 
 
@@ -107,16 +129,29 @@ for _cls in (
     cond.If,
     cond.CaseWhen,
     cond.Coalesce,
-    agg.Sum,
     agg.Count,
-    agg.Average,
     agg.First,
     agg.Last,
 ):
     _expr(_cls)
+_expr(agg.Sum, check=_float_agg_check)
+_expr(agg.Average, check=_float_agg_check)
 _expr(Cast, check=_cast_check)
 _expr(agg.Min, check=_agg_minmax_check)
 _expr(agg.Max, check=_agg_minmax_check)
+for _cls in (agg.StddevSamp, agg.StddevPop, agg.VarianceSamp, agg.VariancePop):
+    _expr(_cls)
+
+
+def _collect_check(e, conf: TpuConf) -> Optional[str]:
+    return (
+        "collect_list/collect_set build variable-length arrays per group; "
+        "the device segment-reduce kernel has no list accumulator yet"
+    )
+
+
+_expr(agg.CollectList, check=_collect_check)
+_expr(agg.CollectSet, check=_collect_check)
 
 
 # string rules — device paths that need a scalar pattern are gated exactly
@@ -277,22 +312,26 @@ def _window_check(e, conf: TpuConf) -> Optional[str]:
         if fr.frame_type == "range" and not (
             fr.lower in sentinels and fr.upper in sentinels
         ):
-            return "numeric RANGE frame bounds are not supported on device"
-        if isinstance(fn, (agg.Min, agg.Max)):
-            from ..exec.tpu_window import MAX_UNROLL_FRAME
+            # numeric RANGE frames: value-space binary searches over ONE
+            # numeric/temporal order key (Spark's own restriction)
+            if len(e.spec.order_by) != 1:
+                return "numeric RANGE frames require exactly one ORDER BY key"
+            ot = e.spec.order_by[0].child.data_type
+            from ..types import is_numeric
 
-            if isinstance(fn.child.data_type, StringType):
-                return "string min/max over windows is CPU-only"
-            if (
-                fr.frame_type == "rows"
-                and fr.lower != W.UNBOUNDED_PRECEDING
-                and fr.upper != W.UNBOUNDED_FOLLOWING
-                and fr.upper - fr.lower + 1 > MAX_UNROLL_FRAME
+            if isinstance(ot, DecimalType):
+                # integer bounds would compare against the UNSCALED int64
+                # (5 would mean 0.05 over decimal(_,2)) — CPU-only until
+                # the bounds are scale-adjusted
+                return "numeric RANGE frame over a decimal order key is CPU-only"
+            if isinstance(ot, StringType) or not (
+                is_numeric(ot) or ot.__class__.__name__ in ("DateType", "TimestampType")
             ):
-                return (
-                    f"bounded ROWS min/max frame wider than {MAX_UNROLL_FRAME} "
-                    "is CPU-only"
-                )
+                return f"numeric RANGE frame over {ot.simple_string} is CPU-only"
+        if isinstance(fn, (agg.Min, agg.Max)) and isinstance(
+            fn.child.data_type, StringType
+        ):
+            return "string min/max over windows is CPU-only"
         return None
     return f"window function {type(fn).__name__} has no device implementation"
 
@@ -351,6 +390,65 @@ _expr(cx.ElementAt, check=_complex_child_check)
 _expr(cx.GetMapValue, check=_complex_child_check)
 _expr(cx.ArrayContains, check=_complex_child_check)
 _expr(cx.Explode, check=_complex_child_check)
+
+
+# ── string long tail + datetime patterns (stringFunctions.scala,
+#    datetimeExpressions.scala) ───────────────────────────────────────────
+from ..expr import strings_ext as sx  # noqa: E402
+from ..expr import datetime_fmt as df  # noqa: E402
+
+
+def _translate_check(e, conf: TpuConf) -> Optional[str]:
+    if not sx.translate_args_ascii(e):
+        return "translate on device requires ASCII literal from/to arguments"
+    return None
+
+
+def _cpu_regex_check(what: str):
+    def check(e, conf: TpuConf) -> Optional[str]:
+        return (
+            f"{what} executes on the CPU engine (the reference leans on "
+            "cuDF's device regex/JSON engines — no XLA analogue)"
+        )
+
+    return check
+
+
+def _fmt_check(e, conf: TpuConf) -> Optional[str]:
+    if not st.is_string_literal(e.fmt):
+        return "datetime pattern must be a string literal"
+    if not df.pattern_supported(e.fmt.value):
+        return (
+            f"datetime pattern {e.fmt.value!r} is outside the device-"
+            "supported token subset (yyyy MM dd HH mm ss + literals)"
+        )
+    return None
+
+
+_expr(sx.ConcatWs)
+_expr(sx.StringTranslate, check=_translate_check)
+_expr(sx.StringSplit, check=_cpu_regex_check("split"))
+_expr(sx.RLike, check=_cpu_regex_check("rlike"))
+_expr(sx.RegExpReplace, check=_cpu_regex_check("regexp_replace"))
+_expr(sx.RegExpExtract, check=_cpu_regex_check("regexp_extract"))
+_expr(sx.GetJsonObject, check=_cpu_regex_check("get_json_object"))
+_expr(df.DateFormatClass, check=_fmt_check)
+_expr(df.FromUnixTime, check=_fmt_check)
+_expr(df.ToUnixTimestamp, check=_fmt_check)
+_expr(df.ParseToDate, check=_fmt_check)
+
+
+# ── UDFs (GpuUserDefinedFunction / GpuArrowEvalPythonExec seam) ───────────
+from ..expr import udf as _udf  # noqa: E402
+
+_expr(_udf.JaxUdf)
+_expr(
+    _udf.PythonUdf,
+    check=lambda e, conf: (
+        "python row UDFs execute on the CPU engine (register a jax_udf for "
+        "device execution — it fuses into the XLA program)"
+    ),
+)
 
 
 def expr_rules() -> dict[type, ExprRule]:
@@ -450,9 +548,7 @@ def _rule(cls, name, convert, exprs_of, check=None):
 
 
 def _conv_project(e: C.CpuProjectExec, ch):
-    t = T.TpuProjectExec(e.exprs, ch[0])
-    t._schema = e.output
-    return t
+    return T.TpuProjectExec(e.exprs, ch[0], schema=e.output)
 
 
 def _conv_filter(e: C.CpuFilterExec, ch):
@@ -684,9 +780,67 @@ class TpuOverrides:
         if not self.conf.is_enabled(cfg.SQL_ENABLED):
             return plan
         converted = self._convert(plan)
+        if self.conf.is_enabled(cfg.CBO_ENABLED):
+            converted = self._cost_optimize(converted)
         out = self._insert_transitions(converted, want_device=False)
         self._maybe_log()
         return out
+
+    # cost-based un-conversion (CostBasedOptimizer.scala:29-310) ───────────
+    # DefaultCostModel stand-in: per-node compute weights; a contiguous
+    # device island pays two transitions, so islands whose total weight is
+    # below the threshold go back to the CPU engine.
+    _CBO_WEIGHTS = {
+        "TpuProjectExec": 1,
+        "TpuFilterExec": 1,
+        "TpuLimitExec": 1,
+        "TpuCoalescePartitionsExec": 0,
+    }
+    _CBO_TRANSITION_COST = 3
+
+    def _island_weight(self, plan: Exec) -> int:
+        """Total weight of the contiguous device region rooted here (host
+        children are the island's boundaries)."""
+        w = self._CBO_WEIGHTS.get(type(plan).__name__, 10)
+        for c in plan.children:
+            if c.is_device:
+                w += self._island_weight(c)
+        return w
+
+    def _unconvert_island(self, plan: Exec) -> Exec:
+        if not plan.is_device:
+            return self._cost_optimize(plan)
+        kids = [self._unconvert_island(c) for c in plan.children]
+        orig = getattr(plan, "_cpu_original", None)
+        if orig is None:
+            return plan.with_new_children(kids)
+        self.explain.append(
+            ExplainEntry(
+                orig.node_string(),
+                False,
+                ["cost-based optimizer: island too small to pay transitions"],
+            )
+        )
+        return orig.with_new_children(kids)
+
+    def _keep_island(self, plan: Exec) -> Exec:
+        """Inside a kept island: never re-evaluate interior sub-islands (the
+        transition boundary wouldn't move, only device work would be lost);
+        resume cost analysis below the island's host boundaries."""
+        kids = [
+            self._keep_island(c) if c.is_device else self._cost_optimize(c)
+            for c in plan.children
+        ]
+        return plan.with_new_children(kids)
+
+    def _cost_optimize(self, plan: Exec) -> Exec:
+        if plan.is_device:
+            if self._island_weight(plan) < self._CBO_TRANSITION_COST:
+                return self._unconvert_island(plan)
+            return self._keep_island(plan)
+        return plan.with_new_children(
+            [self._cost_optimize(c) for c in plan.children]
+        )
 
     # conversion walk (meta.tagForGpu + convertIfNeeded)
     def _convert(self, plan: Exec) -> Exec:
@@ -712,11 +866,24 @@ class TpuOverrides:
                     reasons.append(why)
             for e in rule.exprs_of(plan):
                 _check_expr_tree(e, self.conf, reasons)
+            if not isinstance(plan, (C.CpuProjectExec, C.CpuFilterExec)):
+                # the ANSI error channel is wired through the project/filter
+                # kernels only; ANSI casts elsewhere fall back so errors
+                # still raise (CPU eval raises inline)
+                for e in rule.exprs_of(plan):
+                    if _contains_ansi_cast(e):
+                        reasons.append(
+                            "ANSI-mode cast outside project/filter runs on "
+                            "CPU (device error channel not wired here)"
+                        )
+                        break
         if reasons:
             self.explain.append(ExplainEntry(plan.node_string(), False, reasons))
             return plan.with_new_children(children)
         self.explain.append(ExplainEntry(plan.node_string(), True, []))
-        return rule.convert(plan, children)
+        converted = rule.convert(plan, children)
+        converted._cpu_original = plan  # CBO un-conversion seam
+        return converted
 
     # transition insertion (GpuTransitionOverrides)
     def _insert_transitions(self, plan: Exec, want_device: bool) -> Exec:

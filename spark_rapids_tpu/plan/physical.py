@@ -19,14 +19,20 @@ from ..config import TpuConf
 from ..types import Schema
 
 
+METRIC_LEVELS = {"ESSENTIAL": 0, "MODERATE": 1, "DEBUG": 2}
+
+
 class Metric:
-    """One operator metric — the GpuMetric analogue (GpuExec.scala:40-157)."""
+    """One operator metric — the GpuMetric analogue (GpuExec.scala:40-157).
+    Levels mirror the reference's ESSENTIAL/MODERATE/DEBUG taxonomy; the
+    per-query cutoff comes from ``spark.rapids.sql.metrics.level``."""
 
-    __slots__ = ("name", "value", "_lock")
+    __slots__ = ("name", "value", "level", "_lock")
 
-    def __init__(self, name: str):
+    def __init__(self, name: str, level: str = "ESSENTIAL"):
         self.name = name
         self.value = 0
+        self.level = level
         self._lock = threading.Lock()
 
     def add(self, v: int):
@@ -62,9 +68,26 @@ class ExecContext:
 
         self.semaphore = DeviceSemaphore(cfg.CONCURRENT_TPU_TASKS.get(conf))
         self.catalog = BufferCatalog.from_conf(conf)
+        self.metrics_level = METRIC_LEVELS.get(
+            (cfg.METRICS_LEVEL.get(conf) or "MODERATE").upper(), 1
+        )
         limit = cfg.DEVICE_POOL_LIMIT.get(conf)
         if limit > 0:
             self.catalog.device_limit = limit
+        else:
+            # size the spillable budget from device memory × allocFraction
+            # (GpuDeviceManager.initializeRmm's pool sizing)
+            try:
+                import jax
+
+                stats = jax.local_devices()[0].memory_stats() or {}
+                total = stats.get("bytes_limit", 0)
+                if total:
+                    self.catalog.device_limit = int(
+                        total * cfg.POOL_SIZE_FRACTION.get(conf)
+                    )
+            except Exception:
+                pass  # CPU backend / no stats: unlimited, spill-on-demand
         import itertools
 
         import threading
@@ -200,10 +223,27 @@ class Exec:
         raise NotImplementedError
 
     # ── metrics ─────────────────────────────────────────────────────────
-    def metric(self, name: str) -> Metric:
+    def metric(self, name: str, level: str = "ESSENTIAL") -> Metric:
         if name not in self.metrics:
-            self.metrics[name] = Metric(name)
+            self.metrics[name] = Metric(name, level)
         return self.metrics[name]
+
+    def metrics_on(self, ctx: "ExecContext", level: str) -> bool:
+        """Is a metric of ``level`` collected under this query's
+        ``spark.rapids.sql.metrics.level``?"""
+        return METRIC_LEVELS[level] <= ctx.metrics_level
+
+    def collect_metrics(self) -> dict:
+        """node → {metric: value} for the whole subtree (Spark-UI stand-in)."""
+        out = {}
+        if self.metrics:
+            out[self.node_string()] = {
+                m.name: m.value for m in self.metrics.values()
+            }
+        for c in self.children:
+            for k, v in c.collect_metrics().items():
+                out.setdefault(k, {}).update(v)
+        return out
 
     # ── pretty print ────────────────────────────────────────────────────
     def node_string(self) -> str:

@@ -50,7 +50,18 @@ def plan_physical(lp: L.LogicalPlan, conf: TpuConf) -> Exec:
     if isinstance(lp, L.Project):
         return CpuProjectExec(lp.exprs, plan_physical(lp.child, conf))
     if isinstance(lp, L.Filter):
-        return CpuFilterExec(lp.condition, plan_physical(lp.child, conf))
+        child = lp.child
+        if isinstance(child, L.FileScan):
+            # predicate pushdown: conjuncts of col-vs-literal comparisons go
+            # to the scan for row-group + partition-value pruning (reference:
+            # GpuParquetFileFilterHandler; the Filter stays — stats pruning
+            # is conservative)
+            preds = _extract_pushdown(lp.condition)
+            if preds:
+                opts = dict(child.options)
+                opts["__predicates"] = tuple(preds)
+                child = dataclasses.replace(child, options=opts)
+        return CpuFilterExec(lp.condition, plan_physical(child, conf))
     if isinstance(lp, L.Aggregate):
         return _plan_aggregate(lp, conf)
     if isinstance(lp, L.Sort):
@@ -83,6 +94,16 @@ def plan_physical(lp: L.LogicalPlan, conf: TpuConf) -> Exec:
         return CpuGenerateExec(
             lp.generator, lp.out_names, plan_physical(lp.child, conf)
         )
+    if isinstance(lp, L.WriteFiles):
+        from ..io.writer import CpuWriteFilesExec
+
+        return CpuWriteFilesExec(
+            plan_physical(lp.child, conf),
+            lp.path,
+            lp.file_format,
+            lp.partition_by,
+            lp.options,
+        )
     if isinstance(lp, L.Union):
         return CpuUnionExec([plan_physical(p, conf) for p in lp.plans])
     if isinstance(lp, L.Repartition):
@@ -112,6 +133,41 @@ def plan_physical(lp: L.LogicalPlan, conf: TpuConf) -> Exec:
             child = CpuCoalescePartitionsExec(child)
         return CpuWindowExec(lp.window_cols, child)
     raise NotImplementedError(f"no physical plan for {type(lp).__name__}")
+
+
+def _extract_pushdown(e: Expression):
+    """Conjuncts shaped ``col <op> literal`` → (name, op, value) triples."""
+    from ..expr import predicates as prd
+    from ..expr.base import Literal, UnresolvedAttribute
+
+    ops = {
+        prd.GreaterThan: ">",
+        prd.GreaterThanOrEqual: ">=",
+        prd.LessThan: "<",
+        prd.LessThanOrEqual: "<=",
+        prd.EqualTo: "=",
+    }
+    flip = {">": "<", ">=": "<=", "<": ">", "<=": ">=", "=": "="}
+    out = []
+
+    def walk(x):
+        if isinstance(x, prd.And):
+            for c in x.children():
+                walk(c)
+            return
+        op = ops.get(type(x))
+        if not op:
+            return
+        l, r = x.children()
+        if isinstance(l, UnresolvedAttribute) and isinstance(r, Literal):
+            if r.value is not None:
+                out.append((l.name, op, r.value))
+        elif isinstance(r, UnresolvedAttribute) and isinstance(l, Literal):
+            if l.value is not None:
+                out.append((r.name, flip[op], l.value))
+
+    walk(e)
+    return out
 
 
 def _estimate_size(lp: L.LogicalPlan) -> Optional[int]:
@@ -209,7 +265,155 @@ def _finalize_result_expr(e: Expression, num_keys: int, key_exprs) -> Expression
     return map_child_exprs(e, lambda c: _finalize_result_expr(c, num_keys, key_exprs))
 
 
+def _rewrite_distinct(lp: L.Aggregate) -> L.Aggregate:
+    """Plan DISTINCT aggregates as two stacked aggregations — Spark's
+    AggUtils.planAggregateWithOneDistinct shape (reference relies on it:
+    distinct arrives at the plugin already rewritten):
+
+        Aggregate(keys, [sum(y), count(DISTINCT x)])
+        ⇒ inner:  Aggregate(keys ++ [x], partial non-distinct aggs)
+          outer:  Aggregate(keys, re-aggregate partials + agg over x)
+
+    All distinct aggregates must share one child expression (Spark's
+    multi-distinct Expand rewrite is not implemented)."""
+    import dataclasses as _dc
+
+    from ..expr import Literal
+    from ..expr.aggregates import (
+        Average,
+        Count,
+        First,
+        Last,
+        Max,
+        Min,
+        Sum,
+    )
+    from ..expr.base import map_child_exprs
+    from ..expr.cast import Cast
+    from ..expr.conditional import Coalesce
+    from ..expr.arithmetic import Divide
+    from ..types import DOUBLE, LONG
+
+    # the single distinct child
+    dchildren = []
+
+    def find(e):
+        if isinstance(e, AggregateFunction) and getattr(e, "distinct", False):
+            dchildren.append(e.child)
+        for c in e.children():
+            find(c)
+
+    for e in lp.aggregates:
+        find(e)
+    first_child = dchildren[0]
+    if any(c != first_child for c in dchildren):
+        raise NotImplementedError(
+            "multiple DISTINCT aggregate column sets are not supported "
+            "(Spark's Expand-based rewrite not implemented)"
+        )
+
+    key_names = [f"__k{i}" for i in range(len(lp.grouping))]
+    inner_out: List[Expression] = [
+        Alias(g, n) for g, n in zip(lp.grouping, key_names)
+    ]
+    inner_out.append(Alias(first_child, "__dk"))
+    nd_count = [0]
+
+    def replace_agg(e: Expression) -> Expression:
+        if isinstance(e, AggregateFunction):
+            if getattr(e, "distinct", False):
+                return _dc.replace(e, child=UnresolvedAttribute("__dk"), distinct=False)
+            name = f"__nd{nd_count[0]}"
+            nd_count[0] += 1
+            if isinstance(e, (Min, Max, First, Last)):
+                inner_out.append(Alias(e, name))
+                return _dc.replace(e, child=UnresolvedAttribute(name))
+            if isinstance(e, Sum):
+                # re-summing widens again (decimal p+10): cast back
+                inner_out.append(Alias(e, name))
+                sum_type = bind(e, lp.child.schema).data_type
+                return Cast(Sum(UnresolvedAttribute(name)), sum_type)
+            if isinstance(e, Count):
+                inner_out.append(Alias(e, name))
+                return Coalesce(
+                    (Sum(UnresolvedAttribute(name)), Literal(0, LONG))
+                )
+            if isinstance(e, Average):
+                sname, cname = name + "s", name + "c"
+                inner_out.append(Alias(Sum(Cast(e.child, DOUBLE)), sname))
+                inner_out.append(Alias(Count(e.child), cname))
+                return Divide(
+                    Sum(UnresolvedAttribute(sname)),
+                    Cast(Sum(UnresolvedAttribute(cname)), DOUBLE),
+                )
+            from ..expr.aggregates import _CentralMoment
+
+            if isinstance(e, _CentralMoment):
+                # (count, Σx, Σx²) partials re-sum; the result expression
+                # mirrors _CentralMoment.evaluate term for term
+                from ..expr.arithmetic import Multiply, Subtract
+                from ..expr.conditional import If
+                from ..expr.math import Sqrt
+                from ..expr.predicates import GreaterThan, LessThan
+
+                cname, sname, ssn = name + "c", name + "s", name + "ss"
+                xd = Cast(e.child, DOUBLE)
+                inner_out.append(Alias(Count(e.child), cname))
+                inner_out.append(Alias(Sum(xd), sname))
+                inner_out.append(Alias(Sum(Multiply(xd, xd)), ssn))
+                nD = Cast(
+                    Coalesce((Sum(UnresolvedAttribute(cname)), Literal(0, LONG))),
+                    DOUBLE,
+                )
+                sS = Sum(UnresolvedAttribute(sname))
+                m2 = Subtract(
+                    Sum(UnresolvedAttribute(ssn)), Multiply(sS, Divide(sS, nD))
+                )
+                div = (
+                    Subtract(nD, Literal(1.0, DOUBLE)) if e.sample else nD
+                )
+                var = If(
+                    GreaterThan(div, Literal(0.0, DOUBLE)),
+                    Divide(m2, div),
+                    Literal(float("nan"), DOUBLE),
+                )
+                var = If(
+                    GreaterThan(nD, Literal(0.0, DOUBLE)),
+                    var,
+                    Literal(None, DOUBLE),
+                )
+                var = If(LessThan(var, Literal(0.0, DOUBLE)), Literal(0.0, DOUBLE), var)
+                return Sqrt(var) if e.sqrt else var
+            raise NotImplementedError(
+                f"{type(e).__name__} combined with DISTINCT aggregates"
+            )
+        if not e.children():
+            return e
+        return map_child_exprs(e, replace_agg)
+
+    outer_out: List[Expression] = []
+    for e in lp.aggregates:
+        name = output_name(e)
+        target = e.child if isinstance(e, Alias) else e
+        mapped = None
+        for i, g in enumerate(lp.grouping):
+            if target == g:
+                mapped = UnresolvedAttribute(key_names[i])
+                break
+        if mapped is None:
+            mapped = replace_agg(target)
+        outer_out.append(Alias(mapped, name))
+
+    inner = L.Aggregate(list(lp.grouping) + [first_child], inner_out, lp.child)
+    outer_grouping = [UnresolvedAttribute(n) for n in key_names]
+    return L.Aggregate(outer_grouping, outer_out, inner)
+
+
 def _plan_aggregate(lp: L.Aggregate, conf: TpuConf) -> Exec:
+    from ..expr.aggregates import contains_distinct
+
+    if any(contains_distinct(e) for e in lp.aggregates):
+        lp = _rewrite_distinct(lp)
     child = plan_physical(lp.child, conf)
     child_schema = child.output
     bound_grouping = [bind(g, child_schema) for g in lp.grouping]

@@ -32,6 +32,10 @@ class TpuSession:
 
         K.enable_persistent_cache()  # reuse XLA binaries across processes
         self.conf = TpuConf(conf or {})
+        if cfg.CPU_ONLY.get(self.conf):
+            import jax
+
+            jax.config.update("jax_platforms", "cpu")
         self.read = DataFrameReader(self)
         self._last_plan: Optional[Exec] = None
         self._last_overrides: Optional[TpuOverrides] = None
@@ -71,6 +75,20 @@ class TpuSession:
     def _execute(self, lp: L.LogicalPlan) -> pa.Table:
         from .plan.pruning import prune_columns
 
+        if cfg.ANSI_ENABLED.get(self.conf):
+            # Spark resolves ansiEnabled into Cast at analysis time; same
+            # here — the rewrite happens before planning so both the CPU
+            # oracle and the device plan see ANSI casts
+            import dataclasses as _dc
+
+            from .expr.cast import Cast
+
+            lp = L.transform_expressions(
+                lp,
+                lambda e: _dc.replace(e, ansi=True)
+                if isinstance(e, Cast) and not e.ansi
+                else e,
+            )
         lp = prune_columns(lp)
         cpu_plan = plan_physical(lp, self.conf)
         overrides = TpuOverrides(self.conf)
@@ -87,10 +105,18 @@ class TpuSession:
             # slots + GpuSemaphore model): device dispatch and D2H waits of
             # different partitions overlap instead of serializing per
             # partition; jax releases the GIL while blocking on transfers.
+            import threading
             from concurrent.futures import ThreadPoolExecutor
 
-            with ThreadPoolExecutor(max_workers=n_threads) as pool:
-                results = list(pool.map(lambda t: list(t()), parts.parts))
+            # XLA compilation can run inside these workers (first touch of a
+            # kernel); LLVM passes recurse deeply on large fused programs and
+            # overflow the default worker stack — give executors a big one
+            prev_stack = threading.stack_size(512 * 1024 * 1024)
+            try:
+                with ThreadPoolExecutor(max_workers=n_threads) as pool:
+                    results = list(pool.map(lambda t: list(t()), parts.parts))
+            finally:
+                threading.stack_size(prev_stack)
             batches = [rb for rbs in results for rb in rbs if rb.num_rows]
         else:
             for thunk in parts.parts:
@@ -111,7 +137,15 @@ class TpuSession:
             return
         allowed = (cfg.TEST_ALLOWED_NONTPU.get(self.conf) or "").split(",")
         allowed = {a.strip() for a in allowed if a.strip()}
-        allowed |= {"CpuScan", "CpuFileScan", "DeviceToHost", "HostToDevice"}
+        # WriteFiles encodes on the host side of D2H by design (no device
+        # Parquet codec on TPU — io/writer.py docstring)
+        allowed |= {
+            "CpuScan",
+            "CpuFileScan",
+            "DeviceToHost",
+            "HostToDevice",
+            "WriteFiles",
+        }
         bad = []
         for e in overrides.explain:
             if e.on_device:
@@ -430,6 +464,30 @@ class DataFrame:
             L.Join(self._plan, other._plan, "cross", [], [], None, False),
         )
 
+    def distinct(self) -> "DataFrame":
+        """Spark plans Distinct as Aggregate(all columns) — same here, so it
+        rides the two-phase device group-by."""
+        cols = [UnresolvedAttribute(n) for n in self.schema.names]
+        return DataFrame(self._session, L.Aggregate(cols, list(cols), self._plan))
+
+    def drop_duplicates(self, subset: Optional[List[str]] = None) -> "DataFrame":
+        if subset is None:
+            return self.distinct()
+        from .functions import first as first_fn
+
+        keys = [UnresolvedAttribute(n) for n in subset]
+        keep = set(subset)
+        # output preserves the original column order (pyspark semantics)
+        aggs: List[Expression] = []
+        for f in self.schema:
+            if f.name in keep:
+                aggs.append(UnresolvedAttribute(f.name))
+            else:
+                aggs.append(Alias(first_fn(col(f.name)).expr, f.name))
+        return DataFrame(self._session, L.Aggregate(keys, aggs, self._plan))
+
+    dropDuplicates = drop_duplicates
+
     # ── actions ─────────────────────────────────────────────────────────
     def to_arrow(self) -> pa.Table:
         return self._session._execute(self._plan)
@@ -474,16 +532,69 @@ class GroupedData:
         df: DataFrame,
         grouping: List[Expression],
         grouping_sets: Optional[List[List[int]]] = None,
+        pivot: Optional[tuple] = None,
     ):
         self._df = df
         self._grouping = grouping
         self._grouping_sets = grouping_sets
+        self._pivot = pivot
+
+    def pivot(self, pivot_col: str, values: Optional[list] = None) -> "GroupedData":
+        """Pivot on ``pivot_col`` — Catalyst's RewritePivot shape: each
+        (value, aggregate) pair becomes ``agg(if(p <=> value, x, null))``
+        (reference analogue: GpuPivotFirst; divergence: ``count`` yields 0
+        instead of null for absent combinations, like the SQL rewrite).
+        When ``values`` is omitted they are collected eagerly from the data
+        (sorted, like Spark's auto-detection)."""
+        if self._grouping_sets is not None:
+            raise ValueError("pivot is only supported after a groupBy")
+        if values is None:
+            key = UnresolvedAttribute(pivot_col)
+            vals_df = DataFrame(
+                self._df._session, L.Aggregate([key], [key], self._df._plan)
+            )
+            collected = [v for (v,) in vals_df.collect()]
+            non_null = sorted(v for v in collected if v is not None)
+            values = non_null + ([None] if None in collected else [])
+        return GroupedData(self._df, self._grouping, pivot=(pivot_col, values))
+
+    def _expand_pivot(self, agg_exprs: List[Expression]) -> List[Expression]:
+        import dataclasses as _dc
+
+        from .expr.aggregates import AggregateFunction
+        from .expr.base import Literal, map_child_exprs, to_expr
+        from .expr.conditional import If
+        from .expr.predicates import EqualNullSafe
+        from .types import NULL
+
+        pcol, values = self._pivot
+
+        def wrap(e: Expression, v) -> Expression:
+            if isinstance(e, AggregateFunction):
+                cond = EqualNullSafe(UnresolvedAttribute(pcol), to_expr(v))
+                guarded = If(cond, e.child, Literal(None, NULL))
+                return _dc.replace(e, child=guarded)
+            if not e.children():
+                return e
+            return map_child_exprs(e, lambda c: wrap(c, v))
+
+        out: List[Expression] = []
+        multiple = len(agg_exprs) > 1
+        for v in values:
+            for a in agg_exprs:
+                base = str(v) if v is not None else "null"
+                name = f"{base}_{output_name(a)}" if multiple else base
+                target = a.child if isinstance(a, Alias) else a
+                out.append(Alias(wrap(target, v), name))
+        return out
 
     def agg(self, *aggs) -> DataFrame:
         agg_exprs = []
         for a in aggs:
             e = a.expr if isinstance(a, Column) else a
             agg_exprs.append(e)
+        if self._pivot is not None:
+            agg_exprs = self._expand_pivot(agg_exprs)
         if self._grouping_sets is not None:
             return self._agg_grouping_sets(agg_exprs)
         # Spark: group-by output = grouping columns ++ aggregates

@@ -81,7 +81,12 @@ class ShuffleClient:
         catalog (RapidsShuffleIterator's batch-per-next loop). Safe to call
         from concurrent tasks sharing this client."""
         tx = self._conn.request(REQ_METADATA, M.pack_metadata_request(blocks))
-        tx.wait(self._timeout)
+        try:
+            tx.wait(self._timeout)
+        except TimeoutError as e:
+            # FetchFailedException semantics: timeouts are fetch failures
+            # (stage retry), not task-killing runtime errors
+            raise ShuffleFetchError(f"metadata request timed out: {e}") from e
         if tx.status != TransactionStatus.SUCCESS:
             raise ShuffleFetchError(f"metadata request failed: {tx.error}")
         metas = M.unpack_metadata_response(tx.payload)

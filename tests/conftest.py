@@ -2,6 +2,12 @@ import os
 
 # 8 virtual devices for mesh tests; must be set before jax initializes backends
 os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+# The XLA-CPU executable serializer segfaults writing some window kernels
+# while worker threads execute concurrently (observed deterministically in
+# full-suite runs; compile itself is fine). The on-disk cache only buys
+# cross-process reuse — tests rely on the in-memory kernel cache — so keep
+# it off here; bench/driver runs (TPU backend, different serializer) use it.
+os.environ.setdefault("SPARK_RAPIDS_TPU_NO_PERSISTENT_CACHE", "1")
 
 import jax
 
@@ -17,3 +23,22 @@ def session():
     from spark_rapids_tpu import TpuSession
 
     return TpuSession()
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _bound_jit_code_size():
+    """Release compiled XLA:CPU executables between test modules.
+
+    The full suite compiles thousands of kernels into one process; past a
+    few GB of JITed code the CPU backend segfaults inside
+    backend_compile_and_load (LLVM relocation-range class of failure —
+    observed deterministically near the end of full runs, never in module
+    isolation). Real sessions never accumulate hundreds of distinct query
+    shapes, and the TPU backend doesn't use the LLVM JIT at all."""
+    yield
+    import jax
+
+    from spark_rapids_tpu import kernels as K
+
+    K.clear()
+    jax.clear_caches()

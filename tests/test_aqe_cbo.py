@@ -1,0 +1,70 @@
+"""AQE partition coalescing + cost-based un-conversion — reference:
+GpuCustomShuffleReaderExec.scala (coalesced partition specs over measured
+map sizes) and CostBasedOptimizer.scala:29-310 (transition-aware section
+replacement, default-off there too)."""
+import numpy as np
+import pyarrow as pa
+
+from spark_rapids_tpu.functions import col, sum as sum_
+
+from data_gen import gen_grouped_table
+from harness import assert_cpu_and_tpu_equal, cpu_session, tpu_session
+
+
+def _find_exchange(plan):
+    from spark_rapids_tpu.exec.tpu import TpuShuffleExchangeExec
+
+    if isinstance(plan, TpuShuffleExchangeExec):
+        return plan
+    for c in plan.children:
+        f = _find_exchange(c)
+        if f is not None:
+            return f
+    return None
+
+
+def test_aqe_coalesces_small_partitions():
+    t = gen_grouped_table([("x", __import__("spark_rapids_tpu.types", fromlist=["LONG"]).LONG)], 400, num_groups=6, seed=2)
+    conf = {"spark.sql.adaptive.enabled": True}
+
+    def build(s):
+        return s.create_dataframe(t, num_partitions=3).group_by("k").agg(
+            sum_(col("x")).alias("s")
+        )
+
+    # results identical with AQE on
+    assert_cpu_and_tpu_equal(build, conf=conf)
+    # tiny data under a 64MB advisory size → ONE non-empty reduce group
+    s = tpu_session(conf)
+    build(s).collect()
+    ex = _find_exchange(s._last_plan)
+    assert ex is not None and getattr(ex, "aqe_groups", None) == 1, getattr(
+        ex, "aqe_groups", None
+    )
+    # default (AQE off): no grouping happened
+    s2 = tpu_session()
+    build(s2).collect()
+    assert not hasattr(_find_exchange(s2._last_plan), "aqe_groups")
+
+
+def test_cbo_unconverts_trivial_island():
+    t = pa.table({"a": list(range(100))})
+    conf = {"spark.rapids.sql.optimizer.enabled": True}
+
+    def build(s):
+        # scan → filter → collect: a 1-weight device island between host
+        # boundaries; CBO should keep it on CPU
+        return s.create_dataframe(t).filter(col("a") > 50)
+
+    assert_cpu_and_tpu_equal(build, conf=conf, allowed_non_tpu=["Filter", "CpuFilter"])
+    s = tpu_session(conf, strict=False)
+    assert len(build(s).collect()) == 49
+    assert "TpuFilter" not in s._last_plan.tree_string()
+    # a heavier pipeline (aggregate) stays on device
+    def build2(s):
+        return s.create_dataframe(t).group_by().agg(sum_(col("a")).alias("s"))
+
+    s2 = tpu_session(conf, strict=False)
+    rows = build2(s2).collect()
+    assert rows == [(sum(range(100)),)]
+    assert "TpuHashAggregate" in s2._last_plan.tree_string()

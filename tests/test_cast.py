@@ -1,0 +1,365 @@
+"""Cast matrix differential tests — the CastOpSuite / AnsiCastOpSuite
+analogue (reference: tests/.../CastOpSuite.scala, AnsiCastOpSuite.scala,
+GpuCast.scala:1-1319). Every pair runs the same query on the CPU oracle and
+the device engine and deep-compares."""
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu.expr.base import AnsiError
+from spark_rapids_tpu.functions import col
+from spark_rapids_tpu.types import (
+    BOOLEAN,
+    BYTE,
+    DATE,
+    DOUBLE,
+    FLOAT,
+    INT,
+    LONG,
+    SHORT,
+    STRING,
+    TIMESTAMP,
+    DecimalType,
+)
+
+from data_gen import gen_table
+from harness import assert_cpu_and_tpu_equal, cpu_session, tpu_session
+
+NUMERIC = [BYTE, SHORT, INT, LONG, FLOAT, DOUBLE]
+FLOAT_CONF = {
+    "spark.rapids.sql.castFloatToString.enabled": True,
+    "spark.rapids.sql.castStringToFloat.enabled": True,
+    "spark.rapids.sql.castStringToTimestamp.enabled": True,
+}
+
+
+def _cast_df(table, to):
+    def build(s):
+        return s.create_dataframe(table, num_partitions=2).select(
+            col("a").cast(to).alias("c")
+        )
+
+    return build
+
+
+# ── numeric ↔ numeric (Java narrowing/saturation semantics) ────────────────
+@pytest.mark.parametrize("frm", NUMERIC, ids=str)
+@pytest.mark.parametrize("to", NUMERIC + [BOOLEAN], ids=str)
+def test_numeric_matrix(frm, to):
+    if frm == to:
+        pytest.skip("identity")
+    t = gen_table([("a", frm)], 200, seed=7)
+    assert_cpu_and_tpu_equal(_cast_df(t, to))
+
+
+@pytest.mark.parametrize("to", [BYTE, INT, LONG, FLOAT, DOUBLE], ids=str)
+def test_bool_to_numeric(to):
+    t = gen_table([("a", BOOLEAN)], 100, seed=3)
+    assert_cpu_and_tpu_equal(_cast_df(t, to))
+
+
+# ── temporal ───────────────────────────────────────────────────────────────
+def test_date_timestamp_widening():
+    t = gen_table([("a", DATE)], 200, seed=11)
+    assert_cpu_and_tpu_equal(_cast_df(t, TIMESTAMP))
+    t = gen_table([("a", TIMESTAMP)], 200, seed=12)
+    assert_cpu_and_tpu_equal(_cast_df(t, DATE))
+
+
+@pytest.mark.parametrize("to", [LONG, INT, DOUBLE], ids=str)
+def test_timestamp_to_numeric(to):
+    t = gen_table([("a", TIMESTAMP)], 200, seed=13)
+    assert_cpu_and_tpu_equal(_cast_df(t, to))
+
+
+@pytest.mark.parametrize("frm", [INT, LONG, DOUBLE], ids=str)
+def test_numeric_to_timestamp(frm):
+    # bound the range so seconds→micros stays in the timestamp range
+    # keep seconds within python-datetime-representable years for collect()
+    bound = 2**31 - 1 if frm == INT else 60_000_000_000
+    tbl = pa.table(
+        {
+            "a": pa.array(
+                np.random.default_rng(5).integers(-bound, bound, 100),
+                type=frm.to_arrow(),
+            )
+        }
+    )
+    assert_cpu_and_tpu_equal(_cast_df(tbl, TIMESTAMP))
+
+
+def test_timestamp_to_decimal():
+    t = gen_table([("a", TIMESTAMP)], 200, seed=14)
+    assert_cpu_and_tpu_equal(_cast_df(t, DecimalType(18, 3)))
+
+
+# ── X → string ─────────────────────────────────────────────────────────────
+@pytest.mark.parametrize("frm", [BYTE, SHORT, INT, LONG, BOOLEAN], ids=str)
+def test_to_string(frm):
+    t = gen_table([("a", frm)], 300, seed=21)
+    assert_cpu_and_tpu_equal(_cast_df(t, STRING))
+
+
+def test_date_to_string():
+    t = gen_table([("a", DATE)], 300, seed=22)
+    assert_cpu_and_tpu_equal(_cast_df(t, STRING))
+
+
+def test_timestamp_to_string():
+    t = gen_table([("a", TIMESTAMP)], 300, seed=23)
+    assert_cpu_and_tpu_equal(_cast_df(t, STRING))
+
+
+def test_decimal_to_string():
+    t = gen_table([("a", DecimalType(12, 3))], 300, seed=24)
+    assert_cpu_and_tpu_equal(_cast_df(t, STRING))
+
+
+def test_decimal_scale7_to_string_falls_back():
+    """Java switches to scientific notation past scale 6 — the device kernel
+    only emits plain notation, so the planner must fall back (and the CPU
+    fallback then matches BigDecimal.toString exactly)."""
+    t = gen_table([("a", DecimalType(12, 8))], 50, seed=25)
+    assert_cpu_and_tpu_equal(
+        _cast_df(t, STRING), allowed_non_tpu=["Project", "CpuProject"]
+    )
+
+
+@pytest.mark.parametrize("frm", [FLOAT, DOUBLE], ids=str)
+def test_float_to_string_gated(frm):
+    vals = [
+        0.0, -0.0, 1.5, -3.0, 0.1, 123456.789, 1e7, 9999999.0, 1.23e-4,
+        1e-3, 3.14159e20, -2.5e-20, float("nan"), float("inf"), float("-inf"),
+        None,
+    ]
+    t = pa.table({"a": pa.array(vals, type=frm.to_arrow())})
+    assert_cpu_and_tpu_equal(_cast_df(t, STRING), conf=FLOAT_CONF)
+
+
+def test_float_to_string_fuzz():
+    rng = np.random.default_rng(31)
+    vals = (
+        rng.standard_normal(1500) * np.power(10.0, rng.integers(-200, 200, 1500))
+    ).astype(np.float64)
+    t = pa.table({"a": pa.array(vals, type=pa.float64())})
+    assert_cpu_and_tpu_equal(_cast_df(t, STRING), conf=FLOAT_CONF)
+
+
+# ── string → X ─────────────────────────────────────────────────────────────
+def test_string_to_int():
+    vals = [
+        "12", " -42\t", "+7", "0", "007", "9223372036854775807",
+        "-9223372036854775808", "9223372036854775808", "1e4", "12.5",
+        "", "  ", "abc", "--5", "+-5", "123456789012", None,
+    ]
+    t = pa.table({"a": pa.array(vals)})
+    for to in (BYTE, SHORT, INT, LONG):
+        assert_cpu_and_tpu_equal(_cast_df(t, to))
+
+
+def test_string_to_bool():
+    vals = ["true", "TRUE", "t", "y", "yes", "1", "false", "f", "no", "N",
+            "0", " true ", "tr", "2", "", None]
+    t = pa.table({"a": pa.array(vals)})
+    assert_cpu_and_tpu_equal(_cast_df(t, BOOLEAN))
+
+
+def test_string_to_date():
+    vals = [
+        "2020-01-05", " 2021-12-31 ", "2020", "2020-2", "2020-02-29",
+        "2019-02-29", "2020-02-30", "2020-13-01", "2020-00-10", "junk",
+        "2020-01-05T12:00:00", "1582-10-10", "0001-01-01",
+        "", None,
+    ]
+    t = pa.table({"a": pa.array(vals)})
+    assert_cpu_and_tpu_equal(_cast_df(t, DATE))
+
+
+def test_string_to_timestamp_gated():
+    vals = [
+        "2020-01-05 12:34:56", "2020-01-05T01:02:03.5", "2020-01-05",
+        "2020-01-05 12:34:56.123456", "2020-01-05 12:34:56Z", "2020",
+        "2020-01-05 25:00:00", "2020-01-05 12:61:00", "bad",
+        "2020-01-05 1:2:3", "", None,
+    ]
+    t = pa.table({"a": pa.array(vals)})
+    assert_cpu_and_tpu_equal(_cast_df(t, TIMESTAMP), conf=FLOAT_CONF)
+
+
+def test_string_to_float_gated():
+    vals = [
+        "1.5", "-2e3", "inf", "+Inf", "-Infinity", "NaN", "nan", " 3.25 ",
+        ".5", "5.", "1e", "e5", "abc", "1.2.3", "0", "-0.0",
+        "1.7976931348623157e308", "1e400", "123456789.123456789", None,
+    ]
+    t = pa.table({"a": pa.array(vals)})
+    for to in (FLOAT, DOUBLE):
+        assert_cpu_and_tpu_equal(_cast_df(t, to), conf=FLOAT_CONF)
+
+
+def test_string_to_float_fuzz():
+    rng = np.random.default_rng(41)
+    vals = (
+        rng.standard_normal(800) * np.power(10.0, rng.integers(-250, 250, 800))
+    ).astype(np.float64)
+    strs = [repr(v) for v in vals] + [
+        "%de%d" % (m, e)
+        for m, e in zip(
+            rng.integers(-(10**15), 10**15, 300), rng.integers(-300, 300, 300)
+        )
+    ]
+    t = pa.table({"a": pa.array(strs)})
+    assert_cpu_and_tpu_equal(_cast_df(t, DOUBLE), conf=FLOAT_CONF)
+
+
+def test_string_to_decimal():
+    vals = [
+        "123.456", "-0.0015", "1.23e2", "9999999999", "0.005", "-0.005",
+        ".5", "1e-40", "1e40", "junk", " 7 ", "", None,
+    ]
+    t = pa.table({"a": pa.array(vals)})
+    assert_cpu_and_tpu_equal(_cast_df(t, DecimalType(10, 2)))
+
+
+def test_string_round_trip_int_fuzz():
+    t = gen_table([("a", LONG)], 500, seed=51)
+    def build(s):
+        df = s.create_dataframe(t, num_partitions=2)
+        return df.select(col("a").cast(STRING).cast(LONG).alias("c"))
+    assert_cpu_and_tpu_equal(build)
+
+
+# ── ANSI mode ──────────────────────────────────────────────────────────────
+ANSI = {"spark.sql.ansi.enabled": True}
+
+
+@pytest.mark.parametrize("engine", ["cpu", "tpu"])
+def test_ansi_narrowing_overflow_raises(engine):
+    t = pa.table({"a": pa.array([300], type=pa.int32())})
+    s = cpu_session(ANSI) if engine == "cpu" else tpu_session(ANSI)
+    df = s.create_dataframe(t).select(col("a").cast(BYTE).alias("c"))
+    with pytest.raises(AnsiError):
+        df.collect()
+
+
+@pytest.mark.parametrize("engine", ["cpu", "tpu"])
+def test_ansi_bad_string_raises(engine):
+    t = pa.table({"a": pa.array(["12", "junk"])})
+    s = cpu_session(ANSI) if engine == "cpu" else tpu_session(ANSI)
+    df = s.create_dataframe(t).select(col("a").cast(INT).alias("c"))
+    with pytest.raises(AnsiError):
+        df.collect()
+
+
+@pytest.mark.parametrize("engine", ["cpu", "tpu"])
+def test_ansi_float_to_int_nan_raises(engine):
+    t = pa.table({"a": pa.array([1.5, float("nan")], type=pa.float64())})
+    s = cpu_session(ANSI) if engine == "cpu" else tpu_session(ANSI)
+    df = s.create_dataframe(t).select(col("a").cast(INT).alias("c"))
+    with pytest.raises(AnsiError):
+        df.collect()
+
+
+def test_ansi_ok_values_match():
+    t = pa.table({"a": pa.array([100, -100, None], type=pa.int32())})
+    assert_cpu_and_tpu_equal(_cast_df(t, BYTE), conf=ANSI)
+
+
+def test_ansi_null_input_does_not_raise():
+    t = pa.table({"a": pa.array([None, "5"], type=pa.string())})
+    assert_cpu_and_tpu_equal(_cast_df(t, INT), conf=ANSI)
+
+
+def test_ansi_filtered_row_still_raises():
+    """Spark ANSI: the cast error fires even when a later filter would have
+    dropped the row (errors are evaluated before compaction)."""
+    t = pa.table({"a": pa.array(["5", "junk"])})
+    for mk in (cpu_session, tpu_session):
+        s = mk(ANSI)
+        df = s.create_dataframe(t)
+        df = df.filter(col("a").cast(INT) > 100)
+        with pytest.raises(AnsiError):
+            df.collect()
+
+
+def test_ansi_cast_in_untaken_branch_does_not_raise():
+    """when(a == 'xyz', null).otherwise(cast(a)) must not raise for the
+    'xyz' row — branches are evaluated per-row in Spark."""
+    from spark_rapids_tpu.functions import when, lit
+
+    t = pa.table({"a": pa.array(["1", "xyz", "3"])})
+
+    def build(s):
+        df = s.create_dataframe(t)
+        return df.select(
+            when(col("a") == "xyz", lit(None))
+            .otherwise(col("a").cast(INT))
+            .alias("c")
+        )
+
+    assert_cpu_and_tpu_equal(build, conf=ANSI)
+
+
+def test_ansi_coalesce_masks_later_errors():
+    from spark_rapids_tpu.functions import coalesce
+
+    t = pa.table({"a": pa.array(["1", None]), "b": pa.array(["7", "bad"])})
+
+    def build(s):
+        df = s.create_dataframe(t)
+        # b is only consulted where a is null; 'bad' sits where a is valid
+        return df.select(
+            coalesce(col("a").cast(INT), col("b").cast(INT)).alias("c")
+        )
+
+    t_ok = pa.table({"a": pa.array(["1", "2"]), "b": pa.array(["7", "bad"])})
+
+    def build_ok(s):
+        df = s.create_dataframe(t_ok)
+        return df.select(
+            coalesce(col("a").cast(INT), col("b").cast(INT)).alias("c")
+        )
+
+    assert_cpu_and_tpu_equal(build_ok, conf=ANSI)
+
+
+def test_string_huge_exponent_saturates():
+    vals = ["1e1000", "-1e1000", "1e-1000", "2.5e308", "1e99999999", None]
+    t = pa.table({"a": pa.array(vals)})
+    assert_cpu_and_tpu_equal(_cast_df(t, DOUBLE), conf=FLOAT_CONF)
+
+
+def test_unicode_digits_rejected():
+    vals = ["１２３", "123", "١٢٣"]
+    t = pa.table({"a": pa.array(vals)})
+    assert_cpu_and_tpu_equal(_cast_df(t, INT))
+
+
+def test_timestamp_trailing_dot():
+    vals = ["2020-01-01 12:00:00.", "2020-01-01 12:00:00.5"]
+    t = pa.table({"a": pa.array(vals)})
+    assert_cpu_and_tpu_equal(_cast_df(t, TIMESTAMP), conf=FLOAT_CONF)
+
+
+def test_ansi_error_raises_through_filter_fused_aggregate():
+    """The aggregate's filter-fusion fast path must not swallow ANSI cast
+    errors from the filter condition (r2 review finding)."""
+    from spark_rapids_tpu.functions import sum as sum_
+
+    t = pa.table({"k": [1, 1, 2, 2], "s": ["1", "2", "oops", "4"], "v": [10, 20, 30, 40]})
+    for mk in (cpu_session, tpu_session):
+        sess = mk(ANSI)
+        df = (
+            sess.create_dataframe(t)
+            .filter(col("s").cast(INT) > 0)
+            .group_by("k")
+            .agg(sum_(col("v")).alias("sv"))
+        )
+        with pytest.raises(AnsiError):
+            df.collect()
+
+
+def test_zero_mantissa_huge_exponent_is_zero():
+    vals = ["0e400", "-0.0E+999", "0.000e999"]
+    t = pa.table({"a": pa.array(vals)})
+    assert_cpu_and_tpu_equal(_cast_df(t, DOUBLE), conf=FLOAT_CONF)

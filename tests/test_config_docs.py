@@ -1,0 +1,123 @@
+"""Config hygiene + generated docs — r1 verdict #9: a registered key that
+nothing reads is worse than no key (the reference's keys all gate behavior),
+and docs are generated from code so they cannot drift
+(RapidsConf.scala:1052-1149, TypeChecks.scala:1581)."""
+import os
+import re
+
+import pyarrow as pa
+import pytest
+
+import spark_rapids_tpu.config as cfg
+from spark_rapids_tpu.functions import avg, col, sum as sum_
+
+from harness import assert_cpu_and_tpu_equal, cpu_session, tpu_session
+
+SRC_ROOT = os.path.join(os.path.dirname(__file__), "..", "spark_rapids_tpu")
+
+
+def _source_blob() -> str:
+    chunks = []
+    for root, _dirs, files in os.walk(SRC_ROOT):
+        for f in files:
+            if f.endswith(".py") and f != "config.py":
+                with open(os.path.join(root, f)) as fh:
+                    chunks.append(fh.read())
+    return "\n".join(chunks)
+
+
+def test_every_registered_key_is_read_somewhere():
+    """Each ConfEntry constant must be referenced outside config.py."""
+    blob = _source_blob()
+    names = {
+        name
+        for name, v in vars(cfg).items()
+        if isinstance(v, cfg.ConfEntry)
+    }
+    unused = sorted(
+        n for n in names if not re.search(rf"\bcfg\.{n}\b|\b{n}\.get\b|\bconfig\.{n}\b", blob)
+    )
+    assert not unused, f"registered but never read: {unused}"
+
+
+def test_docs_generate_and_cover_all_public_keys(tmp_path):
+    from spark_rapids_tpu.docs_gen import generate_configs_md, generate_supported_ops_md
+
+    md = generate_configs_md()
+    for key, e in cfg._REGISTRY.items():
+        if not e.internal:
+            assert f"`{key}`" in md, key
+    ops = generate_supported_ops_md()
+    assert "FilterExec" in ops and "Cast" in ops
+
+
+def test_metrics_level_gates_timing_metrics():
+    t = pa.table({"a": list(range(100)), "b": [float(i) for i in range(100)]})
+
+    def q(s):
+        return (
+            s.create_dataframe(t, num_partitions=2)
+            .filter(col("a") > 10)
+            .agg(sum_(col("b")).alias("s"))
+        )
+
+    s1 = tpu_session({"spark.rapids.sql.metrics.level": "MODERATE"})
+    q(s1).collect()
+    m1 = s1._last_plan.collect_metrics()
+    flat1 = {k for d in m1.values() for k in d}
+    assert "numInputRows" in flat1 and "hostToDeviceTime" in flat1
+    timed = [
+        v
+        for d in m1.values()
+        for k, v in d.items()
+        if k == "deviceToHostTime"
+    ]
+    assert timed and timed[0] > 0
+
+    s2 = tpu_session({"spark.rapids.sql.metrics.level": "ESSENTIAL"})
+    q(s2).collect()
+    m2 = s2._last_plan.collect_metrics()
+    timed2 = [
+        v
+        for d in m2.values()
+        for k, v in d.items()
+        if k in ("deviceToHostTime", "hostToDeviceTime")
+    ]
+    assert all(v == 0 for v in timed2)  # ESSENTIAL: no timing collection
+
+
+def test_variable_float_agg_gate():
+    t = pa.table({"k": [1, 1, 2], "x": [0.5, 1.5, 2.5]})
+    s = tpu_session(
+        {"spark.rapids.sql.variableFloatAgg.enabled": False}, strict=False
+    )
+    df = s.create_dataframe(t).group_by("k").agg(sum_(col("x")).alias("s"))
+    rows = sorted(df.collect())
+    assert rows == [(1, 2.0), (2, 2.5)]
+    # the aggregate fell back (explain has a non-device HashAggregate)
+    assert any(
+        "HashAggregate" in e.node and not e.on_device
+        for e in s._last_overrides.explain
+    )
+    # int sums stay on device
+    t2 = pa.table({"k": [1, 1], "x": [1, 2]})
+    s2 = tpu_session({"spark.rapids.sql.variableFloatAgg.enabled": False})
+    df2 = s2.create_dataframe(t2).group_by("k").agg(sum_(col("x")).alias("s"))
+    assert df2.collect() == [(1, 3)]
+
+
+def test_has_nans_false_differential():
+    """hasNans=false skips NaN canonicalization in group keys; with no NaNs
+    present results are identical."""
+    t = pa.table({"k": [1.5, 1.5, 2.5, None], "x": [1, 2, 3, 4]})
+    assert_cpu_and_tpu_equal(
+        lambda s: s.create_dataframe(t).group_by("k").agg(sum_(col("x")).alias("s")),
+        conf={"spark.rapids.sql.hasNans": False},
+    )
+
+
+def test_batch_size_bytes_rechunks_h2d():
+    t = pa.table({"a": list(range(1000))})
+    s = tpu_session({"spark.rapids.sql.batchSizeBytes": "1kb"})
+    df = s.create_dataframe(t).filter(col("a") >= 0)
+    assert len(df.collect()) == 1000

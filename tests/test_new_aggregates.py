@@ -1,0 +1,213 @@
+"""Distinct aggregates, variance/stddev, collect_list/set, pivot, distinct()
+— reference: AggregateFunctions.scala:1-679 (GpuStddevSamp/GpuVariancePop,
+GpuCollectList/Set, GpuPivotFirst), AggUtils.planAggregateWithOneDistinct
+(the distinct two-level rewrite Spark hands the plugin)."""
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu.functions import (
+    avg,
+    col,
+    collect_list,
+    collect_set,
+    count,
+    count_distinct,
+    first,
+    max as max_,
+    min as min_,
+    stddev,
+    stddev_pop,
+    sum as sum_,
+    sum_distinct,
+    var_pop,
+    variance,
+)
+from spark_rapids_tpu.types import DOUBLE, INT, LONG, STRING
+
+from data_gen import gen_grouped_table, gen_table
+from harness import assert_cpu_and_tpu_equal
+
+AGG_FALLBACK = ["HashAggregate", "ShuffleExchange", "CpuHashAggregate",
+                "CpuShuffleExchange", "CpuScan", "CpuCoalesce", "Coalesce"]
+
+
+def _grouped(n=500, seed=0, dtype=LONG):
+    return gen_grouped_table([("x", dtype), ("y", DOUBLE)], n, num_groups=7, seed=seed)
+
+
+# ── DISTINCT (TPC-DS q38/q87-shaped) ───────────────────────────────────────
+def test_count_distinct_grouped():
+    t = _grouped(seed=1)
+    assert_cpu_and_tpu_equal(
+        lambda s: s.create_dataframe(t, num_partitions=3)
+        .group_by("k")
+        .agg(count_distinct(col("x")).alias("cd"))
+    )
+
+
+def test_count_distinct_ungrouped():
+    t = _grouped(seed=2)
+    assert_cpu_and_tpu_equal(
+        lambda s: s.create_dataframe(t, num_partitions=3).agg(
+            count_distinct(col("x")).alias("cd")
+        )
+    )
+
+
+def test_mixed_distinct_and_plain_aggs():
+    t = _grouped(seed=3)
+    assert_cpu_and_tpu_equal(
+        lambda s: s.create_dataframe(t, num_partitions=3)
+        .group_by("k")
+        .agg(
+            count_distinct(col("x")).alias("cd"),
+            sum_distinct(col("x")).alias("sd"),
+            sum_(col("y")).alias("sy"),
+            count(col("y")).alias("cy"),
+            min_(col("x")).alias("mn"),
+            max_(col("x")).alias("mx"),
+            avg(col("y")).alias("ay"),
+        ),
+        approx_float=True,
+    )
+
+
+def test_distinct_on_strings():
+    t = gen_grouped_table([("x", STRING)], 400, num_groups=5, seed=4)
+    assert_cpu_and_tpu_equal(
+        lambda s: s.create_dataframe(t, num_partitions=2)
+        .group_by("k")
+        .agg(count_distinct(col("x")).alias("cd"))
+    )
+
+
+def test_q38_shape_distinct_over_join_keys():
+    """count(distinct) over multiple partitions with duplicate-heavy keys."""
+    rng = np.random.default_rng(5)
+    t = pa.table(
+        {
+            "k": rng.integers(0, 4, 1000),
+            "x": rng.integers(0, 25, 1000),
+        }
+    )
+    assert_cpu_and_tpu_equal(
+        lambda s: s.create_dataframe(t, num_partitions=4)
+        .group_by("k")
+        .agg(count_distinct(col("x")).alias("cd"), count(col("x")).alias("c"))
+    )
+
+
+# ── variance / stddev ──────────────────────────────────────────────────────
+@pytest.mark.parametrize(
+    "fn", [stddev, stddev_pop, variance, var_pop], ids=lambda f: f.__name__
+)
+def test_variance_family(fn):
+    t = _grouped(seed=6)
+    assert_cpu_and_tpu_equal(
+        lambda s: s.create_dataframe(t, num_partitions=3)
+        .group_by("k")
+        .agg(fn(col("y")).alias("v")),
+        approx_float=True,
+    )
+
+
+def test_variance_single_row_group_is_nan_samp():
+    t = pa.table({"k": [1, 2, 2], "y": [1.0, 2.0, 4.0]})
+    assert_cpu_and_tpu_equal(
+        lambda s: s.create_dataframe(t)
+        .group_by("k")
+        .agg(variance(col("y")).alias("v"), var_pop(col("y")).alias("vp"))
+    )
+
+
+def test_variance_ungrouped():
+    t = _grouped(seed=7)
+    assert_cpu_and_tpu_equal(
+        lambda s: s.create_dataframe(t, num_partitions=2).agg(
+            stddev(col("y")).alias("sd")
+        ),
+        approx_float=True,
+    )
+
+
+# ── collect_list / collect_set (CPU path; device falls back by TypeSig) ────
+def _sorted_lists(rows):
+    return [
+        tuple(sorted(v, key=lambda x: (x is None, x)) if isinstance(v, list) else v for v in r)
+        for r in rows
+    ]
+
+
+def test_collect_list_and_set():
+    t = _grouped(200, seed=8)
+
+    def build(s):
+        return (
+            s.create_dataframe(t, num_partitions=2)
+            .group_by("k")
+            .agg(
+                collect_list(col("x")).alias("cl"),
+                collect_set(col("x")).alias("cs"),
+            )
+        )
+
+    from harness import cpu_session, tpu_session
+
+    cpu_rows = _sorted_lists(build(cpu_session()).collect())
+    tpu_rows = _sorted_lists(
+        build(tpu_session(strict=False)).collect()
+    )
+    assert sorted(map(repr, cpu_rows)) == sorted(map(repr, tpu_rows))
+
+
+def test_collect_set_dedups_with_nans():
+    t = pa.table({"k": [1, 1, 1, 1], "y": [float("nan"), float("nan"), 1.0, 1.0]})
+
+    def build(s):
+        return s.create_dataframe(t).group_by("k").agg(collect_set(col("y")).alias("cs"))
+
+    from harness import cpu_session
+
+    rows = build(cpu_session()).collect()
+    assert len(rows[0][1]) == 2  # NaN == NaN for set identity (Spark)
+
+
+# ── pivot ──────────────────────────────────────────────────────────────────
+def test_pivot_auto_values():
+    t = pa.table(
+        {"k": [1, 1, 1, 2, 2], "p": ["a", "b", "a", "a", "c"], "v": [1, 2, 3, 4, 5]}
+    )
+    assert_cpu_and_tpu_equal(
+        lambda s: s.create_dataframe(t, num_partitions=2)
+        .group_by("k")
+        .pivot("p")
+        .agg(sum_(col("v"))),
+    )
+
+
+def test_pivot_explicit_values_multi_agg():
+    t = pa.table(
+        {"k": [1, 1, 1, 2, 2], "p": ["a", "b", "a", "a", "c"], "v": [1, 2, 3, 4, 5]}
+    )
+    assert_cpu_and_tpu_equal(
+        lambda s: s.create_dataframe(t)
+        .group_by("k")
+        .pivot("p", ["a", "b"])
+        .agg(sum_(col("v")).alias("s"), count(col("v")).alias("c")),
+    )
+
+
+# ── distinct() / drop_duplicates ───────────────────────────────────────────
+def test_dataframe_distinct():
+    t = gen_grouped_table([("x", INT)], 300, num_groups=4, seed=9)
+    assert_cpu_and_tpu_equal(
+        lambda s: s.create_dataframe(t, num_partitions=3).distinct()
+    )
+
+
+def test_drop_duplicates_subset():
+    t = gen_grouped_table([("x", INT), ("y", DOUBLE)], 200, num_groups=4, seed=10)
+    assert_cpu_and_tpu_equal(
+        lambda s: s.create_dataframe(t).drop_duplicates(["k"]).select(col("k"))
+    )

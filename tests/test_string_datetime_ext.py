@@ -1,0 +1,254 @@
+"""String long tail + datetime patterns — reference:
+stringFunctions.scala:1-889, GpuGetJsonObject.scala, datetimeExpressions.scala
+(pattern-gated cuDF strftime). concat_ws/translate/date_format/from_unixtime/
+unix_timestamp run on device; split/regexp/json are CPU-engine with per-node
+fallback (the r1 verdict's expression long tail)."""
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu import functions as F
+from spark_rapids_tpu.functions import col
+
+from data_gen import gen_table
+from harness import assert_cpu_and_tpu_equal, cpu_session
+
+CPU_ONLY_OK = ["Project", "CpuProject", "Filter", "CpuFilter"]
+
+
+def _strings(vals):
+    return pa.table({"a": pa.array(vals)})
+
+
+def test_concat_ws_skips_nulls():
+    t = pa.table(
+        {
+            "a": pa.array(["x", None, "y", None]),
+            "b": pa.array(["1", "2", None, None]),
+        }
+    )
+    assert_cpu_and_tpu_equal(
+        lambda s: s.create_dataframe(t, num_partitions=2).select(
+            F.concat_ws("-", col("a"), col("b")).alias("c")
+        )
+    )
+    rows = (
+        cpu_session()
+        .create_dataframe(t)
+        .select(F.concat_ws("-", col("a"), col("b")).alias("c"))
+        .collect()
+    )
+    assert rows == [("x-1",), ("2",), ("y",), ("",)]
+
+
+def test_concat_ws_casts_non_strings():
+    t = pa.table({"a": pa.array([1, 2]), "b": pa.array(["x", "y"])})
+    assert_cpu_and_tpu_equal(
+        lambda s: s.create_dataframe(t).select(
+            F.concat_ws(":", col("a"), col("b")).alias("c")
+        )
+    )
+
+
+def test_translate():
+    t = _strings(["abcabc", "xyz", "", None, "aabbcc"])
+    assert_cpu_and_tpu_equal(
+        lambda s: s.create_dataframe(t, num_partitions=2).select(
+            F.translate(col("a"), "abc", "12").alias("c")  # c deleted
+        )
+    )
+    rows = (
+        cpu_session()
+        .create_dataframe(t)
+        .select(F.translate(col("a"), "abc", "12").alias("c"))
+        .collect()
+    )
+    assert rows == [("1212",), ("xyz",), ("",), (None,), ("1122",)]
+
+
+def test_translate_non_ascii_falls_back():
+    t = _strings(["héllo"])
+    assert_cpu_and_tpu_equal(
+        lambda s: s.create_dataframe(t).select(
+            F.translate(col("a"), "é", "e").alias("c")
+        ),
+        allowed_non_tpu=CPU_ONLY_OK,
+    )
+
+
+def test_split():
+    t = _strings(["a,b,c", "x", "", ",lead", "trail,", None])
+    def build(s):
+        return s.create_dataframe(t).select(F.split(col("a"), ",").alias("c"))
+
+    rows = build(cpu_session()).collect()
+    assert rows == [
+        (["a", "b", "c"],),
+        (["x"],),
+        ([""],),
+        (["", "lead"],),
+        (["trail", ""],),
+        (None,),
+    ]
+    assert_cpu_and_tpu_equal(build, allowed_non_tpu=CPU_ONLY_OK)
+
+
+def test_rlike_and_regexp():
+    t = _strings(["foo123", "bar", "123baz", "", None])
+
+    def build(s):
+        df = s.create_dataframe(t)
+        return df.select(
+            col("a").rlike("[0-9]+").alias("m"),
+            F.regexp_extract(col("a"), "([0-9]+)", 1).alias("e"),
+            F.regexp_replace(col("a"), "[0-9]+", "#").alias("r"),
+        )
+
+    rows = build(cpu_session()).collect()
+    assert rows == [
+        (True, "123", "foo#"),
+        (False, "", "bar"),
+        (True, "123", "#baz"),
+        (False, "", ""),
+        (None, None, None),
+    ]
+    assert_cpu_and_tpu_equal(build, allowed_non_tpu=CPU_ONLY_OK)
+
+
+def test_get_json_object():
+    t = _strings(
+        [
+            '{"a": {"b": 1}, "c": [10, 20]}',
+            '{"a": "text"}',
+            '{"a": true}',
+            "not json",
+            None,
+        ]
+    )
+
+    def build(s):
+        df = s.create_dataframe(t)
+        return df.select(
+            F.get_json_object(col("a"), "$.a.b").alias("ab"),
+            F.get_json_object(col("a"), "$.c[1]").alias("c1"),
+            F.get_json_object(col("a"), "$.a").alias("a"),
+        )
+
+    rows = build(cpu_session()).collect()
+    assert rows == [
+        ("1", "20", '{"b":1}'),
+        (None, None, "text"),
+        (None, None, "true"),
+        (None, None, None),
+        (None, None, None),
+    ]
+    assert_cpu_and_tpu_equal(build, allowed_non_tpu=CPU_ONLY_OK)
+
+
+# ── datetime patterns ──────────────────────────────────────────────────────
+
+
+def test_date_format_device():
+    t = pa.table(
+        {
+            "ts": pa.array(
+                [0, 1577836800123456, 86399999999, None], type=pa.int64()
+            ).cast(pa.timestamp("us", tz="UTC"))
+        }
+    )
+    for fmt in ("yyyy-MM-dd HH:mm:ss", "yyyy/MM/dd", "HH:mm", "dd.MM.yyyy"):
+        assert_cpu_and_tpu_equal(
+            lambda s, fmt=fmt: s.create_dataframe(t).select(
+                F.date_format(col("ts"), fmt).alias("c")
+            )
+        )
+
+
+def test_from_unixtime_round_trip():
+    rng = np.random.default_rng(5)
+    secs = rng.integers(0, 4_000_000_000, 200)
+    t = pa.table({"s": pa.array(secs, type=pa.int64())})
+    assert_cpu_and_tpu_equal(
+        lambda s: s.create_dataframe(t, num_partitions=2).select(
+            F.from_unixtime(col("s")).alias("str"),
+        )
+    )
+    # round trip: format then parse returns the original seconds
+    def build(s):
+        df = s.create_dataframe(t, num_partitions=2)
+        df = df.with_column("str", F.from_unixtime(col("s")))
+        return df.with_column(
+            "back", F.unix_timestamp(col("str"), "yyyy-MM-dd HH:mm:ss")
+        ).select(col("s"), col("back"))
+
+    rows = build(cpu_session()).collect()
+    assert all(a == b for a, b in rows)
+    assert_cpu_and_tpu_equal(build)
+
+
+def test_unix_timestamp_parse_invalid():
+    t = _strings(
+        ["2020-01-05 12:34:56", "2020-13-05 12:00:00", "junk", "2020-01-05", None]
+    )
+    assert_cpu_and_tpu_equal(
+        lambda s: s.create_dataframe(t).select(
+            F.unix_timestamp(col("a"), "yyyy-MM-dd HH:mm:ss").alias("c")
+        )
+    )
+
+
+def test_to_date_with_format():
+    t = _strings(["05/01/2020", "31/12/1999", "junk", None])
+    assert_cpu_and_tpu_equal(
+        lambda s: s.create_dataframe(t).select(
+            F.to_date(col("a"), "dd/MM/yyyy").alias("c")
+        )
+    )
+
+
+def test_to_timestamp_with_format():
+    t = _strings(["2020-01-05 12:00:00", "bad", None])
+    assert_cpu_and_tpu_equal(
+        lambda s: s.create_dataframe(t).select(
+            F.to_timestamp(col("a"), "yyyy-MM-dd HH:mm:ss").alias("c")
+        )
+    )
+
+
+def test_unsupported_pattern_falls_back_to_cpu():
+    t = pa.table(
+        {"ts": pa.array([0], type=pa.int64()).cast(pa.timestamp("us", tz="UTC"))}
+    )
+    s = cpu_session()
+    df = s.create_dataframe(t).select(F.date_format(col("ts"), "yyyy-MM-dd").alias("c"))
+    assert df.collect() == [("1970-01-01",)]
+    # 'EEE' is outside the token subset: planning must fall back, not crash
+    from spark_rapids_tpu.expr.datetime_fmt import pattern_supported
+
+    assert not pattern_supported("EEE, yyyy")
+
+
+def test_partial_patterns_default_month_day():
+    """'yyyy' / 'yyyy-MM' parse like Java: month/day default to 1 (r2
+    review finding)."""
+    t = _strings(["2024", "1999", "bad", None])
+    assert_cpu_and_tpu_equal(
+        lambda s: s.create_dataframe(t).select(
+            F.to_date(col("a"), "yyyy").alias("d")
+        )
+    )
+    rows = (
+        cpu_session()
+        .create_dataframe(t)
+        .select(F.to_date(col("a"), "yyyy").alias("d"))
+        .collect()
+    )
+    import datetime
+
+    assert rows[0] == (datetime.date(2024, 1, 1),)
+    t2 = _strings(["2024-03", "2024-13"])
+    assert_cpu_and_tpu_equal(
+        lambda s: s.create_dataframe(t2).select(
+            F.unix_timestamp(col("a"), "yyyy-MM").alias("u")
+        )
+    )

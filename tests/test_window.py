@@ -163,3 +163,75 @@ def test_window_fallback_wide_minmax_frame():
             "CpuShuffleExchange",
         ],
     )
+
+
+# ── numeric RANGE frames (device binary-search kernel vs CPU linear scan) ──
+
+
+def _range_table(n=260, seed=33):
+    rng = np.random.default_rng(seed)
+    v = rng.integers(0, 60, n).astype(np.int64)  # heavy ties
+    nulls = rng.random(n) < 0.08
+    return pa.table(
+        {
+            "k": pa.array(rng.integers(0, 6, n).astype(np.int32)),
+            "o": pa.array(
+                [None if m else int(x) for x, m in zip(v, nulls)], type=pa.int64()
+            ),
+            "v": pa.array(rng.standard_normal(n)),
+        }
+    )
+
+
+@pytest.mark.parametrize("lo,hi", [(-5, 0), (-3, 3), (0, 10), (-10, -2), (2, 8)])
+def test_numeric_range_frames(lo, hi):
+    t = _range_table()
+    w = Window.partition_by("k").order_by("o").range_between(lo, hi)
+    assert_cpu_and_tpu_equal(
+        lambda s: s.create_dataframe(t, num_partitions=2)
+        .with_column("rs", F.sum(col("v")).over(w))
+        .with_column("rmin", F.min(col("v")).over(w))
+        .with_column("rmax", F.max(col("v")).over(w))
+        .with_column("rc", F.count(col("v")).over(w)),
+        approx_float=True,
+    )
+
+
+def test_numeric_range_desc_order():
+    t = _range_table(seed=34)
+    w = (
+        Window.partition_by("k")
+        .order_by(col("o").desc())
+        .range_between(-4, 4)
+    )
+    assert_cpu_and_tpu_equal(
+        lambda s: s.create_dataframe(t, num_partitions=2)
+        .with_column("rs", F.sum(col("v")).over(w))
+        .with_column("rc", F.count(col("v")).over(w)),
+        approx_float=True,
+    )
+
+
+def test_numeric_range_one_side_unbounded():
+    t = _range_table(seed=35)
+    w = Window.partition_by("k").order_by("o").range_between(
+        Window.unboundedPreceding, 5
+    )
+    assert_cpu_and_tpu_equal(
+        lambda s: s.create_dataframe(t, num_partitions=2)
+        .with_column("rs", F.sum(col("v")).over(w))
+        .with_column("rmax", F.max(col("v")).over(w)),
+        approx_float=True,
+    )
+
+
+def test_wide_bounded_rows_min_max_on_device():
+    """Frames wider than the old unroll cap (256) now run on device via the
+    sparse-table kernel."""
+    t = _table(n=600, with_ties=False)
+    w = _w().rows_between(-400, 400)
+    assert_cpu_and_tpu_equal(
+        lambda s: s.create_dataframe(t, num_partitions=2)
+        .with_column("bmin", F.min(col("v")).over(w))
+        .with_column("bmax", F.max(col("v")).over(w)),
+    )
