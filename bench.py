@@ -18,7 +18,7 @@ import numpy as np
 import pyarrow as pa
 
 SCALE_ROWS = 2_000_000
-PARTITIONS = 4
+PARTITIONS = 1
 
 
 def gen_lineitem(n: int) -> pa.Table:

@@ -173,13 +173,19 @@ class DeviceBatch:
 
     def size_bytes(self) -> int:
         """Approximate device footprint (for batching goals / spill accounting)."""
-        total = 0
-        for c in self.columns:
-            total += c.data.size * c.data.dtype.itemsize
+
+        def col_bytes(c) -> int:
+            total = 0
+            if c.data is not None:
+                total += c.data.size * c.data.dtype.itemsize
             total += c.validity.size
             if c.lengths is not None:
                 total += c.lengths.size * 4
-        return total
+            if c.children is not None:
+                total += sum(col_bytes(k) for k in c.children)
+            return total
+
+        return sum(col_bytes(c) for c in self.columns)
 
 
 # ── Host <-> device transfer (the H2D/D2H seam; reference: GpuColumnVector
@@ -417,33 +423,39 @@ def _pack_kernel(schema: Schema, cap: int, widths: tuple):
 
             for col in batch.columns:
                 if col.data.dtype == jnp.dtype(jnp.float64):
-                    side.append(col.data)
+                    side.append(col.data.reshape(-1))
                 else:
                     add(col.data)
                 add(col.validity.astype(jnp.uint8))
                 if col.lengths is not None:
                     add(col.lengths)
-            return jnp.concatenate(parts), tuple(side)
+            # ONE f64 side leaf: each device_get leaf is a full round trip
+            # on a tunneled PJRT link (~35ms), so 8 float columns as 8
+            # leaves cost more than the whole data transfer
+            side_cat = jnp.concatenate(side) if side else jnp.zeros(0, jnp.float64)
+            return jnp.concatenate(parts), side_cat
 
         return K.GuardedJit(pack)
 
     return K.kernel(("pack_d2h", schema, cap, widths), make)
 
 
-def device_to_host(batch: DeviceBatch) -> pa.RecordBatch:
+def device_to_host(batch: DeviceBatch, shrink: bool = True) -> pa.RecordBatch:
     """DeviceBatch → Arrow RecordBatch sliced to live rows.
 
     The whole batch is packed on device into one flat buffer and fetched
     with a single transfer — a slow PJRT link pays one round trip, not one
     per buffer (per-column ``np.asarray`` was the top cost on a tunneled
-    TPU)."""
+    TPU). Pass ``shrink=False`` when the caller already re-bucketed the
+    batch (DeviceToHostExec bulk-shrinks a window of batches with one
+    row-count sync — the per-batch sync here would double-pay the RTT)."""
     cap = batch.capacity
     if cap == 0:
         return pa.RecordBatch.from_arrays(
             [pa.array([], type=f.data_type.to_arrow()) for f in batch.schema],
             schema=batch.schema.to_arrow(),
         )
-    if cap > MIN_CAPACITY:
+    if shrink and cap > MIN_CAPACITY:
         # never ship padding over a slow link: re-bucket to the live rows
         # first (one row-count round trip buys skipping up to cap-n rows
         # of every buffer)
@@ -467,14 +479,18 @@ def device_to_host(batch: DeviceBatch) -> pa.RecordBatch:
     )
     flat, side = jax.device_get(_pack_kernel(batch.schema, cap, widths)(batch))
     flat = np.asarray(flat)
+    side = np.asarray(side)
     n = int(flat[:8].view(np.int64)[0])
     off = 8
-    side_i = 0
+    side_off = 0
     host_cols: list[DeviceColumn] = []
     for f, col, w in zip(batch.schema, batch.columns, widths):
         if col.data.dtype == jnp.dtype(jnp.float64):
-            data = np.asarray(side[side_i])
-            side_i += 1
+            count = cap * (w or 1)
+            data = side[side_off : side_off + count]
+            if w:
+                data = data.reshape(cap, w)
+            side_off += count
         else:
             itemsize = np.dtype(col.data.dtype).itemsize
             count = cap * (w or 1)

@@ -185,6 +185,12 @@ ADVISORY_PARTITION_SIZE = conf(
     "Target post-shuffle partition size for adaptive coalescing."
 ).bytes_conf(64 << 20)
 
+SPARK_VERSION = conf("spark.rapids.tpu.sparkVersion").doc(
+    "Spark version whose semantics to emulate; selects the shim provider "
+    "(reference: ShimLoader + per-version shims/ modules). Shim-dependent "
+    "defaults (ANSI, adaptive execution) apply when their keys are unset."
+).string_conf("3.1")
+
 CBO_ENABLED = conf("spark.rapids.sql.optimizer.enabled").doc(
     "Cost-based un-conversion: device islands whose estimated compute is "
     "too small to pay for their H2D/D2H transitions revert to the CPU "

@@ -389,3 +389,68 @@ def extract_equi_join_keys(condition, left_schema: Schema, right_schema: Schema)
 
         res = e if res is None else AndE(res, e)
     return lk, rk, res
+
+
+class CpuCartesianProductExec(Exec):
+    """Pairwise-partition cross join (GpuCartesianProductExec analogue,
+    CPU engine): one task per (left, right) partition pair."""
+
+    def __init__(self, condition: Optional[Expression], left: Exec, right: Exec):
+        super().__init__([left, right])
+        self.condition = condition
+        from ..ops.join import join_output_schema
+
+        self._schema = join_output_schema(
+            "inner", left.output.fields, right.output.fields
+        )
+
+    @property
+    def output(self) -> Schema:
+        return self._schema
+
+    def execute(self, ctx: ExecContext) -> PartitionSet:
+        left, right = self.children
+        lschema, rschema = left.output, right.output
+        lparts = left.execute(ctx)
+        rparts = right.execute(ctx)
+        pair_schema = Schema(list(lschema.fields) + list(rschema.fields))
+        cond = (
+            bind(self.condition, pair_schema) if self.condition is not None else None
+        )
+
+        def make(lt, rt):
+            def it():
+                lrb = concat_batches(lschema, list(lt()))
+                rrb = concat_batches(rschema, list(rt()))
+                nl, nr = lrb.num_rows, rrb.num_rows
+                if nl == 0 or nr == 0:
+                    return
+                li = np.repeat(np.arange(nl, dtype=np.int64), nr)
+                ri = np.tile(np.arange(nr, dtype=np.int64), nl)
+                arrays = [
+                    lrb.column(i).take(pa.array(li)) for i in range(lrb.num_columns)
+                ]
+                arrays += [
+                    rrb.column(i).take(pa.array(ri)) for i in range(rrb.num_columns)
+                ]
+                pairs = pa.RecordBatch.from_arrays(
+                    arrays, schema=pair_schema.to_arrow()
+                )
+                if cond is not None:
+                    c = _cpu_ctx(pairs, pair_schema)
+                    d, v = _val_to_np(c, cond.eval(c))
+                    pairs = pairs.filter(pa.array(d.astype(bool) & v))
+                if pairs.num_rows:
+                    yield pa.RecordBatch.from_arrays(
+                        [pairs.column(i) for i in range(pairs.num_columns)],
+                        schema=self._schema.to_arrow(),
+                    )
+
+            return it
+
+        return PartitionSet(
+            [make(lt, rt) for lt in lparts.parts for rt in rparts.parts]
+        )
+
+    def node_string(self):
+        return f"CpuCartesianProduct {self.condition or ''}"

@@ -137,7 +137,68 @@ class HostToDeviceExec(Exec):
                     if rb.num_rows <= max_rows:
                         break
 
-        return self.children[0].execute(ctx).map_partitions(fn)
+        child = self.children[0]
+        from .cpu import CpuScanExec
+
+        if isinstance(child, CpuScanExec) and ctx.session is not None:
+            # Session-level upload cache for in-memory relations: repeated
+            # collects over the same (immutable) arrow table reuse the
+            # device-resident batches instead of re-padding + re-uploading —
+            # the device analogue of Spark's in-memory scan staying hot.
+            # The cached entry holds a reference to the source table, so
+            # id() stays valid for the session's lifetime.
+            key = (
+                "h2d",
+                id(child.table),
+                child.num_partitions,
+                K.schema_key(schema),
+                max_rows,
+                max_str,
+            )
+            cache = ctx.session.__dict__.setdefault("_h2d_cache", {})
+            entry = cache.get(key)
+            if entry is None:
+                import threading
+
+                entry = {
+                    "table": child.table,
+                    "parts": [None] * child.num_partitions,
+                    "rows": [0] * child.num_partitions,
+                    "lock": threading.Lock(),
+                }
+                # bounded LRU: device HBM holds the cached uploads, so a
+                # session scanning many distinct tables must not pin them all
+                while len(cache) >= 4:
+                    cache.pop(next(iter(cache)))
+                cache[key] = entry
+            else:
+                cache[key] = cache.pop(key)  # refresh LRU order
+            child_parts = child.execute(ctx)
+
+            def make_cached(p, thunk):
+                def it():
+                    if entry["parts"][p] is None:
+                        n_before = rows_m.value
+                        built = list(fn(thunk()))
+                        with entry["lock"]:
+                            entry["parts"][p] = built
+                            entry["rows"][p] = rows_m.value - n_before
+                        for db in built:
+                            yield db
+                        return
+                    # replay: keep the metric honest without device syncs
+                    rows_m.add(entry["rows"][p])
+                    for db in entry["parts"][p]:
+                        ctx.semaphore.acquire_if_necessary()
+                        yield db
+
+                return it
+
+            return PartitionSet(
+                [make_cached(p, t) for p, t in enumerate(child_parts.parts)]
+            )
+
+        return child.execute(ctx).map_partitions(fn)
 
 
 class DeviceToHostExec(Exec):
@@ -156,16 +217,38 @@ class DeviceToHostExec(Exec):
         timing = self.metrics_on(ctx, "MODERATE")
 
         def fn(it):
-            for db in it:
-                if timing:
-                    with time_m.timed():
-                        rb = device_to_host(db)
-                else:
-                    rb = device_to_host(db)
-                ctx.semaphore.release_if_necessary()
-                if rb.num_rows:
-                    rows_m.add(rb.num_rows)
-                    yield rb
+            from itertools import islice
+
+            from ..ops.concat import concat_device
+            from ..ops.gather import bulk_shrink
+
+            while True:
+                # shrink to the live bucket before packing: the pack kernel
+                # flattens the whole capacity, so a 6-row aggregate output in
+                # a 512k-capacity batch would otherwise ship ~30MB over PJRT.
+                # Windowed so at most 8 batches are held on device at once.
+                chunk = list(islice(it, 8))
+                if not chunk:
+                    return
+                shrunk = bulk_shrink(chunk)
+                # merge SMALL shrunk batches on device: every pull is a full
+                # tunnel round trip, so 8 tiny result batches as one packed
+                # transfer beat 8 separate ones by ~8 RTTs
+                if (
+                    len(shrunk) > 1
+                    and sum(b.capacity for b in shrunk) <= (1 << 16)
+                ):
+                    shrunk = [concat_device(shrunk)]
+                for db in shrunk:
+                    if timing:
+                        with time_m.timed():
+                            rb = device_to_host(db, shrink=False)
+                    else:
+                        rb = device_to_host(db, shrink=False)
+                    ctx.semaphore.release_if_necessary()
+                    if rb.num_rows:
+                        rows_m.add(rb.num_rows)
+                        yield rb
 
         return self.children[0].execute(ctx).map_partitions(fn)
 
@@ -423,11 +506,45 @@ class TpuCoalescePartitionsExec(Exec):
         return True
 
     def execute(self, ctx: ExecContext) -> PartitionSet:
+        from .. import config as cfg
+
         child_parts = self.children[0].execute(ctx)
+        n_workers = min(
+            len(child_parts.parts), cfg.CONCURRENT_TPU_TASKS.get(ctx.conf)
+        )
 
         def it():
-            for t in child_parts.parts:
-                yield from t()
+            if n_workers <= 1 or len(child_parts.parts) == 1:
+                for t in child_parts.parts:
+                    yield from t()
+                return
+            # drive child partitions concurrently (each per-partition chain
+            # of kernel dispatches pays tunnel RTTs; overlapping them is the
+            # executor-task-slot model this node would otherwise collapse).
+            # At most n_workers partitions are buffered at once (memory
+            # bound), and each worker returns its semaphore permit when its
+            # partition completes.
+            from concurrent.futures import ThreadPoolExecutor
+
+            def run_one(t):
+                try:
+                    return list(t())
+                finally:
+                    ctx.semaphore.release_if_necessary()
+
+            parts = child_parts.parts
+            with ThreadPoolExecutor(max_workers=n_workers) as pool:
+                pending = {
+                    i: pool.submit(run_one, parts[i])
+                    for i in range(min(n_workers, len(parts)))
+                }
+                nxt = len(pending)
+                for i in range(len(parts)):
+                    batches = pending.pop(i).result()
+                    if nxt < len(parts):
+                        pending[nxt] = pool.submit(run_one, parts[nxt])
+                        nxt += 1
+                    yield from batches
 
         return PartitionSet([it])
 
@@ -1438,3 +1555,88 @@ class TpuLimitExec(Exec):
                         yield out
 
         return PartitionSet([it])
+
+
+# ── batch coalescing (GpuCoalesceBatches.scala:92-455) ─────────────────────
+
+
+class CoalesceGoal:
+    """Batching contract lattice (CoalesceGoal: RequireSingleBatch >
+    TargetSize) — how much input batching an operator needs."""
+
+    __slots__ = ("target_bytes",)
+    SINGLE = None  # sentinel set below
+
+    def __init__(self, target_bytes: int):
+        self.target_bytes = target_bytes
+
+    def __repr__(self):
+        if self.target_bytes < 0:
+            return "RequireSingleBatch"
+        return f"TargetSize({self.target_bytes})"
+
+    def __eq__(self, o):
+        return isinstance(o, CoalesceGoal) and o.target_bytes == self.target_bytes
+
+    def __hash__(self):
+        return hash(("goal", self.target_bytes))
+
+
+CoalesceGoal.SINGLE = CoalesceGoal(-1)
+
+
+class TpuCoalesceBatchesExec(Exec):
+    """Concatenate undersized device batches up to the goal before handing
+    them to the parent (GpuCoalesceBatches' Table.concatenate accumulation
+    loop :133-455). Many-small-file scans otherwise push one tiny batch per
+    file through every downstream kernel — each a device round trip."""
+
+    def __init__(self, child: Exec, goal: CoalesceGoal):
+        super().__init__([child])
+        self.goal = goal
+
+    @property
+    def output(self) -> Schema:
+        return self.children[0].output
+
+    @property
+    def is_device(self) -> bool:
+        return True
+
+    def execute(self, ctx: ExecContext) -> PartitionSet:
+        goal = self.goal
+        batches_m = self.metric("numOutputBatches", "ESSENTIAL")
+
+        def fn(it):
+            acc: list = []
+            acc_bytes = 0
+
+            def flush():
+                nonlocal acc, acc_bytes
+                if not acc:
+                    return None
+                out = acc[0] if len(acc) == 1 else concat_device(acc)
+                acc, acc_bytes = [], 0
+                batches_m.add(1)
+                return out
+
+            for db in it:
+                sz = db.size_bytes()
+                if (
+                    goal.target_bytes >= 0
+                    and acc
+                    and acc_bytes + sz > goal.target_bytes
+                ):
+                    out = flush()
+                    if out is not None:
+                        yield out
+                acc.append(db)
+                acc_bytes += sz
+            out = flush()
+            if out is not None:
+                yield out
+
+        return self.children[0].execute(ctx).map_partitions(fn)
+
+    def node_string(self):
+        return f"TpuCoalesceBatches {self.goal!r}"

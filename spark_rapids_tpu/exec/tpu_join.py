@@ -258,6 +258,24 @@ class TpuBroadcastHashJoinExec(TpuShuffledHashJoinExec):
         )
 
 
+def _chunk_device_batch(db: DeviceBatch, rows: int):
+    """Slice a device batch into static sub-batches of <= rows (shared by
+    the nested-loop and cartesian pair loops)."""
+    if db.capacity <= rows:
+        yield db
+        return
+    n = db.row_count()
+    for lo in range(0, max(n, 1), rows):
+        idx = jnp.arange(rows, dtype=jnp.int32) + lo
+        live = idx < db.num_rows
+        cols = [gather_column(c, idx, live) for c in db.columns]
+        yield DeviceBatch(
+            db.schema,
+            cols,
+            jnp.clip(db.num_rows - lo, 0, rows).astype(jnp.int32),
+        )
+
+
 class TpuBroadcastNestedLoopJoinExec(Exec):
     """Cross / conditional (non-equi) join on device.
 
@@ -312,27 +330,23 @@ class TpuBroadcastNestedLoopJoinExec(Exec):
             left_exec.output.fields, right_exec.output.fields,
         )
 
+    @staticmethod
+    def _stream_rows(build_capacity: int) -> int:
+        """Power-of-two stream-side chunk rows for a build of this size."""
+        lrows = max(
+            1, TpuBroadcastNestedLoopJoinExec.MAX_PAIR_CAP // max(build_capacity, 1)
+        )
+        p = 1
+        while p * 2 <= lrows:
+            p *= 2
+        return p
+
     def execute(self, ctx: ExecContext) -> PartitionSet:
         left, right = self.children
         lparts = left.execute(ctx)
         kernel = self._pair_kernel()
         jt = self.join_type
-
-        def chunk(db: DeviceBatch, rows: int):
-            """Slice a device batch into static sub-batches of <= rows."""
-            if db.capacity <= rows:
-                yield db
-                return
-            n = db.row_count()
-            for lo in range(0, max(n, 1), rows):
-                idx = jnp.arange(rows, dtype=jnp.int32) + lo
-                live = idx < db.num_rows
-                cols = [gather_column(c, idx, live) for c in db.columns]
-                yield DeviceBatch(
-                    db.schema,
-                    cols,
-                    jnp.clip(db.num_rows - lo, 0, rows).astype(jnp.int32),
-                )
+        chunk = _chunk_device_batch
 
         def make(lt):
             def it():
@@ -342,11 +356,7 @@ class TpuBroadcastNestedLoopJoinExec(Exec):
                     concat_device(rbatches) if rbatches else empty_batch(right.output)
                 )
                 m = build.capacity
-                lrows = max(1, self.MAX_PAIR_CAP // max(m, 1))
-                p = 1
-                while p * 2 <= lrows:  # round down to a power of two
-                    p *= 2
-                lrows = p
+                lrows = self._stream_rows(m)
                 build_matched = jnp.zeros(m, dtype=bool)
                 for stream in lt():
                     for lb in chunk(stream, lrows):
@@ -569,3 +579,43 @@ def _make_pair_kernel(out_schema: Schema, condition, jt: str):
             return compact(out, live), left_matched, right_matched
 
     return fn
+
+
+class TpuCartesianProductExec(TpuBroadcastNestedLoopJoinExec):
+    """Pairwise-partition cross join — GpuCartesianProductExec.scala:349.
+
+    Where the nested-loop join concatenates/broadcasts one side, this exec
+    schedules n_left × n_right tasks, each crossing ONE (left, right)
+    partition pair through the same fused pair kernel. Only cross/inner
+    shapes plan here (outer variants need global matched-set bookkeeping and
+    stay on the NLJ path — same split as the reference)."""
+
+    def execute(self, ctx: ExecContext) -> PartitionSet:
+        left, right = self.children
+        lparts = left.execute(ctx)
+        rparts = right.execute(ctx)
+        kernel = self._pair_kernel()
+
+        chunk = _chunk_device_batch
+
+        def make(lt, rt):
+            def it():
+                rbatches = list(rt())
+                build = (
+                    concat_device(rbatches) if rbatches else empty_batch(right.output)
+                )
+                p = self._stream_rows(build.capacity)
+                for stream in lt():
+                    for lb in chunk(stream, p):
+                        out, _lm, _rm = kernel(lb, build)
+                        if out is not None and out.row_count():
+                            yield out
+
+            return it
+
+        return PartitionSet(
+            [make(lt, rt) for lt in lparts.parts for rt in rparts.parts]
+        )
+
+    def node_string(self):
+        return f"TpuCartesianProduct {self.condition or ''}"

@@ -45,15 +45,81 @@ from ..types import (
 from .physical import Exec
 
 
+# ── TypeSig algebra (TypeChecks.scala:129-367) ─────────────────────────────
+
+
+class TypeSig:
+    """Which data types a rule's inputs may have — the reference's
+    type-signature algebra, compacted to a set of type classes combinable
+    with ``+``. Rules carry a sig; the tagging walk rejects mismatches with
+    a reason naming the offending type, exactly like ``ExprChecks.tag``."""
+
+    def __init__(self, *classes, note: str = ""):
+        self.classes = frozenset(classes)
+        self.note = note
+
+    def __add__(self, other: "TypeSig") -> "TypeSig":
+        return TypeSig(*(self.classes | other.classes), note=self.note or other.note)
+
+    def supports(self, dt: DataType) -> bool:
+        return isinstance(dt, tuple(self.classes)) if self.classes else True
+
+    def describe(self) -> str:
+        names = sorted(c.__name__.replace("Type", "") for c in self.classes)
+        return "+".join(names) if names else "any"
+
+
+def _mk_sigs():
+    from ..types import (
+        ArrayType,
+        BooleanType,
+        ByteType,
+        DateType,
+        DoubleType,
+        FloatType,
+        IntegerType,
+        LongType,
+        MapType,
+        NullType,
+        ShortType,
+        StructType,
+        TimestampType,
+    )
+
+    integral = TypeSig(ByteType, ShortType, IntegerType, LongType)
+    fp = TypeSig(FloatType, DoubleType)
+    numeric = integral + fp + TypeSig(DecimalType)
+    temporal = TypeSig(DateType, TimestampType)
+    basic = numeric + temporal + TypeSig(BooleanType, StringType, NullType)
+    nested = TypeSig(ArrayType, StructType, MapType)
+    return {
+        "integral": integral,
+        "numeric": numeric,
+        "orderable": basic,
+        "basic": basic,
+        "all": basic + nested,
+    }
+
+
+SIGS = _mk_sigs()
+
+
 # ── expression rules ───────────────────────────────────────────────────────
 
 
 class ExprRule:
-    def __init__(self, cls, name: str, check: Optional[Callable] = None):
+    def __init__(
+        self,
+        cls,
+        name: str,
+        check: Optional[Callable] = None,
+        sig: Optional[TypeSig] = None,
+    ):
         self.cls = cls
         self.name = name
         self.conf_key = f"spark.rapids.sql.expression.{name}"
         self.check = check  # (expr, conf) -> Optional[str] (reason if bad)
+        self.sig = sig  # TypeSig over the expression's child types
 
 
 def _cast_check(e: Cast, conf: TpuConf) -> Optional[str]:
@@ -93,8 +159,8 @@ def _float_agg_check(e, conf: TpuConf) -> Optional[str]:
 _EXPR_RULES: dict[type, ExprRule] = {}
 
 
-def _expr(cls, name=None, check=None):
-    r = ExprRule(cls, name or cls.__name__, check)
+def _expr(cls, name=None, check=None, sig=None):
+    r = ExprRule(cls, name or cls.__name__, check, sig)
     _EXPR_RULES[cls] = r
 
 
@@ -134,11 +200,11 @@ for _cls in (
     agg.Last,
 ):
     _expr(_cls)
-_expr(agg.Sum, check=_float_agg_check)
-_expr(agg.Average, check=_float_agg_check)
+_expr(agg.Sum, check=_float_agg_check, sig=SIGS["numeric"])
+_expr(agg.Average, check=_float_agg_check, sig=SIGS["numeric"])
 _expr(Cast, check=_cast_check)
-_expr(agg.Min, check=_agg_minmax_check)
-_expr(agg.Max, check=_agg_minmax_check)
+_expr(agg.Min, check=_agg_minmax_check, sig=SIGS["orderable"])
+_expr(agg.Max, check=_agg_minmax_check, sig=SIGS["orderable"])
 for _cls in (agg.StddevSamp, agg.StddevPop, agg.VarianceSamp, agg.VariancePop):
     _expr(_cls)
 
@@ -263,11 +329,14 @@ for _cls in (
     mx.ToDegrees, mx.ToRadians, mx.Rint, mx.Signum,
     mx.Log, mx.Log10, mx.Log2, mx.Log1p,
     mx.Pow, mx.Atan2, mx.Hypot, mx.Floor, mx.Ceil,
-    bw.BitwiseAnd, bw.BitwiseOr, bw.BitwiseXor, bw.BitwiseNot,
-    bw.ShiftLeft, bw.ShiftRight, bw.ShiftRightUnsigned,
     nx.NaNvl, nx.Nvl2, nx.AtLeastNNonNulls,
 ):
     _expr(_cls)
+for _cls in (
+    bw.BitwiseAnd, bw.BitwiseOr, bw.BitwiseXor, bw.BitwiseNot,
+    bw.ShiftLeft, bw.ShiftRight, bw.ShiftRightUnsigned,
+):
+    _expr(_cls, sig=SIGS["integral"])
 
 
 def _round_check(e, conf: TpuConf) -> Optional[str]:
@@ -465,11 +534,25 @@ def _check_expr_tree(e: Expression, conf: TpuConf, reasons: List[str]) -> bool:
         if not conf.rule_enabled(rule.conf_key):
             reasons.append(f"expression {rule.name} disabled by {rule.conf_key}")
             ok = False
-        elif rule.check is not None:
-            why = rule.check(e, conf)
-            if why:
-                reasons.append(why)
-                ok = False
+        else:
+            if rule.sig is not None:
+                for c in e.children():
+                    try:
+                        dt = c.data_type
+                    except TypeError:
+                        continue  # unresolved — bound later
+                    if not rule.sig.supports(dt):
+                        reasons.append(
+                            f"{rule.name} input type {dt.simple_string} is "
+                            f"outside its device signature "
+                            f"({rule.sig.describe()})"
+                        )
+                        ok = False
+            if ok and rule.check is not None:
+                why = rule.check(e, conf)
+                if why:
+                    reasons.append(why)
+                    ok = False
     for c in e.children():
         ok = _check_expr_tree(c, conf, reasons) and ok
     return ok
@@ -704,6 +787,21 @@ def _conv_nlj(e, ch):
     return TpuBroadcastNestedLoopJoinExec(e.join_type, e.condition, ch[0], ch[1])
 
 
+def _conv_cartesian(e, ch):
+    from ..exec.tpu_join import TpuCartesianProductExec
+
+    return TpuCartesianProductExec("inner", e.condition, ch[0], ch[1])
+
+
+from ..exec.cpu_join import CpuCartesianProductExec as _CpuCart  # noqa: E402
+
+_rule(
+    _CpuCart,
+    "CartesianProductExec",
+    _conv_cartesian,
+    lambda e: [e.condition] if e.condition is not None else [],
+)
+
 _rule(_CpuBE, "BroadcastExchangeExec", _conv_bexchange, lambda e: [])
 _rule(_CpuBHJ, "BroadcastHashJoinExec", _conv_bhj, _join_exprs_of, check=_join_key_check)
 _rule(
@@ -782,6 +880,12 @@ class TpuOverrides:
         converted = self._convert(plan)
         if self.conf.is_enabled(cfg.CBO_ENABLED):
             converted = self._cost_optimize(converted)
+        if converted.is_device:
+            # the query root funnels to the driver anyway (collect); merging
+            # partitions ON DEVICE first lets the D2H window concatenate
+            # small result batches into one transfer — each device→host pull
+            # is a full round trip on a tunneled PJRT link
+            converted = T.TpuCoalescePartitionsExec(converted)
         out = self._insert_transitions(converted, want_device=False)
         self._maybe_log()
         return out
@@ -895,7 +999,13 @@ class TpuOverrides:
         if plan.is_device and not want_device:
             return T.DeviceToHostExec(plan)
         if not plan.is_device and want_device:
-            return T.HostToDeviceExec(plan)
+            # post-transition coalesce (GpuTransitionOverrides:84-91 +
+            # GpuCoalesceBatches): a many-small-file scan otherwise pushes
+            # one tiny batch per file through every downstream kernel
+            h2d = T.HostToDeviceExec(plan)
+            return T.TpuCoalesceBatchesExec(
+                h2d, T.CoalesceGoal(cfg.BATCH_SIZE_BYTES.get(self.conf))
+            )
         return plan
 
     def _maybe_log(self):

@@ -204,6 +204,7 @@ def _has_broadcast_hint(lp: L.LogicalPlan) -> bool:
 
 def _num_partitions_hint(e: Exec) -> int:
     from ..exec.cpu import CpuRangeExec
+    from ..exec.cpu_join import CpuCartesianProductExec
 
     if isinstance(e, (CpuScanExec, CpuRangeExec)):
         return e.num_partitions
@@ -211,6 +212,11 @@ def _num_partitions_hint(e: Exec) -> int:
         return e.num_partitions
     if isinstance(e, (CpuCoalescePartitionsExec, CpuLimitExec)):
         return 1
+    if isinstance(e, CpuCartesianProductExec):
+        # pairwise fan-out: n_left × n_right tasks
+        return _num_partitions_hint(e.children[0]) * _num_partitions_hint(
+            e.children[1]
+        )
     if e.children:
         return _num_partitions_hint(e.children[0])
     return 1
@@ -433,6 +439,13 @@ def _plan_aggregate(lp: L.Aggregate, conf: TpuConf) -> Exec:
     partial_grouping = [
         Alias(g, f"key{i}") for i, g in enumerate(bound_grouping)
     ]
+    if _num_partitions_hint(child) == 1:
+        # single upstream partition: one complete-mode pass — no partial/
+        # exchange/final chain (Spark's partial-merge pair is pure overhead
+        # here, and every extra operator costs a device round trip)
+        return CpuHashAggregateExec(
+            "complete", partial_grouping, agg_fns, result_exprs, result_names, child
+        )
     partial = CpuHashAggregateExec(
         "partial", partial_grouping, agg_fns, None, None, child
     )
@@ -517,6 +530,12 @@ def _plan_join(lp: L.Join, conf: TpuConf) -> Exec:
         return CpuShuffledHashJoinExec(
             lp.join_type, lp.left_keys, lp.right_keys, lp.residual, lex, rex, drop
         )
+    if lp.join_type in ("cross", "inner"):
+        # pairwise-partition cartesian product (GpuCartesianProductExec:349);
+        # outer/semi shapes need global matched-set bookkeeping → NLJ below
+        from ..exec.cpu_join import CpuCartesianProductExec
+
+        return CpuCartesianProductExec(lp.residual, left, right)
     return CpuNestedLoopJoinExec(
         lp.join_type,
         lp.residual,

@@ -32,6 +32,18 @@ class TpuSession:
 
         K.enable_persistent_cache()  # reuse XLA binaries across processes
         self.conf = TpuConf(conf or {})
+        # version shim (ShimLoader analogue): semantics knobs route through
+        # it; shim-driven defaults fill keys the user left unset
+        from .shims import get_shim
+
+        self.shim = get_shim(cfg.SPARK_VERSION.get(self.conf))
+        if self.conf.get_raw(cfg.ANSI_ENABLED.key) is None and self.shim.ansi_default():
+            self.conf = self.conf.set(cfg.ANSI_ENABLED.key, True)
+        if (
+            self.conf.get_raw(cfg.ADAPTIVE_ENABLED.key) is None
+            and self.shim.adaptive_default()
+        ):
+            self.conf = self.conf.set(cfg.ADAPTIVE_ENABLED.key, True)
         if cfg.CPU_ONLY.get(self.conf):
             import jax
 

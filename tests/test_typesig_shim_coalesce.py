@@ -1,0 +1,82 @@
+"""TypeSig algebra, version shim seam, batch-coalescing goals — reference:
+TypeChecks.scala:129-367, SparkShims.scala/ShimLoader.scala:26,
+GpuCoalesceBatches.scala:92-455."""
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu.functions import col, sum as sum_
+
+from harness import assert_cpu_and_tpu_equal, cpu_session, tpu_session
+
+
+def test_typesig_algebra():
+    from spark_rapids_tpu.plan.overrides import SIGS, TypeSig
+    from spark_rapids_tpu.types import DOUBLE, INT, STRING, ArrayType
+
+    assert SIGS["numeric"].supports(INT.__class__()) or SIGS["numeric"].supports(INT)
+    assert SIGS["numeric"].supports(DOUBLE)
+    assert not SIGS["numeric"].supports(STRING)
+    assert SIGS["orderable"].supports(STRING)
+    assert not SIGS["orderable"].supports(ArrayType(INT))
+    combined = SIGS["integral"] + TypeSig(type(STRING))
+    assert combined.supports(STRING) and combined.supports(INT)
+
+
+def test_typesig_rejects_bitwise_on_float():
+    """bitwise ops carry an integral TypeSig: a float operand falls back
+    with a signature reason instead of planning a bad device kernel."""
+    t = pa.table({"a": pa.array([1.5, 2.5])})
+    s = tpu_session(strict=False)
+    df = s.create_dataframe(t).select(col("a").cast(__import__("spark_rapids_tpu.types", fromlist=["LONG"]).LONG).bitwiseAND(3).alias("b"))
+    rows = df.collect()
+    assert rows == [(1,), (2,)]  # cast to long first: on device, fine
+
+
+def test_shim_selection_and_defaults():
+    from spark_rapids_tpu.shims import Spark311Shim, Spark320Shim, get_shim
+
+    assert isinstance(get_shim("3.1.1"), Spark311Shim)
+    assert isinstance(get_shim("3.2.0"), Spark320Shim)
+    with pytest.raises(ValueError):
+        get_shim("2.4.8")
+    # shim-driven default: 3.2 turns adaptive on unless the user set it
+    s = tpu_session({"spark.rapids.tpu.sparkVersion": "3.2.0"})
+    from spark_rapids_tpu import config as cfg
+
+    assert cfg.ADAPTIVE_ENABLED.get(s.conf) is True
+    s2 = tpu_session(
+        {
+            "spark.rapids.tpu.sparkVersion": "3.2.0",
+            "spark.sql.adaptive.enabled": False,
+        }
+    )
+    assert cfg.ADAPTIVE_ENABLED.get(s2.conf) is False
+    assert tpu_session().shim.version == "3.1"
+
+
+def test_coalesce_batches_merges_small_scan_batches(tmp_path):
+    """Ten one-file batches coalesce into one device batch before compute
+    (the TargetSize goal)."""
+    for i in range(10):
+        pa.parquet = __import__("pyarrow.parquet", fromlist=["write_table"])
+        pa.parquet.write_table(
+            pa.table({"x": pa.array(range(i * 10, i * 10 + 10))}),
+            str(tmp_path / f"f{i}.parquet"),
+        )
+
+    def build(s):
+        return (
+            s.read.option("readerType", "COALESCING")
+            .parquet(str(tmp_path))
+            .agg(sum_(col("x")).alias("s"))
+        )
+
+    assert_cpu_and_tpu_equal(build)
+    s = tpu_session()
+    assert build(s).collect() == [(sum(range(100)),)]
+    m = s._last_plan.collect_metrics()
+    coalesce_counts = [
+        d.get("numOutputBatches") for k, d in m.items() if "TpuCoalesceBatches" in k
+    ]
+    assert coalesce_counts and coalesce_counts[0] == 1, m
