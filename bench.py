@@ -77,7 +77,7 @@ def q6(session, table):
     )
 
 
-def time_query(build, n_warm: int = 1, n_run: int = 3) -> float:
+def time_query(build, n_warm: int = 1, n_run: int = 5) -> float:
     for _ in range(n_warm):
         build().collect()
     best = float("inf")
