@@ -10,10 +10,25 @@ from .base import Ctx, Expression, Val
 
 
 def _select(ctx: Ctx, cond, a: Val, b: Val, dtype: DataType) -> Val:
-    """where(cond, a, b) handling device strings (pad to common width)."""
+    """where(cond, a, b) handling device strings (pad to common width).
+    A typeless NULL branch (un-coerced ``lit(None)``) materializes as an
+    all-null string column here."""
     xp = ctx.xp
     condb = ctx.broadcast_bool(cond)
     if isinstance(dtype, StringType) and ctx.is_device:
+
+        def as_str(v: Val) -> Val:
+            if getattr(v.data, "ndim", 0) == 0 or v.lengths is None:
+                from ..columnar.device import MIN_STR_WIDTH
+
+                return Val(
+                    xp.zeros((ctx.n, MIN_STR_WIDTH), dtype=xp.uint8),
+                    xp.zeros(ctx.n, dtype=bool),
+                    xp.zeros(ctx.n, dtype=xp.int32),
+                )
+            return v
+
+        a, b = as_str(a), as_str(b)
         la = a.data if a.data.ndim == 2 else xp.broadcast_to(a.data[None, :], (ctx.n, a.data.shape[-1]))
         lb = b.data if b.data.ndim == 2 else xp.broadcast_to(b.data[None, :], (ctx.n, b.data.shape[-1]))
         w = max(la.shape[-1], lb.shape[-1])

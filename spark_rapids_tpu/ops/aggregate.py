@@ -95,6 +95,65 @@ def _had_nan_scan(data, valid, starts):
     return segscan((valid & jnp.isnan(data)).astype(jnp.int32), starts, jnp.add) > 0
 
 
+def _string_base_words(col: DeviceColumn):
+    """Ascending sortable uint64 value words of a string column (computed
+    once per column even when both min AND max aggregate it)."""
+    from .sortkeys import column_radix_words
+
+    return column_radix_words(col, ascending=True, nulls_first=True)[1:]
+
+
+def _string_value_words(base_words: list, valid, want_min: bool):
+    """Words for the lex-min scan with invalid rows losing STRICTLY: the
+    prepended validity word (valid→0, invalid→all-ones) breaks ties so a
+    NULL row carrying residual branch bytes can never beat a valid empty
+    string. ``want_min=False`` inverts the value words so one lex-MIN scan
+    serves both directions."""
+    lose = jnp.uint64(0xFFFFFFFFFFFFFFFF)
+    out = [jnp.where(valid, jnp.uint64(0), lose)]
+    for w in base_words:
+        w = w if want_min else ~w
+        out.append(jnp.where(valid, w, lose))
+    return out
+
+
+def _seg_arglexmin(words: list, starts, idx):
+    """Per-row running index of the lexicographically smallest word tuple in
+    the segment (ties keep the earlier row — stable, like the CPU oracle).
+    The (flag, words…, idx) combine is the standard segmented-scan form."""
+
+    def comb(a, b):
+        af, bf = a[0], b[0]
+        a_ws, b_ws = a[1:-1], b[1:-1]
+        lt = jnp.zeros(a_ws[0].shape, dtype=bool)
+        eq = jnp.ones(a_ws[0].shape, dtype=bool)
+        for aw, bw in zip(a_ws, b_ws):
+            lt = lt | (eq & (bw < aw))
+            eq = eq & (bw == aw)
+        take_b = bf | lt  # segment restart at b, or b strictly smaller
+        out_ws = tuple(
+            jnp.where(take_b, bw, aw) for aw, bw in zip(a_ws, b_ws)
+        )
+        out_i = jnp.where(take_b, b[-1], a[-1])
+        return (af | bf, *out_ws, out_i)
+
+    carry = (starts, *words, idx)
+    out = jax.lax.associative_scan(comb, carry)
+    return out[-1]
+
+
+def _whole_arglexmin(words: list, valid, cap):
+    """Index of the lex-smallest valid word tuple over the whole column
+    (returns _BIG when no row is valid)."""
+    cand = valid
+    for w in words:
+        masked = jnp.where(cand, w, jnp.uint64(0xFFFFFFFFFFFFFFFF))
+        m = masked.min()
+        cand = cand & (masked == m) & valid
+    idx = jnp.arange(cap, dtype=jnp.int32)
+    return jnp.where(cand, idx, _BIG).min()
+
+
 def group_aggregate(
     batch: DeviceBatch,
     key_ordinals: list[int],
@@ -158,10 +217,29 @@ def group_aggregate(
         )
 
     out_aggs: list[DeviceColumn] = []
+    str_words_cache: dict = {}  # id(col) → ascending base words (min+max share)
     for col, op in zip(agg_columns, ops):
         sc = gather_column(col, perm)
         v = sc.validity & live
         is_str = isinstance(col.dtype, StringType)
+        if is_str and op in ("min", "max"):
+            # string min/max: lexicographic arg-scan over the sortable word
+            # encoding, then an index-pick like first/last (UTF8String
+            # byte order — the re-sort-free strategy the r1 verdict asked for)
+            base = str_words_cache.get(id(col))
+            if base is None:
+                base = _string_base_words(sc)
+                str_words_cache[id(col)] = base
+            vwords = _string_value_words(base, v, op == "min")
+            pickrow = _seg_arglexmin(vwords, starts, idx)
+            gpick = pickrow[end_pos]
+            any_v = (segscan(v.astype(jnp.int32), starts, jnp.add) > 0)[end_pos]
+            ok = any_v & group_live
+            safe = jnp.clip(gpick, 0, cap - 1)
+            data = jnp.where(ok[:, None], sc.data[safe], 0).astype(jnp.uint8)
+            lengths = jnp.where(ok, sc.lengths[safe], 0).astype(jnp.int32)
+            out_aggs.append(DeviceColumn(col.dtype, data, ok, lengths))
+            continue
         scan_vals, scan_valid, pick = _scan_reduce(op, sc.data, v, starts, idx, cap)
         if pick is not None:
             # first/last: gather the picked row's value per group
@@ -250,8 +328,15 @@ def _ungrouped_aggregate(batch, agg_columns, ops, cap, live_mask=None):
             out_aggs.append(
                 place(valid.sum().astype(jnp.int64), jnp.bool_(True), out_dtype=LONG)
             )
+        elif op in ("min", "max") and is_str:
+            vwords = _string_value_words(_string_base_words(col), valid, op == "min")
+            pick = _whole_arglexmin(vwords, valid, cap)
+            ok = pick != _BIG
+            safe = jnp.clip(pick, 0, cap - 1)
+            out_aggs.append(
+                place(col.data[safe], col.validity[safe] & ok, col.lengths[safe])
+            )
         elif op in ("min", "max"):
-            assert not is_str, "string min/max handled via first/last picks"
             fill = _minmax_fill(op, data.dtype)
             masked = jnp.where(valid, data, fill)
             is_float = jnp.issubdtype(data.dtype, jnp.floating)

@@ -134,10 +134,8 @@ def _contains_ansi_cast(e: Expression) -> bool:
     return any(_contains_ansi_cast(c) for c in e.children())
 
 
-def _agg_minmax_check(e, conf: TpuConf) -> Optional[str]:
-    if isinstance(e.child.data_type, StringType):
-        return "string min/max on device requires the re-sort strategy (not yet implemented)"
-    return None
+# string min/max runs on device via the lexicographic arg-scan
+# (ops/aggregate._seg_arglexmin); the TypeSig excludes complex types
 
 
 def _float_agg_check(e, conf: TpuConf) -> Optional[str]:
@@ -203,8 +201,8 @@ for _cls in (
 _expr(agg.Sum, check=_float_agg_check, sig=SIGS["numeric"])
 _expr(agg.Average, check=_float_agg_check, sig=SIGS["numeric"])
 _expr(Cast, check=_cast_check)
-_expr(agg.Min, check=_agg_minmax_check, sig=SIGS["orderable"])
-_expr(agg.Max, check=_agg_minmax_check, sig=SIGS["orderable"])
+_expr(agg.Min, sig=SIGS["orderable"])
+_expr(agg.Max, sig=SIGS["orderable"])
 for _cls in (agg.StddevSamp, agg.StddevPop, agg.VarianceSamp, agg.VariancePop):
     _expr(_cls)
 
