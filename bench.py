@@ -1,17 +1,28 @@
-"""Benchmark: TPC-H q1 + q6 shaped queries, device engine vs CPU engine.
+"""Benchmark: TPC-shaped queries, device engine vs CPU engine.
 
 The reference publishes only qualitative numbers ("3x-7x, 4x typical" vs CPU
 Spark — docs/FAQ.md:87-88, see BASELINE.md); it ships no benchmark rig, so
-this one is built here. The metric is end-to-end wall-clock speedup of the
-TPU engine over this framework's own CPU (numpy/arrow) engine on the same
-queries — the analogue of the reference's plugin-on vs plugin-off
-comparison. ``vs_baseline`` normalizes by the reference's "4x typical".
+this one is built here. Coverage follows BASELINE.json ``configs[]``:
+
+  q1   group-by aggregate        (GpuHashAggregateExec)
+  q6   filter + project + reduce (GpuProjectExec/GpuFilterExec)
+  q3   shuffled join + group-by + topN (GpuShuffledHashJoinExec)
+  q47  partitioned ordered window (GpuWindowExec; rank + moving avg)
+
+The metric is end-to-end wall-clock speedup of the TPU engine over this
+framework's own CPU (numpy/arrow) engine on the same queries — the analogue
+of the reference's plugin-on vs plugin-off comparison. The headline value is
+the geometric mean of per-query speedups; ``vs_baseline`` normalizes by the
+reference's "4x typical". ``detail.queries`` carries per-query numbers and
+``detail.breakdown`` a device-vs-host time attribution of one profiled q1
+run (spark.rapids.sql.profile.opTime — the NvtxWithMetrics analogue).
 
 Prints ONE JSON line.
 """
 from __future__ import annotations
 
 import json
+import math
 import time
 
 import numpy as np
@@ -19,12 +30,18 @@ import pyarrow as pa
 
 SCALE_ROWS = 2_000_000
 PARTITIONS = 1
+# join/window queries exercise the exchange: a few partitions, small shuffle
+# arity (every extra partition is another host-sync'd pipeline on the
+# tunneled single chip)
+JOIN_PARTITIONS = 2
+SHUFFLE_CONF = {"spark.sql.shuffle.partitions": 2}
 
 
 def gen_lineitem(n: int) -> pa.Table:
     rng = np.random.default_rng(42)
     return pa.table(
         {
+            "l_orderkey": rng.integers(0, n // 4, n).astype(np.int64),
             "l_returnflag": pa.array(
                 np.asarray(["A", "N", "R"], dtype=object)[rng.integers(0, 3, n)]
             ),
@@ -40,10 +57,37 @@ def gen_lineitem(n: int) -> pa.Table:
     )
 
 
-def q1(session, table):
+def gen_orders(n_orders: int) -> pa.Table:
+    rng = np.random.default_rng(43)
+    return pa.table(
+        {
+            "o_orderkey": np.arange(n_orders, dtype=np.int64),
+            "o_custkey": rng.integers(0, n_orders // 8, n_orders).astype(
+                np.int64
+            ),
+            "o_orderdate": rng.integers(8000, 12000, n_orders).astype(np.int32),
+            "o_shippriority": rng.integers(0, 5, n_orders).astype(np.int32),
+        }
+    )
+
+
+def gen_sales(n: int) -> pa.Table:
+    """q47-shaped: (category, store, date) keyed sales for windowing."""
+    rng = np.random.default_rng(44)
+    return pa.table(
+        {
+            "cat": rng.integers(0, 64, n).astype(np.int64),
+            "store": rng.integers(0, 16, n).astype(np.int64),
+            "d": rng.integers(0, 3650, n).astype(np.int64),
+            "sales": (rng.random(n) * 1e4).round(2),
+        }
+    )
+
+
+def q1(session, tables):
     from spark_rapids_tpu.functions import avg, col, count, sum as sum_
 
-    df = session.create_dataframe(table, num_partitions=PARTITIONS)
+    df = session.create_dataframe(tables["lineitem"], num_partitions=PARTITIONS)
     return (
         df.filter(col("l_shipdate") <= 11000)
         .group_by("l_returnflag", "l_linestatus")
@@ -62,10 +106,10 @@ def q1(session, table):
     )
 
 
-def q6(session, table):
+def q6(session, tables):
     from spark_rapids_tpu.functions import col, sum as sum_
 
-    df = session.create_dataframe(table, num_partitions=PARTITIONS)
+    df = session.create_dataframe(tables["lineitem"], num_partitions=PARTITIONS)
     return (
         df.filter(
             (col("l_shipdate") >= 9000)
@@ -75,6 +119,61 @@ def q6(session, table):
             & (col("l_quantity") < 24)
         ).agg(sum_(col("l_extendedprice") * col("l_discount")).alias("revenue"))
     )
+
+
+def q3(session, tables):
+    """TPC-H q3 shape: shuffled join lineitem ⋈ orders, grouped revenue,
+    topN (GpuShuffledHashJoinExec + GpuHashAggregateExec +
+    GpuTakeOrderedAndProjectExec)."""
+    from spark_rapids_tpu.functions import col, sum as sum_
+
+    li = session.create_dataframe(
+        tables["lineitem"], num_partitions=JOIN_PARTITIONS
+    ).filter(col("l_shipdate") > 9500)
+    orders = session.create_dataframe(
+        tables["orders"], num_partitions=JOIN_PARTITIONS
+    ).filter(col("o_orderdate") < 11500)
+    return (
+        li.join(
+            orders,
+            on=[("l_orderkey", "o_orderkey")],
+            how="inner",
+        )
+        .group_by("l_orderkey", "o_orderdate", "o_shippriority")
+        .agg(
+            sum_(col("l_extendedprice") * (1 - col("l_discount"))).alias(
+                "revenue"
+            )
+        )
+        .order_by(col("revenue").desc(), col("o_orderdate"))
+        .limit(10)
+    )
+
+
+def q47(session, tables):
+    """TPC-DS q47 shape: partitioned, ordered window — rank over category
+    sales + centered moving average (GpuWindowExec; ROWS frame)."""
+    from spark_rapids_tpu import functions as F
+    from spark_rapids_tpu.functions import col
+    from spark_rapids_tpu.window import Window
+
+    df = session.create_dataframe(
+        tables["sales"], num_partitions=JOIN_PARTITIONS
+    )
+    w_rank = Window.partition_by("cat").order_by("d", "store")
+    w_avg = (
+        Window.partition_by("cat", "store")
+        .order_by("d")
+        .rows_between(-2, 2)
+    )
+    return (
+        df.with_column("rnk", F.rank().over(w_rank))
+        .with_column("avg5", F.avg(col("sales")).over(w_avg))
+        .filter(col("rnk") <= 100)
+    )
+
+
+QUERIES = [("q1", q1), ("q6", q6), ("q3", q3), ("q47", q47)]
 
 
 def time_query(build, n_warm: int = 1, n_run: int = 5) -> float:
@@ -88,42 +187,77 @@ def time_query(build, n_warm: int = 1, n_run: int = 5) -> float:
     return best
 
 
+def check_equal(rows_t, rows_c, name):
+    assert len(rows_t) == len(rows_c), (
+        f"{name}: row mismatch {len(rows_t)} vs {len(rows_c)}"
+    )
+    for rt, rc in zip(rows_t, rows_c):
+        for vt, vc in zip(rt, rc):
+            if isinstance(vt, float) and isinstance(vc, float):
+                assert vc == vt or abs(vt - vc) <= 1e-9 * max(
+                    abs(vt), abs(vc), 1.0
+                ), (name, rt, rc)
+            else:
+                assert vt == vc, (name, rt, rc)
+
+
 def main():
     from spark_rapids_tpu import TpuSession
 
-    table = gen_lineitem(SCALE_ROWS)
-    tpu = TpuSession({"spark.rapids.sql.enabled": True})
-    cpu = TpuSession({"spark.rapids.sql.enabled": False})
+    tables = {
+        "lineitem": gen_lineitem(SCALE_ROWS),
+        "orders": gen_orders(SCALE_ROWS // 4),
+        "sales": gen_sales(SCALE_ROWS // 2),
+    }
+    tpu = TpuSession({"spark.rapids.sql.enabled": True, **SHUFFLE_CONF})
+    cpu = TpuSession({"spark.rapids.sql.enabled": False, **SHUFFLE_CONF})
 
-    t_tpu = time_query(lambda: q1(tpu, table)) + time_query(lambda: q6(tpu, table))
-    t_cpu = time_query(lambda: q1(cpu, table)) + time_query(lambda: q6(cpu, table))
+    queries_detail = {}
+    speedups = []
+    for name, q in QUERIES:
+        t_tpu = time_query(lambda: q(tpu, tables))
+        t_cpu = time_query(lambda: q(cpu, tables))
+        sp = t_cpu / t_tpu if t_tpu > 0 else 0.0
+        speedups.append(sp)
+        queries_detail[name] = {
+            "tpu_s": round(t_tpu, 3),
+            "cpu_s": round(t_cpu, 3),
+            "speedup": round(sp, 3),
+        }
+        # result fidelity per query (order-insensitive except q3/q47 whose
+        # plans impose their own order — q3 is topN-ordered, compare as-is)
+        rows_t = q(tpu, tables).collect()
+        rows_c = q(cpu, tables).collect()
+        if name not in ("q3",):
+            rows_t, rows_c = sorted(rows_t), sorted(rows_c)
+        check_equal(rows_t, rows_c, name)
 
-    # sanity: identical results (values, not just shape)
-    r_t = sorted(q1(tpu, table).collect())
-    r_c = sorted(q1(cpu, table).collect())
-    assert len(r_t) == len(r_c), f"row mismatch {len(r_t)} vs {len(r_c)}"
-    for rt, rc in zip(r_t, r_c):
-        for vt, vc in zip(rt, rc):
-            if isinstance(vt, float):
-                assert vc == vt or abs(vt - vc) <= 1e-9 * max(abs(vt), abs(vc), 1.0), (
-                    rt,
-                    rc,
-                )
-            else:
-                assert vt == vc, (rt, rc)
+    # one profiled q1 run: device-vs-host attribution for the breakdown
+    prof = TpuSession(
+        {
+            "spark.rapids.sql.enabled": True,
+            "spark.rapids.sql.profile.opTime.enabled": True,
+            "spark.rapids.sql.metrics.level": "DEBUG",
+            **SHUFFLE_CONF,
+        }
+    )
+    q1(prof, tables).collect()
+    from spark_rapids_tpu.profiling import device_host_breakdown
 
-    speedup = t_cpu / t_tpu if t_tpu > 0 else 0.0
+    breakdown = device_host_breakdown(prof._last_plan)
+
+    geo = math.exp(sum(math.log(max(s, 1e-9)) for s in speedups) / len(speedups))
     print(
         json.dumps(
             {
-                "metric": "tpch_q1_q6_wallclock_speedup_vs_cpu_engine",
-                "value": round(speedup, 3),
+                "metric": "tpc_q1_q6_q3_q47_geomean_speedup_vs_cpu_engine",
+                "value": round(geo, 3),
                 "unit": "x",
-                "vs_baseline": round(speedup / 4.0, 3),
+                "vs_baseline": round(geo / 4.0, 3),
                 "detail": {
                     "rows": SCALE_ROWS,
-                    "tpu_s": round(t_tpu, 3),
-                    "cpu_s": round(t_cpu, 3),
+                    "queries": queries_detail,
+                    "breakdown": breakdown,
                 },
             }
         )

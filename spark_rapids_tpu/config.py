@@ -226,6 +226,32 @@ SHUFFLE_PARTITIONS = conf("spark.sql.shuffle.partitions").doc(
     "Default number of partitions for exchanges (Spark's key, honored here)."
 ).int_conf(8)
 
+MESH_ENABLED = conf("spark.rapids.sql.mesh.enabled").doc(
+    "Execute planner-built queries SPMD over a jax.sharding.Mesh: shuffle "
+    "exchanges lower to one fused all_to_all over ICI (the accelerated-"
+    "shuffle data plane wired into query execution, the UCX analogue — "
+    "RapidsShuffleInternalManagerBase.scala) and each partition's kernels "
+    "run on its own chip. Requires shuffle partitions == mesh size (the "
+    "session aligns the default automatically)."
+).boolean_conf(False)
+
+MESH_SIZE = conf("spark.rapids.sql.mesh.size").doc(
+    "Number of devices in the execution mesh; 0 uses every visible device."
+).int_conf(0)
+
+PROFILE_PATH = conf("spark.rapids.sql.profile.path").doc(
+    "When set, each collect() is wrapped in a jax.profiler trace dumped to "
+    "this directory (TensorBoard XPlane capture with per-operator "
+    "TraceAnnotation ranges) — the Nsight+NVTX analogue "
+    "(NvtxWithMetrics.scala)."
+).string_conf("")
+
+PROFILE_OPTIME = conf("spark.rapids.sql.profile.opTime.enabled").doc(
+    "Per-operator device-time attribution: every exec's output batches are "
+    "block_until_ready'd under a timer feeding its opTime metric. "
+    "Serializes the pipeline (CUDA_LAUNCH_BLOCKING-style) — debug only."
+).boolean_conf(False)
+
 TEST_CONF = conf("spark.rapids.sql.test.enabled").doc(
     "Test mode: fail if any operator that was expected on device fell back "
     "(reference: RapidsConf TEST_CONF)."

@@ -93,6 +93,30 @@ def _canon_value(v):
     return v
 
 
+def _sorted_set(items: list) -> list:
+    """Deterministic collect_set order: value-ascending with canonical
+    floats (-0.0 → 0.0, NaN greatest) — mirrors the device kernel's
+    value-sorted dedupe. Spark guarantees no order for collect_set, so a
+    canonical order is a compatible (and testable) choice."""
+    import math
+
+    def canon(v):
+        if isinstance(v, float) and v == 0.0:
+            return 0.0
+        return v
+
+    def key(v):
+        if isinstance(v, float):
+            return (1, 0.0) if math.isnan(v) else (0, v)
+        return (0, v)
+
+    vals = [canon(v) for v in items]
+    try:
+        return sorted(vals, key=key)
+    except TypeError:
+        return vals
+
+
 def _dedup_spark(items: list) -> list:
     seen = set()
     out = []
@@ -173,9 +197,9 @@ def reduce_groups(
                 out[inv[i]].extend(data[i])
             else:
                 out[inv[i]].append(data[i])
-        if op.endswith("set"):
+        if op in ("collect_set", "merge_sets"):
             for g in range(G):
-                out[g] = _dedup_spark(out[g])
+                out[g] = _sorted_set(_dedup_spark(out[g]))
         # collect results are never null — empty array for all-null groups
         return out, np.ones(G, dtype=bool)
     idx = np.arange(len(inv), dtype=np.int64)

@@ -167,7 +167,12 @@ class CpuWindowExec(Exec):
         v = np.asarray(v).astype(bool)
         is_avg = isinstance(fn, Average)
         out_dt = we.data_type
-        out = np.zeros(n, dtype=out_dt.np_dtype if not is_avg else np.float64)
+        from ..types import StringType as _StrT
+
+        if isinstance(out_dt, _StrT):
+            out = np.empty(n, dtype=object)  # string min/max
+        else:
+            out = np.zeros(n, dtype=out_dt.np_dtype if not is_avg else np.float64)
         ov = np.zeros(n, dtype=bool)
         order_info = None
         sentinels = (UNBOUNDED_PRECEDING, CURRENT_ROW, UNBOUNDED_FOLLOWING)
@@ -175,10 +180,12 @@ class CpuWindowExec(Exec):
             frame.lower in sentinels and frame.upper in sentinels
         ):
             o = we.spec.order_by[0]
-            od, ovv = _val_to_np(ctx, bind(o.child, schema).eval(ctx))
+            obound = bind(o.child, schema)
+            od, ovv = _val_to_np(ctx, obound.eval(ctx))
             od = np.asarray(od)
             if not np.issubdtype(od.dtype, np.floating):
                 od = od.astype(np.int64)
+            frame = frame.scaled_for_decimal(obound.data_type)
             order_info = (
                 od if o.ascending else -od,
                 np.asarray(ovv).astype(bool),

@@ -87,6 +87,27 @@ def _row_bytes(schema: Schema) -> int:
     return max(total, 1)
 
 
+def _placed_partitions(ctx: "ExecContext", pset: PartitionSet) -> PartitionSet:
+    """Mesh mode: commit partition p's batches to device p%n so per-partition
+    kernels run data-parallel across chips from the scan onward (single-
+    device mode passes through untouched)."""
+    if ctx.mesh is None:
+        return pset
+    from ..parallel.mesh import put_batch
+
+    mc = ctx.mesh
+
+    def make(p, t):
+        def it():
+            dev = mc.device_for(p)
+            for db in t():
+                yield put_batch(db, dev)
+
+        return it
+
+    return PartitionSet([make(p, t) for p, t in enumerate(pset.parts)])
+
+
 class HostToDeviceExec(Exec):
     """Host Arrow batches → device batches (HostColumnarToGpu analogue).
 
@@ -194,11 +215,14 @@ class HostToDeviceExec(Exec):
 
                 return it
 
-            return PartitionSet(
-                [make_cached(p, t) for p, t in enumerate(child_parts.parts)]
+            return _placed_partitions(
+                ctx,
+                PartitionSet(
+                    [make_cached(p, t) for p, t in enumerate(child_parts.parts)]
+                ),
             )
 
-        return child.execute(ctx).map_partitions(fn)
+        return _placed_partitions(ctx, child.execute(ctx).map_partitions(fn))
 
 
 class DeviceToHostExec(Exec):
@@ -549,6 +573,13 @@ class TpuCoalescePartitionsExec(Exec):
         return PartitionSet([it])
 
 
+# Largest [capacity, W] collect element plane the device path will build
+# (~1GB of int64). Beyond it (one group holding most of a huge input) the
+# padded layout is the wrong tool — the query fails with the kill-switch
+# hint instead of OOMing the device.
+_COLLECT_PLANE_LIMIT = 1 << 27
+
+
 class TpuHashAggregateExec(Exec):
     """Sort-based group-by on device; one phase (partial|final|complete).
 
@@ -602,7 +633,10 @@ class TpuHashAggregateExec(Exec):
     def _buffer_ordinal(self, f: AggregateFunction, j: int) -> int:
         return _buffer_ordinal(self.grouping, self.agg_fns, f, j)
 
-    def _make_kernel(self, child_schema: Schema, pre_filter=None, has_nans=True):
+    def _make_kernel(
+        self, child_schema: Schema, pre_filter=None, has_nans=True,
+        collect_width: int = 0,
+    ):
         return aggregate_kernel(
             self.mode,
             tuple(self.grouping),
@@ -612,7 +646,61 @@ class TpuHashAggregateExec(Exec):
             child_schema,
             pre_filter,
             has_nans,
+            collect_width,
         )
+
+    @property
+    def _has_collect(self) -> bool:
+        return any(
+            op in ("collect_list", "collect_set")
+            for f in self.agg_fns
+            for op in f.update_ops
+        )
+
+    def _width_kernel(self, child_schema: Schema, pre_filter, has_nans):
+        """Max-group-size pre-pass for the collect plane width (one host
+        sync per partition — the join sizes its output buckets the same
+        way)."""
+        grouping = tuple(self.grouping)
+
+        def make():
+            def _width(batch: DeviceBatch):
+                from ..ops.aggregate import group_max_size
+
+                c = Ctx.for_device(batch)
+                live = batch.row_mask()
+                if pre_filter is not None:
+                    fv = pre_filter.eval(c)
+                    live = live & c.broadcast_bool(fv.data) & fv.full_valid(c)
+                if not grouping:
+                    return live.sum().astype(jnp.int32)
+                key_cols = [
+                    val_to_column(c, g.eval(c), g.data_type) for g in grouping
+                ]
+                key_cols = [
+                    dc_replace(k, validity=k.validity & live) for k in key_cols
+                ]
+                work = DeviceBatch(
+                    Schema(
+                        [
+                            StructField(f"k{i}", k.dtype, True)
+                            for i, k in enumerate(key_cols)
+                        ]
+                    ),
+                    key_cols,
+                    batch.num_rows,
+                )
+                return group_max_size(
+                    work,
+                    list(range(len(key_cols))),
+                    live_mask=live if pre_filter is not None else None,
+                    has_nans=has_nans,
+                )
+
+            return _width
+
+        key = ("agg_width", grouping, child_schema, pre_filter, has_nans)
+        return K.jit_kernel(key, make)
 
     def execute(self, ctx: ExecContext) -> PartitionSet:
         child = self.children[0]
@@ -670,6 +758,32 @@ class TpuHashAggregateExec(Exec):
                     return
                 batches = [empty_batch(child_schema)]
             merged = batches[0] if len(batches) == 1 else concat_device(batches)
+            if self._has_collect:
+                # collect plane width from the max-group-size pre-pass
+                # (bucketed so recompiles stay logarithmic in group size).
+                # Shrink first: the [capacity, W] element plane scales with
+                # BOTH factors, and a sparse merged batch inflates capacity.
+                merged = bulk_shrink([merged])[0]
+                w = int(self._width_kernel(child_schema, pre_filter, has_nans)(merged))
+                width = bucket_capacity(max(w, 1))
+                if merged.capacity * width > _COLLECT_PLANE_LIMIT:
+                    raise RuntimeError(
+                        "device collect_list/collect_set needs a "
+                        f"[{merged.capacity}, {width}] element plane "
+                        f"(> {_COLLECT_PLANE_LIMIT} elements) — a single "
+                        "group holds too many rows for the padded device "
+                        "layout; disable the device path with "
+                        "spark.rapids.sql.expression.CollectList=false / "
+                        "spark.rapids.sql.expression.CollectSet=false"
+                    )
+                ck = self._make_kernel(
+                    child_schema,
+                    pre_filter,
+                    has_nans,
+                    collect_width=width,
+                )
+                yield ck(merged)
+                return
             yield kernel(merged)
 
         return child.execute(ctx).map_partitions(run)
@@ -707,6 +821,7 @@ def aggregate_kernel(
     child_schema: Schema,
     pre_filter: Optional[Expression] = None,
     has_nans: bool = True,
+    collect_width: int = 0,
 ):
     """The fused group-aggregate program (update or merge+evaluate), cached
     by the full aggregation signature. ``pre_filter`` fuses a child filter's
@@ -759,6 +874,7 @@ def aggregate_kernel(
                 min_groups=0 if grouping else 1,
                 live_mask=live if pre_filter is not None else None,
                 has_nans=has_nans,
+                collect_width=collect_width,
             )
             if mode == "partial":
                 cols = out_keys + out_aggs
@@ -771,7 +887,12 @@ def aggregate_kernel(
             for f in agg_fns:
                 nbuf = len(f.buffer_types)
                 bufs = [
-                    Val(out_aggs[i + j].data, out_aggs[i + j].validity, out_aggs[i + j].lengths)
+                    Val(
+                        out_aggs[i + j].data,
+                        out_aggs[i + j].validity,
+                        out_aggs[i + j].lengths,
+                        out_aggs[i + j].children,
+                    )
                     for j in range(nbuf)
                 ]
                 agg_results.append(f.evaluate(gctx, bufs))
@@ -787,9 +908,7 @@ def aggregate_kernel(
             cols = []
             for e in result_exprs:
                 col = val_to_column(rctx, e.eval(rctx), e.data_type)
-                cols.append(
-                    DeviceColumn(col.dtype, col.data, col.validity & glive, col.lengths)
-                )
+                cols.append(dc_replace(col, validity=col.validity & glive))
             return DeviceBatch(out_schema, cols, num_groups)
 
         return _aggregate
@@ -804,6 +923,7 @@ def aggregate_kernel(
         child_schema,
         pre_filter,
         has_nans,
+        collect_width,
     )
     return K.jit_kernel(key, make)
 
@@ -1220,6 +1340,12 @@ class TpuShuffleExchangeExec(Exec):
         from .cpu import _bind_partitioning
 
         self.partitioning = _bind_partitioning(partitioning, child.output)
+        # AQE coalescing coordination: a co-partitioned consumer (shuffled
+        # join) links its two feeding exchanges so both compute ONE shared
+        # assignment from combined sizes; if only one side is an exchange,
+        # coalescing is disabled to keep positional pairing intact.
+        self._aqe_peer: "TpuShuffleExchangeExec | None" = None
+        self._aqe_disabled = False
 
     @property
     def num_partitions(self) -> int:
@@ -1324,6 +1450,181 @@ class TpuShuffleExchangeExec(Exec):
 
         return ("single", None)
 
+    # ── mesh (SPMD) path ────────────────────────────────────────────────
+    def _pid_fns(self, nparts):
+        """Per-row partition-id kernels (no per-partition compact): the mesh
+        exchange scatters by pid inside one fused all_to_all program, so
+        hash/range/round-robin all lower to the same ICI data plane."""
+        from ..plan.partitioning import (
+            HashPartitioning,
+            RangePartitioning,
+            RoundRobinPartitioning,
+            words_partition_ids,
+        )
+
+        part = self.partitioning
+        if isinstance(part, HashPartitioning) and part.keys:
+            keys = tuple(part.keys)
+
+            def make_hash():
+                def pids(batch: DeviceBatch):
+                    c = Ctx.for_device(batch)
+                    cols = []
+                    for k in keys:
+                        col = val_to_column(c, k.eval(c), k.data_type)
+                        cols.append(
+                            (k.data_type, col.data, col.validity, col.lengths)
+                        )
+                    h = murmur3_rows(jnp, cols, batch.capacity)
+                    return partition_ids(jnp, h, nparts).astype(jnp.int32)
+
+                return pids
+
+            return ("hash", K.jit_kernel(("mesh_pid_hash", keys, nparts), make_hash))
+        if isinstance(part, RoundRobinPartitioning):
+
+            def make_rr():
+                def pids(batch: DeviceBatch, start):
+                    return (
+                        (start + jnp.arange(batch.capacity, dtype=jnp.int32))
+                        % nparts
+                    ).astype(jnp.int32)
+
+                return pids
+
+            return ("roundrobin", K.jit_kernel(("mesh_pid_rr", nparts), make_rr))
+        if isinstance(part, RangePartitioning):
+            order = part.order
+
+            def make_words():
+                def batch_word_groups(batch: DeviceBatch):
+                    from ..ops.sortkeys import column_radix_words
+
+                    c = Ctx.for_device(batch)
+                    return [
+                        column_radix_words(
+                            val_to_column(c, o.child.eval(c), o.child.data_type),
+                            o.ascending,
+                            o.resolved_nulls_first(),
+                        )
+                        for o in order
+                    ]
+
+                return batch_word_groups
+
+            words_jit = K.jit_kernel(
+                ("mesh_range_words", _order_key(order)), make_words
+            )
+
+            def make_range():
+                def pids(words, bounds):
+                    return words_partition_ids(jnp, words, bounds).astype(jnp.int32)
+
+                return pids
+
+            return ("range", (words_jit, K.jit_kernel(("mesh_pid_range",), make_range)))
+        return ("single", None)
+
+    def _execute_mesh(self, ctx: ExecContext, mc) -> PartitionSet:
+        """SPMD exchange: chip i contributes child partitions j ≡ i (mod n)
+        concatenated to one batch; one fused all_to_all re-partitions every
+        chip's rows over ICI; output partition i stays committed on chip i
+        so downstream per-partition kernels run on their own devices.
+        (GpuShuffleExchangeExec over the UCX data plane, engine-wired —
+        RapidsShuffleInternalManagerBase.scala:200-396.)"""
+        import threading
+
+        from ..parallel.mesh import mesh_exchange, put_batch
+        from ..plan.partitioning import SAMPLE_PER_BATCH, compute_range_bounds
+
+        nparts = self.num_partitions
+        kind, fn = self._pid_fns(nparts)
+        schema = self.output
+        child_parts = self.children[0].execute(ctx)
+        state: dict = {"out": None}
+        lock = threading.Lock()
+
+        def materialize():
+            with lock:
+                if state["out"] is not None:
+                    return state["out"]
+                n = mc.n
+                per_chip_lists: list = [[] for _ in range(n)]
+                for j, t in enumerate(child_parts.parts):
+                    per_chip_lists[j % n].extend(t())
+                per_chip = [
+                    concat_device(l) if l else empty_batch(schema)
+                    for l in per_chip_lists
+                ]
+                # commit each chip's input to its device so the global
+                # stacked view assembles zero-copy
+                per_chip = [
+                    put_batch(b, mc.device_for(i)) for i, b in enumerate(per_chip)
+                ]
+                if kind == "hash":
+                    pids = [fn(b) for b in per_chip]
+                elif kind == "roundrobin":
+                    pids = [
+                        fn(b, jnp.asarray(i, jnp.int32))
+                        for i, b in enumerate(per_chip)
+                    ]
+                elif kind == "range":
+                    words_jit, pid_jit = fn
+                    import numpy as np
+
+                    all_words = self._mesh_range_words(
+                        ctx, words_jit, per_chip
+                    )
+                    dev_samples, dev_valid = [], []
+                    for db, words in zip(per_chip, all_words):
+                        s_idx = (
+                            jnp.arange(SAMPLE_PER_BATCH, dtype=jnp.int32)
+                            * jnp.maximum(db.num_rows, 1)
+                        ) // SAMPLE_PER_BATCH
+                        dev_samples.append(jnp.stack([w[s_idx] for w in words]))
+                        dev_valid.append(
+                            jnp.broadcast_to(db.num_rows > 0, (SAMPLE_PER_BATCH,))
+                        )
+                    host_samples, host_valid = jax.device_get(
+                        (dev_samples, dev_valid)
+                    )
+                    sample_words = [
+                        np.concatenate(
+                            [s[i][v] for s, v in zip(host_samples, host_valid)]
+                        )
+                        for i in range(len(all_words[0]))
+                    ]
+                    if sample_words[0].size:
+                        bounds = compute_range_bounds(sample_words, nparts)
+                        jb = [jnp.asarray(b) for b in bounds]
+                        pids = [
+                            pid_jit(w, jb) for w in all_words
+                        ]
+                    else:
+                        pids = [
+                            jnp.zeros(b.capacity, jnp.int32) for b in per_chip
+                        ]
+                else:
+                    raise AssertionError(kind)
+                out = mesh_exchange(mc, schema, per_chip, pids)
+                state["out"] = out
+                return out
+
+        def make(p):
+            def it():
+                db = materialize()[p]
+                yield db
+
+            return it
+
+        return PartitionSet([make(p) for p in range(nparts)])
+
+    def _mesh_range_words(self, ctx, words_jit, per_chip):
+        from ..plan.partitioning import align_word_groups
+
+        group_lists = [words_jit(b) for b in per_chip]
+        return align_word_groups(group_lists, self.partitioning.order, jnp)
+
     def execute(self, ctx: ExecContext) -> PartitionSet:
         from ..mem.spill import with_oom_retry
         from ..plan.partitioning import SAMPLE_PER_BATCH, compute_range_bounds
@@ -1331,6 +1632,17 @@ class TpuShuffleExchangeExec(Exec):
         import threading
 
         nparts = self.num_partitions
+        mc = ctx.mesh
+        if mc is not None and nparts == mc.n:
+            from ..parallel.mesh import mesh_supported_schema
+            from ..plan.partitioning import SinglePartitioning
+
+            if (
+                mesh_supported_schema(self.output)
+                and not isinstance(self.partitioning, SinglePartitioning)
+                and self._pid_fns(nparts)[0] != "single"
+            ):
+                return self._execute_mesh(ctx, mc)
         kind, fn = self._scatter_fns(nparts)
         catalog = ctx.catalog
         child_parts = self.children[0].execute(ctx)
@@ -1470,7 +1782,7 @@ class TpuShuffleExchangeExec(Exec):
 
             return PartitionSet([make_managed(p) for p in range(nparts)])
 
-        if cfg.ADAPTIVE_ENABLED.get(ctx.conf):
+        if cfg.ADAPTIVE_ENABLED.get(ctx.conf) and not self._aqe_disabled:
             # AQE partition coalescing (GpuCustomShuffleReaderExec +
             # CoalescedPartitionSpec analogue): measured output sizes group
             # adjacent small partitions into one reduce task; the remaining
@@ -1478,15 +1790,33 @@ class TpuShuffleExchangeExec(Exec):
             # The partition COUNT stays static (this engine's PartitionSets
             # are fixed-arity) — the win is fewer tiny downstream batches
             # and idle sibling tasks, the same effect the reference gets.
+            # When this exchange feeds one side of a shuffled join, the
+            # assignment is computed from BOTH sides' combined sizes so the
+            # two sides group identically (Spark's AQE applies the same
+            # CoalescedPartitionSpecs to both shuffle reads of a join).
             advisory = cfg.ADVISORY_PARTITION_SIZE.get(ctx.conf)
             aqe_state = {"assign": None}
 
+            def my_sizes():
+                buckets = materialize()
+                return [sum(db.size_bytes() for db in b) for b in buckets]
+
+            ctx.aqe_size_providers[id(self)] = my_sizes
+
             def assignment():
                 if aqe_state["assign"] is None:
-                    buckets = materialize()
-                    sizes = [
-                        sum(db.size_bytes() for db in b) for b in buckets
-                    ]
+                    sizes = my_sizes()
+                    peer = self._aqe_peer
+                    if peer is not None:
+                        peer_fn = ctx.aqe_size_providers.get(id(peer))
+                        if peer_fn is None:
+                            # peer never took the AQE path: fall back to
+                            # identity grouping (no coalescing) to preserve
+                            # positional pairing
+                            aqe_state["assign"] = [[p] for p in range(nparts)]
+                            self.aqe_groups = nparts
+                            return aqe_state["assign"]
+                        sizes = [a + b for a, b in zip(sizes, peer_fn())]
                     assign: list = [[] for _ in range(nparts)]
                     group: list = []
                     gbytes = 0

@@ -27,6 +27,58 @@ from .tpu import val_to_column
 from .. import kernels as K
 
 
+def _colocate_with(batch: DeviceBatch, anchor: DeviceBatch) -> DeviceBatch:
+    """Move ``batch`` onto ``anchor``'s device when they differ (mesh mode
+    can mix mesh-exchanged and host-exchanged join inputs); single-device
+    mode is a metadata check only."""
+
+    def dev(b):
+        if not b.columns:
+            return None
+        x = b.columns[0].data
+        devices = getattr(x, "devices", None)
+        if devices is None:
+            return None
+        try:
+            return next(iter(devices()))
+        except Exception:
+            return None
+
+    da, db = dev(batch), dev(anchor)
+    if da is None or db is None or da == db:
+        return batch
+    return jax.device_put(batch, db)
+
+
+def _link_aqe_exchanges(left: Exec, right: Exec) -> None:
+    """Positional partition pairing requires both join inputs to share one
+    AQE coalesce assignment. Find the shuffle exchange feeding each side
+    (descending through batch-coalesce wrappers); link the pair so each
+    computes the grouping from combined sizes, or disable coalescing when
+    only one side is exchange-fed (the other side's partitioning is fixed).
+    Spark parity: AQE applies identical CoalescedPartitionSpecs to both
+    shuffle reads of a join (ShufflePartitionsUtil coalescing over all
+    mappers of both shuffles)."""
+    from .tpu import TpuCoalesceBatchesExec, TpuShuffleExchangeExec
+
+    def find(node: Exec):
+        while True:
+            if isinstance(node, TpuShuffleExchangeExec):
+                return node
+            if isinstance(node, TpuCoalesceBatchesExec):
+                node = node.children[0]
+                continue
+            return None
+
+    lex, rex = find(left), find(right)
+    if lex is not None and rex is not None:
+        lex._aqe_peer, rex._aqe_peer = rex, lex
+    else:
+        for ex in (lex, rex):
+            if ex is not None:
+                ex._aqe_disabled = True
+
+
 class TpuShuffledHashJoinExec(Exec):
     def __init__(
         self,
@@ -114,6 +166,7 @@ class TpuShuffledHashJoinExec(Exec):
     # ── execution ───────────────────────────────────────────────────────
     def execute(self, ctx: ExecContext) -> PartitionSet:
         left, right = self.children
+        _link_aqe_exchanges(left, right)
         lparts = left.execute(ctx)
         rparts = right.execute(ctx)
         assert lparts.num_partitions == rparts.num_partitions, (
@@ -133,6 +186,11 @@ class TpuShuffledHashJoinExec(Exec):
                 )
                 build_matched = jnp.zeros(build.capacity, dtype=bool)
                 for probe in lt():
+                    # mesh mode: the two sides can land on different devices
+                    # when only one side's exchange took the mesh path
+                    # (e.g. a complex-typed schema on the other) — one jit
+                    # needs one device
+                    probe = _colocate_with(probe, build)
                     build_order, lower, counts = phase1(build, probe)
                     total = int(counts.sum())
                     out_cap = bucket_capacity(max(total, 1))
@@ -199,6 +257,22 @@ class TpuBroadcastExchangeExec(Exec):
                 )
             return self._cache
 
+    def broadcast_batch_like(self, ctx: ExecContext, peer: DeviceBatch) -> DeviceBatch:
+        """Mesh mode: the build batch replicated onto the peer's device (the
+        in-process analogue of the broadcast re-materializing per executor);
+        per-device copies are cached for the node's lifetime."""
+        build = self.broadcast_batch(ctx)
+        if ctx.mesh is None:
+            return build
+        import jax
+
+        dev = next(iter(peer.columns[0].data.devices()))
+        with self._lock:
+            cache = self.__dict__.setdefault("_dev_cache", {})
+            if dev not in cache:
+                cache[dev] = jax.device_put(build, dev)
+            return cache[dev]
+
     def execute(self, ctx: ExecContext) -> PartitionSet:
         def it():
             yield self.broadcast_batch(ctx)
@@ -226,8 +300,10 @@ class TpuBroadcastHashJoinExec(TpuShuffledHashJoinExec):
 
         def make(lt):
             def it():
-                build = right.broadcast_batch(ctx)
+                build = None
                 for probe in lt():
+                    if build is None:
+                        build = right.broadcast_batch_like(ctx, probe)
                     build_order, lower, counts = phase1(build, probe)
                     total = int(counts.sum())
                     out_cap = bucket_capacity(max(total, 1))

@@ -430,6 +430,57 @@ class CollectSet(CollectList):
         return f"collect_set({self.child})"
 
 
+@dataclass(frozen=True)
+class MergeLists(AggregateFunction):
+    """Internal: merge partial collect_list arrays into one (Spark's
+    Collect merge phase). Produced only by the DISTINCT rewrite when a
+    collect aggregate rides along; CPU-only (the device path plans collect
+    as a single complete aggregate and never merges lists)."""
+
+    child: Expression
+
+    @property
+    def data_type(self) -> DataType:
+        return self.child.data_type
+
+    @property
+    def nullable(self) -> bool:
+        return False
+
+    @property
+    def update_exprs(self):
+        return (self.child,)
+
+    @property
+    def buffer_types(self):
+        return (self.data_type,)
+
+    @property
+    def update_ops(self):
+        return ("merge_lists",)
+
+    @property
+    def merge_ops(self):
+        return ("merge_lists",)
+
+    def __str__(self):
+        return f"merge_lists({self.child})"
+
+
+@dataclass(frozen=True)
+class MergeSets(MergeLists):
+    @property
+    def update_ops(self):
+        return ("merge_sets",)
+
+    @property
+    def merge_ops(self):
+        return ("merge_sets",)
+
+    def __str__(self):
+        return f"merge_sets({self.child})"
+
+
 def is_aggregate(e: Expression) -> bool:
     if isinstance(e, AggregateFunction):
         return True

@@ -356,6 +356,14 @@ class Cast(UnaryExpression):
         if isinstance(frm, BooleanType):
             if isinstance(to, TimestampType):
                 return data.astype(np.int64) * US_PER_SECOND, None
+            if isinstance(to, DecimalType):
+                # true → 1 scaled to the target (unscaled = 10^scale), not
+                # the raw 0/1 bit as unscaled
+                unscaled = data.astype(np.int64) * (10**to.scale)
+                if to.scale >= to.precision:
+                    # decimal(p,s) with s >= p cannot represent 1
+                    return unscaled, data == 0
+                return unscaled, None
             return data.astype(to.np_dtype), None
         if isinstance(frm, DateType) and isinstance(to, TimestampType):
             return data.astype(np.int64) * MICROS_PER_DAY, None
@@ -554,7 +562,7 @@ class Cast(UnaryExpression):
         for i in range(n):
             if not valid[i] or data[i] is None:
                 continue
-            r = _cpu_parse(data[i], to)
+            r = _cpu_parse(data[i], to, ansi=self.ansi)
             if r is not None:
                 out[i] = r
                 ok[i] = True
@@ -578,7 +586,7 @@ class Cast(UnaryExpression):
         elif isinstance(to, (FloatType, DoubleType)):
             out, ok = _dev_str_to_float(ctx, ch, start, end, to)
         elif isinstance(to, IntegralType):
-            out, ok = _dev_str_to_int(ctx, ch, start, end, to)
+            out, ok = _dev_str_to_int(ctx, ch, start, end, to, ansi=self.ansi)
         else:
             raise NotImplementedError(f"device cast string -> {to}")
         ok = ok & has_any
@@ -911,9 +919,13 @@ def _dev_float_str(ctx: Ctx, data, is32: bool):
     return packed, lens
 
 
-def _dev_str_to_int(ctx: Ctx, ch, start, end, to: DataType):
+def _dev_str_to_int(ctx: Ctx, ch, start, end, to: DataType, ansi: bool = False):
     """Spark UTF8String.toLong semantics over the trimmed region —
-    Java Long.parseLong's negative accumulation, so ``-2^63`` parses."""
+    Java Long.parseLong's negative accumulation, so ``-2^63`` parses.
+    Non-ANSI additionally accepts a decimal tail (``'1.5' → 1``, truncation
+    toward zero), matching the reference castStringToInts regex
+    ``^([+\\-]?[0-9]+)(?:\\.[0-9]*)?$``; ANSI rejects it like Spark's
+    toLongExact."""
     xp = ctx.xp
     n, w = ch.shape
     idx = xp.arange(w, dtype=xp.int32)[None, :]
@@ -922,9 +934,17 @@ def _dev_str_to_int(ctx: Ctx, ch, start, end, to: DataType):
     neg = first_ch == ord("-")
     dstart = start + has_sign.astype(xp.int32)
     is_digit = (ch >= 48) & (ch <= 57)
-    digit_region = (idx >= dstart[:, None]) & (idx < end[:, None])
-    ok_chars = xp.where(digit_region, is_digit, True).all(axis=1)
+    in_region = (idx >= dstart[:, None]) & (idx < end[:, None])
+    dot_in = (ch == ord(".")) & in_region
+    has_dot = dot_in.any(axis=1)
+    first_dot = xp.argmax(dot_in, axis=1).astype(xp.int32)
+    int_end = xp.where(has_dot, first_dot, end)
+    digit_region = (idx >= dstart[:, None]) & (idx < int_end[:, None])
+    frac_region = (idx > int_end[:, None]) & (idx < end[:, None])
+    ok_chars = xp.where(digit_region | frac_region, is_digit, True).all(axis=1)
     has_digit = (is_digit & digit_region).any(axis=1)
+    if ansi:
+        ok_chars = ok_chars & ~has_dot
     limit = xp.where(
         neg,
         xp.asarray(I64_MIN, dtype=xp.int64),
@@ -1277,7 +1297,7 @@ def _cpu_parse_date_part(s: str):
     return _days_from_civil_py(y, m, d)
 
 
-def _cpu_parse(s: str, to: DataType):
+def _cpu_parse(s: str, to: DataType, ansi: bool = False):
     """CPU string parse for one value; None on malformed (→ NULL)."""
     s = s.strip(
         "".join(chr(c) for c in range(0x21))
@@ -1360,6 +1380,14 @@ def _cpu_parse(s: str, to: DataType):
             return None
     if isinstance(to, IntegralType):
         body = s[1:] if s[:1] in "+-" else s
+        if not ansi and "." in body:
+            # UTF8String.toLong truncation: '1.5' → 1 when the tail after
+            # '.' is all digits (or empty); ANSI rejects like toLongExact
+            intpart, _, frac = body.partition(".")
+            if frac and not frac.isdigit():
+                return None
+            body = intpart
+            s = (s[:1] if s[:1] in "+-" else "") + intpart
         if not body.isdigit():
             return None
         try:

@@ -52,6 +52,26 @@ class WindowFrame:
     lower: int  # <= 0 preceding; sentinels above
     upper: int
 
+    def scaled_for_decimal(self, order_dt) -> "WindowFrame":
+        """RANGE offsets over a decimal order key compare against the
+        UNSCALED int64 representation: scale the integer bounds by
+        10^scale (5 PRECEDING over decimal(_,2) means 500 unscaled).
+        Shared by the device and CPU window execs so the oracle cannot
+        diverge from the device path."""
+        from ..types import DecimalType
+
+        if not isinstance(order_dt, DecimalType):
+            return self
+        import dataclasses as _dc
+
+        pow10 = 10 ** order_dt.scale
+        sent = (UNBOUNDED_PRECEDING, CURRENT_ROW, UNBOUNDED_FOLLOWING)
+        return _dc.replace(
+            self,
+            lower=self.lower if self.lower in sent else self.lower * pow10,
+            upper=self.upper if self.upper in sent else self.upper * pow10,
+        )
+
     def _b(self, v, pre):
         if v == UNBOUNDED_PRECEDING:
             return "UNBOUNDED PRECEDING"

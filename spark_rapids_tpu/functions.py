@@ -209,6 +209,14 @@ class Column:
         return self.getItem(key)
 
     def isin(self, *values) -> "Column":
+        # a DataFrame argument is `x IN (subquery)` (GpuInSet via the
+        # session's subquery resolution); literal lists stay an In chain
+        if len(values) == 1 and hasattr(values[0], "_plan"):
+            from .expr.subquery import InSubquery
+
+            return Column(InSubquery(self.expr, values[0]._plan))
+        if len(values) == 1 and isinstance(values[0], (list, tuple, set)):
+            values = tuple(values[0])
         return Column(In(self.expr, tuple(_e(v) for v in values)))
 
     def is_null(self) -> "Column":
@@ -319,6 +327,16 @@ def broadcast(df):
     from .session import DataFrame
 
     return DataFrame(df._session, L.Hint("broadcast", df._plan))
+
+
+def scalar_subquery(df) -> Column:
+    """A single-value subquery usable inside any expression — e.g.
+    ``df.filter(col("y") > scalar_subquery(other.agg(avg(col("y")))))``.
+    Executed before the main query and inlined as a literal
+    (GpuScalarSubquery.scala analogue)."""
+    from .expr.subquery import ScalarSubquery
+
+    return Column(ScalarSubquery(df._plan))
 
 
 def col(name: str) -> Column:

@@ -321,6 +321,26 @@ def _iter_file(
         want = [
             n for n in schema.names if n in f.schema.names and n not in part_names
         ]
+        if predicates and f.nstripes > 1:
+            # stripe-granularity read with statistics gating
+            # (GpuOrcScan.scala:853 + OrcFilters.scala analogue; pyarrow
+            # reads per stripe, our orc_meta parses the stats footer)
+            from .orc_meta import read_stripe_stats, stripe_survives
+
+            stats = read_stripe_stats(path)
+            if stats is not None:
+                keep = [
+                    i
+                    for i in range(f.nstripes)
+                    if stripe_survives(stats, i, predicates)
+                ]
+                if pruned_counter is not None and len(keep) < f.nstripes:
+                    pruned_counter(f.nstripes - len(keep))
+                for i in keep:
+                    rb_s = f.read_stripe(i, columns=want)
+                    for off in range(0, rb_s.num_rows, batch_rows):
+                        yield out(rb_s.slice(off, batch_rows))
+                return
         table = f.read(columns=want)
         for rb in table.to_batches(max_chunksize=batch_rows):
             yield out(rb)
@@ -400,7 +420,7 @@ class CpuFileScanExec(Exec):
             self.batch_rows,
             self.part_schema,
             vals,
-            self.predicates if self.fmt == "parquet" else (),
+            self.predicates if self.fmt in ("parquet", "orc") else (),
             self._count_pruned,
         )
 

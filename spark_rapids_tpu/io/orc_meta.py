@@ -1,0 +1,259 @@
+"""Minimal ORC footer/metadata reader for stripe-granularity pruning.
+
+pyarrow exposes per-stripe READS (``ORCFile.read_stripe``) but not the
+stripe statistics, so this module parses the two protobuf sections the
+pruning pass needs straight from the file tail — postscript → Footer
+(stripe list + flat field names) and Metadata (per-stripe column
+statistics). Reference: GpuOrcScan.scala:853 (stripe gating) +
+OrcFilters.scala (predicate → stats SearchArgument); the ORC layout is the
+public spec (orc_proto: PostScript/Footer/Metadata/ColumnStatistics).
+
+Only what pruning needs is decoded: integer/double/string/date/decimal
+min/max + hasNull, flat (non-nested) schemas, NONE/ZLIB/ZSTD compression.
+Anything unexpected → ``None`` → the caller reads every stripe (pruning is
+an optimization, never a correctness dependency).
+"""
+from __future__ import annotations
+
+import struct
+from typing import List, Optional
+
+# ── protobuf wire decoding (just varint/len-delimited/fixed64) ─────────────
+
+
+def _varint(buf: bytes, pos: int):
+    out = 0
+    shift = 0
+    while True:
+        b = buf[pos]
+        pos += 1
+        out |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return out, pos
+        shift += 7
+
+
+def _zigzag(v: int) -> int:
+    return (v >> 1) ^ -(v & 1)
+
+
+def _fields(buf: bytes):
+    """Yield (field_number, wire_type, value) — value is int for varint/
+    fixed, bytes for length-delimited."""
+    pos = 0
+    n = len(buf)
+    while pos < n:
+        key, pos = _varint(buf, pos)
+        fno, wt = key >> 3, key & 7
+        if wt == 0:
+            v, pos = _varint(buf, pos)
+        elif wt == 1:
+            v = struct.unpack_from("<Q", buf, pos)[0]
+            pos += 8
+        elif wt == 2:
+            ln, pos = _varint(buf, pos)
+            v = buf[pos : pos + ln]
+            pos += ln
+        elif wt == 5:
+            v = struct.unpack_from("<I", buf, pos)[0]
+            pos += 4
+        else:
+            raise ValueError(f"wire type {wt}")
+        yield fno, wt, v
+
+
+# ── ORC section decompression ──────────────────────────────────────────────
+
+_NONE, _ZLIB, _SNAPPY, _LZO, _LZ4, _ZSTD = range(6)
+
+
+def _decompress(raw: bytes, codec: int) -> Optional[bytes]:
+    if codec == _NONE:
+        return raw
+    out = []
+    pos = 0
+    while pos + 3 <= len(raw):
+        hdr = raw[pos] | (raw[pos + 1] << 8) | (raw[pos + 2] << 16)
+        pos += 3
+        ln = hdr >> 1
+        chunk = raw[pos : pos + ln]
+        pos += ln
+        if hdr & 1:  # original (stored) block
+            out.append(chunk)
+        elif codec == _ZLIB:
+            import zlib
+
+            out.append(zlib.decompress(chunk, wbits=-15))
+        elif codec == _ZSTD:
+            try:
+                import zstandard
+
+                out.append(zstandard.ZstdDecompressor().decompress(chunk))
+            except Exception:
+                return None
+        else:
+            return None
+    return b"".join(out)
+
+
+# ── sections ───────────────────────────────────────────────────────────────
+
+
+class OrcStripeStats:
+    """names: flat field names (schema column i ↔ stats column i+1);
+    stripes: list of per-stripe dicts col_index → (kind, min, max,
+    has_null)."""
+
+    def __init__(self, names: List[str], stripes: List[dict]):
+        self.names = names
+        self.stripes = stripes
+
+
+def _parse_column_stats(buf: bytes):
+    kind = None
+    mn = mx = None
+    has_null = False
+    for fno, wt, v in _fields(buf):
+        if fno == 10 and wt == 0:
+            has_null = bool(v)
+        elif fno == 2 and wt == 2:  # IntegerStatistics
+            kind = "int"
+            for f2, w2, v2 in _fields(v):
+                if f2 == 1:
+                    mn = _zigzag(v2)
+                elif f2 == 2:
+                    mx = _zigzag(v2)
+        elif fno == 3 and wt == 2:  # DoubleStatistics
+            kind = "double"
+            for f2, w2, v2 in _fields(v):
+                if f2 == 1:
+                    mn = struct.unpack("<d", struct.pack("<Q", v2))[0]
+                elif f2 == 2:
+                    mx = struct.unpack("<d", struct.pack("<Q", v2))[0]
+        elif fno == 4 and wt == 2:  # StringStatistics
+            kind = "string"
+            for f2, w2, v2 in _fields(v):
+                if f2 == 1:
+                    mn = v2.decode("utf-8", "replace")
+                elif f2 == 2:
+                    mx = v2.decode("utf-8", "replace")
+        elif fno == 6 and wt == 2:  # DecimalStatistics (string form)
+            kind = "decimal"
+            for f2, w2, v2 in _fields(v):
+                if f2 == 1:
+                    mn = v2.decode()
+                elif f2 == 2:
+                    mx = v2.decode()
+        elif fno == 7 and wt == 2:  # DateStatistics (days, sint32)
+            kind = "date"
+            for f2, w2, v2 in _fields(v):
+                if f2 == 1:
+                    mn = _zigzag(v2)
+                elif f2 == 2:
+                    mx = _zigzag(v2)
+    return kind, mn, mx, has_null
+
+
+def read_stripe_stats(path: str) -> Optional[OrcStripeStats]:
+    """Parse [metadata][footer][postscript][len] from the file tail; None
+    when anything is unsupported (nested schema, exotic codec, parse
+    error)."""
+    try:
+        with open(path, "rb") as fh:
+            fh.seek(0, 2)
+            size = fh.tell()
+            tail_len = min(size, 16 * 1024 * 1024)
+            fh.seek(size - tail_len)
+            tail = fh.read(tail_len)
+        ps_len = tail[-1]
+        ps = tail[-1 - ps_len : -1]
+        footer_len = meta_len = 0
+        codec = _NONE
+        for fno, wt, v in _fields(ps):
+            if fno == 1:
+                footer_len = v
+            elif fno == 2:
+                codec = v
+            elif fno == 5:
+                meta_len = v
+        foot_raw = tail[-1 - ps_len - footer_len : -1 - ps_len]
+        meta_raw = tail[
+            -1 - ps_len - footer_len - meta_len : -1 - ps_len - footer_len
+        ]
+        footer = _decompress(foot_raw, codec)
+        metadata = _decompress(meta_raw, codec)
+        if footer is None or metadata is None:
+            return None
+
+        # Footer: field 4 = repeated Type (root first), field 3 = stripes
+        names: List[str] = []
+        types_seen = 0
+        n_stripes = 0
+        for fno, wt, v in _fields(footer):
+            if fno == 4 and wt == 2:
+                types_seen += 1
+                if types_seen == 1:  # root struct: fieldNames live here
+                    kind = None
+                    for f2, w2, v2 in _fields(v):
+                        if f2 == 1:
+                            kind = v2
+                        elif f2 == 3:
+                            names.append(v2.decode())
+                    if kind != 12:  # STRUCT
+                        return None
+                else:
+                    # nested children would shift column ids; only flat
+                    # schemas (root's children are leaves) are supported
+                    for f2, w2, v2 in _fields(v):
+                        if f2 == 2:
+                            return None
+            elif fno == 3 and wt == 2:
+                n_stripes += 1
+        if not names:
+            return None
+
+        stripes: List[dict] = []
+        for fno, wt, v in _fields(metadata):
+            if fno == 1 and wt == 2:  # StripeStatistics
+                cols: dict = {}
+                ci = 0
+                for f2, w2, v2 in _fields(v):
+                    if f2 == 1 and w2 == 2:  # repeated ColumnStatistics
+                        cols[ci] = _parse_column_stats(v2)
+                        ci += 1
+                stripes.append(cols)
+        if len(stripes) != n_stripes:
+            return None
+        return OrcStripeStats(names, stripes)
+    except Exception:
+        return None
+
+
+def stripe_survives(stats: OrcStripeStats, stripe: int, predicates) -> bool:
+    """Conjunct gate over one stripe's column stats — mirrors
+    row_group_survives for parquet (floats never pruned: NaN-blind stats)."""
+    from .files import _stat_allows
+
+    cols = stats.stripes[stripe]
+    for name, op, value in predicates:
+        try:
+            idx = stats.names.index(name) + 1  # root struct is column 0
+        except ValueError:
+            continue
+        entry = cols.get(idx)
+        if entry is None:
+            continue
+        kind, mn, mx, _has_null = entry
+        if kind in (None, "double") or mn is None or mx is None:
+            continue
+        if kind == "decimal":
+            import decimal
+
+            try:
+                mn, mx = decimal.Decimal(mn), decimal.Decimal(mx)
+                value = decimal.Decimal(str(value))
+            except Exception:
+                continue
+        if not _stat_allows(op, value, mn, mx):
+            return False
+    return True

@@ -232,6 +232,24 @@ class DataFrameWriter:
 
     partitionBy = partition_by
 
+    def _reads_from(self, path: str) -> bool:
+        """True when the DataFrame's plan scans ``path`` (or a file inside
+        it) — Spark refuses 'Cannot overwrite a path that is also being
+        read from' rather than deleting its own input."""
+        from ..plan import logical as L
+
+        target = os.path.realpath(path)
+
+        def walk(p) -> bool:
+            if isinstance(p, L.FileScan):
+                for sp in p.paths:
+                    rp = os.path.realpath(sp)
+                    if rp == target or rp.startswith(target + os.sep):
+                        return True
+            return any(walk(c) for c in p.children())
+
+        return walk(self._df._plan)
+
     def _write(self, path: str, fmt: str):
         if os.path.exists(path):
             if self._mode in ("error", "errorifexists"):
@@ -239,6 +257,11 @@ class DataFrameWriter:
             if self._mode == "overwrite":
                 import shutil
 
+                if self._reads_from(path):
+                    raise ValueError(
+                        f"Cannot overwrite a path that is also being read"
+                        f" from: {path}"
+                    )
                 shutil.rmtree(path)
             elif self._mode == "ignore":
                 return

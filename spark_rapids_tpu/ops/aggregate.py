@@ -162,6 +162,7 @@ def group_aggregate(
     min_groups: int = 0,
     live_mask=None,
     has_nans: bool = True,
+    collect_width: int = 0,
 ) -> tuple[list[DeviceColumn], list[DeviceColumn], jax.Array]:
     """Group ``batch`` rows by key columns; reduce ``agg_columns[i]`` with
     ``ops[i]``. Returns (key cols, agg cols, num_groups) — all [capacity]
@@ -178,7 +179,10 @@ def group_aggregate(
         cap = agg_columns[0].capacity  # ungrouped: key-less work batch
     keys = [_normalize_float(batch.columns[i], has_nans) for i in key_ordinals]
     if not keys:
-        return _ungrouped_aggregate(batch, agg_columns, ops, cap, live_mask)
+        return _ungrouped_aggregate(
+            batch, agg_columns, ops, cap, live_mask,
+            collect_width=collect_width, has_nans=has_nans,
+        )
 
     words = batch_radix_words(keys)
     row_mask = batch.row_mask() if live_mask is None else live_mask
@@ -222,6 +226,25 @@ def group_aggregate(
         sc = gather_column(col, perm)
         v = sc.validity & live
         is_str = isinstance(col.dtype, StringType)
+        if op in ("collect_list", "collect_set"):
+            out_aggs.append(
+                _group_collect(
+                    op,
+                    col,
+                    sc,
+                    words,
+                    row_mask,
+                    n_live,
+                    live,
+                    starts,
+                    end_pos,
+                    group_live,
+                    collect_width,
+                    cap,
+                    has_nans,
+                )
+            )
+            continue
         if is_str and op in ("min", "max"):
             # string min/max: lexicographic arg-scan over the sortable word
             # encoding, then an index-pick like first/last (UTF8String
@@ -288,13 +311,122 @@ def group_aggregate(
     return out_keys, out_aggs, num_groups
 
 
+def group_max_size(batch: DeviceBatch, key_ordinals: list[int], live_mask=None,
+                   has_nans: bool = True) -> jax.Array:
+    """Largest group's row count — the collect family's width pre-pass
+    (upper bound on any collect plane width; ONE host sync in the exec)."""
+    cap = batch.capacity
+    keys = [_normalize_float(batch.columns[i], has_nans) for i in key_ordinals]
+    row_mask = batch.row_mask() if live_mask is None else live_mask
+    n_live = (
+        batch.num_rows if live_mask is None
+        else live_mask.sum().astype(jnp.int32)
+    )
+    if not keys:
+        return n_live.astype(jnp.int32)
+    words = batch_radix_words(keys)
+    perm = sort_permutation(words, row_mask)
+    live = jnp.arange(cap, dtype=jnp.int32) < n_live
+    s_words = [w[perm] for w in words]
+    starts = segment_starts(s_words, live)
+    run = segscan(jnp.ones(cap, jnp.int32), starts, jnp.add)
+    return jnp.where(live, run, 0).max().astype(jnp.int32)
+
+
+def _group_collect(
+    op: str,
+    col: DeviceColumn,
+    sc: DeviceColumn,
+    key_words: list,
+    row_mask,
+    n_live,
+    live,
+    starts,
+    end_pos,
+    group_live,
+    W: int,
+    cap: int,
+    has_nans: bool,
+) -> DeviceColumn:
+    """collect_list / collect_set as an array-plane build — the device list
+    accumulator (reference GpuCollectList/GpuCollectSet,
+    AggregateFunctions.scala:644). No scatters: kept rows compact to the
+    front with ONE stable argsort, group planes gather through an
+    offset+rank index matrix. ``W`` (static plane width) is the
+    bucket-capacity of the largest group, measured by the exec's width
+    kernel in a prior pass (the one host sync this aggregate family needs).
+
+    collect_list keeps input row order (the key sort is stable); collect_set
+    re-sorts by value and dedupes adjacent equal values, so its output is
+    value-ascending — deterministic, and mirrored by the CPU engine (Spark
+    itself guarantees no order)."""
+    from ..types import ArrayType
+    from .sortkeys import column_radix_words
+
+    idx = jnp.arange(cap, dtype=jnp.int32)
+    if op == "collect_set":
+        vcol = _normalize_float(col, has_nans)
+        vwords = column_radix_words(vcol, ascending=True, nulls_first=False)
+        words2 = key_words + vwords
+        perm2 = sort_permutation(words2, row_mask)
+        s_keywords = [w[perm2] for w in key_words]
+        starts2 = segment_starts(s_keywords, live)
+        sc2 = gather_column(vcol, perm2)
+        v2 = sc2.validity & live
+        diff = jnp.zeros(cap, dtype=bool)
+        for w in vwords:
+            sw = w[perm2]
+            prev = jnp.concatenate([sw[:1], sw[:-1]])
+            diff = diff | (sw != prev)
+        keep = v2 & (starts2 | diff)
+        ends2 = seg_end_flags(starts2 | (idx == n_live)) & live
+        end_pos2 = first_k_positions(ends2)
+        use_sc, use_starts, use_end_pos = sc2, starts2, end_pos2
+    else:
+        use_sc, use_starts, use_end_pos = sc, starts, end_pos
+        keep = sc.validity & live
+
+    kc = segscan(keep.astype(jnp.int32), use_starts, jnp.add)[use_end_pos]
+    kc = jnp.where(group_live, kc, 0).astype(jnp.int32)
+    # kept rows to the front, (group, order) sequence preserved
+    perm_k = jnp.argsort(~keep, stable=True).astype(jnp.int32)
+    kept = gather_column(use_sc, perm_k)
+    offs = jnp.concatenate(
+        [jnp.zeros(1, jnp.int32), jnp.cumsum(kc)[:-1].astype(jnp.int32)]
+    )
+    j = jnp.arange(max(W, 1), dtype=jnp.int32)[None, :]
+    gidx = offs[:, None] + j  # [cap, W]
+    elem_live = (j < kc[:, None]) & group_live[:, None]
+    safe = jnp.clip(gidx, 0, cap - 1)
+    if isinstance(col.dtype, StringType):
+        edata = jnp.where(
+            elem_live[:, :, None], kept.data[safe], 0
+        ).astype(jnp.uint8)
+        elengths = jnp.where(elem_live, kept.lengths[safe], 0).astype(jnp.int32)
+        elem = DeviceColumn(col.dtype, edata, elem_live, elengths)
+    else:
+        edata = jnp.where(elem_live, kept.data[safe], jnp.zeros((), kept.data.dtype))
+        elem = DeviceColumn(col.dtype, edata, elem_live, None)
+    # collect is never null: empty array for all-null/empty groups
+    return DeviceColumn(
+        ArrayType(col.dtype, contains_null=False),
+        None,
+        group_live,
+        kc,
+        (elem,),
+    )
+
+
 def _mask_data(data, group_live):
     if data.ndim == 2:
         return jnp.where(group_live[:, None], data, 0)
     return jnp.where(group_live, data, jnp.zeros_like(data))
 
 
-def _ungrouped_aggregate(batch, agg_columns, ops, cap, live_mask=None):
+def _ungrouped_aggregate(
+    batch, agg_columns, ops, cap, live_mask=None, collect_width: int = 0,
+    has_nans: bool = True,
+):
     """No keys: one output group; plain masked whole-array reductions."""
     if live_mask is not None:
         live = live_mask
@@ -327,6 +459,69 @@ def _ungrouped_aggregate(batch, agg_columns, ops, cap, live_mask=None):
         elif op == "count":
             out_aggs.append(
                 place(valid.sum().astype(jnp.int64), jnp.bool_(True), out_dtype=LONG)
+            )
+        elif op in ("collect_list", "collect_set"):
+            from ..types import ArrayType
+            from .sortkeys import column_radix_words
+
+            W = max(collect_width, 1)
+            if op == "collect_set":
+                vcol = _normalize_float(col, has_nans)
+                vwords = column_radix_words(
+                    vcol, ascending=True, nulls_first=False
+                )
+                perm2 = sort_permutation(vwords, valid)
+                svals = gather_column(vcol, perm2)
+                v2 = valid[perm2]
+                diff = jnp.zeros(cap, dtype=bool)
+                for w in vwords:
+                    sw = w[perm2]
+                    prev = jnp.concatenate([sw[:1], sw[:-1]])
+                    diff = diff | (sw != prev)
+                keep = v2 & ((idx == 0) | diff)
+            else:
+                perm2 = jnp.argsort(~valid, stable=True).astype(jnp.int32)
+                svals = gather_column(col, perm2)
+                keep = valid[perm2]
+            kept = gather_column(
+                svals, jnp.argsort(~keep, stable=True).astype(jnp.int32)
+            )
+            kcount = keep.sum().astype(jnp.int32)
+            jW = jnp.arange(W, dtype=jnp.int32)
+            elem_live0 = jW < kcount  # [W]
+            safeW = jnp.clip(jW, 0, cap - 1)
+            if is_str:
+                row0 = jnp.where(
+                    elem_live0[:, None], kept.data[safeW], 0
+                ).astype(jnp.uint8)
+                edata = jnp.where(one_live[:, None, None], row0[None], 0)
+                elengths = jnp.where(
+                    one_live[:, None],
+                    jnp.where(elem_live0, kept.lengths[safeW], 0)[None, :],
+                    0,
+                ).astype(jnp.int32)
+                elem = DeviceColumn(
+                    col.dtype, edata, one_live[:, None] & elem_live0[None, :],
+                    elengths,
+                )
+            else:
+                row0 = jnp.where(
+                    elem_live0, kept.data[safeW],
+                    jnp.zeros((), kept.data.dtype),
+                )
+                edata = jnp.where(one_live[:, None], row0[None], jnp.zeros((), row0.dtype))
+                elem = DeviceColumn(
+                    col.dtype, edata, one_live[:, None] & elem_live0[None, :],
+                    None,
+                )
+            out_aggs.append(
+                DeviceColumn(
+                    ArrayType(col.dtype, contains_null=False),
+                    None,
+                    one_live,
+                    jnp.where(one_live, kcount, 0).astype(jnp.int32),
+                    (elem,),
+                )
             )
         elif op in ("min", "max") and is_str:
             vwords = _string_value_words(_string_base_words(col), valid, op == "min")

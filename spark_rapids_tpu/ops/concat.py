@@ -92,6 +92,7 @@ def concat_device(batches: list[DeviceBatch], capacity: int | None = None) -> De
     assert batches, "concat of zero batches"
     if len(batches) == 1 and (capacity is None or batches[0].capacity == capacity):
         return batches[0]
+    batches = _colocate(batches)
     schema = batches[0].schema
     cap = capacity or bucket_capacity(sum(b.capacity for b in batches))
     shapes = tuple(tuple(_col_shape_sig(c) for c in b.columns) for b in batches)
@@ -100,6 +101,34 @@ def concat_device(batches: list[DeviceBatch], capacity: int | None = None) -> De
         lambda: K.GuardedJit(lambda bs: _concat_impl(list(bs), cap)),
     )
     return fn(tuple(batches))
+
+
+def _colocate(batches: list[DeviceBatch]) -> list[DeviceBatch]:
+    """Mesh mode gathers batches produced on different chips (coalesce /
+    sort merge / broadcast build); XLA requires one device per program, so
+    stragglers move to the first batch's device. Single-device mode: no-op
+    (metadata check only, no transfer)."""
+
+    def dev_of(b):
+        if not b.columns:
+            return None
+        data = b.columns[0].data
+        devices = getattr(data, "devices", None)
+        if devices is None:
+            return None  # tracer / non-committed value
+        try:
+            return next(iter(devices()))
+        except Exception:
+            return None
+    devs = [dev_of(b) for b in batches]
+    real = [d for d in devs if d is not None]
+    if len(set(real)) <= 1:
+        return batches
+    target = real[0]
+    return [
+        b if d is None or d == target else jax.device_put(b, target)
+        for b, d in zip(batches, devs)
+    ]
 
 
 def _concat_impl(batches: list[DeviceBatch], cap: int) -> DeviceBatch:

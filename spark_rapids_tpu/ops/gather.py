@@ -60,8 +60,19 @@ def bulk_shrink(batches: list[DeviceBatch]) -> list[DeviceBatch]:
 
     if not batches:
         return batches
-    # stack the device scalars so the host fetch is ONE array transfer
-    counts = np.asarray(jnp.stack([b.num_rows for b in batches]))
+    try:
+        same_dev = (
+            len({next(iter(b.num_rows.devices())) for b in batches}) <= 1
+        )
+    except Exception:
+        same_dev = True
+    if same_dev:
+        # stack the device scalars so the host fetch is ONE array transfer
+        counts = np.asarray(jnp.stack([b.num_rows for b in batches]))
+    else:
+        # mesh mode gathers batches from several chips: device_get pipelines
+        # the per-device pulls (copy_to_host_async per leaf)
+        counts = np.asarray(jax.device_get([b.num_rows for b in batches]))
     return [shrink_one(b, int(n)) for b, n in zip(batches, counts)]
 
 

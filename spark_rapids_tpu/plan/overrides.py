@@ -31,6 +31,7 @@ from ..expr import math as mx
 from ..expr import nullexprs as nx
 from ..expr import predicates as pred
 from ..expr import strings as st
+from ..expr import subquery as sq
 from ..expr.base import Alias, BoundReference, Literal, UnresolvedAttribute
 from ..expr.cast import Cast, can_cast_on_device
 from ..exec import cpu as C
@@ -190,6 +191,7 @@ for _cls in (
     pred.IsNotNull,
     pred.IsNaN,
     pred.In,
+    sq.InSet,
     cond.If,
     cond.CaseWhen,
     cond.Coalesce,
@@ -208,14 +210,26 @@ for _cls in (agg.StddevSamp, agg.StddevPop, agg.VarianceSamp, agg.VariancePop):
 
 
 def _collect_check(e, conf: TpuConf) -> Optional[str]:
-    return (
-        "collect_list/collect_set build variable-length arrays per group; "
-        "the device segment-reduce kernel has no list accumulator yet"
-    )
+    from ..types import is_complex
+
+    if is_complex(e.child.data_type):
+        return "collect over nested element types is not supported on device"
+    return None
 
 
 _expr(agg.CollectList, check=_collect_check)
 _expr(agg.CollectSet, check=_collect_check)
+
+
+def _merge_lists_check(e, conf: TpuConf) -> Optional[str]:
+    return (
+        "merging partial collect arrays (collect alongside DISTINCT "
+        "aggregates) runs on the CPU engine"
+    )
+
+
+_expr(agg.MergeLists, check=_merge_lists_check)
+_expr(agg.MergeSets, check=_merge_lists_check)
 
 
 # string rules — device paths that need a scalar pattern are gated exactly
@@ -386,19 +400,13 @@ def _window_check(e, conf: TpuConf) -> Optional[str]:
             ot = e.spec.order_by[0].child.data_type
             from ..types import is_numeric
 
-            if isinstance(ot, DecimalType):
-                # integer bounds would compare against the UNSCALED int64
-                # (5 would mean 0.05 over decimal(_,2)) — CPU-only until
-                # the bounds are scale-adjusted
-                return "numeric RANGE frame over a decimal order key is CPU-only"
+            # decimal keys compare unscaled with scale-adjusted bounds
+            # (exec/tpu_window.py); strings and other non-numeric keys
+            # have no value-space offset semantics
             if isinstance(ot, StringType) or not (
                 is_numeric(ot) or ot.__class__.__name__ in ("DateType", "TimestampType")
             ):
                 return f"numeric RANGE frame over {ot.simple_string} is CPU-only"
-        if isinstance(fn, (agg.Min, agg.Max)) and isinstance(
-            fn.child.data_type, StringType
-        ):
-            return "string min/max over windows is CPU-only"
         return None
     return f"window function {type(fn).__name__} has no device implementation"
 

@@ -95,6 +95,16 @@ class ExecContext:
         self._shuffle_manager = None
         self._shuffle_mgr_lock = threading.Lock()
         self._shuffle_ids = itertools.count(1)
+        # AQE: per-exchange measured-size providers, so the two exchanges
+        # feeding a co-partitioned join can compute ONE shared coalesce
+        # assignment (Spark applies identical CoalescedPartitionSpecs to
+        # both shuffle reads of a join).
+        self.aqe_size_providers: dict = {}
+        # Mesh execution: session-held MeshContext (stable across queries so
+        # exchange programs stay compile-cached); None = single-device mode.
+        self.mesh = None
+        if cfg.MESH_ENABLED.get(conf) and session is not None:
+            self.mesh = session.mesh_context()
 
     @property
     def shuffle_manager(self):

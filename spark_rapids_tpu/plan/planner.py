@@ -271,17 +271,17 @@ def _finalize_result_expr(e: Expression, num_keys: int, key_exprs) -> Expression
     return map_child_exprs(e, lambda c: _finalize_result_expr(c, num_keys, key_exprs))
 
 
-def _rewrite_distinct(lp: L.Aggregate) -> L.Aggregate:
-    """Plan DISTINCT aggregates as two stacked aggregations — Spark's
-    AggUtils.planAggregateWithOneDistinct shape (reference relies on it:
-    distinct arrives at the plugin already rewritten):
-
-        Aggregate(keys, [sum(y), count(DISTINCT x)])
-        ⇒ inner:  Aggregate(keys ++ [x], partial non-distinct aggs)
-          outer:  Aggregate(keys, re-aggregate partials + agg over x)
-
-    All distinct aggregates must share one child expression (Spark's
-    multi-distinct Expand rewrite is not implemented)."""
+def _merge_regular_agg(
+    e: AggregateFunction,
+    name: str,
+    inner_out: List[Expression],
+    child: Expression,
+    sum_type,
+) -> Expression:
+    """Split a non-distinct aggregate into an inner partial (appended to
+    ``inner_out``) and the outer merge expression returned. ``child`` is
+    the expression the partial aggregates over (the original child for the
+    one-distinct shape; an Expand-projected column for multi-distinct)."""
     import dataclasses as _dc
 
     from ..expr import Literal
@@ -293,30 +293,108 @@ def _rewrite_distinct(lp: L.Aggregate) -> L.Aggregate:
         Max,
         Min,
         Sum,
+        _CentralMoment,
     )
-    from ..expr.base import map_child_exprs
     from ..expr.cast import Cast
     from ..expr.conditional import Coalesce
     from ..expr.arithmetic import Divide
     from ..types import DOUBLE, LONG
+
+    if isinstance(e, (Min, Max, First, Last)):
+        inner_out.append(Alias(_dc.replace(e, child=child), name))
+        return _dc.replace(e, child=UnresolvedAttribute(name))
+    if isinstance(e, Sum):
+        # re-summing widens again (decimal p+10): cast back
+        inner_out.append(Alias(_dc.replace(e, child=child), name))
+        return Cast(Sum(UnresolvedAttribute(name)), sum_type)
+    if isinstance(e, Count):
+        inner_out.append(Alias(_dc.replace(e, child=child), name))
+        return Coalesce((Sum(UnresolvedAttribute(name)), Literal(0, LONG)))
+    if isinstance(e, Average):
+        sname, cname = name + "s", name + "c"
+        inner_out.append(Alias(Sum(Cast(child, DOUBLE)), sname))
+        inner_out.append(Alias(Count(child), cname))
+        return Divide(
+            Sum(UnresolvedAttribute(sname)),
+            Cast(Sum(UnresolvedAttribute(cname)), DOUBLE),
+        )
+    if isinstance(e, _CentralMoment):
+        # (count, Σx, Σx²) partials re-sum; the result expression
+        # mirrors _CentralMoment.evaluate term for term
+        from ..expr.arithmetic import Multiply, Subtract
+        from ..expr.conditional import If
+        from ..expr.math import Sqrt
+        from ..expr.predicates import GreaterThan, LessThan
+
+        cname, sname, ssn = name + "c", name + "s", name + "ss"
+        xd = Cast(child, DOUBLE)
+        inner_out.append(Alias(Count(child), cname))
+        inner_out.append(Alias(Sum(xd), sname))
+        inner_out.append(Alias(Sum(Multiply(xd, xd)), ssn))
+        nD = Cast(
+            Coalesce((Sum(UnresolvedAttribute(cname)), Literal(0, LONG))),
+            DOUBLE,
+        )
+        sS = Sum(UnresolvedAttribute(sname))
+        m2 = Subtract(
+            Sum(UnresolvedAttribute(ssn)), Multiply(sS, Divide(sS, nD))
+        )
+        div = Subtract(nD, Literal(1.0, DOUBLE)) if e.sample else nD
+        var = If(
+            GreaterThan(div, Literal(0.0, DOUBLE)),
+            Divide(m2, div),
+            Literal(float("nan"), DOUBLE),
+        )
+        var = If(
+            GreaterThan(nD, Literal(0.0, DOUBLE)),
+            var,
+            Literal(None, DOUBLE),
+        )
+        var = If(LessThan(var, Literal(0.0, DOUBLE)), Literal(0.0, DOUBLE), var)
+        return Sqrt(var) if e.sqrt else var
+    from ..expr.aggregates import CollectList, CollectSet, MergeLists, MergeSets
+
+    if isinstance(e, CollectList):
+        # partial collect per inner group, merged at the outer aggregate
+        # (Spark's Collect merge phase; MergeLists/Sets are CPU-executed)
+        inner_out.append(Alias(_dc.replace(e, child=child), name))
+        merge_cls = MergeSets if isinstance(e, CollectSet) else MergeLists
+        return merge_cls(UnresolvedAttribute(name))
+    raise NotImplementedError(
+        f"{type(e).__name__} combined with DISTINCT aggregates"
+    )
+
+
+def _rewrite_distinct(lp: L.Aggregate) -> L.Aggregate:
+    """Plan DISTINCT aggregates as two stacked aggregations — Spark's
+    AggUtils.planAggregateWithOneDistinct shape (reference relies on it:
+    distinct arrives at the plugin already rewritten):
+
+        Aggregate(keys, [sum(y), count(DISTINCT x)])
+        ⇒ inner:  Aggregate(keys ++ [x], partial non-distinct aggs)
+          outer:  Aggregate(keys, re-aggregate partials + agg over x)
+
+    Multiple DISTINCT column sets take the Expand-based rewrite
+    (_rewrite_multi_distinct)."""
+    import dataclasses as _dc
+
+    from ..expr.base import map_child_exprs
 
     # the single distinct child
     dchildren = []
 
     def find(e):
         if isinstance(e, AggregateFunction) and getattr(e, "distinct", False):
-            dchildren.append(e.child)
+            if e.child not in dchildren:
+                dchildren.append(e.child)
         for c in e.children():
             find(c)
 
     for e in lp.aggregates:
         find(e)
+    if len(dchildren) > 1:
+        return _rewrite_multi_distinct(lp, dchildren)
     first_child = dchildren[0]
-    if any(c != first_child for c in dchildren):
-        raise NotImplementedError(
-            "multiple DISTINCT aggregate column sets are not supported "
-            "(Spark's Expand-based rewrite not implemented)"
-        )
 
     key_names = [f"__k{i}" for i in range(len(lp.grouping))]
     inner_out: List[Expression] = [
@@ -331,68 +409,8 @@ def _rewrite_distinct(lp: L.Aggregate) -> L.Aggregate:
                 return _dc.replace(e, child=UnresolvedAttribute("__dk"), distinct=False)
             name = f"__nd{nd_count[0]}"
             nd_count[0] += 1
-            if isinstance(e, (Min, Max, First, Last)):
-                inner_out.append(Alias(e, name))
-                return _dc.replace(e, child=UnresolvedAttribute(name))
-            if isinstance(e, Sum):
-                # re-summing widens again (decimal p+10): cast back
-                inner_out.append(Alias(e, name))
-                sum_type = bind(e, lp.child.schema).data_type
-                return Cast(Sum(UnresolvedAttribute(name)), sum_type)
-            if isinstance(e, Count):
-                inner_out.append(Alias(e, name))
-                return Coalesce(
-                    (Sum(UnresolvedAttribute(name)), Literal(0, LONG))
-                )
-            if isinstance(e, Average):
-                sname, cname = name + "s", name + "c"
-                inner_out.append(Alias(Sum(Cast(e.child, DOUBLE)), sname))
-                inner_out.append(Alias(Count(e.child), cname))
-                return Divide(
-                    Sum(UnresolvedAttribute(sname)),
-                    Cast(Sum(UnresolvedAttribute(cname)), DOUBLE),
-                )
-            from ..expr.aggregates import _CentralMoment
-
-            if isinstance(e, _CentralMoment):
-                # (count, Σx, Σx²) partials re-sum; the result expression
-                # mirrors _CentralMoment.evaluate term for term
-                from ..expr.arithmetic import Multiply, Subtract
-                from ..expr.conditional import If
-                from ..expr.math import Sqrt
-                from ..expr.predicates import GreaterThan, LessThan
-
-                cname, sname, ssn = name + "c", name + "s", name + "ss"
-                xd = Cast(e.child, DOUBLE)
-                inner_out.append(Alias(Count(e.child), cname))
-                inner_out.append(Alias(Sum(xd), sname))
-                inner_out.append(Alias(Sum(Multiply(xd, xd)), ssn))
-                nD = Cast(
-                    Coalesce((Sum(UnresolvedAttribute(cname)), Literal(0, LONG))),
-                    DOUBLE,
-                )
-                sS = Sum(UnresolvedAttribute(sname))
-                m2 = Subtract(
-                    Sum(UnresolvedAttribute(ssn)), Multiply(sS, Divide(sS, nD))
-                )
-                div = (
-                    Subtract(nD, Literal(1.0, DOUBLE)) if e.sample else nD
-                )
-                var = If(
-                    GreaterThan(div, Literal(0.0, DOUBLE)),
-                    Divide(m2, div),
-                    Literal(float("nan"), DOUBLE),
-                )
-                var = If(
-                    GreaterThan(nD, Literal(0.0, DOUBLE)),
-                    var,
-                    Literal(None, DOUBLE),
-                )
-                var = If(LessThan(var, Literal(0.0, DOUBLE)), Literal(0.0, DOUBLE), var)
-                return Sqrt(var) if e.sqrt else var
-            raise NotImplementedError(
-                f"{type(e).__name__} combined with DISTINCT aggregates"
-            )
+            sum_type = bind(e, lp.child.schema).data_type
+            return _merge_regular_agg(e, name, inner_out, e.child, sum_type)
         if not e.children():
             return e
         return map_child_exprs(e, replace_agg)
@@ -411,6 +429,143 @@ def _rewrite_distinct(lp: L.Aggregate) -> L.Aggregate:
         outer_out.append(Alias(mapped, name))
 
     inner = L.Aggregate(list(lp.grouping) + [first_child], inner_out, lp.child)
+    outer_grouping = [UnresolvedAttribute(n) for n in key_names]
+    return L.Aggregate(outer_grouping, outer_out, inner)
+
+
+def _rewrite_multi_distinct(
+    lp: L.Aggregate, dchildren: List[Expression]
+) -> L.Aggregate:
+    """Multiple DISTINCT column sets — Spark's RewriteDistinctAggregates:
+    fan each input row out through an Expand, one projection per distinct
+    group (gid=i carries only that group's child value) plus a gid=0
+    projection carrying the regular aggregates' inputs, then aggregate
+    twice:
+
+        inner: group by keys ++ [d1..dm, gid]   (dedupes each distinct set)
+        outer: group by keys; distinct agg i over if(gid=i, di, null),
+               regular aggs re-aggregate their gid=0 partials
+
+    (Catalyst's RewriteDistinctAggregates rule; the reference receives this
+    plan shape from Spark and runs it through GpuExpandExec —
+    GpuExpandExec.scala.)"""
+    import dataclasses as _dc
+
+    from ..expr import Literal
+    from ..expr.base import map_child_exprs
+    from ..expr.conditional import If
+    from ..expr.predicates import EqualTo
+    from ..types import INT
+
+    child_schema = lp.child.schema
+    m = len(dchildren)
+
+    # regular (non-distinct) aggregate children, deduped; each becomes an
+    # Expand column live only in the gid=0 projection (count(*)'s literal
+    # too, so expanded duplicate rows are not double-counted)
+    reg_children: List[Expression] = []
+
+    def collect_regular(e):
+        if isinstance(e, AggregateFunction) and not getattr(e, "distinct", False):
+            if e.child not in reg_children:
+                reg_children.append(e.child)
+        for c in e.children():
+            collect_regular(c)
+
+    for e in lp.aggregates:
+        collect_regular(e)
+
+    key_names = [f"__k{i}" for i in range(len(lp.grouping))]
+    d_names = [f"__d{i}" for i in range(m)]
+    r_names = [f"__r{j}" for j in range(len(reg_children))]
+    gid_name = "__gid"
+    out_names = key_names + d_names + r_names + [gid_name]
+
+    def null_of(expr):
+        return Literal(None, bind(expr, child_schema).data_type)
+
+    projections: List[List[Expression]] = []
+    proj0: List[Expression] = [
+        Alias(g, n) for g, n in zip(lp.grouping, key_names)
+    ]
+    proj0 += [Alias(null_of(d), n) for d, n in zip(dchildren, d_names)]
+    proj0 += [Alias(c, n) for c, n in zip(reg_children, r_names)]
+    proj0.append(Alias(Literal(0, INT), gid_name))
+    projections.append(proj0)
+    for i, d in enumerate(dchildren):
+        proj: List[Expression] = [
+            Alias(g, n) for g, n in zip(lp.grouping, key_names)
+        ]
+        proj += [
+            Alias(dj if j == i else null_of(dj), n)
+            for j, (dj, n) in enumerate(zip(dchildren, d_names))
+        ]
+        proj += [Alias(null_of(c), n) for c, n in zip(reg_children, r_names)]
+        proj.append(Alias(Literal(i + 1, INT), gid_name))
+        projections.append(proj)
+
+    expand = L.Expand(projections, out_names, lp.child)
+
+    inner_grouping = [
+        UnresolvedAttribute(n) for n in key_names + d_names + [gid_name]
+    ]
+    inner_out: List[Expression] = [
+        Alias(UnresolvedAttribute(n), n)
+        for n in key_names + d_names + [gid_name]
+    ]
+    nd_count = [0]
+
+    def replace_agg(e: Expression) -> Expression:
+        if isinstance(e, AggregateFunction):
+            if getattr(e, "distinct", False):
+                i = dchildren.index(e.child)
+                guarded = If(
+                    EqualTo(UnresolvedAttribute(gid_name), Literal(i + 1, INT)),
+                    UnresolvedAttribute(d_names[i]),
+                    null_of(e.child),
+                )
+                return _dc.replace(e, child=guarded, distinct=False)
+            name = f"__nd{nd_count[0]}"
+            nd_count[0] += 1
+            sum_type = bind(e, child_schema).data_type
+            j = reg_children.index(e.child)
+            from ..expr.aggregates import First, Last
+
+            if isinstance(e, (First, Last)):
+                # gid!=0 inner groups carry all-null partials (their __r
+                # column is the Expand-projected null); a null-blind merge
+                # could pick one, so the outer merge must skip null
+                # partials — there is exactly one gid=0 partial per key
+                inner_out.append(
+                    Alias(
+                        _dc.replace(e, child=UnresolvedAttribute(r_names[j])),
+                        name,
+                    )
+                )
+                return _dc.replace(
+                    e, child=UnresolvedAttribute(name), ignore_nulls=True
+                )
+            return _merge_regular_agg(
+                e, name, inner_out, UnresolvedAttribute(r_names[j]), sum_type
+            )
+        if not e.children():
+            return e
+        return map_child_exprs(e, replace_agg)
+
+    outer_out: List[Expression] = []
+    for e in lp.aggregates:
+        name = output_name(e)
+        target = e.child if isinstance(e, Alias) else e
+        mapped = None
+        for i, g in enumerate(lp.grouping):
+            if target == g:
+                mapped = UnresolvedAttribute(key_names[i])
+                break
+        if mapped is None:
+            mapped = replace_agg(target)
+        outer_out.append(Alias(mapped, name))
+
+    inner = L.Aggregate(inner_grouping, inner_out, expand)
     outer_grouping = [UnresolvedAttribute(n) for n in key_names]
     return L.Aggregate(outer_grouping, outer_out, inner)
 
@@ -446,10 +601,28 @@ def _plan_aggregate(lp: L.Aggregate, conf: TpuConf) -> Exec:
         return CpuHashAggregateExec(
             "complete", partial_grouping, agg_fns, result_exprs, result_names, child
         )
+    nparts = cfg.SHUFFLE_PARTITIONS.get(conf)
+    from ..expr.aggregates import CollectList, MergeLists
+
+    if any(isinstance(f, (CollectList, MergeLists)) for f in agg_fns):
+        # collect_list/set has no fixed-width merge buffer: exchange the RAW
+        # rows by the grouping keys, then one complete aggregate per
+        # partition — result identical to Spark's partial+merge, and the
+        # device kernel only ever builds final list planes (the reference's
+        # GpuCollectList merges device lists; this engine trades that merge
+        # for a row exchange)
+        if bound_grouping:
+            pre = CpuShuffleExchangeExec(
+                P.HashPartitioning(nparts, list(bound_grouping)), child
+            )
+        else:
+            pre = CpuCoalescePartitionsExec(child)
+        return CpuHashAggregateExec(
+            "complete", partial_grouping, agg_fns, result_exprs, result_names, pre
+        )
     partial = CpuHashAggregateExec(
         "partial", partial_grouping, agg_fns, None, None, child
     )
-    nparts = cfg.SHUFFLE_PARTITIONS.get(conf)
     if bound_grouping:
         exchange = CpuShuffleExchangeExec(
             P.HashPartitioning(

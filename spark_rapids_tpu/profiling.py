@@ -1,0 +1,144 @@
+"""Tracing / profiling subsystem — the NvtxWithMetrics analogue (SURVEY §5).
+
+The reference fuses NVTX ranges with GpuMetrics so one instrumentation
+point feeds both the Nsight timeline and the Spark-UI metric totals
+(sql-plugin NvtxWithMetrics.scala, GpuMetric ranges). The TPU analogues:
+
+- **timeline**: ``jax.profiler.trace`` dumps an XPlane/TensorBoard capture
+  of the whole query (device kernels + host gaps);
+  ``jax.profiler.TraceAnnotation`` marks each operator's partition work so
+  the capture carries plan-node names — that is the NVTX range.
+- **device-time attribution**: dispatch is async (enqueue ≈ 0), so
+  per-operator device time needs a sync point. ``instrument_plan`` wraps
+  every exec's partition iterators with ``block_until_ready`` + a timer
+  feeding an ``opTime`` metric — the CUDA_LAUNCH_BLOCKING-style debug mode.
+  It serializes the inter-operator pipeline, so it is opt-in
+  (``spark.rapids.sql.profile.opTime.enabled``), exactly like the
+  reference's DEBUG metric level.
+
+``metrics_report`` renders the per-node metric tree (wall + device time,
+rows) — the Spark-UI stand-in the bench uses for its device-vs-host
+breakdown.
+"""
+from __future__ import annotations
+
+import time
+from typing import Iterator
+
+import jax
+
+from .plan.physical import Exec, ExecContext, PartitionSet
+
+
+def walk(plan: Exec) -> Iterator[Exec]:
+    yield plan
+    for c in plan.children:
+        yield from walk(c)
+
+
+def _wrap_partitions(node: Exec, pset: PartitionSet) -> PartitionSet:
+    """Per-partition: annotate the trace with the node name and attribute
+    blocked device time per produced batch to the node's opTime metric."""
+    op_time = node.metric("opTime", "DEBUG")
+    batches_m = node.metric("opOutputBatches", "DEBUG")
+    name = type(node).__name__
+
+    def make(t):
+        def it():
+            for db in t():
+                t0 = time.perf_counter_ns()
+                with jax.profiler.TraceAnnotation(name):
+                    jax.block_until_ready(db)
+                op_time.add(time.perf_counter_ns() - t0)
+                batches_m.add(1)
+                yield db
+
+        return it
+
+    return PartitionSet([make(t) for t in pset.parts])
+
+
+def instrument_plan(plan: Exec) -> None:
+    """Instance-level wrap of every node's ``execute`` so its output
+    partitions block-and-time per batch. Wall-clock spent blocking at node
+    X = device work that finished between X-1's sync and X's sync = X's own
+    kernels (the pipeline is serialized by the syncs themselves)."""
+    for node in walk(plan):
+        if getattr(node, "_profiled", False):
+            continue
+        orig = node.execute
+
+        def execute(ctx: ExecContext, _orig=orig, _node=node):
+            return _wrap_partitions(_node, _orig(ctx))
+
+        node.execute = execute  # type: ignore[method-assign]
+        node._profiled = True  # type: ignore[attr-defined]
+
+
+class query_trace:
+    """Context manager: wrap one query execution in a jax.profiler trace
+    dump when a path is configured (else no-op)."""
+
+    def __init__(self, path: str | None):
+        self.path = path or None
+        self._cm = None
+
+    def __enter__(self):
+        if self.path:
+            self._cm = jax.profiler.trace(self.path)
+            self._cm.__enter__()
+        return self
+
+    def __exit__(self, *exc):
+        if self._cm is not None:
+            return self._cm.__exit__(*exc)
+        return False
+
+
+def metrics_report(plan: Exec) -> str:
+    """Human-readable per-node metric tree (Spark-UI stand-in)."""
+    lines = []
+
+    def fmt(node: Exec, indent: int):
+        ms = {m.name: m.value for m in node.metrics.values()}
+        shown = []
+        for k in sorted(ms):
+            v = ms[k]
+            if k.endswith("Time") or k == "opTime":
+                shown.append(f"{k}={v / 1e6:.1f}ms")
+            else:
+                shown.append(f"{k}={v}")
+        lines.append("  " * indent + node.node_string() + (
+            ("  [" + ", ".join(shown) + "]") if shown else ""
+        ))
+        for c in node.children:
+            fmt(c, indent + 1)
+
+    fmt(plan, 0)
+    return "\n".join(lines)
+
+
+def device_host_breakdown(plan: Exec) -> dict:
+    """Aggregate totals for the bench JSON ``detail``: device-attributed
+    op time vs host transfer time vs rows moved."""
+    out = {
+        "op_time_ms": 0.0,
+        "h2d_time_ms": 0.0,
+        "d2h_time_ms": 0.0,
+        "per_node_ms": {},
+    }
+    for node in walk(plan):
+        for m in node.metrics.values():
+            if m.name == "opTime":
+                ms = m.value / 1e6
+                out["op_time_ms"] += ms
+                key = type(node).__name__
+                out["per_node_ms"][key] = out["per_node_ms"].get(key, 0.0) + ms
+            elif m.name == "hostToDeviceTime":
+                out["h2d_time_ms"] += m.value / 1e6
+            elif m.name == "deviceToHostTime":
+                out["d2h_time_ms"] += m.value / 1e6
+    out["per_node_ms"] = dict(
+        sorted(out["per_node_ms"].items(), key=lambda kv: -kv[1])
+    )
+    return out

@@ -48,9 +48,28 @@ class TpuSession:
             import jax
 
             jax.config.update("jax_platforms", "cpu")
+        self._mesh_ctx = None
+        if cfg.MESH_ENABLED.get(self.conf):
+            # mesh mode: one exchange partition per chip, so the planner's
+            # shuffle arity matches the mesh unless the user pinned it
+            if self.conf.get_raw(cfg.SHUFFLE_PARTITIONS.key) is None:
+                self.conf = self.conf.set(
+                    cfg.SHUFFLE_PARTITIONS.key, self.mesh_context().n
+                )
         self.read = DataFrameReader(self)
         self._last_plan: Optional[Exec] = None
         self._last_overrides: Optional[TpuOverrides] = None
+
+    def mesh_context(self):
+        """Lazily build the session's MeshContext (mesh mode only)."""
+        if self._mesh_ctx is None:
+            import jax
+
+            from .parallel.mesh import MeshContext
+
+            n = cfg.MESH_SIZE.get(self.conf) or len(jax.devices())
+            self._mesh_ctx = MeshContext(min(n, len(jax.devices())))
+        return self._mesh_ctx
 
     # ── builders ────────────────────────────────────────────────────────
     def create_dataframe(
@@ -84,9 +103,73 @@ class TpuSession:
         self.conf = self.conf.set(key, value)
 
     # ── execution ───────────────────────────────────────────────────────
+    def _resolve_subqueries(self, lp: L.LogicalPlan) -> L.LogicalPlan:
+        """Execute every subquery plan through the full engine and inline
+        the results (Spark executes subqueries before the main query;
+        reference GpuScalarSubquery.scala / GpuInSet.scala):
+
+            ScalarSubquery(plan) → Literal(value)
+            InSubquery(c, plan)  → InSet(c, distinct values)
+        """
+        from .expr.base import Literal
+        from .expr.subquery import InSet, InSubquery, ScalarSubquery
+
+        def fix(e):
+            if isinstance(e, ScalarSubquery):
+                tbl = self._execute(e.plan)
+                if tbl.num_columns != 1:
+                    raise ValueError(
+                        "scalar subquery must return one column, got "
+                        f"{tbl.num_columns}"
+                    )
+                if tbl.num_rows > 1:
+                    raise ValueError(
+                        "scalar subquery returned more than one row"
+                    )
+                val = tbl.column(0)[0].as_py() if tbl.num_rows else None
+                from .types import DateType, TimestampType
+
+                if val is not None and isinstance(
+                    e.data_type, (DateType, TimestampType)
+                ):
+                    # date/timestamp literals store their physical ints
+                    # (Literal.eval special-cases only None/string/decimal)
+                    val = InSet._encode_values([val], e.data_type)[0]
+                return Literal(val, e.data_type)
+            if isinstance(e, InSubquery):
+                tbl = self._execute(e.plan)
+                if tbl.num_columns != 1:
+                    raise ValueError(
+                        "IN-subquery must return one column, got "
+                        f"{tbl.num_columns}"
+                    )
+                vals = tbl.column(0).to_pylist()
+                seen: set = set()
+                out = []
+                has_null = False
+                for x in vals:
+                    if x is None:
+                        has_null = True
+                        continue
+                    try:
+                        new = x not in seen
+                        if new:
+                            seen.add(x)
+                    except TypeError:
+                        new = True
+                    if new:
+                        out.append(x)
+                if has_null:
+                    out.append(None)
+                return InSet(e.c, tuple(out))
+            return e
+
+        return L.transform_expressions(lp, fix)
+
     def _execute(self, lp: L.LogicalPlan) -> pa.Table:
         from .plan.pruning import prune_columns
 
+        lp = self._resolve_subqueries(lp)
         if cfg.ANSI_ENABLED.get(self.conf):
             # Spark resolves ansiEnabled into Cast at analysis time; same
             # here — the rewrite happens before planning so both the CPU
@@ -109,6 +192,16 @@ class TpuSession:
         self._last_overrides = overrides
         self._assert_test_mode(overrides, final_plan)
         ctx = ExecContext(self.conf, self)
+        if cfg.PROFILE_OPTIME.get(self.conf):
+            from .profiling import instrument_plan
+
+            instrument_plan(final_plan)
+        from .profiling import query_trace
+
+        with query_trace(cfg.PROFILE_PATH.get(self.conf)):
+            return self._run_plan(final_plan, ctx)
+
+    def _run_plan(self, final_plan, ctx) -> pa.Table:
         parts = final_plan.execute(ctx)
         batches: List[pa.RecordBatch] = []
         n_threads = min(len(parts.parts), cfg.CONCURRENT_TPU_TASKS.get(self.conf))
@@ -554,8 +647,8 @@ class GroupedData:
     def pivot(self, pivot_col: str, values: Optional[list] = None) -> "GroupedData":
         """Pivot on ``pivot_col`` — Catalyst's RewritePivot shape: each
         (value, aggregate) pair becomes ``agg(if(p <=> value, x, null))``
-        (reference analogue: GpuPivotFirst; divergence: ``count`` yields 0
-        instead of null for absent combinations, like the SQL rewrite).
+        (reference analogue: GpuPivotFirst); ``count`` yields null for
+        absent (group, value) combinations like Spark's DataFrame pivot.
         When ``values`` is omitted they are collected eagerly from the data
         (sorted, like Spark's auto-detection)."""
         if self._grouping_sets is not None:
@@ -583,9 +676,23 @@ class GroupedData:
 
         def wrap(e: Expression, v) -> Expression:
             if isinstance(e, AggregateFunction):
+                from .expr.aggregates import Count
+                from .expr.predicates import GreaterThan
+
                 cond = EqualNullSafe(UnresolvedAttribute(pcol), to_expr(v))
                 guarded = If(cond, e.child, Literal(None, NULL))
-                return _dc.replace(e, child=guarded)
+                agg = _dc.replace(e, child=guarded)
+                if isinstance(e, Count):
+                    # Spark's DataFrame pivot (PivotFirst / GpuPivotFirst)
+                    # yields NULL, not 0, when no input row matched the
+                    # pivot value; gate the count on a matched-row count
+                    matched = _dc.replace(
+                        e, child=If(cond, to_expr(1), Literal(None, NULL))
+                    )
+                    return If(
+                        GreaterThan(matched, to_expr(0)), agg, Literal(None, NULL)
+                    )
+                return agg
             if not e.children():
                 return e
             return map_child_exprs(e, lambda c: wrap(c, v))

@@ -4,6 +4,7 @@ map sizes) and CostBasedOptimizer.scala:29-310 (transition-aware section
 replacement, default-off there too)."""
 import numpy as np
 import pyarrow as pa
+import pytest
 
 from spark_rapids_tpu.functions import col, sum as sum_
 
@@ -45,6 +46,72 @@ def test_aqe_coalesces_small_partitions():
     s2 = tpu_session()
     build(s2).collect()
     assert not hasattr(_find_exchange(s2._last_plan), "aqe_groups")
+
+
+def _find_exchanges(plan, out=None):
+    from spark_rapids_tpu.exec.tpu import TpuShuffleExchangeExec
+
+    if out is None:
+        out = []
+    if isinstance(plan, TpuShuffleExchangeExec):
+        out.append(plan)
+    for c in plan.children:
+        _find_exchanges(c, out)
+    return out
+
+
+def test_aqe_join_shares_one_coalesce_assignment():
+    """Regression: independent per-exchange coalescing broke the positional
+    partition pairing of TpuShuffledHashJoinExec and silently dropped
+    matches. Both sides must group identically (Spark applies the same
+    CoalescedPartitionSpecs to both shuffle reads of a join)."""
+    from spark_rapids_tpu.types import LONG
+
+    # asymmetric sides: left 50× heavier than right, so independent
+    # size-based assignments would differ
+    lt = gen_grouped_table([("lv", LONG), ("lw", LONG)], 5000, num_groups=40, seed=7)
+    rt = gen_grouped_table([("rv", LONG)], 100, num_groups=40, seed=8)
+    conf = {
+        "spark.sql.adaptive.enabled": True,
+        "spark.sql.autoBroadcastJoinThreshold": "-1",
+        # tiny advisory size: force nontrivial grouping on the big side
+        "spark.sql.adaptive.advisoryPartitionSizeInBytes": str(16 * 1024),
+    }
+
+    def build(s):
+        return s.create_dataframe(lt, num_partitions=4).join(
+            s.create_dataframe(rt, num_partitions=4), on="k", how="inner"
+        )
+
+    assert_cpu_and_tpu_equal(build, conf=conf)
+    s = tpu_session(conf)
+    build(s).collect()
+    exchanges = _find_exchanges(s._last_plan)
+    groups = [getattr(ex, "aqe_groups", None) for ex in exchanges]
+    assert len(exchanges) == 2, s._last_plan.tree_string()
+    # identical assignment on both sides (or identity on both)
+    assert groups[0] == groups[1], groups
+
+
+@pytest.mark.parametrize("how", ["left", "full", "left_anti"])
+def test_aqe_join_outer_types(how):
+    """Outer joins make dropped/duplicated matches visible as extra or
+    missing null-extended rows."""
+    from spark_rapids_tpu.types import LONG
+
+    lt = gen_grouped_table([("lv", LONG)], 3000, num_groups=30, seed=9)
+    rt = gen_grouped_table([("rv", LONG)], 120, num_groups=50, seed=10)
+    conf = {
+        "spark.sql.adaptive.enabled": True,
+        "spark.sql.autoBroadcastJoinThreshold": "-1",
+        "spark.sql.adaptive.advisoryPartitionSizeInBytes": str(8 * 1024),
+    }
+    assert_cpu_and_tpu_equal(
+        lambda s: s.create_dataframe(lt, num_partitions=5).join(
+            s.create_dataframe(rt, num_partitions=5), on="k", how=how
+        ),
+        conf=conf,
+    )
 
 
 def test_cbo_unconverts_trivial_island():

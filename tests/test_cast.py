@@ -221,6 +221,45 @@ def test_string_to_decimal():
     assert_cpu_and_tpu_equal(_cast_df(t, DecimalType(10, 2)))
 
 
+def test_string_decimal_form_to_int_truncates():
+    """UTF8String.toLong semantics: '1.5' → 1 (truncate toward zero) in
+    non-ANSI mode; no digits before the dot, double dots, or non-digit
+    fraction stays NULL (reference castStringToInts regex)."""
+    vals = ["1.5", "-1.5", "1.", "1.999", "+2.0", ".5", "1.2.3", "1.a", None]
+    t = pa.table({"a": pa.array(vals)})
+    for to in (INT, LONG):
+        assert_cpu_and_tpu_equal(_cast_df(t, to))
+    got = _cast_df(t, LONG)(tpu_session()).collect()
+    assert [r[0] for r in got] == [1, -1, 1, 1, 2, None, None, None, None]
+
+
+@pytest.mark.parametrize("engine", ["cpu", "tpu"])
+def test_ansi_string_decimal_form_to_int_raises(engine):
+    t = pa.table({"a": pa.array(["1.5"])})
+    s = cpu_session(ANSI) if engine == "cpu" else tpu_session(ANSI)
+    df = s.create_dataframe(t).select(col("a").cast(INT).alias("c"))
+    with pytest.raises(AnsiError):
+        df.collect()
+
+
+def test_bool_to_decimal():
+    """cast(true as decimal(5,2)) is 1.00 — the unscaled value is
+    1×10^scale, not the raw bit."""
+    t = pa.table({"a": pa.array([True, False, None])})
+    assert_cpu_and_tpu_equal(_cast_df(t, DecimalType(5, 2)))
+    got = _cast_df(t, DecimalType(5, 2))(tpu_session()).collect()
+    import decimal
+
+    assert [r[0] for r in got] == [
+        decimal.Decimal("1.00"),
+        decimal.Decimal("0.00"),
+        None,
+    ]
+    # decimal(2,2) cannot represent 1 → true overflows to NULL non-ANSI
+    got2 = _cast_df(t, DecimalType(2, 2))(tpu_session()).collect()
+    assert [r[0] for r in got2] == [None, decimal.Decimal("0.00"), None]
+
+
 def test_string_round_trip_int_fuzz():
     t = gen_table([("a", LONG)], 500, seed=51)
     def build(s):

@@ -125,6 +125,20 @@ def test_write_mode_error_raises(tmp_path):
     s.create_dataframe(t).write.mode("overwrite").parquet(path)  # no raise
 
 
+def test_overwrite_path_being_read_raises(tmp_path):
+    """Spark: 'Cannot overwrite a path that is also being read from' — the
+    source files must not be rmtree'd before the scan executes."""
+    t = _data(20, seed=6)
+    path = str(tmp_path / "self")
+    s = cpu_session()
+    s.create_dataframe(t).write.parquet(path)
+    df = s.read.parquet(path)
+    with pytest.raises(ValueError, match="also being read"):
+        df.write.mode("overwrite").parquet(path)
+    # the data survived the refused overwrite
+    assert len(s.read.parquet(path).collect()) == 20
+
+
 def test_write_stats_rows(tmp_path):
     t = _data(100, seed=5)
     path = str(tmp_path / "stats")
@@ -155,6 +169,64 @@ def test_row_group_pruning_skips_groups(tmp_path):
         return sess.read.parquet(f).filter(col("x") >= 900).select(col("y"))
 
     assert_cpu_and_tpu_equal(build)
+
+
+def test_orc_stripe_pruning_skips_stripes(tmp_path):
+    """Stripe-granularity ORC reads with statistics gating — the parquet
+    row-group path's analogue (GpuOrcScan.scala:853 + OrcFilters.scala);
+    stats come from our own footer parser (io/orc_meta.py) since pyarrow
+    exposes stripe reads but not stripe statistics."""
+    import pyarrow.orc as paorc
+
+    n = 100_000
+    t = pa.table(
+        {"x": pa.array(np.arange(n)), "y": pa.array(np.arange(n) * 0.5)}
+    )
+    f = str(tmp_path / "st.orc")
+    paorc.write_table(t, f, stripe_size=64 * 1024)
+    nstripes = paorc.ORCFile(f).nstripes
+    assert nstripes > 4  # multi-stripe premise
+
+    s = tpu_session()
+    df = s.read.orc(f).filter(col("x") >= n - 50).agg(sum_(col("y")).alias("sy"))
+    rows = df.collect()
+    scan = _find_scan(s._last_plan)
+    assert scan is not None and scan.pruned_row_groups >= nstripes - 2, (
+        scan.pruned_row_groups,
+        nstripes,
+    )
+    assert rows == [(sum(i * 0.5 for i in range(n - 50, n)),)]
+
+    # differential: pruning must not change results
+    def build(sess):
+        return sess.read.orc(f).filter(col("x") >= n - 50).select(col("y"))
+
+    assert_cpu_and_tpu_equal(build)
+
+
+def test_orc_stripe_pruning_string_stats(tmp_path):
+    import pyarrow.orc as paorc
+
+    n = 50_000
+    t = pa.table(
+        {
+            "s": pa.array([f"k{i // 1000:03d}" for i in range(n)]),
+            "v": pa.array(np.arange(n)),
+        }
+    )
+    f = str(tmp_path / "sts.orc")
+    paorc.write_table(t, f, stripe_size=64 * 1024)
+    assert paorc.ORCFile(f).nstripes > 2
+
+    def build(sess):
+        return sess.read.orc(f).filter(col("s") == "k004").select(col("v"))
+
+    assert_cpu_and_tpu_equal(build)
+    s = tpu_session()
+    rows = build(s).collect()
+    assert len(rows) == 1000
+    scan = _find_scan(s._last_plan)
+    assert scan.pruned_row_groups > 0
 
 
 def test_partition_value_file_pruning(tmp_path):

@@ -73,6 +73,122 @@ def test_mixed_distinct_and_plain_aggs():
     )
 
 
+# ── multiple DISTINCT sets (Expand rewrite — Catalyst's
+# RewriteDistinctAggregates; TPC-DS q14/q38/q87 shapes) ────────────────────
+def test_two_distinct_sets_grouped():
+    t = gen_grouped_table(
+        [("a", LONG), ("b", INT)], 600, num_groups=6, seed=21
+    )
+    assert_cpu_and_tpu_equal(
+        lambda s: s.create_dataframe(t, num_partitions=3)
+        .group_by("k")
+        .agg(
+            count_distinct(col("a")).alias("ca"),
+            count_distinct(col("b")).alias("cb"),
+        )
+    )
+
+
+def test_two_distinct_sets_with_regular_aggs():
+    t = gen_grouped_table(
+        [("a", LONG), ("b", INT), ("y", DOUBLE)], 600, num_groups=6, seed=22
+    )
+    assert_cpu_and_tpu_equal(
+        lambda s: s.create_dataframe(t, num_partitions=3)
+        .group_by("k")
+        .agg(
+            count_distinct(col("a")).alias("ca"),
+            sum_distinct(col("b")).alias("sb"),
+            sum_(col("y")).alias("sy"),
+            count(col("y")).alias("cy"),
+            count("*").alias("cn"),
+            avg(col("y")).alias("ay"),
+            min_(col("a")).alias("mn"),
+        ),
+        approx_float=True,
+    )
+
+
+def test_two_distinct_sets_ungrouped():
+    t = gen_grouped_table([("a", LONG), ("b", INT)], 500, num_groups=5, seed=23)
+    assert_cpu_and_tpu_equal(
+        lambda s: s.create_dataframe(t, num_partitions=3).agg(
+            count_distinct(col("a")).alias("ca"),
+            count_distinct(col("b")).alias("cb"),
+            count("*").alias("cn"),
+        )
+    )
+
+
+def test_three_distinct_sets_string_key():
+    t = gen_grouped_table(
+        [("a", STRING), ("b", LONG), ("c", INT)], 400, num_groups=4, seed=24
+    )
+    assert_cpu_and_tpu_equal(
+        lambda s: s.create_dataframe(t, num_partitions=2)
+        .group_by("k")
+        .agg(
+            count_distinct(col("a")).alias("ca"),
+            count_distinct(col("b")).alias("cb"),
+            sum_distinct(col("c")).alias("sc"),
+        )
+    )
+
+
+def test_multi_distinct_with_first_last():
+    """Regression: gid!=0 Expand groups carry all-null partials; a
+    null-blind first/last merge could pick one and return NULL. y is
+    functionally dependent on k so first/last are deterministic."""
+    from spark_rapids_tpu.functions import last
+
+    rng = np.random.default_rng(26)
+    ks = rng.integers(0, 6, 400)
+    t = pa.table(
+        {
+            "k": ks,
+            "a": rng.integers(0, 30, 400),
+            "b": rng.integers(0, 12, 400),
+            "y": ks * 10,
+        }
+    )
+
+    def build(s):
+        return (
+            s.create_dataframe(t, num_partitions=3)
+            .group_by("k")
+            .agg(
+                count_distinct(col("a")).alias("ca"),
+                count_distinct(col("b")).alias("cb"),
+                first(col("y")).alias("fy"),
+                last(col("y")).alias("ly"),
+            )
+        )
+
+    assert_cpu_and_tpu_equal(build)
+    from harness import tpu_session
+
+    rows = build(tpu_session()).collect()
+    # first/last must be the real value, never the gid!=0 null partial
+    for k, ca, cb, fy, ly in rows:
+        assert fy == k * 10 and ly == k * 10, (k, fy, ly)
+
+
+def test_multi_distinct_with_nulls():
+    rng = np.random.default_rng(25)
+    a = [int(v) if v % 3 else None for v in rng.integers(0, 20, 400)]
+    b = [int(v) if v % 4 else None for v in rng.integers(0, 9, 400)]
+    t = pa.table({"k": rng.integers(0, 5, 400), "a": a, "b": b})
+    assert_cpu_and_tpu_equal(
+        lambda s: s.create_dataframe(t, num_partitions=3)
+        .group_by("k")
+        .agg(
+            count_distinct(col("a")).alias("ca"),
+            count_distinct(col("b")).alias("cb"),
+            count(col("a")).alias("na"),
+        )
+    )
+
+
 def test_distinct_on_strings():
     t = gen_grouped_table([("x", STRING)], 400, num_groups=5, seed=4)
     assert_cpu_and_tpu_equal(
@@ -131,7 +247,9 @@ def test_variance_ungrouped():
     )
 
 
-# ── collect_list / collect_set (CPU path; device falls back by TypeSig) ────
+# ── collect_list / collect_set (device list accumulator in the segment
+# reduce — reference GpuCollectList/GpuCollectSet,
+# AggregateFunctions.scala:644) ─────────────────────────────────────────────
 def _sorted_lists(rows):
     return [
         tuple(sorted(v, key=lambda x: (x is None, x)) if isinstance(v, list) else v for v in r)
@@ -155,10 +273,86 @@ def test_collect_list_and_set():
     from harness import cpu_session, tpu_session
 
     cpu_rows = _sorted_lists(build(cpu_session()).collect())
-    tpu_rows = _sorted_lists(
-        build(tpu_session(strict=False)).collect()
-    )
+    tpu_rows = _sorted_lists(build(tpu_session()).collect())
     assert sorted(map(repr, cpu_rows)) == sorted(map(repr, tpu_rows))
+
+
+def test_collect_on_device_strict():
+    """collect runs ON DEVICE (strict test mode: any fallback fails) and
+    matches the CPU engine exactly — list order, set order, null skips,
+    empty (all-null) groups as empty arrays."""
+    rng = np.random.default_rng(9)
+    xs = [int(v) if v % 4 else None for v in rng.integers(0, 15, 400)]
+    ks = list(rng.integers(0, 7, 400)) + [99, 99]  # 99: all-null group
+    t = pa.table({"k": ks, "x": xs + [None, None]})
+    assert_cpu_and_tpu_equal(
+        lambda s: s.create_dataframe(t, num_partitions=3)
+        .group_by("k")
+        .agg(
+            collect_list(col("x")).alias("cl"),
+            collect_set(col("x")).alias("cs"),
+            count(col("x")).alias("c"),
+        )
+    )
+
+
+def test_collect_strings_and_ungrouped():
+    rng = np.random.default_rng(10)
+    ss = [f"s{int(v)}" if v % 5 else None for v in rng.integers(0, 40, 300)]
+    t = pa.table({"k": rng.integers(0, 5, 300), "s": ss})
+    assert_cpu_and_tpu_equal(
+        lambda s: s.create_dataframe(t, num_partitions=2)
+        .group_by("k")
+        .agg(collect_set(col("s")).alias("cs"))
+    )
+    assert_cpu_and_tpu_equal(
+        lambda s: s.create_dataframe(t, num_partitions=2).agg(
+            collect_list(col("s")).alias("cl")
+        )
+    )
+
+
+def test_collect_alongside_distinct():
+    """collect + DISTINCT in one aggregate: the rewrite emits partial
+    collects merged by MergeLists/MergeSets (CPU-executed merge phase);
+    merged sets must still dedupe."""
+    t = pa.table({"k": [1, 1, 1, 2], "x": [1, 2, 2, 5], "s": ["a", "b", "a", "z"]})
+
+    def build(s):
+        return (
+            s.create_dataframe(t, num_partitions=2)
+            .group_by("k")
+            .agg(
+                count_distinct(col("x")).alias("cx"),
+                collect_set(col("s")).alias("ss"),
+                collect_list(col("s")).alias("ls"),
+            )
+        )
+
+    assert_cpu_and_tpu_equal(
+        build, allowed_non_tpu=AGG_FALLBACK + ["Expand", "CpuExpand", "Project", "CpuProject"]
+    )
+    from harness import cpu_session
+
+    rows = sorted(build(cpu_session()).collect())
+    assert rows[0][1] == 2 and rows[0][2] == ["a", "b"], rows[0]
+    assert sorted(rows[0][3]) == ["a", "a", "b"], rows[0]
+
+
+def test_collect_floats_canonical():
+    """-0.0/0.0 and NaN/NaN dedupe to one set element, NaN sorts greatest
+    on both engines."""
+    t = pa.table(
+        {
+            "k": [1] * 6 + [2] * 2,
+            "y": [float("nan"), float("nan"), -0.0, 0.0, 2.5, 2.5, 1.0, -1.0],
+        }
+    )
+    assert_cpu_and_tpu_equal(
+        lambda s: s.create_dataframe(t, num_partitions=2)
+        .group_by("k")
+        .agg(collect_set(col("y")).alias("cs"))
+    )
 
 
 def test_collect_set_dedups_with_nans():
@@ -196,6 +390,29 @@ def test_pivot_explicit_values_multi_agg():
         .pivot("p", ["a", "b"])
         .agg(sum_(col("v")).alias("s"), count(col("v")).alias("c")),
     )
+
+
+def test_pivot_count_absent_combo_is_null():
+    """Spark's DataFrame pivot (PivotFirst / GpuPivotFirst) yields NULL,
+    not 0, for a (group, pivot-value) combination with no input rows."""
+    t = pa.table(
+        {"k": [1, 1, 2], "p": ["a", "b", "a"], "v": [10, 20, 30]}
+    )
+
+    def build(s):
+        return (
+            s.create_dataframe(t)
+            .group_by("k")
+            .pivot("p", ["a", "b"])
+            .agg(count(col("v")).alias("c"))
+        )
+
+    assert_cpu_and_tpu_equal(build)
+    from harness import tpu_session
+
+    rows = sorted(build(tpu_session()).collect())
+    # group 2 has no 'b' rows → null (not 0)
+    assert rows == [(1, 1, 1), (2, 1, None)]
 
 
 # ── distinct() / drop_duplicates ───────────────────────────────────────────

@@ -1,0 +1,156 @@
+"""Scalar subqueries, IN (subquery), InSet — reference:
+GpuScalarSubquery.scala (plugin executes the subquery plan, inlines the
+value) and GpuInSet.scala (literal-set membership). TPC-DS shapes:
+``where x in (select ...)`` and ``where y > (select avg ...)``."""
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu.functions import avg, col, count, max as max_, scalar_subquery, sum as sum_
+from spark_rapids_tpu.types import INT, LONG, STRING
+
+from data_gen import gen_grouped_table, gen_table
+from harness import assert_cpu_and_tpu_equal, cpu_session, tpu_session
+
+
+def test_in_subquery_int():
+    rng = np.random.default_rng(70)
+    t = pa.table({"k": rng.integers(0, 25, 800), "x": rng.integers(0, 99, 800)})
+    sel = pa.table({"v": [1, 4, 9, 16, 23]})
+    assert_cpu_and_tpu_equal(
+        lambda s: s.create_dataframe(t, num_partitions=3).filter(
+            col("k").isin(s.create_dataframe(sel))
+        )
+    )
+
+
+def test_in_subquery_strings():
+    t = pa.table({"s": [f"name_{i % 40}" for i in range(500)]})
+    sel = pa.table({"v": [f"name_{i}" for i in range(0, 40, 3)]})
+    assert_cpu_and_tpu_equal(
+        lambda s: s.create_dataframe(t, num_partitions=2).filter(
+            col("s").isin(s.create_dataframe(sel))
+        )
+    )
+
+
+def test_in_subquery_large_set():
+    """Hundreds of values: the chunked InSet membership, not an OR chain."""
+    rng = np.random.default_rng(71)
+    t = pa.table({"k": rng.integers(0, 5000, 2000)})
+    sel = pa.table({"v": np.unique(rng.integers(0, 5000, 900))})
+    assert_cpu_and_tpu_equal(
+        lambda s: s.create_dataframe(t, num_partitions=2).filter(
+            col("k").isin(s.create_dataframe(sel))
+        )
+    )
+
+
+def test_in_subquery_null_semantics():
+    """Spark IN: NULL input → NULL; no match with a NULL in the set → NULL."""
+    t = pa.table({"x": [1, 2, None, 9]})
+    sel = pa.table({"v": [1, None]})
+
+    def build(s):
+        return s.create_dataframe(t).select(
+            col("x").isin(s.create_dataframe(sel)).alias("m")
+        )
+
+    assert_cpu_and_tpu_equal(build, sort_result=False)
+    assert build(tpu_session()).collect() == [
+        (True,), (None,), (None,), (None,)
+    ]
+
+
+def test_in_subquery_derived_from_query():
+    """The subquery is itself a planned query (filter + distinct keys)."""
+    lt = gen_grouped_table([("x", LONG)], 400, num_groups=30, seed=72)
+    rt = gen_grouped_table([("y", LONG)], 200, num_groups=50, seed=73)
+    assert_cpu_and_tpu_equal(
+        lambda s: s.create_dataframe(lt, num_partitions=2).filter(
+            col("k").isin(
+                s.create_dataframe(rt, num_partitions=2)
+                .filter(col("y") > 0)
+                .select(col("k"))
+            )
+        )
+    )
+
+
+def test_scalar_subquery_in_filter():
+    rng = np.random.default_rng(74)
+    t = pa.table({"k": rng.integers(0, 20, 600), "y": rng.random(600) * 100})
+    assert_cpu_and_tpu_equal(
+        lambda s: s.create_dataframe(t, num_partitions=2).filter(
+            col("y") > scalar_subquery(
+                s.create_dataframe(t).agg(avg(col("y")).alias("a"))
+            )
+        )
+    )
+
+
+def test_scalar_subquery_in_projection():
+    t = pa.table({"x": [1, 2, 3, 4]})
+    assert_cpu_and_tpu_equal(
+        lambda s: s.create_dataframe(t).select(
+            col("x"),
+            (
+                col("x")
+                + scalar_subquery(
+                    s.create_dataframe(t).agg(sum_(col("x")).alias("s"))
+                )
+            ).alias("xs"),
+        )
+    )
+
+
+def test_scalar_subquery_empty_is_null():
+    t = pa.table({"x": [1, 2, 3]})
+
+    def build(s):
+        sub = s.create_dataframe(t).filter(col("x") > 100).agg(
+            max_(col("x")).alias("m")
+        )
+        # max over empty input → NULL literal; x > NULL filters all rows
+        return s.create_dataframe(t).filter(col("x") > scalar_subquery(sub))
+
+    assert build(cpu_session()).collect() == []
+    assert build(tpu_session()).collect() == []
+
+
+def test_scalar_subquery_date():
+    """Regression: date/timestamp scalar-subquery results must inline as
+    physical ints (Literal has no datetime special case)."""
+    import datetime
+
+    days = [datetime.date(2020, 1, d) for d in range(1, 11)]
+    t = pa.table({"d": pa.array(days), "x": list(range(10))})
+    assert_cpu_and_tpu_equal(
+        lambda s: s.create_dataframe(t).filter(
+            col("d") > scalar_subquery(
+                s.create_dataframe(t)
+                .filter(col("x") == 4)
+                .select(col("d"))
+            )
+        )
+    )
+
+
+def test_scalar_subquery_multirow_raises():
+    t = pa.table({"x": [1, 2, 3]})
+    s = cpu_session()
+    sub = s.create_dataframe(t).select(col("x"))
+    with pytest.raises(ValueError, match="more than one row"):
+        s.create_dataframe(t).filter(
+            col("x") > scalar_subquery(sub)
+        ).collect()
+
+
+def test_isin_literal_list_still_works():
+    t = pa.table({"x": [1, 2, 3, 4, 5]})
+    assert_cpu_and_tpu_equal(
+        lambda s: s.create_dataframe(t).filter(col("x").isin(2, 4))
+    )
+    assert_cpu_and_tpu_equal(
+        lambda s: s.create_dataframe(t).filter(col("x").isin([1, 5]))
+    )
