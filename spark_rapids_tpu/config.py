@@ -239,6 +239,13 @@ MESH_SIZE = conf("spark.rapids.sql.mesh.size").doc(
     "Number of devices in the execution mesh; 0 uses every visible device."
 ).int_conf(0)
 
+UDF_COMPILER_ENABLED = conf("spark.rapids.sql.udfCompiler.enabled").doc(
+    "Translate simple python UDFs (arithmetic/comparison/conditional/math/"
+    "string-method subset) into expression trees that fuse on device — the "
+    "udf-compiler analogue. Off by default like the reference: a translated "
+    "UDF null-propagates where the raw python function would raise on None."
+).boolean_conf(False)
+
 PROFILE_PATH = conf("spark.rapids.sql.profile.path").doc(
     "When set, each collect() is wrapped in a jax.profiler trace dumped to "
     "this directory (TensorBoard XPlane capture with per-operator "

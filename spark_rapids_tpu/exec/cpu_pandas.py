@@ -1,0 +1,115 @@
+"""pandas-interop execs — the L7 python-exec family.
+
+Reference: GpuMapInPandasExec / GpuFlatMapGroupsInPandasExec (+ the shared
+GpuArrowEvalPythonExec Arrow streaming, :391). There the columnar batches
+stream over Arrow IPC to a separate python worker; this engine IS python,
+so the "worker protocol" collapses to zero-copy ``RecordBatch →
+pandas`` conversions in-process — same dataflow, no socket. These execs
+run on the host side of a D2H transition (python user code cannot run on
+the TPU), exactly like the reference pairs its python execs with
+columnar↔row transitions.
+"""
+from __future__ import annotations
+
+from typing import Iterator, List
+
+import pyarrow as pa
+
+from ..plan.physical import Exec, ExecContext, PartitionSet
+from ..types import Schema
+
+
+def _df_to_batches(df, schema: Schema, what: str) -> Iterator[pa.RecordBatch]:
+    import pandas as pd
+
+    if not isinstance(df, pd.DataFrame):
+        raise TypeError(f"{what} must return pandas DataFrames, got {type(df)}")
+    target = schema.to_arrow()
+    tbl = pa.Table.from_pandas(df, preserve_index=False)
+    cols = []
+    for f in target:
+        if f.name not in tbl.column_names:
+            raise ValueError(
+                f"{what} result is missing column {f.name!r} "
+                f"(declared schema: {schema.names})"
+            )
+        arr = tbl.column(f.name)
+        if arr.type != f.type:
+            arr = arr.cast(f.type)
+        cols.append(arr.combine_chunks())
+    for rb in pa.Table.from_arrays(cols, schema=target).to_batches():
+        if rb.num_rows:
+            yield rb
+
+
+class CpuMapInPandasExec(Exec):
+    """fn(iterator of pd.DataFrame) → iterator of pd.DataFrame, one call
+    per partition (pyspark mapInPandas contract)."""
+
+    def __init__(self, fn, schema: Schema, child: Exec):
+        super().__init__([child])
+        self.fn = fn
+        self._schema = schema
+
+    @property
+    def output(self) -> Schema:
+        return self._schema
+
+    def execute(self, ctx: ExecContext) -> PartitionSet:
+        fn, schema = self.fn, self._schema
+
+        def run(it: Iterator[pa.RecordBatch]):
+            def dfs():
+                for rb in it:
+                    yield rb.to_pandas()
+
+            for df in fn(dfs()):
+                yield from _df_to_batches(df, schema, "mapInPandas fn")
+
+        return self.children[0].execute(ctx).map_partitions(run)
+
+    def node_string(self):
+        return f"CpuMapInPandas {getattr(self.fn, '__name__', 'fn')}"
+
+
+class CpuFlatMapGroupsInPandasExec(Exec):
+    """fn(pd.DataFrame) → pd.DataFrame per key group. The planner exchanges
+    rows by the grouping keys first, so each partition holds whole groups
+    (the reference plans its python exec the same way)."""
+
+    def __init__(self, grouping: List[str], fn, schema: Schema, child: Exec):
+        super().__init__([child])
+        self.grouping = list(grouping)
+        self.fn = fn
+        self._schema = schema
+
+    @property
+    def output(self) -> Schema:
+        return self._schema
+
+    def execute(self, ctx: ExecContext) -> PartitionSet:
+        fn, schema, keys = self.fn, self._schema, self.grouping
+
+        def run(it: Iterator[pa.RecordBatch]):
+            batches = list(it)
+            if not batches:
+                return
+            pdf = pa.Table.from_batches(batches).to_pandas()
+            if not len(pdf):
+                return
+            if not keys:
+                # groupBy().applyInPandas: the whole frame is one group
+                yield from _df_to_batches(fn(pdf), schema, "applyInPandas fn")
+                return
+            # dropna=False: NULL keys form a group (Spark semantics)
+            for _, group in pdf.groupby(keys, dropna=False, sort=False):
+                out = fn(group.reset_index(drop=True))
+                yield from _df_to_batches(out, schema, "applyInPandas fn")
+
+        return self.children[0].execute(ctx).map_partitions(run)
+
+    def node_string(self):
+        return (
+            f"CpuFlatMapGroupsInPandas {self.grouping} "
+            f"{getattr(self.fn, '__name__', 'fn')}"
+        )

@@ -70,6 +70,99 @@ class JaxUdf(Expression):
 
 
 @dataclass(frozen=True)
+class VectorizedUdf(Expression):
+    """Batch-vectorized (pandas-style) python UDF: ``fn`` receives pandas
+    Series covering the WHOLE batch and returns a Series/array — one
+    python call per batch instead of per row (the GpuArrowEvalPythonExec
+    data path without the separate worker process; pyspark's
+    ``pandas_udf`` scalar flavor). Null convention mirrors Arrow→pandas:
+    float NaN for numeric nulls (ints with nulls widen to float64),
+    ``None`` for strings/objects; result nulls are taken from
+    ``Series.isna``."""
+
+    fn: Callable
+    return_type: DataType
+    args: Tuple[Expression, ...]
+    name: str = "pandas_udf"
+
+    @property
+    def data_type(self) -> DataType:
+        return self.return_type
+
+    def eval(self, ctx: Ctx) -> Val:
+        assert not ctx.is_device, "vectorized python UDFs run on the CPU engine"
+        import pandas as pd
+
+        from ..types import DateType, StringType, TimestampType
+
+        series = []
+        for a in self.args:
+            v = a.eval(ctx)
+            d = np.array(
+                np.broadcast_to(np.asarray(v.data), (ctx.n,)), copy=True
+            )
+            m = ctx.broadcast_bool(v.valid)
+            dt = a.data_type
+            if isinstance(dt, TimestampType):
+                # Arrow→pandas convention: datetime64 Series, NaT for nulls
+                s_in = pd.Series(pd.to_datetime(d.astype(np.int64), unit="us"))
+                s_in[~m] = pd.NaT
+                series.append(s_in)
+            elif isinstance(dt, DateType):
+                s_in = pd.Series(pd.to_datetime(d.astype(np.int64), unit="D"))
+                s_in[~m] = pd.NaT
+                series.append(s_in)
+            elif d.dtype == object:
+                d[~m] = None
+                series.append(pd.Series(d))
+            elif np.issubdtype(d.dtype, np.floating):
+                d[~m] = np.nan
+                series.append(pd.Series(d))
+            elif (~m).any():
+                # Arrow→pandas: integer/bool columns with nulls widen
+                f = d.astype(np.float64)
+                f[~m] = np.nan
+                series.append(pd.Series(f))
+            else:
+                series.append(pd.Series(d))
+        out = self.fn(*series)
+        s = pd.Series(out) if not isinstance(out, pd.Series) else out
+        if len(s) != ctx.n:
+            raise ValueError(
+                f"pandas UDF {self.name} returned {len(s)} rows for a "
+                f"{ctx.n}-row batch"
+            )
+        ok = (~s.isna()).to_numpy()
+        if isinstance(self.return_type, StringType):
+            data = np.array(s.astype(object).to_numpy(), copy=True)
+            data[~ok] = None
+            return Val(data, ok)
+        if isinstance(self.return_type, (TimestampType, DateType)):
+            ts = pd.to_datetime(s)
+            unit = "us" if isinstance(self.return_type, TimestampType) else "D"
+            conv = ts.astype(f"datetime64[{unit}]").astype(np.int64)
+            data = np.zeros(ctx.n, dtype=self.return_type.np_dtype)
+            data[ok] = conv.to_numpy()[ok].astype(self.return_type.np_dtype)
+            return Val(data, ok)
+        if pd.api.types.is_numeric_dtype(s):
+            vals = s
+        else:
+            vals = pd.to_numeric(s, errors="coerce")
+            bad = ok & vals.isna().to_numpy()
+            if bad.any():
+                raise TypeError(
+                    f"pandas UDF {self.name} returned non-numeric value "
+                    f"{s[bad].iloc[0]!r} for {self.return_type.simple_string}"
+                )
+        data = np.zeros(ctx.n, dtype=self.return_type.np_dtype)
+        data[ok] = vals.to_numpy()[ok].astype(self.return_type.np_dtype)
+        return Val(data, ok)
+
+    def __str__(self):
+        return f"{self.name}({', '.join(map(str, self.args))})"
+
+
+@dataclass(frozen=True)
 class PythonUdf(Expression):
     """Row-at-a-time python UDF (CPU engine; planner falls back)."""
 

@@ -907,6 +907,34 @@ def udf(f=None, returnType=None):
     return wrap(f)
 
 
+def pandas_udf(f=None, returnType=None):
+    """Batch-vectorized python UDF (pyspark pandas_udf scalar flavor):
+    ``fn(*series: pd.Series) -> pd.Series`` called once per batch — the
+    GpuArrowEvalPythonExec data path. CPU engine; the plan falls back
+    per-node with a reason."""
+    from .types import DOUBLE as _D
+
+    rt = returnType if returnType is not None else _D
+
+    def wrap(fn):
+        from .expr.udf import VectorizedUdf
+
+        def call(*cols) -> Column:
+            return Column(
+                VectorizedUdf(fn, rt, tuple(_e(c) for c in cols), fn.__name__)
+            )
+
+        call.__name__ = fn.__name__
+        return call
+
+    if f is None:
+        return wrap
+    return wrap(f)
+
+
+vectorized_udf = pandas_udf
+
+
 def jax_udf(f=None, returnType=None):
     """Device UDF: ``fn(*arrays) -> array`` written with jax.numpy; traced
     into the enclosing fused kernel (the RapidsUDF analogue — but the body

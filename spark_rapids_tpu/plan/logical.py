@@ -379,6 +379,51 @@ def _bound(e: Expression, schema: Schema) -> Expression:
 
 
 @dataclass
+class MapInPandas(LogicalPlan):
+    """fn(iter[pd.DataFrame]) → iter[pd.DataFrame] over each partition
+    (pyspark mapInPandas; reference GpuMapInPandasExec)."""
+
+    fn: object
+    _schema: Schema
+    child: LogicalPlan
+
+    def children(self):
+        return [self.child]
+
+    @property
+    def schema(self) -> Schema:
+        return self._schema
+
+    def _node_string(self):
+        return f"MapInPandas {getattr(self.fn, '__name__', 'fn')}"
+
+
+@dataclass
+class FlatMapGroupsInPandas(LogicalPlan):
+    """group_by(keys).apply_in_pandas(fn): fn(pd.DataFrame) → pd.DataFrame
+    per key group (pyspark applyInPandas; reference
+    GpuFlatMapGroupsInPandasExec)."""
+
+    grouping: list  # key column names
+    fn: object
+    _schema: Schema
+    child: LogicalPlan
+
+    def children(self):
+        return [self.child]
+
+    @property
+    def schema(self) -> Schema:
+        return self._schema
+
+    def _node_string(self):
+        return (
+            f"FlatMapGroupsInPandas {self.grouping} "
+            f"{getattr(self.fn, '__name__', 'fn')}"
+        )
+
+
+@dataclass
 class WriteFiles(LogicalPlan):
     """Write command node (GpuDataWritingCommandExec analogue); output is
     the per-file write stats."""

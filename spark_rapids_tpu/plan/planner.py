@@ -64,6 +64,29 @@ def plan_physical(lp: L.LogicalPlan, conf: TpuConf) -> Exec:
         return CpuFilterExec(lp.condition, plan_physical(child, conf))
     if isinstance(lp, L.Aggregate):
         return _plan_aggregate(lp, conf)
+    if isinstance(lp, L.MapInPandas):
+        from ..exec.cpu_pandas import CpuMapInPandasExec
+
+        return CpuMapInPandasExec(lp.fn, lp.schema, plan_physical(lp.child, conf))
+    if isinstance(lp, L.FlatMapGroupsInPandas):
+        from ..exec.cpu_pandas import CpuFlatMapGroupsInPandasExec
+
+        child = plan_physical(lp.child, conf)
+        if _num_partitions_hint(child) != 1:
+            if lp.grouping:
+                # whole groups per partition (the reference plans its python
+                # exec behind a hash exchange on the grouping keys too)
+                child = CpuShuffleExchangeExec(
+                    P.HashPartitioning(
+                        cfg.SHUFFLE_PARTITIONS.get(conf),
+                        [UnresolvedAttribute(n) for n in lp.grouping],
+                    ),
+                    child,
+                )
+            else:
+                # groupBy().applyInPandas: the whole frame is one group
+                child = CpuCoalescePartitionsExec(child)
+        return CpuFlatMapGroupsInPandasExec(lp.grouping, lp.fn, lp.schema, child)
     if isinstance(lp, L.Sort):
         child = plan_physical(lp.child, conf)
         if lp.is_global and _num_partitions_hint(child) != 1:

@@ -166,10 +166,30 @@ class TpuSession:
 
         return L.transform_expressions(lp, fix)
 
+    def _translate_udfs(self, lp: L.LogicalPlan) -> L.LogicalPlan:
+        """udf-compiler pass: rewrite translatable python UDFs into plain
+        expression trees so they fuse on device (reference
+        udf-compiler/CatalystExpressionBuilder.scala; subset documented in
+        expr/udf_compiler.py). Untranslatable UDFs keep their CPU
+        fallback."""
+        from .expr.udf import PythonUdf
+        from .expr.udf_compiler import try_translate
+
+        def fix(e):
+            if isinstance(e, PythonUdf):
+                t = try_translate(e.fn, list(e.args), e.return_type)
+                if t is not None:
+                    return t
+            return e
+
+        return L.transform_expressions(lp, fix)
+
     def _execute(self, lp: L.LogicalPlan) -> pa.Table:
         from .plan.pruning import prune_columns
 
         lp = self._resolve_subqueries(lp)
+        if cfg.UDF_COMPILER_ENABLED.get(self.conf):
+            lp = self._translate_udfs(lp)
         if cfg.ANSI_ENABLED.get(self.conf):
             # Spark resolves ansiEnabled into Cast at analysis time; same
             # here — the rewrite happens before planning so both the CPU
@@ -426,6 +446,15 @@ class DataFrame:
         exprs, plan = _extract_generators(exprs, plan)
         return DataFrame(self._session, L.Project(exprs, plan))
 
+    def map_in_pandas(self, fn, schema) -> "DataFrame":
+        """``fn(iterator of pd.DataFrame) -> iterator of pd.DataFrame`` per
+        partition (pyspark mapInPandas; reference GpuMapInPandasExec).
+        ``schema`` declares the result columns."""
+        schema = _to_schema(schema)
+        return DataFrame(self._session, L.MapInPandas(fn, schema, self._plan))
+
+    mapInPandas = map_in_pandas
+
     def with_column(self, name: str, c: Column) -> "DataFrame":
         exprs: List[Expression] = []
         replaced = False
@@ -628,6 +657,22 @@ class DataFrame:
         return DataFrameWriter(self)
 
 
+def _to_schema(schema) -> Schema:
+    """Accept a Schema, or a list of (name, DataType) pairs / StructFields."""
+    from .types import StructField
+
+    if isinstance(schema, Schema):
+        return schema
+    fields = []
+    for f in schema:
+        if isinstance(f, StructField):
+            fields.append(f)
+        else:
+            name, dt = f
+            fields.append(StructField(name, dt, True))
+    return Schema(fields)
+
+
 GROUPING_ID = "__grouping_id"
 
 
@@ -706,6 +751,27 @@ class GroupedData:
                 target = a.child if isinstance(a, Alias) else a
                 out.append(Alias(wrap(target, v), name))
         return out
+
+    def apply_in_pandas(self, fn, schema) -> DataFrame:
+        """``fn(pd.DataFrame) -> pd.DataFrame`` once per key group (pyspark
+        applyInPandas; reference GpuFlatMapGroupsInPandasExec). Grouping
+        must be plain columns; ``schema`` declares the result columns."""
+        if self._grouping_sets is not None or self._pivot is not None:
+            raise ValueError("apply_in_pandas requires a plain groupBy")
+        names = []
+        for g in self._grouping:
+            if not isinstance(g, UnresolvedAttribute):
+                raise ValueError(
+                    "apply_in_pandas grouping must be plain columns"
+                )
+            names.append(g.name)
+        schema = _to_schema(schema)
+        return DataFrame(
+            self._df._session,
+            L.FlatMapGroupsInPandas(names, fn, schema, self._df._plan),
+        )
+
+    applyInPandas = apply_in_pandas
 
     def agg(self, *aggs) -> DataFrame:
         agg_exprs = []

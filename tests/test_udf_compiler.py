@@ -1,0 +1,357 @@
+"""udf-compiler + vectorized/pandas UDF family — reference:
+udf-compiler (Instruction.scala / CatalystExpressionBuilder.scala: simple
+lambdas → Catalyst expressions) and the python exec family
+(GpuArrowEvalPythonExec:391, GpuMapInPandasExec,
+GpuFlatMapGroupsInPandasExec)."""
+import math
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu.functions import col, pandas_udf, udf
+from spark_rapids_tpu.types import BOOLEAN, DOUBLE, INT, LONG, STRING
+
+from harness import assert_cpu_and_tpu_equal, cpu_session, tpu_session
+
+TRANSLATE = {"spark.rapids.sql.udfCompiler.enabled": True}
+
+
+def _plan_has_device_project(s):
+    return "TpuProject" in s._last_plan.tree_string()
+
+
+def _check_translated(build, expect_rows=None):
+    """Translated UDFs must run on device under strict mode and match the
+    row-wise python evaluation (CPU engine, translation OFF)."""
+    want = build(cpu_session()).collect()
+    s = tpu_session(TRANSLATE)
+    got = build(s).collect()
+    assert _plan_has_device_project(s), s._last_plan.tree_string()
+    key = lambda r: tuple((v is None, str(type(v)), repr(v)) for v in r)
+    assert sorted(got, key=key) == sorted(want, key=key), (want[:4], got[:4])
+    if expect_rows is not None:
+        assert sorted(got, key=key) == sorted(expect_rows, key=key)
+
+
+# ── ≥10 translation patterns ───────────────────────────────────────────────
+def test_tx_arithmetic_lambda():
+    t = pa.table({"x": [1, 2, 3, 4]})
+    f = udf(lambda v: v * 2 + 1, returnType=LONG)
+    _check_translated(
+        lambda s: s.create_dataframe(t).select(f(col("x")).alias("r")),
+        [(3,), (5,), (7,), (9,)],
+    )
+
+
+def test_tx_division_is_float():
+    t = pa.table({"x": [1, 2, 5]})
+    f = udf(lambda v: v / 2, returnType=DOUBLE)
+    _check_translated(
+        lambda s: s.create_dataframe(t).select(f(col("x")).alias("r")),
+        [(0.5,), (1.0,), (2.5,)],
+    )
+
+
+def test_tx_comparison():
+    t = pa.table({"x": [1, 5, 9]})
+    f = udf(lambda v: v > 4, returnType=BOOLEAN)
+    _check_translated(
+        lambda s: s.create_dataframe(t).select(f(col("x")).alias("r")),
+        [(False,), (True,), (True,)],
+    )
+
+
+def test_tx_chained_comparison():
+    t = pa.table({"x": [1, 5, 9]})
+    f = udf(lambda v: 2 < v < 8, returnType=BOOLEAN)
+    _check_translated(
+        lambda s: s.create_dataframe(t).select(f(col("x")).alias("r")),
+        [(False,), (True,), (False,)],
+    )
+
+
+def test_tx_boolean_ops():
+    t = pa.table({"x": [1, 5, 9], "y": [9, 5, 1]})
+    f = udf(lambda a, b: a > 2 and not (b > 2) or a == 1, returnType=BOOLEAN)
+    _check_translated(
+        lambda s: s.create_dataframe(t).select(f(col("x"), col("y")).alias("r"))
+    )
+
+
+def test_tx_conditional():
+    t = pa.table({"x": [1, 5, 9]})
+    f = udf(lambda v: v * 10 if v > 4 else -v, returnType=LONG)
+    _check_translated(
+        lambda s: s.create_dataframe(t).select(f(col("x")).alias("r")),
+        [(-1,), (50,), (90,)],
+    )
+
+
+def test_tx_math_calls():
+    t = pa.table({"x": [1.0, 4.0, 9.0]})
+
+    def g(v):
+        return math.sqrt(v) + math.floor(v / 2)
+
+    f = udf(g, returnType=DOUBLE)
+    _check_translated(
+        lambda s: s.create_dataframe(t).select(f(col("x")).alias("r")),
+        [(1.0,), (4.0,), (7.0,)],
+    )
+
+
+def test_tx_abs_min_max():
+    t = pa.table({"x": [-3, 2, -7], "y": [1, 5, 2]})
+    f = udf(lambda a, b: max(abs(a), b) + min(a, b), returnType=LONG)
+    _check_translated(
+        lambda s: s.create_dataframe(t).select(f(col("x"), col("y")).alias("r"))
+    )
+
+
+def test_tx_string_methods():
+    t = pa.table({"s": ["Ab", "cD", "x Y "]})
+    f = udf(lambda v: v.upper(), returnType=STRING)
+    g = udf(lambda v: len(v), returnType=INT)
+    _check_translated(
+        lambda s: s.create_dataframe(t).select(
+            f(col("s")).alias("u"), g(col("s")).alias("n")
+        ),
+        [("AB", 2), ("CD", 2), ("X Y ", 4)],
+    )
+
+
+def test_tx_null_propagates_where_python_would_raise():
+    """Documented divergence (same as the reference udf-compiler): a
+    translated UDF null-propagates; the raw row-wise call would raise
+    on None. Translation is opt-in partly for this reason."""
+    t = pa.table({"s": ["Ab", None]})
+    f = udf(lambda v: v.upper(), returnType=STRING)
+    s = tpu_session(TRANSLATE)
+    rows = s.create_dataframe(t).select(f(col("s")).alias("u")).collect()
+    assert rows == [("AB",), (None,)]
+    with pytest.raises(AttributeError):
+        cpu_session().create_dataframe(t).select(
+            f(col("s")).alias("u")
+        ).collect()
+
+
+def test_tx_closure_constant():
+    t = pa.table({"x": [1, 2, 3]})
+    k = 7
+    f = udf(lambda v: v + k, returnType=LONG)
+    _check_translated(
+        lambda s: s.create_dataframe(t).select(f(col("x")).alias("r")),
+        [(8,), (9,), (10,)],
+    )
+
+
+def test_tx_def_function_with_docstring():
+    t = pa.table({"x": [2, 4]})
+
+    def scaled(v):
+        """doc line."""
+        return v * 3 % 5
+
+    f = udf(scaled, returnType=LONG)
+    _check_translated(
+        lambda s: s.create_dataframe(t).select(f(col("x")).alias("r")),
+        [(1,), (2,)],
+    )
+
+
+def test_tx_floordiv_mod_python_semantics():
+    """Python // floors and % takes the divisor's sign — NOT java
+    truncate/remainder (review regression)."""
+    t = pa.table({"x": [-7, 7, -7, 6]})
+    fd = udf(lambda v: v // 2, returnType=LONG)
+    md = udf(lambda v: v % 3, returnType=LONG)
+    _check_translated(
+        lambda s: s.create_dataframe(t).select(
+            fd(col("x")).alias("d"), md(col("x")).alias("m")
+        ),
+        [(-4, 2), (3, 1), (-4, 2), (3, 0)],
+    )
+
+
+def test_tx_two_lambdas_one_line_not_misattributed():
+    """Two lambdas on one source line: translation must not pick the wrong
+    body (fallback is acceptable; wrong results are not)."""
+    t = pa.table({"x": [3]})
+    a, b = udf(lambda v: v + 1, returnType=LONG), udf(lambda v: v * 100, returnType=LONG)
+    s = tpu_session(TRANSLATE, strict=False)
+    rows = s.create_dataframe(t).select(
+        a(col("x")).alias("a"), b(col("x")).alias("b")
+    ).collect()
+    assert rows == [(4, 300)], rows
+
+
+# ── fallback behavior ──────────────────────────────────────────────────────
+def test_untranslatable_falls_back_with_reason():
+    t = pa.table({"x": [3, 1]})
+    f = udf(lambda v: str(sorted([v]))[:3], returnType=STRING)
+    s = tpu_session(TRANSLATE, strict=False)
+    rows = s.create_dataframe(t).select(f(col("x")).alias("w")).collect()
+    assert rows == [("[3]",), ("[1]",)]
+    assert "TpuProject" not in s._last_plan.tree_string()
+
+
+def test_translation_off_by_default():
+    t = pa.table({"x": [1, 2]})
+    f = udf(lambda v: v + 1, returnType=LONG)
+    s = tpu_session(strict=False)
+    rows = s.create_dataframe(t).select(f(col("x")).alias("r")).collect()
+    assert rows == [(2,), (3,)]
+    assert "TpuProject" not in s._last_plan.tree_string()
+
+
+# ── vectorized / pandas UDF family ─────────────────────────────────────────
+def test_pandas_udf_scalar():
+    t = pa.table(
+        {"x": [1, 2, None, 4], "y": [10.0, None, 30.0, 40.0]}
+    )
+
+    @pandas_udf(returnType=DOUBLE)
+    def vscale(x, y):
+        return x * 0.5 + y.fillna(0)
+
+    assert_cpu_and_tpu_equal(
+        lambda s: s.create_dataframe(t, num_partitions=2).select(
+            vscale(col("x"), col("y")).alias("v")
+        ),
+        allowed_non_tpu=["Project", "CpuProject", "CpuScan"],
+    )
+
+
+def test_pandas_udf_string():
+    t = pa.table({"s": ["a", None, "ccc"]})
+
+    @pandas_udf(returnType=STRING)
+    def up(s):
+        return s.str.upper()
+
+    assert_cpu_and_tpu_equal(
+        lambda s: s.create_dataframe(t).select(up(col("s")).alias("u")),
+        allowed_non_tpu=["Project", "CpuProject", "CpuScan"],
+    )
+
+
+def test_map_in_pandas():
+    t = pa.table({"x": [1, 2, None, 4]})
+
+    def mapper(dfs):
+        for d in dfs:
+            d = d[d["x"].notna()]
+            yield d.assign(z=d["x"] * 10)[["z"]]
+
+    def build(s):
+        return s.create_dataframe(t, num_partitions=2).map_in_pandas(
+            mapper, [("z", LONG)]
+        )
+
+    assert sorted(build(cpu_session()).collect()) == [(10,), (20,), (40,)]
+    assert sorted(
+        build(tpu_session(strict=False)).collect()
+    ) == [(10,), (20,), (40,)]
+
+
+def test_apply_in_pandas_grouped():
+    rng = np.random.default_rng(80)
+    t = pa.table(
+        {"k": rng.integers(0, 5, 200), "v": rng.random(200) * 10}
+    )
+
+    def demean(g):
+        return g.assign(v=g["v"] - g["v"].mean())[["k", "v"]]
+
+    def build(s):
+        return (
+            s.create_dataframe(t, num_partitions=3)
+            .group_by("k")
+            .apply_in_pandas(demean, [("k", LONG), ("v", DOUBLE)])
+        )
+
+    key = lambda r: tuple(repr(v) for v in r)
+    c = sorted(build(cpu_session()).collect(), key=key)
+    d = sorted(build(tpu_session(strict=False)).collect(), key=key)
+    assert len(c) == 200 and len(d) == 200
+    for rc, rd in zip(c, d):
+        assert rc[0] == rd[0] and abs(rc[1] - rd[1]) < 1e-9
+
+
+def test_pandas_udf_timestamp_roundtrip():
+    """Timestamp args arrive as datetime64 Series (Arrow→pandas
+    convention) and datetime64 results convert back to engine micros."""
+    import datetime
+
+    ts = [
+        datetime.datetime(2021, 3, 1, 10, 30, 0, 123456),
+        None,
+        datetime.datetime(1999, 12, 31, 23, 59, 59),
+    ]
+    t = pa.table({"t": pa.array(ts, type=pa.timestamp("us"))})
+    from spark_rapids_tpu.types import TIMESTAMP
+
+    @pandas_udf(returnType=TIMESTAMP)
+    def add_day(s):
+        return s + __import__("pandas").Timedelta(days=1)
+
+    def build(s):
+        return s.create_dataframe(t).select(add_day(col("t")).alias("r"))
+
+    rows = build(cpu_session()).collect()
+    got0 = rows[0][0].replace(tzinfo=None)  # engine emits UTC-aware values
+    assert got0 == ts[0] + datetime.timedelta(days=1), rows[0]
+    assert rows[1][0] is None
+    assert_cpu_and_tpu_equal(
+        build, allowed_non_tpu=["Project", "CpuProject", "CpuScan"],
+        sort_result=False,
+    )
+
+
+def test_pandas_udf_bad_return_type_raises():
+    t = pa.table({"x": [1, 2]})
+
+    @pandas_udf(returnType=LONG)
+    def bad(s):
+        import pandas as pd
+
+        return pd.Series(["abc", "def"])
+
+    with pytest.raises(TypeError, match="non-numeric"):
+        cpu_session().create_dataframe(t).select(bad(col("x")).alias("r")).collect()
+
+
+def test_apply_in_pandas_global_group():
+    """groupBy().applyInPandas: the whole frame is one group."""
+    t = pa.table({"v": [1.0, 2.0, 3.0, 4.0]})
+
+    def summarize(g):
+        import pandas as pd
+
+        return pd.DataFrame({"n": [len(g)], "s": [g["v"].sum()]})
+
+    def build(s):
+        return s.create_dataframe(t, num_partitions=2).group_by().apply_in_pandas(
+            summarize, [("n", LONG), ("s", DOUBLE)]
+        )
+
+    assert build(cpu_session()).collect() == [(4, 10.0)]
+    assert build(tpu_session(strict=False)).collect() == [(4, 10.0)]
+
+
+def test_apply_in_pandas_null_keys_form_group():
+    t = pa.table({"k": [1, None, 1, None], "v": [1.0, 2.0, 3.0, 4.0]})
+
+    def count_rows(g):
+        import pandas as pd
+
+        return pd.DataFrame({"n": [len(g)]})
+
+    def build(s):
+        return s.create_dataframe(t).group_by("k").apply_in_pandas(
+            count_rows, [("n", LONG)]
+        )
+
+    assert sorted(build(cpu_session()).collect()) == [(2,), (2,)]
+    assert sorted(build(tpu_session(strict=False)).collect()) == [(2,), (2,)]
