@@ -173,16 +173,37 @@ def q47(session, tables):
     )
 
 
-QUERIES = [("q1", q1), ("q6", q6), ("q3", q3), ("q47", q47)]
+# (name, fn, timed runs): q1/q6 keep best-of-5 for round-over-round
+# comparability; the heavier join/window queries use best-of-3 to keep the
+# rig inside the driver's wall-clock budget on the tunneled chip
+QUERIES = [("q1", q1, 5), ("q6", q6, 5), ("q3", q3, 3), ("q47", q47, 3)]
+
+
+def _collect_retry(build, attempts: int = 3):
+    """The tunneled PJRT link occasionally drops mid-compile
+    ('remote_compile: response body closed'); compiled programs are cached
+    server-side, so a retry usually lands."""
+    for i in range(attempts):
+        try:
+            return build().collect()
+        except Exception as e:  # noqa: BLE001 - retry only transport errors
+            msg = str(e)
+            if i + 1 < attempts and (
+                "remote_compile" in msg or "response body" in msg
+                or "DEADLINE" in msg or "UNAVAILABLE" in msg
+            ):
+                time.sleep(2.0 * (i + 1))
+                continue
+            raise
 
 
 def time_query(build, n_warm: int = 1, n_run: int = 5) -> float:
     for _ in range(n_warm):
-        build().collect()
+        _collect_retry(build)
     best = float("inf")
     for _ in range(n_run):
         t0 = time.perf_counter()
-        build().collect()
+        _collect_retry(build)
         best = min(best, time.perf_counter() - t0)
     return best
 
@@ -214,9 +235,9 @@ def main():
 
     queries_detail = {}
     speedups = []
-    for name, q in QUERIES:
-        t_tpu = time_query(lambda: q(tpu, tables))
-        t_cpu = time_query(lambda: q(cpu, tables))
+    for name, q, n_run in QUERIES:
+        t_tpu = time_query(lambda: q(tpu, tables), n_run=n_run)
+        t_cpu = time_query(lambda: q(cpu, tables), n_run=n_run)
         sp = t_cpu / t_tpu if t_tpu > 0 else 0.0
         speedups.append(sp)
         queries_detail[name] = {

@@ -185,6 +185,26 @@ ADVISORY_PARTITION_SIZE = conf(
     "Target post-shuffle partition size for adaptive coalescing."
 ).bytes_conf(64 << 20)
 
+SKEW_JOIN_ENABLED = conf("spark.sql.adaptive.skewJoin.enabled").doc(
+    "Runtime skew-join handling (Spark's key, honored here): an oversized "
+    "join-side partition is split across the slots freed by coalescing "
+    "while the other side's partition is replicated "
+    "(OptimizeSkewedJoin analogue)."
+).boolean_conf(True)
+
+SKEW_JOIN_THRESHOLD = conf(
+    "spark.sql.adaptive.skewJoin.skewedPartitionThresholdInBytes"
+).doc(
+    "A partition larger than this (and skewedPartitionFactor x the median) "
+    "is considered skewed."
+).bytes_conf(256 << 20)
+
+SKEW_JOIN_FACTOR = conf(
+    "spark.sql.adaptive.skewJoin.skewedPartitionFactor"
+).doc(
+    "Skew multiplier over the median partition size."
+).int_conf(5)
+
 SPARK_VERSION = conf("spark.rapids.tpu.sparkVersion").doc(
     "Spark version whose semantics to emulate; selects the shim provider "
     "(reference: ShimLoader + per-version shims/ modules). Shim-dependent "
@@ -239,6 +259,12 @@ MESH_SIZE = conf("spark.rapids.sql.mesh.size").doc(
     "Number of devices in the execution mesh; 0 uses every visible device."
 ).int_conf(0)
 
+SPLIT_MAX_TOKENS = conf("spark.rapids.sql.split.maxTokens").doc(
+    "Static token-plane width for device split(): a row splitting into "
+    "more tokens fails loudly (never truncates) — raise this or disable "
+    "spark.rapids.sql.expression.StringSplit for such data."
+).int_conf(16)
+
 UDF_COMPILER_ENABLED = conf("spark.rapids.sql.udfCompiler.enabled").doc(
     "Translate simple python UDFs (arithmetic/comparison/conditional/math/"
     "string-method subset) into expression trees that fuse on device — the "
@@ -276,6 +302,17 @@ METRICS_LEVEL = conf("spark.rapids.sql.metrics.level").doc(
 CPU_ONLY = conf("spark.rapids.tpu.cpuOnly").doc(
     "Force the JAX CPU backend (testing; the virtual-device mesh path)."
 ).internal().boolean_conf(False)
+
+PARQUET_READER_TYPE = conf("spark.rapids.sql.format.parquet.reader.type").doc(
+    "File reader strategy: PERFILE (one task per file), COALESCING (small "
+    "files stitched into shared partitions), or MULTITHREADED (cloud-style "
+    "thread-pool reads). The per-read option 'readerType' overrides this "
+    "per DataFrame (reference: RapidsConf.scala:624-671)."
+).string_conf("PERFILE")
+
+ORC_READER_TYPE = conf("spark.rapids.sql.format.orc.reader.type").doc(
+    "ORC file reader strategy; same values as the parquet key."
+).string_conf("PERFILE")
 
 MULTITHREADED_READ_NUM_THREADS = conf(
     "spark.rapids.sql.multiThreadedRead.numThreads"

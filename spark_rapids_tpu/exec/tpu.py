@@ -1327,6 +1327,113 @@ def _plane_element(plane: DeviceColumn, r, p, live):
     return Val(data, valid, lengths)
 
 
+# which join side may be SPLIT under skew (the other side is replicated;
+# replication must not be able to emit unmatched rows of its own side)
+_SPLITTABLE_SIDES = {
+    "inner": ("left", "right"),
+    "left": ("left",),
+    "left_semi": ("left",),
+    "left_anti": ("left",),
+    "right": ("right",),
+    "full": (),
+}
+
+
+def _aqe_join_plan(sa, sb, n, advisory, sides, skew_thresh, skew_factor):
+    """One shared AQE plan for both shuffle reads of a join: per output
+    slot, a list of (source partition, split index, split count) for each
+    side. Coalescing groups adjacent small partitions; a skewed partition
+    (one side > max(threshold, factor x median), other side small) is
+    split across the slots coalescing freed while the other side's
+    partition replicates into each. Deterministic in (sa, sb) so both
+    exchanges compute identical plans."""
+    combined = [x + y for x, y in zip(sa, sb)]
+    skewed: dict = {}
+    if sides and skew_thresh > 0:
+        med_a = sorted(sa)[n // 2]
+        med_b = sorted(sb)[n // 2]
+        for p in range(n):
+            if (
+                "left" in sides
+                and sa[p] > max(skew_thresh, skew_factor * med_a)
+                and sb[p] <= skew_thresh
+            ):
+                skewed[p] = "left"
+            elif (
+                "right" in sides
+                and sb[p] > max(skew_thresh, skew_factor * med_b)
+                and sa[p] <= skew_thresh
+            ):
+                skewed[p] = "right"
+    groups: list = []
+    cur: list = []
+    by = 0
+    for p in range(n):
+        if p in skewed:
+            if cur:
+                groups.append(("g", cur))
+                cur, by = [], 0
+            groups.append(("s", [p]))
+            continue
+        if cur and by + combined[p] > advisory:
+            groups.append(("g", cur))
+            cur, by = [], 0
+        cur.append(p)
+        by += combined[p]
+    if cur:
+        groups.append(("g", cur))
+    free = n - len(groups)
+    out_a: list = [[] for _ in range(n)]
+    out_b: list = [[] for _ in range(n)]
+    slot = 0
+    for kind, g in groups:
+        if kind == "s" and free > 0:
+            p = g[0]
+            side = skewed[p]
+            big = sa[p] if side == "left" else sb[p]
+            want = max(2, int(big // max(advisory, 1)))
+            k = min(free + 1, want, n)
+            free -= k - 1
+            for j in range(k):
+                if side == "left":
+                    out_a[slot].append((p, j, k))
+                    out_b[slot].append((p, 0, 1))
+                else:
+                    out_a[slot].append((p, 0, 1))
+                    out_b[slot].append((p, j, k))
+                slot += 1
+        else:
+            for p in g:
+                out_a[slot].append((p, 0, 1))
+                out_b[slot].append((p, 0, 1))
+            slot += 1
+    return out_a, out_b
+
+
+def _row_range_slice(db: DeviceBatch, j: int, k: int) -> Optional[DeviceBatch]:
+    """Rows of capacity-range slice j of k, compacted (skew split unit)."""
+    fn = K.jit_kernel(
+        ("aqe_split", db.schema, db.capacity, j, k),
+        lambda: _make_row_range_slice(j, k),
+    )
+    return fn(db)
+
+
+def _make_row_range_slice(j: int, k: int):
+    def run(db: DeviceBatch) -> DeviceBatch:
+        # slice the LIVE prefix [0, num_rows), not the padded capacity —
+        # rows are prefix-compacted, so capacity-based slices would leave
+        # every live row in slice 0
+        n = db.num_rows.astype(jnp.int32)
+        lo = (n * j) // k
+        hi = (n * (j + 1)) // k
+        idx = jnp.arange(db.capacity, dtype=jnp.int32)
+        keep = (idx >= lo) & (idx < hi) & db.row_mask()
+        return compact(db, keep)
+
+    return run
+
+
 class TpuShuffleExchangeExec(Exec):
     """Partitioned exchange with on-device bucketing and device-side slicing
     (GpuShuffleExchangeExec + the four GpuPartitioning impls;
@@ -1783,60 +1890,95 @@ class TpuShuffleExchangeExec(Exec):
             return PartitionSet([make_managed(p) for p in range(nparts)])
 
         if cfg.ADAPTIVE_ENABLED.get(ctx.conf) and not self._aqe_disabled:
-            # AQE partition coalescing (GpuCustomShuffleReaderExec +
-            # CoalescedPartitionSpec analogue): measured output sizes group
-            # adjacent small partitions into one reduce task; the remaining
-            # group heads yield the merged data, other members yield nothing.
-            # The partition COUNT stays static (this engine's PartitionSets
-            # are fixed-arity) — the win is fewer tiny downstream batches
-            # and idle sibling tasks, the same effect the reference gets.
-            # When this exchange feeds one side of a shuffled join, the
-            # assignment is computed from BOTH sides' combined sizes so the
-            # two sides group identically (Spark's AQE applies the same
-            # CoalescedPartitionSpecs to both shuffle reads of a join).
+            # AQE partition coalescing + skew-join splitting
+            # (GpuCustomShuffleReaderExec / CoalescedPartitionSpec +
+            # OptimizeSkewedJoin analogues): measured output sizes group
+            # adjacent small partitions into one reduce task, and — when
+            # this exchange feeds a shuffled join — an oversized partition
+            # is split across the freed slots while the peer's partition is
+            # replicated. The partition COUNT stays static (PartitionSets
+            # are fixed-arity); both join sides compute the SAME plan from
+            # the combined measurements, so positional pairing holds.
             advisory = cfg.ADVISORY_PARTITION_SIZE.get(ctx.conf)
+            skew_on = cfg.SKEW_JOIN_ENABLED.get(ctx.conf)
+            skew_thresh = cfg.SKEW_JOIN_THRESHOLD.get(ctx.conf)
+            skew_factor = cfg.SKEW_JOIN_FACTOR.get(ctx.conf)
             aqe_state = {"assign": None}
 
             def my_sizes():
-                buckets = materialize()
-                return [sum(db.size_bytes() for db in b) for b in buckets]
+                # LIVE-row bytes, not capacity bytes: bucket batches share
+                # the input's (padded) capacity, which would make every
+                # bucket look equally big and hide both small partitions
+                # and skew. One pipelined device_get for all counts,
+                # memoized — both sides of a linked join read each
+                # exchange's sizes (tunnel RTTs are the budget).
+                if aqe_state.get("sizes") is None:
+                    buckets = materialize()
+                    counts = jax.device_get(
+                        [[db.num_rows for db in b] for b in buckets]
+                    )
+                    rb = _row_bytes(self.output)
+                    aqe_state["sizes"] = [int(sum(c)) * rb for c in counts]
+                return aqe_state["sizes"]
 
             ctx.aqe_size_providers[id(self)] = my_sizes
 
             def assignment():
-                if aqe_state["assign"] is None:
-                    sizes = my_sizes()
-                    peer = self._aqe_peer
-                    if peer is not None:
-                        peer_fn = ctx.aqe_size_providers.get(id(peer))
-                        if peer_fn is None:
-                            # peer never took the AQE path: fall back to
-                            # identity grouping (no coalescing) to preserve
-                            # positional pairing
-                            aqe_state["assign"] = [[p] for p in range(nparts)]
-                            self.aqe_groups = nparts
-                            return aqe_state["assign"]
-                        sizes = [a + b for a, b in zip(sizes, peer_fn())]
-                    assign: list = [[] for _ in range(nparts)]
-                    group: list = []
-                    gbytes = 0
-                    for p in range(nparts):
-                        if group and gbytes + sizes[p] > advisory:
-                            assign[group[0]] = list(group)
-                            group, gbytes = [], 0
-                        group.append(p)
-                        gbytes += sizes[p]
-                    if group:
-                        assign[group[0]] = list(group)
-                    self.aqe_groups = sum(1 for a in assign if a)
-                    aqe_state["assign"] = assign
-                return aqe_state["assign"]
+                if aqe_state["assign"] is not None:
+                    return aqe_state["assign"]
+                sizes = my_sizes()
+                peer = self._aqe_peer
+                if peer is None:
+                    assign, _ = _aqe_join_plan(
+                        sizes, [0] * nparts, nparts, advisory, (), 0, 0
+                    )
+                else:
+                    peer_fn = ctx.aqe_size_providers.get(id(peer))
+                    if peer_fn is None:
+                        # peer never took the AQE path: identity grouping
+                        # preserves positional pairing
+                        assign = [[(p, 0, 1)] for p in range(nparts)]
+                        aqe_state["assign"] = assign
+                        self.aqe_groups = nparts
+                        return assign
+                    sides = (
+                        _SPLITTABLE_SIDES.get(
+                            getattr(self, "_aqe_join_type", "inner"), ()
+                        )
+                        if skew_on
+                        else ()
+                    )
+                    mine, theirs = sizes, peer_fn()
+                    if getattr(self, "_aqe_side", "left") == "left":
+                        a, b = _aqe_join_plan(
+                            mine, theirs, nparts, advisory, sides,
+                            skew_thresh, skew_factor,
+                        )
+                        assign = a
+                    else:
+                        a, b = _aqe_join_plan(
+                            theirs, mine, nparts, advisory, sides,
+                            skew_thresh, skew_factor,
+                        )
+                        assign = b
+                self.aqe_groups = sum(1 for a in assign if a)
+                self.aqe_splits = sum(
+                    1 for slot in assign for (_, j, k) in slot if k > 1 and j == 0
+                )
+                aqe_state["assign"] = assign
+                return assign
 
             def make_aqe(p):
                 def it():
                     buckets = materialize()
-                    for src in assignment()[p]:
-                        yield from buckets[src]
+                    for src, j, k in assignment()[p]:
+                        if k == 1:
+                            yield from buckets[src]
+                        else:
+                            for db in buckets[src]:
+                                part = _row_range_slice(db, j, k)
+                                if part is not None:
+                                    yield part
 
                 return it
 

@@ -50,15 +50,18 @@ def _colocate_with(batch: DeviceBatch, anchor: DeviceBatch) -> DeviceBatch:
     return jax.device_put(batch, db)
 
 
-def _link_aqe_exchanges(left: Exec, right: Exec) -> None:
+def _link_aqe_exchanges(left: Exec, right: Exec, join_type: str = "inner") -> None:
     """Positional partition pairing requires both join inputs to share one
     AQE coalesce assignment. Find the shuffle exchange feeding each side
     (descending through batch-coalesce wrappers); link the pair so each
     computes the grouping from combined sizes, or disable coalescing when
     only one side is exchange-fed (the other side's partitioning is fixed).
-    Spark parity: AQE applies identical CoalescedPartitionSpecs to both
-    shuffle reads of a join (ShufflePartitionsUtil coalescing over all
-    mappers of both shuffles)."""
+    The join type rides along so the skew-split pass knows which side may
+    be split (the other side is replicated — only legal when replication
+    cannot emit unmatched rows). Spark parity: AQE applies identical
+    CoalescedPartitionSpecs to both shuffle reads of a join
+    (ShufflePartitionsUtil) and OptimizeSkewedJoin splits a skewed side
+    while replicating the other."""
     from .tpu import TpuCoalesceBatchesExec, TpuShuffleExchangeExec
 
     def find(node: Exec):
@@ -73,6 +76,8 @@ def _link_aqe_exchanges(left: Exec, right: Exec) -> None:
     lex, rex = find(left), find(right)
     if lex is not None and rex is not None:
         lex._aqe_peer, rex._aqe_peer = rex, lex
+        lex._aqe_side, rex._aqe_side = "left", "right"
+        lex._aqe_join_type = rex._aqe_join_type = join_type
     else:
         for ex in (lex, rex):
             if ex is not None:
@@ -166,7 +171,7 @@ class TpuShuffledHashJoinExec(Exec):
     # ── execution ───────────────────────────────────────────────────────
     def execute(self, ctx: ExecContext) -> PartitionSet:
         left, right = self.children
-        _link_aqe_exchanges(left, right)
+        _link_aqe_exchanges(left, right, self.join_type)
         lparts = left.execute(ctx)
         rparts = right.execute(ctx)
         assert lparts.num_partitions == rparts.num_partitions, (

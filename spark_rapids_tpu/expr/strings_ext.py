@@ -169,14 +169,45 @@ def translate_args_ascii(e: "StringTranslate") -> bool:
     )
 
 
+_REGEX_META = set("\\^$.|?*+()[]{}")
+
+
+def split_device_pattern(pat: str):
+    """(kind, payload) when the split pattern is device-feasible — a pure
+    literal ('lit', bytes) or a plain char class like [,;] ('class',
+    bytes of alternatives) — else None (full regex stays CPU-gated, like
+    the reference gates what cuDF regex can't do; GpuOverrides.scala:2207
+    GpuStringSplitMeta accepts literal/char-class there too)."""
+    if not pat or not pat.isascii():
+        # non-ASCII delimiters are multi-byte in UTF-8; the byte-wise class
+        # kernel would mis-split — CPU path
+        return None
+    if not (_REGEX_META & set(pat)):
+        return ("lit", pat.encode("utf-8"))
+    if pat.startswith("[") and pat.endswith("]") and len(pat) > 2:
+        inner = pat[1:-1]
+        if not (_REGEX_META & set(inner)) and "-" not in inner and "^" not in inner:
+            return ("class", inner.encode("utf-8"))
+    return None
+
+
 @dataclass(frozen=True)
 class StringSplit(Expression):
-    """``split(str, regex[, limit])`` → array<string> (CPU engine; the
-    reference splits on device via cuDF regex — no XLA analogue)."""
+    """``split(str, regex[, limit])`` → array<string>.
+
+    Device path (literal / plain char-class patterns, the same subset the
+    reference device-splits — GpuStringSplitMeta): delimiter-start mask
+    over the padded byte planes (multi-byte literals resolve left-to-right
+    non-overlap with a lax.scan over the width), token boundaries by
+    per-token arg-min, one gather into the [n, maxTokens, w] element
+    planes. Token counts beyond ``spark.rapids.sql.split.maxTokens`` fail
+    loudly through the kernel error channel — never truncate silently.
+    Full regex patterns execute on the CPU engine (planner gates)."""
 
     child: Expression
     pattern: Expression  # literal
     limit: int = -1
+    max_tokens: int = 16  # device plane width; planner wires the conf in
 
     @property
     def data_type(self) -> DataType:
@@ -187,7 +218,8 @@ class StringSplit(Expression):
         return self.child.nullable
 
     def eval(self, ctx: Ctx) -> Val:
-        assert not ctx.is_device, "split is CPU-only (planner gates)"
+        if ctx.is_device:
+            return self._eval_device(ctx)
         pat = self.pattern.value
         c = self.child.eval(ctx)
         s = _cpu_strs(ctx, c)
@@ -204,6 +236,108 @@ class StringSplit(Expression):
                 pass
             out[i] = parts
         return Val(out, c.valid)
+
+    def _eval_device(self, ctx: Ctx) -> Val:
+        import jax
+        from ..columnar.device import DeviceColumn
+        from .strings import dev_str
+
+        xp = ctx.xp
+        kind, payload = split_device_pattern(self.pattern.value)
+        v = self.child.eval(ctx)
+        ch, lengths = dev_str(ctx, v)
+        n, w = ch.shape
+        m = 1 if kind == "class" else len(payload)
+        idx = xp.arange(w, dtype=xp.int32)
+
+        if kind == "class":
+            alts = np.frombuffer(payload, dtype=np.uint8)
+            raw = xp.zeros((n, w), dtype=bool)
+            for b in alts:
+                raw = raw | (ch == int(b))
+            raw = raw & (idx[None, :] < lengths[:, None])
+            take = raw
+        else:
+            pat = np.frombuffer(payload, dtype=np.uint8)
+            raw = xp.ones((n, w), dtype=bool)
+            for t, b in enumerate(pat):
+                shifted = xp.concatenate(
+                    [ch[:, t:], xp.zeros((n, t), dtype=ch.dtype)], axis=1
+                ) if t else ch
+                raw = raw & (shifted == int(b))
+            raw = raw & (idx[None, :] + m <= lengths[:, None])
+            if m == 1:
+                take = raw
+            else:
+                # left-to-right non-overlap: skip m-1 positions after a take
+                def step(carry, col):
+                    t = col & (carry == 0)
+                    nxt = xp.where(t, m - 1, xp.maximum(carry - 1, 0))
+                    return nxt, t
+
+                _, taken = jax.lax.scan(
+                    step, xp.zeros(n, dtype=xp.int32), raw.T
+                )
+                take = taken.T
+
+        if self.limit > 0:
+            order = xp.cumsum(take.astype(xp.int32), axis=1)
+            take = take & (order <= self.limit - 1)
+        ndelim = take.sum(axis=1).astype(xp.int32)
+        ntok = ndelim + 1
+        W = self.max_tokens
+        if self.limit > 0:
+            W = min(W, self.limit)
+        # overflow → kernel error channel (loud, never truncated)
+        ctx.register_error(
+            f"split produced more than "
+            f"{W} tokens (spark.rapids.sql.split.maxTokens) — raise the "
+            f"conf or disable spark.rapids.sql.expression.StringSplit",
+            (ntok > W) & ctx.broadcast_bool(v.valid),
+        )
+        cum = xp.cumsum(take.astype(xp.int32), axis=1)
+        big = xp.int32(w)
+        # delimiter positions in order: d_pos[:, t] = argmin over j of
+        # (take & cum == t+1) — W is small and static, a python loop fuses
+        d_pos = []
+        for t in range(W - 1):
+            cond = take & (cum == t + 1)
+            d_pos.append(xp.where(cond, idx[None, :], big).min(axis=1))
+        if d_pos:
+            d_pos_m = xp.stack(d_pos, axis=1)  # [n, W-1]
+        else:
+            d_pos_m = xp.zeros((n, 0), dtype=xp.int32)
+        starts = xp.concatenate(
+            [xp.zeros((n, 1), xp.int32), (d_pos_m + m).astype(xp.int32)], axis=1
+        )  # [n, W]
+        tpos = xp.arange(W, dtype=xp.int32)[None, :]
+        last = tpos == (xp.minimum(ntok, W)[:, None] - 1)
+        ends = xp.concatenate(
+            [d_pos_m.astype(xp.int32), xp.full((n, 1), w, xp.int32)], axis=1
+        )
+        ends = xp.where(last, lengths[:, None], ends)
+        tok_live = tpos < xp.minimum(ntok, W)[:, None]
+        tlen = xp.clip(ends - starts, 0, w) * tok_live
+        cidx = xp.arange(w, dtype=xp.int32)[None, None, :]
+        src = xp.clip(starts[:, :, None] + cidx, 0, w - 1)
+        gathered = xp.take_along_axis(
+            xp.broadcast_to(ch[:, None, :], (n, W, w)), src, axis=2
+        )
+        el_live = cidx < tlen[:, :, None]
+        edata = xp.where(el_live, gathered, 0).astype(xp.uint8)
+        valid = ctx.broadcast_bool(v.valid)
+        elem = DeviceColumn(
+            STRING,
+            edata,
+            tok_live & valid[:, None],
+            tlen.astype(xp.int32),
+        )
+        return Val(
+            None,
+            valid,
+            xp.where(valid, xp.minimum(ntok, W), 0).astype(xp.int32),
+            (elem,),
+        )
 
 
 @dataclass(frozen=True)

@@ -381,7 +381,12 @@ class CpuFileScanExec(Exec):
         self.options = options
         self.batch_rows = cfg.MAX_READER_BATCH_SIZE_ROWS.get(conf)
         self.coalesce_bytes = cfg.MAX_READER_BATCH_SIZE_BYTES.get(conf)
-        self.reader_type = options.get("readerType", "PERFILE").upper()
+        conf_key = (
+            cfg.ORC_READER_TYPE if fmt == "orc" else cfg.PARQUET_READER_TYPE
+        )
+        self.reader_type = options.get(
+            "readerType", conf_key.get(conf)
+        ).upper()
         self.num_threads = cfg.MULTITHREADED_READ_NUM_THREADS.get(conf)
         # pushed-down conjuncts (name, op, literal) — set by the planner
         self.predicates: list = list(options.get("__predicates", ()))

@@ -379,6 +379,28 @@ def _bound(e: Expression, schema: Schema) -> Expression:
 
 
 @dataclass
+class InMemoryRelation(LogicalPlan):
+    """df.cache(): the subtree's result is materialized once and served
+    from a parquet-compressed in-memory store thereafter (the
+    ParquetCachedBatchSerializer analogue — columnar bytes, not rows).
+    The session resolves this node before planning."""
+
+    child: LogicalPlan
+    cache_key: int
+    num_partitions: int = 1
+
+    def children(self):
+        return [self.child]
+
+    @property
+    def schema(self) -> Schema:
+        return self.child.schema
+
+    def _node_string(self):
+        return f"InMemoryRelation #{self.cache_key}"
+
+
+@dataclass
 class MapInPandas(LogicalPlan):
     """fn(iter[pd.DataFrame]) → iter[pd.DataFrame] over each partition
     (pyspark mapInPandas; reference GpuMapInPandasExec)."""

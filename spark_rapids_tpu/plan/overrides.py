@@ -502,7 +502,21 @@ def _fmt_check(e, conf: TpuConf) -> Optional[str]:
 
 _expr(sx.ConcatWs)
 _expr(sx.StringTranslate, check=_translate_check)
-_expr(sx.StringSplit, check=_cpu_regex_check("split"))
+def _split_check(e, conf: TpuConf) -> Optional[str]:
+    from ..expr.strings_ext import split_device_pattern
+
+    if not st.is_string_literal(e.pattern):
+        return "split pattern must be a string literal for the device path"
+    if split_device_pattern(e.pattern.value) is None:
+        return (
+            "only literal / plain char-class split patterns run on device "
+            "(full regex is CPU-only, like the reference's "
+            "GpuStringSplitMeta gate)"
+        )
+    return None
+
+
+_expr(sx.StringSplit, check=_split_check)
 _expr(sx.RLike, check=_cpu_regex_check("rlike"))
 _expr(sx.RegExpReplace, check=_cpu_regex_check("regexp_replace"))
 _expr(sx.RegExpExtract, check=_cpu_regex_check("regexp_extract"))

@@ -184,12 +184,67 @@ class TpuSession:
 
         return L.transform_expressions(lp, fix)
 
+    def _resolve_cached(self, lp: L.LogicalPlan) -> L.LogicalPlan:
+        """Materialize InMemoryRelation nodes: first touch executes the
+        subtree and stores the result as PARQUET BYTES in memory (the
+        ParquetCachedBatchSerializer analogue — compressed columnar cache,
+        reference shims/spark311/ParquetCachedBatchSerializer.scala);
+        later touches decode from the store."""
+        import dataclasses as _dc
+
+        if not isinstance(lp, L.LogicalPlan):
+            return lp
+        if isinstance(lp, L.InMemoryRelation):
+            import io
+
+            import pyarrow.parquet as papq
+
+            store = self.__dict__.setdefault("_cache_store", {})
+            entry = store.get(lp.cache_key)
+            if entry is None:
+                table = self._execute(lp.child)
+                buf = io.BytesIO()
+                papq.write_table(table, buf, compression="zstd")
+                entry = (buf.getvalue(), table.schema)
+                store[lp.cache_key] = entry
+            table = papq.read_table(io.BytesIO(entry[0]))
+            return L.LocalRelation(table, lp.schema, lp.num_partitions)
+        kw = {}
+        changed = False
+        for f in _dc.fields(lp):
+            v = getattr(lp, f.name)
+            if isinstance(v, L.LogicalPlan):
+                nv = self._resolve_cached(v)
+            elif isinstance(v, list) and v and isinstance(v[0], L.LogicalPlan):
+                nv = [self._resolve_cached(c) for c in v]
+            else:
+                nv = v
+            kw[f.name] = nv
+            if nv is not v:
+                changed = True
+        return _dc.replace(lp, **kw) if changed else lp
+
+    def uncache(self, key: int) -> None:
+        self.__dict__.setdefault("_cache_store", {}).pop(key, None)
+
     def _execute(self, lp: L.LogicalPlan) -> pa.Table:
         from .plan.pruning import prune_columns
 
+        lp = self._resolve_cached(lp)
         lp = self._resolve_subqueries(lp)
         if cfg.UDF_COMPILER_ENABLED.get(self.conf):
             lp = self._translate_udfs(lp)
+        mt = cfg.SPLIT_MAX_TOKENS.get(self.conf)
+        import dataclasses as _dc
+
+        from .expr.strings_ext import StringSplit as _SS
+
+        lp = L.transform_expressions(
+            lp,
+            lambda e: _dc.replace(e, max_tokens=mt)
+            if isinstance(e, _SS) and e.max_tokens != mt
+            else e,
+        )
         if cfg.ANSI_ENABLED.get(self.conf):
             # Spark resolves ansiEnabled into Cast at analysis time; same
             # here — the rewrite happens before planning so both the CPU
@@ -445,6 +500,35 @@ class DataFrame:
         exprs, plan = _extract_windows(_to_exprs(cols), self._plan)
         exprs, plan = _extract_generators(exprs, plan)
         return DataFrame(self._session, L.Project(exprs, plan))
+
+    def cache(self) -> "DataFrame":
+        """Materialize this DataFrame's result on first use and serve later
+        uses from a parquet-compressed in-memory store (the
+        ParquetCachedBatchSerializer analogue)."""
+        import itertools
+
+        counter = self._session.__dict__.setdefault(
+            "_cache_ids", itertools.count(1)
+        )
+        key = next(counter)
+
+        def parts_of(p) -> int:
+            own = getattr(p, "num_partitions", 0)
+            kids = [parts_of(c) for c in p.children()]
+            return max([own] + kids + [1])
+
+        return DataFrame(
+            self._session,
+            L.InMemoryRelation(self._plan, key, parts_of(self._plan)),
+        )
+
+    persist = cache
+
+    def unpersist(self) -> "DataFrame":
+        if isinstance(self._plan, L.InMemoryRelation):
+            self._session.uncache(self._plan.cache_key)
+            return DataFrame(self._session, self._plan.child)
+        return self
 
     def map_in_pandas(self, fn, schema) -> "DataFrame":
         """``fn(iterator of pd.DataFrame) -> iterator of pd.DataFrame`` per

@@ -135,3 +135,60 @@ def test_cbo_unconverts_trivial_island():
     rows = build2(s2).collect()
     assert rows == [(sum(range(100)),)]
     assert "TpuHashAggregate" in s2._last_plan.tree_string()
+
+
+@pytest.mark.parametrize("how", ["inner", "left"])
+def test_aqe_skew_join_split(how):
+    """OptimizeSkewedJoin analogue: one hot key makes one hash bucket huge;
+    the skewed left side splits across freed slots while the right
+    partition replicates. Results must match exactly."""
+    from spark_rapids_tpu.types import LONG
+
+    rng = np.random.default_rng(91)
+    n = 6000
+    ks = np.where(rng.random(n) < 0.85, 7, rng.integers(0, 40, n))
+    lt = pa.table({"k": ks, "lv": rng.integers(0, 100, n), "lw": rng.integers(0, 9, n)})
+    rt = pa.table({"k": list(range(40)), "rv": list(range(0, 80, 2))})
+    conf = {
+        "spark.sql.adaptive.enabled": True,
+        "spark.sql.autoBroadcastJoinThreshold": "-1",
+        "spark.sql.adaptive.advisoryPartitionSizeInBytes": str(8 * 1024),
+        "spark.sql.adaptive.skewJoin.skewedPartitionThresholdInBytes": str(16 * 1024),
+        "spark.sql.adaptive.skewJoin.skewedPartitionFactor": 2,
+    }
+    assert_cpu_and_tpu_equal(
+        lambda s: s.create_dataframe(lt, num_partitions=6).join(
+            s.create_dataframe(rt, num_partitions=6), on="k", how=how
+        ),
+        conf=conf,
+    )
+    # the split actually fired on the skewed side
+    s = tpu_session(conf)
+    s.create_dataframe(lt, num_partitions=6).join(
+        s.create_dataframe(rt, num_partitions=6), on="k", how=how
+    ).collect()
+    splits = [getattr(ex, "aqe_splits", 0) for ex in _find_exchanges(s._last_plan)]
+    assert sum(splits) >= 1, splits
+
+
+def test_aqe_skew_split_disabled_for_full_join():
+    from spark_rapids_tpu.types import LONG
+
+    rng = np.random.default_rng(92)
+    n = 4000
+    ks = np.where(rng.random(n) < 0.9, 3, rng.integers(0, 30, n))
+    lt = pa.table({"k": ks, "lv": rng.integers(0, 100, n)})
+    rt = pa.table({"k": list(range(0, 30, 2)), "rv": list(range(15))})
+    conf = {
+        "spark.sql.adaptive.enabled": True,
+        "spark.sql.autoBroadcastJoinThreshold": "-1",
+        "spark.sql.adaptive.advisoryPartitionSizeInBytes": str(8 * 1024),
+        "spark.sql.adaptive.skewJoin.skewedPartitionThresholdInBytes": str(16 * 1024),
+        "spark.sql.adaptive.skewJoin.skewedPartitionFactor": 2,
+    }
+    assert_cpu_and_tpu_equal(
+        lambda s: s.create_dataframe(lt, num_partitions=5).join(
+            s.create_dataframe(rt, num_partitions=5), on="k", how="full"
+        ),
+        conf=conf,
+    )

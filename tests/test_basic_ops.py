@@ -149,3 +149,31 @@ def test_casts_numeric():
 def test_cast_string_to_int():
     t = pa.table({"s": ["12", " 34 ", "-5", "abc", "", None, "2147483648", "99"]})
     assert_cpu_and_tpu_equal(lambda s: _df(s, t).select(col("s").cast(INT).alias("i")))
+
+
+# ── df.cache(): ParquetCachedBatchSerializer analogue ──────────────────────
+def test_cache_roundtrip_and_reuse():
+    import numpy as np
+
+    rng = np.random.default_rng(55)
+    t = pa.table({"k": rng.integers(0, 8, 2000), "x": rng.integers(0, 99, 2000)})
+
+    def build(s):
+        from spark_rapids_tpu.functions import sum as sum_
+
+        base = s.create_dataframe(t, num_partitions=2).filter(col("x") > 10).cache()
+        return base.group_by("k").agg(sum_(col("x")).alias("s"))
+
+    assert_cpu_and_tpu_equal(build)
+    from harness import tpu_session
+
+    s = tpu_session()
+    base = s.create_dataframe(t, num_partitions=2).filter(col("x") > 10).cache()
+    from spark_rapids_tpu.functions import sum as sum_
+
+    r1 = sorted(base.group_by("k").agg(sum_(col("x")).alias("s")).collect())
+    r2 = sorted(base.group_by("k").agg(sum_(col("x")).alias("s")).collect())
+    assert r1 == r2
+    assert len(s._cache_store) == 1  # parquet-bytes entry, reused
+    base.unpersist()
+    assert len(s._cache_store) == 0

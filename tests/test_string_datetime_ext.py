@@ -252,3 +252,47 @@ def test_partial_patterns_default_month_day():
             F.unix_timestamp(col("a"), "yyyy-MM").alias("u")
         )
     )
+
+
+# ── device split (literal / char-class patterns — GpuStringSplitMeta) ──────
+def test_split_literal_on_device():
+    t = pa.table(
+        {"s": ["a,b,c", "", "x", None, "a,,c", ",lead", "trail,", "one"]}
+    )
+    for lim in (-1, 2, 3):
+        assert_cpu_and_tpu_equal(
+            lambda s, lim=lim: s.create_dataframe(t, num_partitions=2).select(
+                F.split(col("s"), ",", lim).alias("p")
+            )
+        )
+
+
+def test_split_char_class_and_multichar_on_device():
+    t = pa.table({"s": ["a;b,c", "aXXbXXXc", "XXXX", "aXXXa", None]})
+    assert_cpu_and_tpu_equal(
+        lambda s: s.create_dataframe(t).select(F.split(col("s"), "[;,]").alias("p"))
+    )
+    assert_cpu_and_tpu_equal(
+        lambda s: s.create_dataframe(t).select(F.split(col("s"), "XX").alias("p"))
+    )
+
+
+def test_split_regex_falls_back():
+    from harness import tpu_session
+
+    t = pa.table({"s": ["a1b22c"]})
+    s = tpu_session(strict=False)
+    rows = s.create_dataframe(t).select(F.split(col("s"), "[0-9]+").alias("p")).collect()
+    assert rows == [(["a", "b", "c"],)]
+
+
+def test_split_max_tokens_overflow_raises():
+    from harness import tpu_session
+
+    t = pa.table({"s": [",".join(str(i) for i in range(40))]})
+    s = tpu_session()
+    with pytest.raises(Exception, match="maxTokens"):
+        s.create_dataframe(t).select(F.split(col("s"), ",").alias("p")).collect()
+    s2 = tpu_session({"spark.rapids.sql.split.maxTokens": 64})
+    rows = s2.create_dataframe(t).select(F.split(col("s"), ",").alias("p")).collect()
+    assert len(rows[0][0]) == 40
