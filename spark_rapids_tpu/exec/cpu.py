@@ -47,11 +47,19 @@ def _val_to_np(ctx: Ctx, val) -> tuple[np.ndarray, np.ndarray]:
 class CpuScanExec(Exec):
     """In-memory arrow table scan (LocalRelation)."""
 
-    def __init__(self, table: pa.Table, schema: Schema, num_partitions: int = 1):
+    def __init__(
+        self,
+        table: pa.Table,
+        schema: Schema,
+        num_partitions: int = 1,
+        source: pa.Table = None,
+    ):
         super().__init__([])
         self.table = table
         self._schema = schema
         self.num_partitions = num_partitions
+        # identity anchor for the device-upload cache (see LocalRelation)
+        self.source = source if source is not None else table
 
     @property
     def output(self) -> Schema:

@@ -170,9 +170,9 @@ class HostToDeviceExec(Exec):
             # id() stays valid for the session's lifetime.
             key = (
                 "h2d",
-                id(child.table),
+                id(child.source),
                 child.num_partitions,
-                K.schema_key(schema),
+                K.schema_key(schema),  # field names participate here
                 max_rows,
                 max_str,
             )
@@ -182,7 +182,10 @@ class HostToDeviceExec(Exec):
                 import threading
 
                 entry = {
-                    "table": child.table,
+                    # pin BOTH: the source anchors the cache key's id()
+                    # across pruning passes, the pruned table backs the
+                    # uploaded batches
+                    "table": (child.source, child.table),
                     "parts": [None] * child.num_partitions,
                     "rows": [0] * child.num_partitions,
                     "lock": threading.Lock(),
@@ -995,7 +998,9 @@ class TpuSortExec(Exec):
 
         def make_run(b):
             """Sort one input batch into a spillable run; drop the input ref."""
-            catalog.ensure_headroom(2 * b.size_bytes())
+            from ..mem.spill import _batch_device
+
+            catalog.ensure_headroom(2 * b.size_bytes(), _batch_device(b))
             return catalog.register(
                 with_oom_retry(catalog, _sort, b), SpillPriorities.WORKING
             )
@@ -1034,7 +1039,12 @@ class TpuSortExec(Exec):
                         # pin the operands FIRST so the headroom pass (and
                         # any retry-spill) cannot evict what is being merged
                         ba, bb = a.get_batch(), b.get_batch()
-                        catalog.ensure_headroom(2 * (a.size_bytes + b.size_bytes))
+                        from ..mem.spill import _batch_device
+
+                        catalog.ensure_headroom(
+                            2 * (a.size_bytes + b.size_bytes),
+                            _batch_device(ba),
+                        )
                         return _sort(concat_device([ba, bb]))
 
                     out = with_oom_retry(catalog, merge_pair)

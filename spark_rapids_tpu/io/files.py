@@ -201,8 +201,12 @@ def _read_csv(path: str, options: dict) -> pa.Table:
     read_opts = pacsv.ReadOptions(autogenerate_column_names=not header)
     parse_opts = pacsv.ParseOptions(delimiter=sep)
     # Spark's CSV defaults: nullValue is the empty string (and ONLY it —
-    # "NaN" must parse as a float NaN, not null), empty strings read as null
-    null_opts = dict(null_values=[""], strings_can_be_null=True)
+    # "NaN" must parse as a float NaN, not null), empty strings read as
+    # null; the default routes through the version shim, users override
+    # with the nullValue option
+    null_opts = dict(
+        null_values=[options.get("nullValue", "")], strings_can_be_null=True
+    )
     conv = pacsv.ConvertOptions(**null_opts)
     if "schema" in options:
         schema: Schema = options["schema"]

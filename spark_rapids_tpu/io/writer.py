@@ -37,12 +37,55 @@ STATS_SCHEMA = Schema(
 _NAN = float("nan")  # canonical NaN for partition-combo dedup
 
 
+# 1582-10-15, the Gregorian cutover, as days since epoch
+_CUTOVER_DAYS = -141427
+
+
+def _rebase_guard(rb: pa.RecordBatch) -> None:
+    """spark.sql.parquet.datetimeRebaseModeInWrite=EXCEPTION (shim-routed
+    default, Spark 3.1/3.2): refuse dates/timestamps before the Gregorian
+    cutover — the engine writes proleptic values and performs no julian
+    rebase (reference RebaseHelper.newRebaseExceptionInWrite)."""
+    import pyarrow.compute as pc
+
+    per_unit = {
+        "s": 86_400,
+        "ms": 86_400_000,
+        "us": 86_400_000_000,
+        "ns": 86_400_000_000_000,
+    }
+    for i, f in enumerate(rb.schema):
+        if pa.types.is_date32(f.type):
+            cut = _CUTOVER_DAYS
+        elif pa.types.is_date64(f.type):
+            cut = _CUTOVER_DAYS * 86_400_000  # date64 stores milliseconds
+        elif pa.types.is_timestamp(f.type):
+            cut = _CUTOVER_DAYS * per_unit[f.type.unit]
+        else:
+            continue
+        col = rb.column(i)
+        if col.null_count == len(col):
+            continue
+        # compare raw storage units (view strips date/datetime boxing;
+        # date32 is int32-backed, the rest int64)
+        width = pa.int32() if pa.types.is_date32(f.type) else pa.int64()
+        lo = pc.min(col.view(width)).as_py()
+        if lo is not None and lo < cut:
+            raise ValueError(
+                f"write of column {f.name!r} contains dates before "
+                "1582-10-15, which would need julian rebase "
+                "(spark.sql.parquet.datetimeRebaseModeInWrite="
+                "EXCEPTION; use the 3.3 shim for CORRECTED writes)"
+            )
+
+
 class _FormatWriter:
     """One open output file, append-able batch by batch."""
 
     def __init__(self, fmt: str, path: str, schema: pa.Schema, options: dict):
         self.path = path
         self.fmt = fmt
+        self.options = options
         self.rows = 0
         if fmt == "parquet":
             self._w = papq.ParquetWriter(path, schema)
@@ -61,6 +104,8 @@ class _FormatWriter:
 
     def write(self, rb: pa.RecordBatch):
         self.rows += rb.num_rows
+        if self.fmt == "parquet" and self.options.get("__rebase") == "EXCEPTION":
+            _rebase_guard(rb)
         if self.fmt == "orc":
             self._w.write(pa.Table.from_batches([rb]))
         else:
@@ -269,8 +314,11 @@ class DataFrameWriter:
         session = self._df._session
         from ..plan import logical as L
 
+        opts = dict(self._options)
+        # shim-routed write semantics (SparkShims seam)
+        opts.setdefault("__rebase", session.shim.parquet_rebase_write())
         lp = L.WriteFiles(
-            self._df._plan, path, fmt, list(self._partition_by), dict(self._options)
+            self._df._plan, path, fmt, list(self._partition_by), opts
         )
         stats = session._execute(lp)
         # driver commit marker (FileFormatWriter's _SUCCESS)

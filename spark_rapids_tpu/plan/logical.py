@@ -43,11 +43,17 @@ class LogicalPlan:
 
 @dataclass
 class LocalRelation(LogicalPlan):
-    """In-memory arrow table source."""
+    """In-memory arrow table source.
+
+    ``source`` pins the ORIGINAL user table through column pruning (which
+    rebuilds ``table`` via select, a new object every planning pass) so
+    the session's device-upload cache can key on a stable identity —
+    without it every collect() re-uploads the whole table."""
 
     table: object  # pa.Table
     _schema: Schema
     num_partitions: int = 1
+    source: object = None  # original pa.Table (identity anchor)
 
     @property
     def schema(self) -> Schema:

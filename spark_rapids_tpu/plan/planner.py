@@ -38,7 +38,7 @@ from ..types import Schema
 
 def plan_physical(lp: L.LogicalPlan, conf: TpuConf) -> Exec:
     if isinstance(lp, L.LocalRelation):
-        return CpuScanExec(lp.table, lp.schema, lp.num_partitions)
+        return CpuScanExec(lp.table, lp.schema, lp.num_partitions, lp.source)
     if isinstance(lp, L.FileScan):
         from ..io.files import CpuFileScanExec
 

@@ -48,7 +48,12 @@ def prune_columns(plan: L.LogicalPlan, required: Optional[Set[str]] = None):
             return plan
         sub = Schema([plan.schema[n] for n in names])
         if isinstance(plan, L.LocalRelation):
-            return L.LocalRelation(plan.table.select(names), sub, plan.num_partitions)
+            return L.LocalRelation(
+                plan.table.select(names),
+                sub,
+                plan.num_partitions,
+                source=plan.source if plan.source is not None else plan.table,
+            )
         return L.FileScan(plan.paths, plan.file_format, sub, dict(plan.options))
     if isinstance(plan, L.Project):
         child = prune_columns(plan.child, _names_of(plan.exprs))

@@ -205,10 +205,16 @@ class TpuSession:
                 table = self._execute(lp.child)
                 buf = io.BytesIO()
                 papq.write_table(table, buf, compression="zstd")
-                entry = (buf.getvalue(), table.schema)
+                entry = {"bytes": buf.getvalue(), "table": None}
                 store[lp.cache_key] = entry
-            table = papq.read_table(io.BytesIO(entry[0]))
-            return L.LocalRelation(table, lp.schema, lp.num_partitions)
+            if entry["table"] is None:
+                entry["table"] = papq.read_table(io.BytesIO(entry["bytes"]))
+                # the decoded table serves all later reads (and anchors the
+                # device-upload cache); the compressed bytes are done
+                entry["bytes"] = None
+            return L.LocalRelation(
+                entry["table"], lp.schema, lp.num_partitions
+            )
         kw = {}
         changed = False
         for f in _dc.fields(lp):
@@ -225,7 +231,14 @@ class TpuSession:
         return _dc.replace(lp, **kw) if changed else lp
 
     def uncache(self, key: int) -> None:
-        self.__dict__.setdefault("_cache_store", {}).pop(key, None)
+        entry = self.__dict__.setdefault("_cache_store", {}).pop(key, None)
+        if entry and entry.get("table") is not None:
+            # also evict the device uploads anchored on the decoded table —
+            # unpersist() must actually free HBM
+            tid = id(entry["table"])
+            h2d = self.__dict__.get("_h2d_cache", {})
+            for k in [k for k in h2d if len(k) > 1 and k[1] == tid]:
+                h2d.pop(k, None)
 
     def _execute(self, lp: L.LogicalPlan) -> pa.Table:
         from .plan.pruning import prune_columns
@@ -371,6 +384,8 @@ class DataFrameReader:
 
         opts = dict(self._options)
         opts.update(kwargs)
+        # shim-routed default (SparkShims seam): what string reads as NULL
+        opts.setdefault("nullValue", self._session.shim.csv_null_value())
         files = expand_paths(paths, "csv")
         schema = infer_schema(files, "csv", opts)
         return DataFrame(self._session, L.FileScan(files, "csv", schema, opts))

@@ -39,6 +39,14 @@ class SparkShim:
     def max_decimal_precision(self) -> int:
         return 18
 
+    # spark.sql.parquet.datetimeRebaseModeInWrite default: writing dates
+    # before the Gregorian cutover needs julian rebase the engine does not
+    # perform — EXCEPTION refuses them loudly (Spark 3.1/3.2 default;
+    # reference RebaseHelper.scala). CORRECTED writes proleptic values
+    # as-is (newer defaults).
+    def parquet_rebase_write(self) -> str:
+        return "EXCEPTION"
+
 
 class Spark311Shim(SparkShim):
     version = "3.1"
@@ -51,7 +59,14 @@ class Spark320Shim(SparkShim):
         return True
 
 
-_PROVIDERS = {s.version: s for s in (Spark311Shim, Spark320Shim)}
+class Spark330Shim(Spark320Shim):
+    version = "3.3"
+
+    def parquet_rebase_write(self) -> str:
+        return "CORRECTED"
+
+
+_PROVIDERS = {s.version: s for s in (Spark311Shim, Spark320Shim, Spark330Shim)}
 
 
 def get_shim(version: str | None) -> SparkShim:

@@ -145,3 +145,30 @@ def test_out_of_core_sort_matches_cpu():
         return df.sort("k", "s")
 
     assert_cpu_and_tpu_equal(q, conf=conf, sort_result=False)
+
+
+def test_per_device_accounting_and_headroom():
+    """Mesh mode: each chip has its own HBM — headroom is enforced per
+    device, and spilling one chip's buffers leaves the other's alone."""
+    import jax
+
+    if len(jax.devices()) < 2:
+        pytest.skip("needs 2 devices")
+    d0, d1 = jax.devices()[:2]
+    cat = BufferCatalog(device_limit=None)
+    b0 = jax.device_put(_batch(64), d0)
+    b1 = jax.device_put(_batch(64), d1)
+    s0, s1 = cat.register(b0), cat.register(b1)
+    stats = cat.stats()
+    assert len(stats["device_bytes_by_dev"]) == 2, stats
+    per_dev = set(stats["device_bytes_by_dev"].values())
+    assert per_dev == {s0.size_bytes}, stats
+    # per-device spill: free chip 0 only
+    freed = cat.synchronous_spill(s0.size_bytes, d0)
+    assert freed >= s0.size_bytes
+    stats = cat.stats()
+    assert len(stats["device_bytes_by_dev"]) == 1, stats
+    # chip 1's buffer still device-resident
+    db = s1.get_batch()
+    assert db.row_count() == 64
+    s1.unpin()

@@ -80,3 +80,69 @@ def test_coalesce_batches_merges_small_scan_batches(tmp_path):
         d.get("numOutputBatches") for k, d in m.items() if "TpuCoalesceBatches" in k
     ]
     assert coalesce_counts and coalesce_counts[0] == 1, m
+
+
+def test_shim_parquet_rebase_write(tmp_path):
+    """SparkShims seam carries real behavior: the 3.1/3.2 shims refuse
+    pre-Gregorian-cutover dates in parquet writes (rebase EXCEPTION mode,
+    reference RebaseHelper); the 3.3 shim writes them as-is (CORRECTED)."""
+    import datetime
+
+    import pyarrow as pa
+
+    from spark_rapids_tpu import TpuSession
+
+    t = pa.table({"d": pa.array([datetime.date(1500, 1, 1)])})
+    s = TpuSession({"spark.rapids.sql.enabled": False})
+    with pytest.raises(ValueError, match="1582"):
+        s.create_dataframe(t).write.parquet(str(tmp_path / "old"))
+    s33 = TpuSession(
+        {"spark.rapids.sql.enabled": False, "spark.rapids.tpu.sparkVersion": "3.3"}
+    )
+    s33.create_dataframe(t).write.parquet(str(tmp_path / "ok"))
+    got = s33.read.parquet(str(tmp_path / "ok")).collect()
+    assert got == [(datetime.date(1500, 1, 1),)]
+    # modern dates write fine under the default shim
+    t2 = pa.table({"d": pa.array([datetime.date(2020, 5, 4)])})
+    s.create_dataframe(t2).write.parquet(str(tmp_path / "new"))
+
+
+def test_shim_csv_null_value_routed(tmp_path):
+    import pyarrow as pa
+
+    from spark_rapids_tpu import TpuSession
+
+    p = str(tmp_path / "x.csv")
+    open(p, "w").write("a,b\n1,\n2,NULLISH\n")
+    s = TpuSession({"spark.rapids.sql.enabled": False})
+    rows = s.read.option("header", "true").csv(p).collect()
+    assert rows == [(1, None), (2, "NULLISH")]
+    rows2 = (
+        s.read.option("header", "true")
+        .option("nullValue", "NULLISH")
+        .csv(p)
+        .collect()
+    )
+    assert rows2 == [(1, ""), (2, None)]
+
+
+def test_rebase_guard_respects_timestamp_unit(tmp_path):
+    """Regression: a 1960 timestamp[ns] is post-cutover and must write; a
+    genuine 1500 timestamp[s] must be refused (raw values compare against
+    unit-scaled cutovers)."""
+    import datetime
+
+    import pyarrow as pa
+
+    from spark_rapids_tpu import TpuSession
+
+    s = TpuSession({"spark.rapids.sql.enabled": False})
+    ok = pa.table(
+        {"t": pa.array([datetime.datetime(1960, 1, 1)], type=pa.timestamp("ns"))}
+    )
+    s.create_dataframe(ok).write.parquet(str(tmp_path / "ns"))  # no raise
+    old = pa.table(
+        {"t": pa.array([int(-1.48e10)], type=pa.timestamp("s"))}
+    )
+    with pytest.raises(ValueError, match="1582"):
+        s.create_dataframe(old).write.parquet(str(tmp_path / "s"))
