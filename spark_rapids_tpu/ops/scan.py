@@ -32,8 +32,9 @@ def seg_end_flags(starts: jax.Array) -> jax.Array:
 
 def first_k_positions(flags: jax.Array) -> jax.Array:
     """Positions of True flags, in order, compacted to the front (argsort of
-    the negated mask — one cheap single-key sort, no scatter). Position k of
-    the result is the row index of the k-th flagged row."""
+    the negated mask — one cheap single-key sort, no scatter; measured
+    FASTER than cumsum+searchsorted on TPU). Position k of the result is
+    the row index of the k-th flagged row."""
     cap = flags.shape[0]
     iota = jnp.arange(cap, dtype=jnp.int32)
     key = jnp.where(flags, jnp.uint32(0), jnp.uint32(1))

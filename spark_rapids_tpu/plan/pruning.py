@@ -76,6 +76,45 @@ def prune_columns(plan: L.LogicalPlan, required: Optional[Set[str]] = None):
         return dataclasses.replace(plan, child=prune_columns(plan.child, req))
     if isinstance(plan, L.Limit):
         return dataclasses.replace(plan, child=prune_columns(plan.child, required))
+    if isinstance(plan, L.Join):
+        # split the requirement by side; keys and residual inputs are
+        # always needed. A name on both sides goes to both (superset is
+        # safe). Joins were previously unmodeled, which left e.g. TPC-H q3
+        # dragging all 8 lineitem columns through filter + exchange + join
+        # when 4 are referenced — every gather/upload pays per column.
+        need = None
+        if required is not None:
+            need = (
+                set(required)
+                | _names_of(plan.left_keys)
+                | _names_of(plan.right_keys)
+            )
+            if plan.residual is not None:
+                _expr_names(plan.residual, need)
+        lreq = None if need is None else need & set(plan.left.schema.names)
+        rreq = None if need is None else need & set(plan.right.schema.names)
+        return dataclasses.replace(
+            plan,
+            left=prune_columns(plan.left, lreq),
+            right=prune_columns(plan.right, rreq),
+        )
+    if isinstance(plan, L.Window):
+        # output = child columns ++ window columns: the child must provide
+        # the required pass-through names plus every spec/function input
+        if required is None:
+            req = None
+        else:
+            win_names = {name for name, _ in plan.window_cols}
+            req = set(required) - win_names
+            for _, we in plan.window_cols:
+                # children() covers only the function; the spec's partition
+                # and order expressions are separate fields
+                _expr_names(we, req)
+                for p in we.spec.partition_by:
+                    _expr_names(p, req)
+                for o in we.spec.order_by:
+                    _expr_names(o.child, req)
+        return dataclasses.replace(plan, child=prune_columns(plan.child, req))
     # unmodeled node: recurse with "all columns" required beneath it
     kids = list(plan.children())
     if not kids:

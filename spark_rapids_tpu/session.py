@@ -56,6 +56,17 @@ class TpuSession:
                 self.conf = self.conf.set(
                     cfg.SHUFFLE_PARTITIONS.key, self.mesh_context().n
                 )
+        elif (
+            cfg.SQL_ENABLED.get(self.conf)
+            and self.conf.get_raw(cfg.SHUFFLE_PARTITIONS.key) is None
+        ):
+            # single-device default: ONE task (the reference's
+            # concurrentGpuTasks model). Without mesh mode every partition
+            # runs serialized on the default device — each extra partition
+            # is another kernel pipeline + host sync, measured 2-4x slower
+            # at partitions=2 vs 1 on the bench queries. Mesh mode above
+            # sets one partition per chip instead.
+            self.conf = self.conf.set(cfg.SHUFFLE_PARTITIONS.key, 1)
         self.read = DataFrameReader(self)
         self._last_plan: Optional[Exec] = None
         self._last_overrides: Optional[TpuOverrides] = None

@@ -27,6 +27,10 @@ def tpu_session(extra_conf: Optional[dict] = None, strict: bool = True) -> TpuSe
     conf = {
         "spark.rapids.sql.enabled": True,
         "spark.rapids.sql.test.enabled": strict,
+        # the engine's single-device default is ONE shuffle partition (perf);
+        # tests pin the classic 8 so exchanges/joins/AQE keep exercising
+        # their multi-partition paths on the virtual 8-device backend
+        "spark.sql.shuffle.partitions": 8,
     }
     conf.update(extra_conf or {})
     return TpuSession(conf)
