@@ -100,7 +100,9 @@ def _string_base_words(col: DeviceColumn):
     once per column even when both min AND max aggregate it)."""
     from .sortkeys import column_radix_words
 
-    return column_radix_words(col, ascending=True, nulls_first=True)[1:]
+    return column_radix_words(
+        col, ascending=True, nulls_first=True, value_only=True
+    )
 
 
 def _string_value_words(base_words: list, valid, want_min: bool):

@@ -67,8 +67,10 @@ def _key_words(cols: list[DeviceColumn], live: jax.Array, side_flag: int):
     for c in cols:
         c = _normalize_float(c)
         any_null = any_null | ~c.validity
-        # drop the per-column validity word (nulls handled by exclusion)
-        words.extend(column_radix_words(c)[1:])
+        # no standalone validity word (nulls handled by the exclusion
+        # sentinel; packed sub-64-bit words keep their folded bit, which is
+        # constant across valid rows so equality is unaffected)
+        words.extend(column_radix_words(c, value_only=True))
     sentinel = jnp.where(any_null, jnp.uint64(2 + side_flag), jnp.uint64(0))
     return [sentinel] + words, any_null
 

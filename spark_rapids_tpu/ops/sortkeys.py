@@ -57,9 +57,18 @@ def column_radix_words(
     col: DeviceColumn,
     ascending: bool = True,
     nulls_first: bool = True,
+    value_only: bool = False,
 ) -> list[jax.Array]:
     """Encode one column into uint64 words; unsigned lexicographic order over
-    the word list == the requested Spark ordering."""
+    the word list == the requested Spark ordering.
+
+    ``value_only`` omits the standalone validity word for callers that
+    handle nulls themselves AND keeps the classic widened-to-64-bit
+    encoding: the join compares words across columns of DIFFERENT integer
+    widths, which only works when every width shares one encoding. Default
+    (sort) callers get the packed layout for sub-64-bit types — validity
+    folded into bit 63 of the single value word — so callers must never
+    assume word[0] is a validity word; use this flag instead of slicing."""
     dt = col.dtype
     valid = col.validity
     # validity word: order nulls relative to values
@@ -78,8 +87,33 @@ def column_radix_words(
         for k in range(nwords):
             words.append(packed[:, k])
         words.append(lengths.astype(jnp.uint64))
-    elif isinstance(dt, BooleanType):
-        words.append(col.data.astype(jnp.uint64))
+    elif not value_only and (
+        isinstance(dt, BooleanType)
+        or (
+            getattr(dt, "np_dtype", None) is not None
+            and dt.np_dtype.itemsize <= 4
+        )
+    ):
+        # value encoding fits 32 bits: fold the validity bit into bit 63 of
+        # the SAME word — one LSD pass instead of two for int8/16/32, date,
+        # float32, bool keys (each pass is ~15ms at 2M rows, and sorts are
+        # the engine's hottest primitive)
+        if isinstance(dt, FloatType):
+            enc = _float_bits_ordered(col.data, dt) & jnp.uint64(0xFFFFFFFF)
+        elif isinstance(dt, BooleanType):
+            enc = col.data.astype(jnp.uint64)
+        else:
+            enc = (
+                col.data.astype(jnp.int64) + jnp.int64(1 << 31)
+            ).astype(jnp.uint64)
+        packed = (vw << jnp.uint64(63)) | jnp.where(
+            valid, enc, jnp.uint64(0)
+        )
+        if not ascending:
+            # invert the VALUE bits only — null placement is nulls_first's
+            # job (the unpacked layout never inverts its validity word)
+            packed = packed ^ jnp.uint64(0x7FFFFFFFFFFFFFFF)
+        return [packed]
     elif isinstance(dt, (FloatType, DoubleType)):
         words.append(_float_bits_ordered(col.data, dt))
     else:  # integral / date / timestamp / decimal(int64)
@@ -90,6 +124,8 @@ def column_radix_words(
     words = [jnp.where(valid, wd, jnp.uint64(0)) for wd in words]
     if not ascending:
         words = [~wd for wd in words]
+    if value_only:
+        return words
     return [vw] + words
 
 
@@ -153,7 +189,10 @@ def np_column_radix_words(
     nulls_first: bool = True,
 ):
     """Numpy twin of :func:`column_radix_words` for the CPU engine's range
-    partitioner (same word layout; engines never mix word spaces)."""
+    partitioner. NOT the same word layout anymore: the device version packs
+    validity into the value word for sub-64-bit types; this twin keeps the
+    classic [validity, value64] pair. The engines never mix word spaces —
+    do not compare words across the two functions."""
     import numpy as np
 
     valid = np.asarray(valid).astype(bool)

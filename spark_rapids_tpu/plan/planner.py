@@ -665,6 +665,56 @@ def _plan_aggregate(lp: L.Aggregate, conf: TpuConf) -> Exec:
     )
 
 
+def _coerce_join_keys(lp: L.Join) -> L.Join:
+    """Catalyst coerces mismatched equi-join key types at analysis (casts
+    the narrower side); without it, hash partitioning and word-encoded
+    matchers see different representations of equal values and silently
+    drop matches. Integral pairs widen to the wider side; integral/float
+    pairs promote to double."""
+    if not lp.left_keys:
+        return lp
+    import dataclasses as _dc
+
+    from ..expr.cast import Cast
+    from ..types import (
+        DOUBLE,
+        DoubleType,
+        FloatType,
+        IntegralType,
+    )
+
+    lk, rk = list(lp.left_keys), list(lp.right_keys)
+    changed = False
+    for i, (a, b) in enumerate(zip(lk, rk)):
+        try:
+            ta = bind(a, lp.left.schema).data_type
+            tb = bind(b, lp.right.schema).data_type
+        except Exception:
+            continue
+        if type(ta) is type(tb):
+            continue
+        if isinstance(ta, IntegralType) and isinstance(tb, IntegralType):
+            wide = ta if ta.np_dtype.itemsize >= tb.np_dtype.itemsize else tb
+            if type(ta) is not type(wide):
+                lk[i] = Cast(a, wide)
+                changed = True
+            if type(tb) is not type(wide):
+                rk[i] = Cast(b, wide)
+                changed = True
+            continue
+        num = (IntegralType, FloatType, DoubleType)
+        if isinstance(ta, num) and isinstance(tb, num):
+            if not isinstance(ta, DoubleType):
+                lk[i] = Cast(a, DOUBLE)
+                changed = True
+            if not isinstance(tb, DoubleType):
+                rk[i] = Cast(b, DOUBLE)
+                changed = True
+    if not changed:
+        return lp
+    return _dc.replace(lp, left_keys=lk, right_keys=rk)
+
+
 def _plan_join(lp: L.Join, conf: TpuConf) -> Exec:
     from ..exec.cpu_join import (
         CpuBroadcastExchangeExec,
@@ -673,6 +723,7 @@ def _plan_join(lp: L.Join, conf: TpuConf) -> Exec:
         CpuShuffledHashJoinExec,
     )
 
+    lp = _coerce_join_keys(lp)
     nparts = cfg.SHUFFLE_PARTITIONS.get(conf)
     if lp.left_keys:
         jt = lp.join_type

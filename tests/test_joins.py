@@ -130,3 +130,36 @@ def test_join_empty_sides():
                 s.create_dataframe(rt), on=[("k", "k")], how=how
             )
         )
+
+
+def test_join_mixed_width_int_keys():
+    """Regression: int32 and int64 key columns must share one word encoding
+    in the matcher — validity-packed sort words would silently mismatch."""
+    import numpy as np
+
+    lt = pa.table(
+        {
+            "k32": pa.array(np.asarray([1, 2, 3, 4, 5], dtype=np.int32)),
+            "lv": [10, 20, 30, 40, 50],
+        }
+    )
+    rt = pa.table(
+        {
+            "k64": pa.array(np.asarray([2, 4, 6], dtype=np.int64)),
+            "rv": [200, 400, 600],
+        }
+    )
+    assert_cpu_and_tpu_equal(
+        lambda s: s.create_dataframe(lt).join(
+            s.create_dataframe(rt), on=[("k32", "k64")], how="inner"
+        )
+    )
+    from harness import tpu_session
+
+    s = tpu_session({"spark.sql.autoBroadcastJoinThreshold": "-1"})
+    rows = sorted(
+        s.create_dataframe(lt)
+        .join(s.create_dataframe(rt), on=[("k32", "k64")], how="inner")
+        .collect()
+    )
+    assert rows == [(2, 20, 2, 200), (4, 40, 4, 400)], rows
