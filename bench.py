@@ -28,6 +28,11 @@ import time
 import numpy as np
 import pyarrow as pa
 
+# 2M rows: the largest scale whose kernels compile reliably over the
+# tunneled remote-compile service (4M+ bucket shapes SIGKILL the remote
+# TPU compile helper). q6 caveat: its whole CPU run (~56ms) is under ONE
+# tunnel RTT (see detail.tunnel_rtt_ms), so its "speedup" measures link
+# latency, not compute — co-located hardware has ~ms RTTs.
 SCALE_ROWS = 2_000_000
 PARTITIONS = 1
 # ONE task per chip (the reference's concurrentGpuTasks model): on a single
