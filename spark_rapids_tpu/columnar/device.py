@@ -395,49 +395,110 @@ def _pack_kernel(schema: Schema, cap: int, widths: tuple):
     a raw PJRT transfer is exact for whatever the device holds."""
     from .. import kernels as K
 
+    return K.kernel(
+        ("pack_d2h", schema, cap, widths), lambda: K.GuardedJit(_pack_pure)
+    )
+
+
+def _pack_to_bytes(flat):
+    """1-D array → little-endian uint8 bytes. 64-bit ints split into
+    (lo, hi) uint32 halves arithmetically (ops/bits.py): the TPU X64
+    emulation can't width-change bitcast 64-bit types."""
+    from ..ops.bits import i64_bytes_le
+
+    if flat.dtype == jnp.bool_:
+        return flat.astype(jnp.uint8)
+    if flat.dtype in (jnp.dtype(jnp.int64), jnp.dtype(jnp.uint64)):
+        return i64_bytes_le(flat)
+    if flat.dtype != jnp.uint8:
+        return jax.lax.bitcast_convert_type(flat, jnp.uint8).reshape(-1)
+    return flat
+
+
+def _pack_pure(batch: DeviceBatch):
+    """The traceable pack body (shape-generic; callers cache per shape)."""
+    parts = [_pack_to_bytes(batch.num_rows.astype(jnp.int64).reshape(1))]
+    side: list[jax.Array] = []
+
+    def add(arr):
+        flat = _pack_to_bytes(arr.reshape(-1))
+        pad = _pad8(flat.shape[0]) - flat.shape[0]
+        if pad:
+            flat = jnp.concatenate([flat, jnp.zeros(pad, jnp.uint8)])
+        parts.append(flat)
+
+    for f, col in zip(batch.schema, batch.columns):
+        # decode derives the layout from the SCHEMA; a drifted device dtype
+        # would silently shift every later offset — fail at trace time
+        assert col.data.dtype == _decode_np_dtype(f.data_type), (
+            f.name,
+            col.data.dtype,
+            f.data_type,
+        )
+        assert (col.lengths is not None) == _has_lengths(f.data_type), f.name
+        if col.data.dtype == jnp.dtype(jnp.float64):
+            side.append(col.data.reshape(-1))
+        else:
+            add(col.data)
+        add(col.validity.astype(jnp.uint8))
+        if col.lengths is not None:
+            add(col.lengths)
+    # ONE f64 side leaf: each device_get leaf is a full round trip
+    # on a tunneled PJRT link (~35ms), so 8 float columns as 8
+    # leaves cost more than the whole data transfer
+    side_cat = jnp.concatenate(side) if side else jnp.zeros(0, jnp.float64)
+    return jnp.concatenate(parts), side_cat
+
+
+SPEC_PULL_PREFIX = 8192
+
+
+def device_to_host_speculative(batch: DeviceBatch):
+    """ONE-transfer fetch for small results: pull (true row count, pack of
+    the first SPEC_PULL_PREFIX rows) together; when the batch's live rows
+    fit the prefix, that single round trip IS the result — the usual
+    shrink-then-pull path pays two. Aggregate/TopN outputs (a handful of
+    rows in a capacity-sized batch) are exactly this shape, and on the
+    tunneled link every round trip is ~100ms. Returns (record_batch, None)
+    on success; (None, true_row_count) when the result does not fit so the
+    caller can shrink WITHOUT re-paying the row-count sync; (None, None)
+    for nested/small batches it does not handle."""
+    cap = batch.capacity
+    if cap <= SPEC_PULL_PREFIX or not batch.columns:
+        return None, None
+    if any(c.children is not None for c in batch.columns):
+        return None, None
+    from .. import kernels as K
+    from ..ops.gather import gather_column
+
+    widths = tuple(
+        c.data.shape[1] if c.data.ndim == 2 else None for c in batch.columns
+    )
+
     def make():
-        def to_bytes(flat):
-            """1-D array → little-endian uint8 bytes. 64-bit ints split into
-            (lo, hi) uint32 halves arithmetically (ops/bits.py): the TPU X64
-            emulation can't width-change bitcast 64-bit types."""
-            from ..ops.bits import i64_bytes_le
+        def run(b: DeviceBatch):
+            idx = jnp.arange(SPEC_PULL_PREFIX, dtype=jnp.int32)
+            cols = [gather_column(c, idx) for c in b.columns]
+            nb = DeviceBatch(
+                b.schema, cols, jnp.minimum(b.num_rows, SPEC_PULL_PREFIX)
+            )
+            flat, side = _pack_pure(nb)
+            return b.num_rows.astype(jnp.int32), flat, side
 
-            if flat.dtype == jnp.bool_:
-                return flat.astype(jnp.uint8)
-            if flat.dtype in (jnp.dtype(jnp.int64), jnp.dtype(jnp.uint64)):
-                return i64_bytes_le(flat)
-            if flat.dtype != jnp.uint8:
-                return jax.lax.bitcast_convert_type(flat, jnp.uint8).reshape(-1)
-            return flat
+        return K.GuardedJit(run)
 
-        def pack(batch: DeviceBatch):
-            parts = [to_bytes(batch.num_rows.astype(jnp.int64).reshape(1))]
-            side: list[jax.Array] = []
-
-            def add(arr):
-                flat = to_bytes(arr.reshape(-1))
-                pad = _pad8(flat.shape[0]) - flat.shape[0]
-                if pad:
-                    flat = jnp.concatenate([flat, jnp.zeros(pad, jnp.uint8)])
-                parts.append(flat)
-
-            for col in batch.columns:
-                if col.data.dtype == jnp.dtype(jnp.float64):
-                    side.append(col.data.reshape(-1))
-                else:
-                    add(col.data)
-                add(col.validity.astype(jnp.uint8))
-                if col.lengths is not None:
-                    add(col.lengths)
-            # ONE f64 side leaf: each device_get leaf is a full round trip
-            # on a tunneled PJRT link (~35ms), so 8 float columns as 8
-            # leaves cost more than the whole data transfer
-            side_cat = jnp.concatenate(side) if side else jnp.zeros(0, jnp.float64)
-            return jnp.concatenate(parts), side_cat
-
-        return K.GuardedJit(pack)
-
-    return K.kernel(("pack_d2h", schema, cap, widths), make)
+    kernel = K.kernel(("d2h_spec", batch.schema, cap, widths), make)
+    n_true, flat, side = jax.device_get(kernel(batch))
+    if int(n_true) > SPEC_PULL_PREFIX:
+        return None, int(n_true)
+    rb = _decode_packed(
+        batch.schema,
+        widths,
+        SPEC_PULL_PREFIX,
+        np.asarray(flat),
+        np.asarray(side),
+    )
+    return rb, None
 
 
 def device_to_host(batch: DeviceBatch, shrink: bool = True) -> pa.RecordBatch:
@@ -478,36 +539,56 @@ def device_to_host(batch: DeviceBatch, shrink: bool = True) -> pa.RecordBatch:
         c.data.shape[1] if c.data.ndim == 2 else None for c in batch.columns
     )
     flat, side = jax.device_get(_pack_kernel(batch.schema, cap, widths)(batch))
-    flat = np.asarray(flat)
-    side = np.asarray(side)
+    return _decode_packed(
+        batch.schema, widths, cap, np.asarray(flat), np.asarray(side)
+    )
+
+
+def _decode_np_dtype(dt: DataType) -> "np.dtype":
+    """Device storage dtype of a flat column (strings ride as uint8 byte
+    matrices; everything else stores its np_dtype)."""
+    if isinstance(dt, StringType):
+        return np.dtype(np.uint8)
+    return np.dtype(dt.np_dtype)
+
+
+def _has_lengths(dt: DataType) -> bool:
+    return isinstance(dt, StringType)
+
+
+def _decode_packed(
+    schema: Schema, widths: tuple, cap: int, flat: "np.ndarray", side: "np.ndarray"
+) -> pa.RecordBatch:
+    """Host-side decode of _pack_pure's flat layout → Arrow RecordBatch."""
     n = int(flat[:8].view(np.int64)[0])
     off = 8
     side_off = 0
     host_cols: list[DeviceColumn] = []
-    for f, col, w in zip(batch.schema, batch.columns, widths):
-        if col.data.dtype == jnp.dtype(jnp.float64):
+    for f, w in zip(schema, widths):
+        np_dt = _decode_np_dtype(f.data_type)
+        if np_dt == np.dtype(np.float64):
             count = cap * (w or 1)
             data = side[side_off : side_off + count]
             if w:
                 data = data.reshape(cap, w)
             side_off += count
         else:
-            itemsize = np.dtype(col.data.dtype).itemsize
+            itemsize = np_dt.itemsize
             count = cap * (w or 1)
             nbytes = count * itemsize
-            data = flat[off : off + nbytes].view(col.data.dtype)
+            data = flat[off : off + nbytes].view(np_dt)
             data = data.reshape(cap, w) if w else data
             off += _pad8(nbytes)
         validity = flat[off : off + cap].view(np.bool_)
         off += _pad8(cap)
         lengths = None
-        if col.lengths is not None:
+        if _has_lengths(f.data_type):
             lengths = flat[off : off + cap * 4].view(np.int32)
             off += _pad8(cap * 4)
         host_cols.append(DeviceColumn(f.data_type, data, validity, lengths))
     arrays: list[pa.Array] = []
     fields: list[pa.Field] = []
-    for f, col in zip(batch.schema, host_cols):
+    for f, col in zip(schema, host_cols):
         dt = f.data_type
         valid = np.asarray(col.validity)[: max(n, 0)].astype(bool)
         if isinstance(dt, StringType):

@@ -257,6 +257,25 @@ class DeviceToHostExec(Exec):
         time_m = self.metric("deviceToHostTime", "MODERATE")
         timing = self.metrics_on(ctx, "MODERATE")
 
+        # speculate only below execs whose results are usually tiny
+        # relative to capacity (a big scan/filter single batch would pay a
+        # guaranteed-wasted prefix round trip)
+        def _result_shrinking(node) -> bool:
+            while isinstance(
+                node, (TpuCoalescePartitionsExec, TpuCoalesceBatchesExec)
+            ):
+                node = node.children[0]
+            return isinstance(
+                node,
+                (
+                    TpuHashAggregateExec,
+                    TpuTakeOrderedAndProjectExec,
+                    TpuLimitExec,
+                ),
+            )
+
+        speculate = _result_shrinking(self.children[0])
+
         def fn(it):
             from itertools import islice
 
@@ -271,6 +290,28 @@ class DeviceToHostExec(Exec):
                 chunk = list(islice(it, 8))
                 if not chunk:
                     return
+                if speculate and len(chunk) == 1:
+                    # single batch below a result-shrinking exec (aggregate
+                    # / TopN / limit): try the ONE-round-trip speculative
+                    # pull before paying the shrink sync + pull pair
+                    from ..columnar.device import device_to_host_speculative
+                    from ..ops.gather import shrink_one
+
+                    if timing:
+                        with time_m.timed():
+                            rb, n_true = device_to_host_speculative(chunk[0])
+                    else:
+                        rb, n_true = device_to_host_speculative(chunk[0])
+                    if rb is not None:
+                        ctx.semaphore.release_if_necessary()
+                        if rb.num_rows:
+                            rows_m.add(rb.num_rows)
+                            yield rb
+                        continue
+                    if n_true is not None:
+                        # the count came back with the failed speculation —
+                        # shrink without a second sync
+                        chunk = [shrink_one(chunk[0], n_true)]
                 shrunk = bulk_shrink(chunk)
                 # merge SMALL shrunk batches on device: every pull is a full
                 # tunnel round trip, so 8 tiny result batches as one packed
