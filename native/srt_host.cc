@@ -1,0 +1,323 @@
+// srt_host — native host-runtime data plane for the TPU Spark accelerator.
+//
+// The reference keeps its hot host-side runtime in native code (cuDF's
+// JCudfSerialization contiguous tables, RMM/AddressSpaceAllocator.scala:22
+// sub-allocation, spark-exact murmur3 inside libcudf). The TPU build keeps
+// the same split: XLA is the device compute path, and this library is the
+// native host data plane — columnar murmur3 (HashFunctions.scala semantics),
+// a best-fit address-space sub-allocator (AddressSpaceAllocator.scala
+// analogue) for staging arenas, and a contiguous multi-buffer frame codec
+// (the GpuColumnVectorFromBuffer / JCudfSerialization "one contiguous
+// buffer" spill+shuffle currency).
+//
+// C ABI only; loaded from python via ctypes (spark_rapids_tpu/native).
+
+#include <cstdint>
+#include <cstring>
+#include <map>
+#include <new>
+
+extern "C" {
+
+// ---------------------------------------------------------------------------
+// version / feature probe
+// ---------------------------------------------------------------------------
+
+int32_t srt_version() { return 1; }
+
+// ---------------------------------------------------------------------------
+// Spark-exact murmur3 (x86_32 variant, per-row running seed).
+//
+// Matches org.apache.spark.sql.catalyst.expressions.Murmur3Hash /
+// the device kernels in ops/hash.py: each column updates a per-row running
+// hash h[i]; NULL rows leave h[i] unchanged.
+// ---------------------------------------------------------------------------
+
+static const uint32_t C1 = 0xcc9e2d51u;
+static const uint32_t C2 = 0x1b873593u;
+static const uint32_t M5 = 0xe6546b64u;
+
+static inline uint32_t rotl32(uint32_t x, int r) {
+  return (x << r) | (x >> (32 - r));
+}
+
+static inline uint32_t mix_k1(uint32_t k1) {
+  k1 *= C1;
+  k1 = rotl32(k1, 15);
+  return k1 * C2;
+}
+
+static inline uint32_t mix_h1(uint32_t h1, uint32_t k1) {
+  h1 ^= k1;
+  h1 = rotl32(h1, 13);
+  return h1 * 5u + M5;
+}
+
+static inline uint32_t fmix(uint32_t h1, uint32_t length) {
+  h1 ^= length;
+  h1 ^= h1 >> 16;
+  h1 *= 0x85ebca6bu;
+  h1 ^= h1 >> 13;
+  h1 *= 0xc2b2ae35u;
+  return h1 ^ (h1 >> 16);
+}
+
+static inline uint32_t hash_int32(uint32_t x, uint32_t seed) {
+  return fmix(mix_h1(seed, mix_k1(x)), 4);
+}
+
+static inline uint32_t hash_int64(uint64_t x, uint32_t seed) {
+  uint32_t low = (uint32_t)(x & 0xffffffffu);
+  uint32_t high = (uint32_t)((x >> 32) & 0xffffffffu);
+  uint32_t h1 = mix_h1(seed, mix_k1(low));
+  h1 = mix_h1(h1, mix_k1(high));
+  return fmix(h1, 8);
+}
+
+// valid: uint8[n] (1 = non-null) or NULL meaning all-valid.
+void srt_mm3_i32(const int32_t* x, const uint8_t* valid, uint32_t* h,
+                 int64_t n) {
+  for (int64_t i = 0; i < n; ++i)
+    if (!valid || valid[i]) h[i] = hash_int32((uint32_t)x[i], h[i]);
+}
+
+void srt_mm3_i64(const int64_t* x, const uint8_t* valid, uint32_t* h,
+                 int64_t n) {
+  for (int64_t i = 0; i < n; ++i)
+    if (!valid || valid[i]) h[i] = hash_int64((uint64_t)x[i], h[i]);
+}
+
+void srt_mm3_bool(const uint8_t* x, const uint8_t* valid, uint32_t* h,
+                  int64_t n) {
+  for (int64_t i = 0; i < n; ++i)
+    if (!valid || valid[i]) h[i] = hash_int32(x[i] ? 1u : 0u, h[i]);
+}
+
+// float/double: Spark normalizes -0.0 -> 0.0 and the JVM collapses NaNs to
+// the canonical bit pattern before hashing the raw bits.
+void srt_mm3_f32(const float* x, const uint8_t* valid, uint32_t* h,
+                 int64_t n) {
+  for (int64_t i = 0; i < n; ++i) {
+    if (valid && !valid[i]) continue;
+    float v = x[i];
+    if (v == 0.0f) v = 0.0f;  // folds -0.0
+    uint32_t bits;
+    if (v != v)
+      bits = 0x7fc00000u;  // Float.floatToIntBits canonical NaN
+    else
+      std::memcpy(&bits, &v, 4);
+    h[i] = hash_int32(bits, h[i]);
+  }
+}
+
+void srt_mm3_f64(const double* x, const uint8_t* valid, uint32_t* h,
+                 int64_t n) {
+  for (int64_t i = 0; i < n; ++i) {
+    if (valid && !valid[i]) continue;
+    double v = x[i];
+    if (v == 0.0) v = 0.0;
+    uint64_t bits;
+    if (v != v)
+      bits = 0x7ff8000000000000ull;  // Double.doubleToLongBits canonical NaN
+    else
+      std::memcpy(&bits, &v, 8);
+    h[i] = hash_int64(bits, h[i]);
+  }
+}
+
+// hashUnsafeBytes over padded rows: data is [n, width] row-major u8 with
+// per-row byte lengths. Words are consumed 4-at-a-time little-endian; the
+// tail byte-by-byte sign-extended (matches ops/hash.py hash_bytes_padded).
+void srt_mm3_bytes(const uint8_t* data, const int32_t* lengths,
+                   const uint8_t* valid, uint32_t* h, int64_t n,
+                   int64_t width) {
+  for (int64_t i = 0; i < n; ++i) {
+    if (valid && !valid[i]) continue;
+    const uint8_t* row = data + i * width;
+    int32_t len = lengths[i];
+    uint32_t h1 = h[i];
+    int32_t nwords = len / 4;
+    for (int32_t w = 0; w < nwords; ++w) {
+      uint32_t word;
+      std::memcpy(&word, row + 4 * w, 4);  // little-endian host
+      h1 = mix_h1(h1, mix_k1(word));
+    }
+    for (int32_t b = nwords * 4; b < len; ++b) {
+      int32_t sb = (int8_t)row[b];  // sign-extend
+      h1 = mix_h1(h1, mix_k1((uint32_t)sb));
+    }
+    h[i] = fmix(h1, (uint32_t)len);
+  }
+}
+
+// Pmod(hash, n) partition bucketing over a finished row-hash vector.
+void srt_pmod_i32(const int32_t* h, int32_t* out, int64_t n, int32_t parts) {
+  for (int64_t i = 0; i < n; ++i) {
+    int32_t m = h[i] % parts;
+    out[i] = m < 0 ? m + parts : m;
+  }
+}
+
+// ---------------------------------------------------------------------------
+// Best-fit address-space sub-allocator (AddressSpaceAllocator.scala:22).
+//
+// Allocates offsets within one externally-owned arena (a host staging
+// buffer / pinned pool). Best-fit over a size-ordered free map, coalescing
+// neighbours on free — the same strategy the reference uses for its pinned
+// host pool sub-allocation.
+// ---------------------------------------------------------------------------
+
+struct Asa {
+  uint64_t size;
+  uint64_t allocated;
+  // offset -> length of free blocks (address-ordered, for coalescing)
+  std::map<uint64_t, uint64_t> free_by_addr;
+  // offset -> length of live allocations
+  std::map<uint64_t, uint64_t> live;
+};
+
+void* srt_asa_create(uint64_t size) {
+  Asa* a = new (std::nothrow) Asa();
+  if (!a) return nullptr;
+  a->size = size;
+  a->allocated = 0;
+  a->free_by_addr[0] = size;
+  return a;
+}
+
+void srt_asa_destroy(void* p) { delete (Asa*)p; }
+
+// Returns the allocated offset, or -1 when no free block fits.
+int64_t srt_asa_alloc(void* p, uint64_t size) {
+  Asa* a = (Asa*)p;
+  if (size == 0) size = 1;
+  // best fit: smallest free block with length >= size
+  std::map<uint64_t, uint64_t>::iterator best = a->free_by_addr.end();
+  uint64_t best_len = ~0ull;
+  for (auto it = a->free_by_addr.begin(); it != a->free_by_addr.end(); ++it) {
+    if (it->second >= size && it->second < best_len) {
+      best = it;
+      best_len = it->second;
+      if (best_len == size) break;
+    }
+  }
+  if (best == a->free_by_addr.end()) return -1;
+  uint64_t off = best->first;
+  uint64_t len = best->second;
+  a->free_by_addr.erase(best);
+  if (len > size) a->free_by_addr[off + size] = len - size;
+  a->live[off] = size;
+  a->allocated += size;
+  return (int64_t)off;
+}
+
+// Returns the freed length, or -1 if the offset is not a live allocation.
+int64_t srt_asa_free(void* p, uint64_t off) {
+  Asa* a = (Asa*)p;
+  auto it = a->live.find(off);
+  if (it == a->live.end()) return -1;
+  uint64_t len = it->second;
+  a->live.erase(it);
+  a->allocated -= len;
+  // insert and coalesce with address-adjacent free neighbours
+  auto ins = a->free_by_addr.emplace(off, len).first;
+  if (ins != a->free_by_addr.begin()) {
+    auto prev = std::prev(ins);
+    if (prev->first + prev->second == ins->first) {
+      prev->second += ins->second;
+      a->free_by_addr.erase(ins);
+      ins = prev;
+    }
+  }
+  auto next = std::next(ins);
+  if (next != a->free_by_addr.end() &&
+      ins->first + ins->second == next->first) {
+    ins->second += next->second;
+    a->free_by_addr.erase(next);
+  }
+  return (int64_t)len;
+}
+
+uint64_t srt_asa_allocated(void* p) { return ((Asa*)p)->allocated; }
+uint64_t srt_asa_available(void* p) {
+  Asa* a = (Asa*)p;
+  return a->size - a->allocated;
+}
+int64_t srt_asa_largest_free(void* p) {
+  Asa* a = (Asa*)p;
+  uint64_t best = 0;
+  for (auto& kv : a->free_by_addr)
+    if (kv.second > best) best = kv.second;
+  return (int64_t)best;
+}
+
+// ---------------------------------------------------------------------------
+// Contiguous multi-buffer frame codec.
+//
+// Packs N byte buffers into ONE contiguous frame with 8-byte-aligned
+// payloads — the spill/shuffle currency the reference carries as a single
+// contiguous device buffer (GpuColumnVectorFromBuffer.java,
+// JCudfSerialization). Layout:
+//   magic  u32 'SRTF'   version u32
+//   nbufs  u32          pad u32
+//   lens   u64[nbufs]
+//   payloads, each 8-byte aligned
+// ---------------------------------------------------------------------------
+
+static const uint32_t FRAME_MAGIC = 0x46545253u;  // "SRTF" LE
+static const uint32_t FRAME_VERSION = 1;
+
+static inline uint64_t align8(uint64_t x) { return (x + 7) & ~7ull; }
+
+int64_t srt_frame_size(const uint64_t* lens, int32_t nbufs) {
+  uint64_t sz = 16 + 8ull * nbufs;
+  for (int32_t i = 0; i < nbufs; ++i) sz = align8(sz) + lens[i];
+  return (int64_t)sz;
+}
+
+// bufs: array of nbufs pointers; returns bytes written or -1 on overflow.
+int64_t srt_frame_pack(const uint8_t** bufs, const uint64_t* lens,
+                       int32_t nbufs, uint8_t* out, uint64_t out_cap) {
+  uint64_t need = (uint64_t)srt_frame_size(lens, nbufs);
+  if (out_cap < need) return -1;
+  uint32_t hdr[4] = {FRAME_MAGIC, FRAME_VERSION, (uint32_t)nbufs, 0};
+  std::memcpy(out, hdr, 16);
+  std::memcpy(out + 16, lens, 8ull * nbufs);
+  uint64_t off = 16 + 8ull * nbufs;
+  for (int32_t i = 0; i < nbufs; ++i) {
+    uint64_t aligned = align8(off);
+    if (aligned > off) std::memset(out + off, 0, aligned - off);
+    off = aligned;
+    if (lens[i]) std::memcpy(out + off, bufs[i], lens[i]);
+    off += lens[i];
+  }
+  return (int64_t)off;
+}
+
+// Returns nbufs, or -1 on a malformed frame.
+int32_t srt_frame_count(const uint8_t* data, uint64_t len) {
+  if (len < 16) return -1;
+  uint32_t hdr[4];
+  std::memcpy(hdr, data, 16);
+  if (hdr[0] != FRAME_MAGIC || hdr[1] != FRAME_VERSION) return -1;
+  return (int32_t)hdr[2];
+}
+
+// Fills offs/lens (caller-sized to srt_frame_count); returns 0 or -1.
+int32_t srt_frame_unpack(const uint8_t* data, uint64_t len, uint64_t* offs,
+                         uint64_t* lens, int32_t cap) {
+  int32_t nbufs = srt_frame_count(data, len);
+  if (nbufs < 0 || nbufs > cap) return -1;
+  if (len < 16 + 8ull * nbufs) return -1;
+  std::memcpy(lens, data + 16, 8ull * nbufs);
+  uint64_t off = 16 + 8ull * nbufs;
+  for (int32_t i = 0; i < nbufs; ++i) {
+    off = align8(off);
+    if (off + lens[i] > len) return -1;
+    offs[i] = off;
+    off += lens[i];
+  }
+  return 0;
+}
+
+}  // extern "C"

@@ -483,19 +483,25 @@ def device_to_host_speculative(batch: DeviceBatch):
                 b.schema, cols, jnp.minimum(b.num_rows, SPEC_PULL_PREFIX)
             )
             flat, side = _pack_pure(nb)
-            return b.num_rows.astype(jnp.int32), flat, side
+            # the TRUE row count rides as an extra 8-byte header word in the
+            # SAME flat buffer — a separate leaf would be its own round trip
+            # on a tunneled PJRT link, defeating the one-transfer point
+            true_hdr = _pack_to_bytes(b.num_rows.astype(jnp.int64).reshape(1))
+            return jnp.concatenate([true_hdr, flat]), side
 
         return K.GuardedJit(run)
 
     kernel = K.kernel(("d2h_spec", batch.schema, cap, widths), make)
-    n_true, flat, side = jax.device_get(kernel(batch))
-    if int(n_true) > SPEC_PULL_PREFIX:
-        return None, int(n_true)
+    flat, side = jax.device_get(kernel(batch))
+    flat = np.asarray(flat)
+    n_true = int(flat[:8].view(np.int64)[0])
+    if n_true > SPEC_PULL_PREFIX:
+        return None, n_true
     rb = _decode_packed(
         batch.schema,
         widths,
         SPEC_PULL_PREFIX,
-        np.asarray(flat),
+        flat[8:],
         np.asarray(side),
     )
     return rb, None

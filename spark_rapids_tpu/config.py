@@ -112,6 +112,14 @@ SQL_ENABLED = conf("spark.rapids.sql.enabled").doc(
     "Enable (true) or disable (false) TPU acceleration of SQL operators."
 ).boolean_conf(True)
 
+NATIVE_ENABLED = conf("spark.rapids.native.enabled").doc(
+    "Use the native (C++) host data plane — Spark-exact murmur3 hashing, "
+    "the best-fit staging-arena sub-allocator, and contiguous spill frames "
+    "(built from native/srt_host.cc; auto-compiled with g++ on first use). "
+    "Pure-python/numpy fallbacks run when disabled or when no toolchain is "
+    "available."
+).boolean_conf(True)
+
 EXPLAIN = conf("spark.rapids.sql.explain").doc(
     "Explain why parts of a query were or were not placed on the TPU: "
     "NONE, NOT_ON_GPU (only log un-replaced nodes), ALL."

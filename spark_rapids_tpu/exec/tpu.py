@@ -290,6 +290,7 @@ class DeviceToHostExec(Exec):
                 chunk = list(islice(it, 8))
                 if not chunk:
                     return
+                shrunk = None
                 if speculate and len(chunk) == 1:
                     # single batch below a result-shrinking exec (aggregate
                     # / TopN / limit): try the ONE-round-trip speculative
@@ -310,9 +311,11 @@ class DeviceToHostExec(Exec):
                         continue
                     if n_true is not None:
                         # the count came back with the failed speculation —
-                        # shrink without a second sync
-                        chunk = [shrink_one(chunk[0], n_true)]
-                shrunk = bulk_shrink(chunk)
+                        # shrink without a second sync (and skip bulk_shrink,
+                        # whose row-count fetch would re-pay that sync)
+                        shrunk = [shrink_one(chunk[0], n_true)]
+                if shrunk is None:
+                    shrunk = bulk_shrink(chunk)
                 # merge SMALL shrunk batches on device: every pull is a full
                 # tunnel round trip, so 8 tiny result batches as one packed
                 # transfer beat 8 separate ones by ~8 RTTs

@@ -181,6 +181,54 @@ def _hash_scalar_np(dt: DataType, value, seed_u32: np.uint32) -> np.uint32:
     return np.uint32(one[0])
 
 
+def _native_hash_column(dt: DataType, data, valid, lengths, seed_u32):
+    """Host path through the C++ murmur3 kernels (native/srt_host.cc;
+    bit-identical to the numpy path, differential-tested in
+    tests/test_native.py). Returns uint32[n] or None when native is
+    unavailable/disabled or the column isn't native-eligible."""
+    from .. import native
+
+    if not native.available():
+        return None
+    if isinstance(dt, StringType):
+        if getattr(data, "ndim", 1) != 2 or lengths is None:
+            data, lengths = np_strings_to_padded(
+                data, np.asarray(valid).astype(bool)
+            )
+        n = data.shape[0]
+        h = np.ascontiguousarray(
+            np.broadcast_to(np.asarray(seed_u32, dtype=np.uint32), (n,))
+        ).copy()
+        native.murmur3_update(
+            "bytes",
+            np.ascontiguousarray(data, dtype=np.uint8),
+            valid,
+            h,
+            np.ascontiguousarray(lengths, dtype=np.int32),
+        )
+        return h
+    if isinstance(dt, BooleanType):
+        kind, arr = "bool", np.ascontiguousarray(data, dtype=np.uint8)
+    elif isinstance(dt, (LongType, TimestampType)):
+        kind, arr = "i64", np.ascontiguousarray(data, dtype=np.int64)
+    elif isinstance(dt, DecimalType):
+        if dt.precision > 18:
+            return None
+        kind, arr = "i64", np.ascontiguousarray(data, dtype=np.int64)
+    elif isinstance(dt, FloatType):
+        kind, arr = "f32", np.ascontiguousarray(data, dtype=np.float32)
+    elif isinstance(dt, DoubleType):
+        kind, arr = "f64", np.ascontiguousarray(data, dtype=np.float64)
+    else:  # byte/short/int/date
+        kind, arr = "i32", np.ascontiguousarray(data, dtype=np.int32)
+    n = arr.shape[0]
+    h = np.ascontiguousarray(
+        np.broadcast_to(np.asarray(seed_u32, dtype=np.uint32), (n,))
+    ).copy()
+    native.murmur3_update(kind, arr, valid, h)
+    return h
+
+
 def hash_column(xp, dt: DataType, data, valid, lengths, seed_u32):
     """One column's contribution: returns the new running hash (uint32[n]),
     leaving rows with NULL unchanged (Spark semantics)."""
@@ -195,6 +243,10 @@ def hash_column(xp, dt: DataType, data, valid, lengths, seed_u32):
             if v[i] and data[i] is not None:
                 out[i] = _hash_scalar_np(dt, data[i], seeds[i])
         return out
+    if xp is np:
+        nh = _native_hash_column(dt, data, valid, lengths, seed_u32)
+        if nh is not None:
+            return nh
     if isinstance(dt, StringType):
         if xp is np and (getattr(data, "ndim", 1) != 2 or lengths is None):
             data, lengths = np_strings_to_padded(data, np.asarray(valid).astype(bool))
@@ -242,5 +294,10 @@ def murmur3_rows(xp, cols: list[tuple[DataType, Any, Any, Any]], n: int, seed: i
 
 def partition_ids(xp, row_hash_i32, num_partitions: int):
     """Spark's ``Pmod(hash, n)`` — non-negative modulus."""
+    if xp is np:
+        from .. import native
+
+        if native.available():
+            return native.pmod(row_hash_i32, num_partitions)
     m = row_hash_i32 % np.int32(num_partitions)
     return xp.where(m < 0, m + np.int32(num_partitions), m).astype(xp.int32)

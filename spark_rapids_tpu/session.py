@@ -48,6 +48,10 @@ class TpuSession:
             import jax
 
             jax.config.update("jax_platforms", "cpu")
+        # native host data plane gate (spark.rapids.native.enabled)
+        from . import native as _native
+
+        _native.set_enabled(cfg.NATIVE_ENABLED.get(self.conf))
         self._mesh_ctx = None
         if cfg.MESH_ENABLED.get(self.conf):
             # mesh mode: one exchange partition per chip, so the planner's

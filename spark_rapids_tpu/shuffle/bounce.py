@@ -52,10 +52,10 @@ def windowed_blocks(
 
 
 class BounceBuffer:
-    def __init__(self, pool: "BounceBufferManager", index: int, size: int):
+    def __init__(self, pool: "BounceBufferManager", offset: Optional[int], data):
         self._pool = pool
-        self.index = index
-        self.data = bytearray(size)
+        self.offset = offset  # arena offset (native mode) or None
+        self.data = data
 
     def close(self):
         self._pool.release(self)
@@ -70,35 +70,71 @@ class BounceBuffer:
 class BounceBufferManager:
     """Fixed pool of host staging buffers; acquire blocks when exhausted
     (BounceBufferManager.scala). The pool bound is what keeps a slow peer
-    from ballooning host memory."""
+    from ballooning host memory.
+
+    With the native data plane available, buffers are sub-allocated from ONE
+    contiguous arena through the best-fit AddressSpaceAllocator
+    (AddressSpaceAllocator.scala:22 — the reference carves its bounce
+    buffers out of a single pinned allocation the same way); otherwise each
+    buffer is its own bytearray."""
 
     def __init__(self, buffer_size: int, num_buffers: int):
+        from .. import native
+
         self.buffer_size = buffer_size
         self.num_buffers = num_buffers
-        self._free: List[BounceBuffer] = [
-            BounceBuffer(self, i, buffer_size) for i in range(num_buffers)
-        ]
+        self._outstanding = 0
         self._lock = threading.Condition()
+        self._recycled: List = []  # released data buffers, reused on acquire
+        if native.available():
+            cap = buffer_size * num_buffers
+            self._arena: Optional[memoryview] = memoryview(bytearray(cap))
+            self._asa = native.AddressSpaceAllocator(cap)
+        else:
+            self._arena = None
+            self._asa = None
+
+    def _make(self) -> BounceBuffer:
+        if self._recycled:
+            off, data = self._recycled.pop()
+            return BounceBuffer(self, off, data)
+        if self._asa is not None:
+            off = self._asa.alloc(self.buffer_size)
+            if off is None:  # can't happen with uniform sizes; fail loudly
+                raise RuntimeError("bounce arena fragmented")
+            return BounceBuffer(
+                self, off, self._arena[off : off + self.buffer_size]
+            )
+        return BounceBuffer(self, None, bytearray(self.buffer_size))
 
     def acquire(self, timeout: Optional[float] = None) -> BounceBuffer:
         with self._lock:
-            if not self._lock.wait_for(lambda: self._free, timeout):
+            if not self._lock.wait_for(
+                lambda: self._outstanding < self.num_buffers, timeout
+            ):
                 raise TimeoutError("bounce buffer pool exhausted")
-            return self._free.pop()
+            self._outstanding += 1
+            return self._make()
 
     def try_acquire(self) -> Optional[BounceBuffer]:
         with self._lock:
-            return self._free.pop() if self._free else None
+            if self._outstanding >= self.num_buffers:
+                return None
+            self._outstanding += 1
+            return self._make()
 
     def release(self, buf: BounceBuffer):
         with self._lock:
-            self._free.append(buf)
+            self._recycled.append((buf.offset, buf.data))
+            buf.offset = None
+            buf.data = None
+            self._outstanding -= 1
             self._lock.notify()
 
     @property
     def free_count(self) -> int:
         with self._lock:
-            return len(self._free)
+            return self.num_buffers - self._outstanding
 
 
 class BufferSendState:
