@@ -113,3 +113,130 @@ class CpuFlatMapGroupsInPandasExec(Exec):
             f"CpuFlatMapGroupsInPandas {self.grouping} "
             f"{getattr(self.fn, '__name__', 'fn')}"
         )
+
+
+def _group_map(pdf, keys):
+    """key tuple → group DataFrame (dropna=False: NULL keys group; insertion
+    order preserved)."""
+    out = {}
+    if not len(pdf):
+        return out
+    for key, group in pdf.groupby(keys, dropna=False, sort=False):
+        if not isinstance(key, tuple):
+            key = (key,)
+        # NaN keys are not equal to themselves; normalize for matching
+        norm = tuple(None if (isinstance(k, float) and k != k) else k for k in key)
+        out[norm] = group.reset_index(drop=True)
+    return out
+
+
+class CpuFlatMapCoGroupsInPandasExec(Exec):
+    """``fn(left_pd, right_pd) -> pd.DataFrame`` once per key group present
+    on either side; the planner exchanges both children by their keys with
+    the same arity so co-grouped keys land in the same partition pair
+    (reference GpuFlatMapCoGroupsInPandasExec)."""
+
+    def __init__(self, left_keys, right_keys, fn, schema: Schema, left: Exec, right: Exec):
+        super().__init__([left, right])
+        self.left_keys = list(left_keys)
+        self.right_keys = list(right_keys)
+        self.fn = fn
+        self._schema = schema
+
+    @property
+    def output(self) -> Schema:
+        return self._schema
+
+    def execute(self, ctx: ExecContext) -> PartitionSet:
+        fn, schema = self.fn, self._schema
+        lk, rk = self.left_keys, self.right_keys
+        lschema = self.children[0].output.to_arrow()
+        rschema = self.children[1].output.to_arrow()
+        lparts = self.children[0].execute(ctx)
+        rparts = self.children[1].execute(ctx)
+        assert lparts.num_partitions == rparts.num_partitions, (
+            "cogroup sides must be co-partitioned"
+        )
+
+        def make(lt, rt):
+            def run():
+                lpdf = pa.Table.from_batches(list(lt()), schema=lschema).to_pandas()
+                rpdf = pa.Table.from_batches(list(rt()), schema=rschema).to_pandas()
+                lgroups = _group_map(lpdf, lk)
+                rgroups = _group_map(rpdf, rk)
+                lempty = lpdf.iloc[0:0]
+                rempty = rpdf.iloc[0:0]
+                keys = list(lgroups) + [k for k in rgroups if k not in lgroups]
+                for key in keys:
+                    out = fn(
+                        lgroups.get(key, lempty), rgroups.get(key, rempty)
+                    )
+                    yield from _df_to_batches(out, schema, "cogroup applyInPandas fn")
+
+            return run
+
+        return PartitionSet(
+            [make(lt, rt) for lt, rt in zip(lparts.parts, rparts.parts)]
+        )
+
+    def node_string(self):
+        return (
+            f"CpuFlatMapCoGroupsInPandas {self.left_keys}/{self.right_keys} "
+            f"{getattr(self.fn, '__name__', 'fn')}"
+        )
+
+
+class CpuAggregateInPandasExec(Exec):
+    """GROUPED_AGG pandas UDFs: one scalar per (group, udf); output is
+    grouping columns ++ udf results (reference GpuAggregateInPandasExec).
+    ``udfs``: list of (out_name, fn, return_type, arg_col_names)."""
+
+    def __init__(self, grouping: List[str], udfs, schema: Schema, child: Exec):
+        super().__init__([child])
+        self.grouping = list(grouping)
+        self.udfs = list(udfs)
+        self._schema = schema
+
+    @property
+    def output(self) -> Schema:
+        return self._schema
+
+    def execute(self, ctx: ExecContext) -> PartitionSet:
+        schema, keys, udfs = self._schema, self.grouping, self.udfs
+        child_schema = self.children[0].output.to_arrow()
+
+        def run(it: Iterator[pa.RecordBatch]):
+            import pandas as pd
+
+            batches = list(it)
+            pdf = pa.Table.from_batches(batches, schema=child_schema).to_pandas()
+            if keys and not len(pdf):
+                return
+            rows: dict = {f.name: [] for f in schema.to_arrow()}
+            if keys:
+                groups = pdf.groupby(keys, dropna=False, sort=False)
+            else:
+                # keyless global aggregate: exactly one output row even for
+                # empty input (Spark emits the UDF over an empty frame)
+                groups = [((), pdf)]
+            for key, group in groups:
+                if not isinstance(key, tuple):
+                    key = (key,)
+                for name, k in zip(keys, key):
+                    rows[name].append(
+                        None if (isinstance(k, float) and k != k) else k
+                    )
+                for out_name, fn, _rt, arg_names in udfs:
+                    rows[out_name].append(
+                        fn(*[group[a].reset_index(drop=True) for a in arg_names])
+                    )
+            out = pd.DataFrame(rows)
+            yield from _df_to_batches(out, schema, "grouped-agg pandas UDF")
+
+        return self.children[0].execute(ctx).map_partitions(run)
+
+    def node_string(self):
+        return (
+            f"CpuAggregateInPandas {self.grouping} "
+            f"[{', '.join(u[0] for u in self.udfs)}]"
+        )

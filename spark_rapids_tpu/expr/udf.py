@@ -69,6 +69,86 @@ class JaxUdf(Expression):
         return f"{self.name}({', '.join(map(str, self.args))})"
 
 
+def np_to_series(dt, d: "np.ndarray", m: "np.ndarray"):
+    """numpy column (+validity mask) → pandas Series under the Arrow→pandas
+    null convention: datetime64/NaT for timestamps and dates, NaN for
+    floats, None for objects, int/bool-with-nulls widened to float64.
+    ``d`` must be a mutable copy (null slots are overwritten)."""
+    import pandas as pd
+
+    from ..types import DateType, TimestampType
+
+    if isinstance(dt, TimestampType):
+        s_in = pd.Series(pd.to_datetime(d.astype(np.int64), unit="us"))
+        s_in[~m] = pd.NaT
+        return s_in
+    if isinstance(dt, DateType):
+        s_in = pd.Series(pd.to_datetime(d.astype(np.int64), unit="D"))
+        s_in[~m] = pd.NaT
+        return s_in
+    if d.dtype == object:
+        d[~m] = None
+        return pd.Series(d)
+    if np.issubdtype(d.dtype, np.floating):
+        d[~m] = np.nan
+        return pd.Series(d)
+    if (~m).any():
+        # Arrow→pandas: integer/bool columns with nulls widen
+        f = d.astype(np.float64)
+        f[~m] = np.nan
+        return pd.Series(f)
+    return pd.Series(d)
+
+
+def scalar_from_agg_result(dt, value):
+    """One grouped-agg UDF result scalar → (np value, valid) under the
+    declared return type (NaN/None/NaT → null)."""
+    import pandas as pd
+
+    from ..types import DateType, StringType, TimestampType
+
+    if value is None or (
+        isinstance(value, (float, np.floating)) and np.isnan(value)
+    ) or (value is pd.NaT):
+        return np.zeros((), dtype=object if isinstance(dt, StringType) else dt.np_dtype), False
+    if isinstance(dt, StringType):
+        return str(value), True
+    if isinstance(dt, (TimestampType, DateType)):
+        unit = "us" if isinstance(dt, TimestampType) else "D"
+        ts = pd.to_datetime(value)
+        if ts is pd.NaT:
+            return np.zeros((), dtype=dt.np_dtype), False
+        return np.datetime64(ts).astype(f"datetime64[{unit}]").astype(np.int64).astype(dt.np_dtype), True
+    return np.asarray(value).astype(dt.np_dtype), True
+
+
+@dataclass(frozen=True)
+class GroupedAggUdf(Expression):
+    """Grouped-aggregate pandas UDF (pyspark ``pandas_udf`` GROUPED_AGG
+    flavor): ``fn`` receives pandas Series covering ONE key group (or one
+    window frame) and returns a scalar. Consumed by
+    CpuAggregateInPandasExec and the CPU window exec — the reference's
+    GpuAggregateInPandasExec / GpuWindowInPandasExecBase pair."""
+
+    fn: Callable
+    return_type: DataType
+    args: Tuple[Expression, ...]
+    name: str = "pandas_agg_udf"
+
+    @property
+    def data_type(self) -> DataType:
+        return self.return_type
+
+    def eval(self, ctx: Ctx) -> Val:  # pragma: no cover - planner routes
+        raise AssertionError(
+            "grouped-agg pandas UDFs are evaluated by AggregateInPandas / "
+            "window execs, not as row expressions"
+        )
+
+    def __str__(self):
+        return f"{self.name}({', '.join(map(str, self.args))})"
+
+
 @dataclass(frozen=True)
 class VectorizedUdf(Expression):
     """Batch-vectorized (pandas-style) python UDF: ``fn`` receives pandas
@@ -102,29 +182,7 @@ class VectorizedUdf(Expression):
                 np.broadcast_to(np.asarray(v.data), (ctx.n,)), copy=True
             )
             m = ctx.broadcast_bool(v.valid)
-            dt = a.data_type
-            if isinstance(dt, TimestampType):
-                # Arrow→pandas convention: datetime64 Series, NaT for nulls
-                s_in = pd.Series(pd.to_datetime(d.astype(np.int64), unit="us"))
-                s_in[~m] = pd.NaT
-                series.append(s_in)
-            elif isinstance(dt, DateType):
-                s_in = pd.Series(pd.to_datetime(d.astype(np.int64), unit="D"))
-                s_in[~m] = pd.NaT
-                series.append(s_in)
-            elif d.dtype == object:
-                d[~m] = None
-                series.append(pd.Series(d))
-            elif np.issubdtype(d.dtype, np.floating):
-                d[~m] = np.nan
-                series.append(pd.Series(d))
-            elif (~m).any():
-                # Arrow→pandas: integer/bool columns with nulls widen
-                f = d.astype(np.float64)
-                f[~m] = np.nan
-                series.append(pd.Series(f))
-            else:
-                series.append(pd.Series(d))
+            series.append(np_to_series(a.data_type, d, m))
         out = self.fn(*series)
         s = pd.Series(out) if not isinstance(out, pd.Series) else out
         if len(s) != ctx.n:

@@ -907,21 +907,36 @@ def udf(f=None, returnType=None):
     return wrap(f)
 
 
-def pandas_udf(f=None, returnType=None):
-    """Batch-vectorized python UDF (pyspark pandas_udf scalar flavor):
-    ``fn(*series: pd.Series) -> pd.Series`` called once per batch — the
-    GpuArrowEvalPythonExec data path. CPU engine; the plan falls back
-    per-node with a reason."""
+def pandas_udf(f=None, returnType=None, functionType="scalar"):
+    """Batch-vectorized python UDF (pyspark ``pandas_udf``). Flavors:
+
+    * ``"scalar"`` (default): ``fn(*series) -> series`` once per batch —
+      the GpuArrowEvalPythonExec data path.
+    * ``"grouped_agg"``: ``fn(*series) -> scalar`` once per key group or
+      window frame — usable in ``groupBy().agg(...)`` (reference
+      GpuAggregateInPandasExec) and ``.over(window)`` (reference
+      GpuWindowInPandasExecBase).
+
+    CPU engine; the plan falls back per-node with a reason."""
     from .types import DOUBLE as _D
 
     rt = returnType if returnType is not None else _D
+    flavor = functionType.lower().replace("_", "")
+    if flavor not in ("scalar", "groupedagg"):
+        raise ValueError(
+            f"unsupported pandas_udf functionType {functionType!r}; "
+            "supported: 'scalar', 'grouped_agg' (use mapInPandas/"
+            "applyInPandas for the map/grouped-map flavors)"
+        )
 
     def wrap(fn):
-        from .expr.udf import VectorizedUdf
+        from .expr.udf import GroupedAggUdf, VectorizedUdf
+
+        cls = GroupedAggUdf if flavor == "groupedagg" else VectorizedUdf
 
         def call(*cols) -> Column:
             return Column(
-                VectorizedUdf(fn, rt, tuple(_e(c) for c in cols), fn.__name__)
+                cls(fn, rt, tuple(_e(c) for c in cols), fn.__name__)
             )
 
         call.__name__ = fn.__name__

@@ -249,13 +249,10 @@ class AddressSpaceAllocator:
 # contiguous frames
 # ---------------------------------------------------------------------------
 
-def frame_pack(buffers: Sequence) -> memoryview:
-    """Pack buffers (bytes / memoryview / contiguous ndarray) into one
-    contiguous frame (8-byte-aligned payloads). Returns a zero-copy view
-    of the frame."""
-    lib = _load()
-    assert lib is not None
-    n = len(buffers)
+def _as_u8_arrays(buffers: Sequence) -> List[np.ndarray]:
+    """Normalize bytes / memoryview / contiguous ndarray buffers to flat
+    uint8 arrays — the single definition both frame_pack and frame_write
+    layer on (layouts must stay byte-identical)."""
     arrs = []
     for b in buffers:
         if isinstance(b, np.ndarray):
@@ -265,6 +262,17 @@ def frame_pack(buffers: Sequence) -> memoryview:
             arrs.append(
                 np.frombuffer(b, dtype=np.uint8) if len(b) else np.empty(0, np.uint8)
             )
+    return arrs
+
+
+def frame_pack(buffers: Sequence) -> memoryview:
+    """Pack buffers (bytes / memoryview / contiguous ndarray) into one
+    contiguous frame (8-byte-aligned payloads). Returns a zero-copy view
+    of the frame."""
+    lib = _load()
+    assert lib is not None
+    n = len(buffers)
+    arrs = _as_u8_arrays(buffers)
     lens = np.asarray([a.shape[0] for a in arrs], dtype=np.uint64)
     lens_p = _vp(lens, ctypes.c_uint64)
     total = lib.srt_frame_size(lens_p, n)
@@ -285,15 +293,7 @@ def frame_write(fobj, buffers: Sequence) -> int:
     WITHOUT materializing the whole frame — the spill path runs under host
     memory pressure, where a full-frame copy would transiently double the
     buffer being shed. Returns bytes written."""
-    arrs = []
-    for b in buffers:
-        if isinstance(b, np.ndarray):
-            a = np.ascontiguousarray(b).reshape(-1)
-            arrs.append(a.view(np.uint8) if a.size else np.empty(0, np.uint8))
-        else:
-            arrs.append(
-                np.frombuffer(b, dtype=np.uint8) if len(b) else np.empty(0, np.uint8)
-            )
+    arrs = _as_u8_arrays(buffers)
     n = len(arrs)
     lens = np.asarray([a.shape[0] for a in arrs], dtype=np.uint64)
     import struct

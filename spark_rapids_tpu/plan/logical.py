@@ -452,6 +452,61 @@ class FlatMapGroupsInPandas(LogicalPlan):
 
 
 @dataclass
+class FlatMapCoGroupsInPandas(LogicalPlan):
+    """``df1.groupBy(k).cogroup(df2.groupBy(k)).applyInPandas(fn)``:
+    ``fn(left_pd, right_pd) -> pd.DataFrame`` once per key group present on
+    EITHER side (pyspark cogroup; reference
+    GpuFlatMapCoGroupsInPandasExec)."""
+
+    left_keys: list
+    right_keys: list
+    fn: object
+    _schema: Schema
+    left: LogicalPlan
+    right: LogicalPlan
+
+    def children(self):
+        return [self.left, self.right]
+
+    @property
+    def schema(self) -> Schema:
+        return self._schema
+
+    def _node_string(self):
+        return (
+            f"FlatMapCoGroupsInPandas {self.left_keys}/{self.right_keys} "
+            f"{getattr(self.fn, '__name__', 'fn')}"
+        )
+
+
+@dataclass
+class AggregateInPandas(LogicalPlan):
+    """``groupBy(keys).agg(grouped_agg_pandas_udf(...))``: each UDF sees the
+    group's Series and returns one scalar (pyspark GROUPED_AGG pandas UDF;
+    reference GpuAggregateInPandasExec). ``udfs`` is a list of
+    ``(out_name, fn, return_type, arg_names)`` over columns the session
+    pre-projected."""
+
+    grouping: list  # key column names
+    udfs: list
+    _schema: Schema
+    child: LogicalPlan
+
+    def children(self):
+        return [self.child]
+
+    @property
+    def schema(self) -> Schema:
+        return self._schema
+
+    def _node_string(self):
+        return (
+            f"AggregateInPandas {self.grouping} "
+            f"[{', '.join(u[0] for u in self.udfs)}]"
+        )
+
+
+@dataclass
 class WriteFiles(LogicalPlan):
     """Write command node (GpuDataWritingCommandExec analogue); output is
     the per-file write stats."""

@@ -87,6 +87,68 @@ def plan_physical(lp: L.LogicalPlan, conf: TpuConf) -> Exec:
                 # groupBy().applyInPandas: the whole frame is one group
                 child = CpuCoalescePartitionsExec(child)
         return CpuFlatMapGroupsInPandasExec(lp.grouping, lp.fn, lp.schema, child)
+    if isinstance(lp, L.FlatMapCoGroupsInPandas):
+        from ..exec.cpu_pandas import CpuFlatMapCoGroupsInPandasExec
+
+        left = plan_physical(lp.left, conf)
+        right = plan_physical(lp.right, conf)
+        if (
+            _num_partitions_hint(left) != 1
+            or _num_partitions_hint(right) != 1
+        ):
+            # co-partition both sides on their keys with the same arity so
+            # matching key groups meet in the same partition pair. Mismatched
+            # key dtypes hash differently (murmur3 of int32 5 != int64 5);
+            # the PARTITIONING keys are cast to the common type — the frames
+            # the user's fn sees keep their own types (Catalyst coerces join
+            # keys the same way; see _coerce_join_keys)
+            from ..expr.cast import Cast
+            from ..types import numeric_promote
+
+            lkeys: list = [UnresolvedAttribute(n) for n in lp.left_keys]
+            rkeys: list = [UnresolvedAttribute(n) for n in lp.right_keys]
+            for i, (ln, rn) in enumerate(zip(lp.left_keys, lp.right_keys)):
+                ta = lp.left.schema[ln].data_type
+                tb = lp.right.schema[rn].data_type
+                if type(ta) is type(tb):
+                    continue
+                try:
+                    common = numeric_promote(ta, tb)
+                except Exception:
+                    raise ValueError(
+                        f"cogroup keys {ln}:{ta.simple_string} and "
+                        f"{rn}:{tb.simple_string} are incompatible"
+                    )
+                if type(ta) is not type(common):
+                    lkeys[i] = Cast(lkeys[i], common)
+                if type(tb) is not type(common):
+                    rkeys[i] = Cast(rkeys[i], common)
+            nparts = cfg.SHUFFLE_PARTITIONS.get(conf)
+            left = CpuShuffleExchangeExec(
+                P.HashPartitioning(nparts, lkeys), left
+            )
+            right = CpuShuffleExchangeExec(
+                P.HashPartitioning(nparts, rkeys), right
+            )
+        return CpuFlatMapCoGroupsInPandasExec(
+            lp.left_keys, lp.right_keys, lp.fn, lp.schema, left, right
+        )
+    if isinstance(lp, L.AggregateInPandas):
+        from ..exec.cpu_pandas import CpuAggregateInPandasExec
+
+        child = plan_physical(lp.child, conf)
+        if _num_partitions_hint(child) != 1:
+            if lp.grouping:
+                child = CpuShuffleExchangeExec(
+                    P.HashPartitioning(
+                        cfg.SHUFFLE_PARTITIONS.get(conf),
+                        [UnresolvedAttribute(n) for n in lp.grouping],
+                    ),
+                    child,
+                )
+            else:
+                child = CpuCoalescePartitionsExec(child)
+        return CpuAggregateInPandasExec(lp.grouping, lp.udfs, lp.schema, child)
     if isinstance(lp, L.Sort):
         child = plan_physical(lp.child, conf)
         if lp.is_global and _num_partitions_hint(child) != 1:

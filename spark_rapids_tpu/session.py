@@ -772,11 +772,16 @@ class DataFrame:
 
 
 def _to_schema(schema) -> Schema:
-    """Accept a Schema, or a list of (name, DataType) pairs / StructFields."""
+    """Accept a Schema, a pyspark-style DDL string (``"a long, b double"``),
+    or a list of (name, DataType) pairs / StructFields."""
     from .types import StructField
 
     if isinstance(schema, Schema):
         return schema
+    if isinstance(schema, str):
+        from .types import parse_ddl_schema
+
+        return parse_ddl_schema(schema)
     fields = []
     for f in schema:
         if isinstance(f, StructField):
@@ -887,11 +892,76 @@ class GroupedData:
 
     applyInPandas = apply_in_pandas
 
+    def cogroup(self, other: "GroupedData") -> "CoGroupedData":
+        """Pair this grouped frame with another for
+        ``cogroup(...).apply_in_pandas(fn, schema)`` (pyspark cogroup;
+        reference GpuFlatMapCoGroupsInPandasExec)."""
+        if not isinstance(other, GroupedData):
+            raise TypeError("cogroup expects another groupBy()")
+        return CoGroupedData(self, other)
+
+    def _plain_key_names(self, what: str) -> List[str]:
+        if self._grouping_sets is not None or self._pivot is not None:
+            raise ValueError(f"{what} requires a plain groupBy")
+        names = []
+        for g in self._grouping:
+            if not isinstance(g, UnresolvedAttribute):
+                raise ValueError(f"{what} grouping must be plain columns")
+            names.append(g.name)
+        return names
+
+    def _agg_in_pandas(self, agg_exprs: List[Expression]) -> DataFrame:
+        """GROUPED_AGG pandas UDF route: pre-project key + argument columns,
+        then AggregateInPandas evaluates one scalar per (group, udf)."""
+        from .expr.udf import GroupedAggUdf
+        from .types import StructField
+
+        keys = self._plain_key_names("grouped-agg pandas UDFs")
+        proj: List[Expression] = [UnresolvedAttribute(n) for n in keys]
+        udfs = []
+        out_fields = []
+        child_schema = self._df.schema
+        for n in keys:
+            out_fields.append(StructField(n, child_schema[n].data_type, True))
+        for i, a in enumerate(agg_exprs):
+            target = a.child if isinstance(a, Alias) else a
+            if not isinstance(target, GroupedAggUdf):
+                raise ValueError(
+                    "grouped-agg pandas UDFs cannot be mixed with other "
+                    f"aggregates in one agg() (got {a})"
+                )
+            arg_names = []
+            for j, arg in enumerate(target.args):
+                nm = f"__pagg_arg{i}_{j}"
+                proj.append(Alias(arg, nm))
+                arg_names.append(nm)
+            out_name = output_name(a)
+            udfs.append((out_name, target.fn, target.return_type, arg_names))
+            out_fields.append(StructField(out_name, target.return_type, True))
+        projected = L.Project(proj, self._df._plan)
+        return DataFrame(
+            self._df._session,
+            L.AggregateInPandas(keys, udfs, Schema(out_fields), projected),
+        )
+
     def agg(self, *aggs) -> DataFrame:
         agg_exprs = []
         for a in aggs:
             e = a.expr if isinstance(a, Column) else a
             agg_exprs.append(e)
+        from .expr.udf import GroupedAggUdf
+
+        def _has_grouped_agg(e) -> bool:
+            stack = [e]
+            while stack:
+                x = stack.pop()
+                if isinstance(x, GroupedAggUdf):
+                    return True
+                stack.extend(x.children())
+            return False
+
+        if any(_has_grouped_agg(a) for a in agg_exprs):
+            return self._agg_in_pandas(agg_exprs)
         if self._pivot is not None:
             agg_exprs = self._expand_pivot(agg_exprs)
         if self._grouping_sets is not None:
@@ -970,3 +1040,32 @@ class GroupedData:
         from .functions import max as max_fn
 
         return self.agg(*[max_fn(col(n)).alias(f"max({n})") for n in names])
+
+
+class CoGroupedData:
+    """Two co-grouped frames awaiting ``apply_in_pandas`` (pyspark
+    ``GroupedData.cogroup``; reference GpuFlatMapCoGroupsInPandasExec)."""
+
+    def __init__(self, left: GroupedData, right: GroupedData):
+        self._left = left
+        self._right = right
+
+    def apply_in_pandas(self, fn, schema) -> DataFrame:
+        """``fn(left_pd, right_pd) -> pd.DataFrame`` once per key group
+        present on either side; an absent side arrives as an empty frame
+        with that side's columns."""
+        lk = self._left._plain_key_names("cogroup apply_in_pandas")
+        rk = self._right._plain_key_names("cogroup apply_in_pandas")
+        if len(lk) != len(rk):
+            raise ValueError(
+                f"cogroup key counts differ: {lk} vs {rk}"
+            )
+        schema = _to_schema(schema)
+        return DataFrame(
+            self._left._df._session,
+            L.FlatMapCoGroupsInPandas(
+                lk, rk, fn, schema, self._left._df._plan, self._right._df._plan
+            ),
+        )
+
+    applyInPandas = apply_in_pandas

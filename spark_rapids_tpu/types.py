@@ -263,6 +263,80 @@ class MapType(DataType):
         return hash((MapType, self.key_type, self.value_type))
 
 
+def parse_ddl_type(s: str) -> DataType:
+    """One Spark DDL type name → DataType (``long``, ``decimal(10,2)``,
+    ``array<int>``, ``map<string,int>``, ``struct<a:int,b:string>``)."""
+    s = s.strip()
+    low = s.lower()  # type NAMES are case-insensitive; field names keep case
+    simple = {
+        "boolean": BooleanType(), "byte": ByteType(), "tinyint": ByteType(),
+        "short": ShortType(), "smallint": ShortType(),
+        "int": IntegerType(), "integer": IntegerType(),
+        "long": LongType(), "bigint": LongType(),
+        "float": FloatType(), "real": FloatType(),
+        "double": DoubleType(), "string": StringType(),
+        "date": DateType(), "timestamp": TimestampType(),
+        "decimal": DecimalType(10, 0), "void": NullType(),
+        "null": NullType(),
+    }
+    if low in simple:
+        return simple[low]
+    if low.startswith("decimal(") and low.endswith(")"):
+        p, sc = s[len("decimal(") : -1].split(",")
+        return DecimalType(int(p), int(sc))
+    if low.startswith("array<") and low.endswith(">"):
+        return ArrayType(parse_ddl_type(s[len("array<") : -1]))
+    if low.startswith("map<") and low.endswith(">"):
+        k, v = _split_top(s[len("map<") : -1])
+        return MapType(parse_ddl_type(k), parse_ddl_type(v))
+    if low.startswith("struct<") and low.endswith(">"):
+        fields = []
+        for part in _split_top_all(s[len("struct<") : -1]):
+            name, dt = part.split(":", 1)
+            fields.append(StructField(name.strip(), parse_ddl_type(dt), True))
+        return StructType(tuple(fields))
+    raise ValueError(f"cannot parse DDL type {s!r}")
+
+
+def _split_top_all(s: str) -> list:
+    """Split on top-level commas (angle-bracket and paren aware)."""
+    parts, depth, cur = [], 0, []
+    for ch in s:
+        if ch in "<(":
+            depth += 1
+        elif ch in ">)":
+            depth -= 1
+        if ch == "," and depth == 0:
+            parts.append("".join(cur))
+            cur = []
+        else:
+            cur.append(ch)
+    if cur:
+        parts.append("".join(cur))
+    return parts
+
+
+def _split_top(s: str):
+    parts = _split_top_all(s)
+    if len(parts) != 2:
+        raise ValueError(f"expected two type args in {s!r}")
+    return parts[0], parts[1]
+
+
+def parse_ddl_schema(s: str) -> "Schema":
+    """``"a long, b double"`` (pyspark DDL schema string) → Schema."""
+    fields = []
+    for part in _split_top_all(s):
+        part = part.strip()
+        if not part:
+            continue
+        bits = part.split(None, 1)
+        if len(bits) != 2:
+            raise ValueError(f"cannot parse DDL field {part!r}")
+        fields.append(StructField(bits[0], parse_ddl_type(bits[1]), True))
+    return Schema(fields)
+
+
 def is_complex(dt: DataType) -> bool:
     return isinstance(dt, (ArrayType, StructType, MapType))
 

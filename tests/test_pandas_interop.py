@@ -1,0 +1,243 @@
+"""L7 pandas-interop exec family: cogroup, grouped-agg pandas UDFs, and
+window-in-pandas (reference GpuFlatMapCoGroupsInPandasExec,
+GpuAggregateInPandasExec, GpuWindowInPandasExecBase)."""
+from __future__ import annotations
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu import TpuSession
+from spark_rapids_tpu import functions as F
+from spark_rapids_tpu.functions import col
+from spark_rapids_tpu.types import DOUBLE, LONG, STRING
+from spark_rapids_tpu.window import Window
+
+
+def _sessions():
+    return (
+        TpuSession({"spark.rapids.sql.enabled": True, "spark.sql.shuffle.partitions": 3}),
+        TpuSession({"spark.rapids.sql.enabled": False, "spark.sql.shuffle.partitions": 3}),
+    )
+
+
+T1 = pa.table({"id": [1, 2, 1, 3, 2, 1], "v": [1.0, 2.0, 3.0, 4.0, 5.0, 6.0]})
+T2 = pa.table({"id": [1, 2, 4], "w": [10.0, 20.0, 40.0]})
+
+
+class TestCoGroup:
+    def test_cogroup_merge(self):
+        def merge(left, right):
+            m = left.copy()
+            m["w"] = right["w"].iloc[0] if len(right) else -1.0
+            return m
+
+        def q(s):
+            d1 = s.create_dataframe(T1, num_partitions=2)
+            d2 = s.create_dataframe(T2, num_partitions=2)
+            return (
+                d1.group_by("id")
+                .cogroup(d2.group_by("id"))
+                .apply_in_pandas(merge, "id long, v double, w double")
+            )
+
+        dev, cpu = _sessions()
+        assert sorted(q(dev).collect()) == sorted(q(cpu).collect())
+        rows = sorted(q(dev).collect())
+        assert rows == sorted(
+            [(1, 1.0, 10.0), (1, 3.0, 10.0), (1, 6.0, 10.0),
+             (2, 2.0, 20.0), (2, 5.0, 20.0), (3, 4.0, -1.0)]
+        )
+
+    def test_cogroup_keys_on_either_side(self):
+        """Groups present on only one side arrive with an empty frame for
+        the absent side (pyspark cogroup contract)."""
+
+        def count_both(left, right):
+            kid = left["id"].iloc[0] if len(left) else right["id"].iloc[0]
+            return pd.DataFrame(
+                {"id": [kid], "nl": [float(len(left))], "nr": [float(len(right))]}
+            )
+
+        dev, _ = _sessions()
+        d1 = dev.create_dataframe(T1, num_partitions=2)
+        d2 = dev.create_dataframe(T2, num_partitions=2)
+        out = sorted(
+            d1.group_by("id")
+            .cogroup(d2.group_by("id"))
+            .apply_in_pandas(count_both, "id long, nl double, nr double")
+            .collect()
+        )
+        assert out == [(1, 3.0, 1.0), (2, 2.0, 1.0), (3, 1.0, 0.0), (4, 0.0, 1.0)]
+
+    def test_cogroup_mismatched_key_dtypes(self):
+        """int32 vs int64 keys: the partitioning hashes the COMMON type so
+        matching keys meet in one partition pair (the join-key coercion
+        rule applied to cogroup)."""
+        t_small = pa.table(
+            {"id": pa.array([1, 2, 1], type=pa.int32()), "v": [1.0, 2.0, 3.0]}
+        )
+
+        def count_both(left, right):
+            kid = left["id"].iloc[0] if len(left) else right["id"].iloc[0]
+            return pd.DataFrame(
+                {"id": [int(kid)], "nl": [float(len(left))], "nr": [float(len(right))]}
+            )
+
+        dev, _ = _sessions()
+        d1 = dev.create_dataframe(t_small, num_partitions=2)
+        d2 = dev.create_dataframe(T2, num_partitions=2)
+        out = sorted(
+            d1.group_by("id")
+            .cogroup(d2.group_by("id"))
+            .apply_in_pandas(count_both, "id long, nl double, nr double")
+            .collect()
+        )
+        assert out == [(1, 2.0, 1.0), (2, 1.0, 1.0), (4, 0.0, 1.0)]
+
+    def test_cogroup_key_count_mismatch(self):
+        dev, _ = _sessions()
+        d1 = dev.create_dataframe(T1)
+        d2 = dev.create_dataframe(T2)
+        with pytest.raises(ValueError, match="key counts differ"):
+            d1.group_by("id").cogroup(d2.group_by("id", "w")).apply_in_pandas(
+                lambda a, b: a, "id long"
+            )
+
+
+class TestAggregateInPandas:
+    def test_grouped_agg_udf(self):
+        wmean = F.pandas_udf(
+            lambda v, w: float(np.average(v, weights=w)), DOUBLE, "grouped_agg"
+        )
+        t = pa.table(
+            {"k": [1, 1, 2, 2, 2], "v": [1.0, 2.0, 3.0, 4.0, 5.0],
+             "w": [1.0, 3.0, 1.0, 1.0, 2.0]}
+        )
+
+        def q(s):
+            return (
+                s.create_dataframe(t, num_partitions=2)
+                .group_by("k")
+                .agg(wmean(col("v"), col("w")).alias("wm"))
+            )
+
+        dev, cpu = _sessions()
+        got = sorted(q(dev).collect())
+        assert got == sorted(q(cpu).collect())
+        assert got[0][0] == 1 and abs(got[0][1] - 1.75) < 1e-12
+        assert got[1][0] == 2 and abs(got[1][1] - 4.25) < 1e-12
+
+    def test_grouped_agg_udf_ungrouped(self):
+        med = F.pandas_udf(lambda v: float(v.median()), DOUBLE, "grouped_agg")
+        dev, _ = _sessions()
+        r = dev.create_dataframe(T1).agg(med(col("v")).alias("m")).collect()
+        assert r == [(3.5,)]
+
+    def test_grouped_agg_udf_empty_global(self):
+        """Keyless aggregate over empty input emits ONE row (Spark calls
+        the UDF over an empty frame), matching the builtin agg path."""
+        mean_or_none = F.pandas_udf(
+            lambda v: float(v.mean()) if len(v) else None, DOUBLE, "grouped_agg"
+        )
+        dev, _ = _sessions()
+        df = dev.create_dataframe(T1).filter(col("v") > 100)
+        assert df.agg(mean_or_none(col("v")).alias("m")).collect() == [(None,)]
+
+    def test_bad_function_type_rejected(self):
+        with pytest.raises(ValueError, match="unsupported pandas_udf"):
+            F.pandas_udf(lambda v: v, DOUBLE, "grouped_map")
+
+    def test_grouped_agg_udf_null_result(self):
+        """None/NaN scalar results become SQL NULLs."""
+        maybe = F.pandas_udf(
+            lambda v: float(v.sum()) if v.iloc[0] < 4 else None,
+            DOUBLE,
+            "grouped_agg",
+        )
+        dev, _ = _sessions()
+        t = pa.table({"k": [1, 1, 2], "v": [1.0, 2.0, 9.0]})
+        r = sorted(
+            dev.create_dataframe(t).group_by("k").agg(maybe(col("v")).alias("s")).collect(),
+            key=lambda x: x[0],
+        )
+        assert r == [(1, 3.0), (2, None)]
+
+    def test_grouped_agg_expression_args(self):
+        """UDF arguments may be arbitrary expressions (pre-projected)."""
+        total = F.pandas_udf(lambda x: float(x.sum()), DOUBLE, "grouped_agg")
+        dev, cpu = _sessions()
+
+        def q(s):
+            return (
+                s.create_dataframe(T1, num_partitions=2)
+                .group_by("id")
+                .agg(total(col("v") * 2 + 1).alias("t"))
+            )
+
+        assert sorted(q(dev).collect()) == sorted(q(cpu).collect())
+
+    def test_mixing_with_builtin_aggs_rejected(self):
+        med = F.pandas_udf(lambda v: float(v.median()), DOUBLE, "grouped_agg")
+        dev, _ = _sessions()
+        with pytest.raises(ValueError, match="cannot be mixed"):
+            dev.create_dataframe(T1).group_by("id").agg(
+                med(col("v")).alias("m"), F.sum(col("v")).alias("s")
+            ).collect()
+
+
+class TestWindowInPandas:
+    def test_whole_partition_frame(self):
+        med = F.pandas_udf(lambda v: float(v.median()), DOUBLE, "grouped_agg")
+        t = pa.table({"k": [1, 1, 1, 2, 2], "d": [1, 2, 3, 1, 2],
+                      "v": [1.0, 2.0, 3.0, 4.0, 5.0]})
+        dev, cpu = _sessions()
+
+        def q(s):
+            return s.create_dataframe(t).with_column(
+                "m", med(col("v")).over(Window.partition_by("k"))
+            )
+
+        got = sorted(q(dev).collect())
+        assert got == sorted(q(cpu).collect())
+        assert got == [(1, 1, 1.0, 2.0), (1, 2, 2.0, 2.0), (1, 3, 3.0, 2.0),
+                       (2, 1, 4.0, 4.5), (2, 2, 5.0, 4.5)]
+
+    def test_bounded_rows_frame(self):
+        med = F.pandas_udf(lambda v: float(v.median()), DOUBLE, "grouped_agg")
+        t = pa.table({"k": [1, 1, 1, 2, 2], "d": [1, 2, 3, 1, 2],
+                      "v": [1.0, 2.0, 3.0, 4.0, 5.0]})
+        dev, _ = _sessions()
+        w = Window.partition_by("k").order_by("d").rows_between(-1, 0)
+        got = sorted(
+            dev.create_dataframe(t).with_column("m", med(col("v")).over(w)).collect()
+        )
+        assert got == [(1, 1, 1.0, 1.0), (1, 2, 2.0, 1.5), (1, 3, 3.0, 2.5),
+                       (2, 1, 4.0, 4.0), (2, 2, 5.0, 4.5)]
+
+    def test_fallback_reason_logged(self):
+        """The window UDF falls back with a reason; device sections remain
+        around it (explain shows CpuWindowExec under device exchange)."""
+        med = F.pandas_udf(lambda v: float(v.median()), DOUBLE, "grouped_agg")
+        dev, _ = _sessions()
+        t = pa.table({"k": [1, 2], "v": [1.0, 2.0]})
+        df = dev.create_dataframe(t).with_column(
+            "m", med(col("v")).over(Window.partition_by("k"))
+        )
+        df.collect()  # must execute despite the fallback
+
+
+class TestDdlSchema:
+    def test_parse_ddl(self):
+        from spark_rapids_tpu.types import (
+            ArrayType, DecimalType, parse_ddl_schema,
+        )
+
+        sch = parse_ddl_schema(
+            "a long, b double, c string, d decimal(10,2), e array<int>"
+        )
+        assert sch.names == ["a", "b", "c", "d", "e"]
+        assert isinstance(sch["d"].data_type, DecimalType)
+        assert sch["d"].data_type.precision == 10
+        assert isinstance(sch["e"].data_type, ArrayType)
