@@ -339,6 +339,15 @@ DEVICE_POOL_LIMIT = conf("spark.rapids.tpu.memory.deviceLimitBytes").doc(
     "(reference: RMM pool size via spark.rapids.memory.gpu.allocFraction)."
 ).bytes_conf(0)
 
+ADAPTIVE_BROADCAST_THRESHOLD = conf(
+    "spark.sql.adaptive.autoBroadcastJoinThreshold"
+).doc(
+    "AQE runtime join-strategy switch: a shuffled hash join whose MEASURED "
+    "build side is at most this many bytes re-plans as a broadcast join at "
+    "execution time (the probe side's exchange is read locally, skipping "
+    "its all-to-all). -1 falls back to spark.sql.autoBroadcastJoinThreshold."
+).bytes_conf(-1)
+
 AUTO_BROADCAST_THRESHOLD = conf("spark.sql.autoBroadcastJoinThreshold").doc(
     "Maximum estimated build-side size for which a join is planned as a "
     "broadcast hash join (Spark's key, honored here; -1 disables)."

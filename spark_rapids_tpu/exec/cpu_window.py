@@ -214,9 +214,11 @@ class CpuWindowExec(Exec):
                     for i in range(s, e):
                         lo, hi = _frame_bounds(frame, i, s, e, peer_start, order_info)
                         if lo > hi:
-                            ov[i] = False
-                            continue
-                        scalar, valid = call(lo, hi)
+                            # empty frame: Spark still calls the UDF (a
+                            # count-style UDF returns 0, not NULL)
+                            scalar, valid = call(0, -1)
+                        else:
+                            scalar, valid = call(lo, hi)
                         out[i] = scalar
                         ov[i] = valid
             return out, ov

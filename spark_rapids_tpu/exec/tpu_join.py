@@ -10,6 +10,7 @@ output bucket.
 from __future__ import annotations
 
 import dataclasses as dc
+import threading
 from typing import List, Optional
 
 import jax
@@ -82,6 +83,43 @@ def _link_aqe_exchanges(left: Exec, right: Exec, join_type: str = "inner") -> No
         for ex in (lex, rex):
             if ex is not None:
                 ex._aqe_disabled = True
+
+
+def _stream_probe_join(node, get_build, probe_thunk, phase1, phase2, jt,
+                       matched_acc=None):
+    """One probe stream joined against one build batch — the shared loop
+    under the shuffled, runtime-broadcast-switched, and broadcast joins.
+    ``get_build(first_probe)`` supplies the build batch lazily (broadcast
+    materializes it on the probe's device); ``matched_acc['m']`` (when
+    given) accumulates build-row match bits for right/full null-extension.
+    """
+    build = None
+    for probe in probe_thunk():
+        if build is None:
+            build = get_build(probe)
+        # mesh mode: the two sides can land on different devices when only
+        # one side's exchange took the mesh path — one jit needs one device
+        probe = _colocate_with(probe, build)
+        build_order, lower, counts = phase1(build, probe)
+        total = int(counts.sum())
+        out_cap = bucket_capacity(max(total, 1))
+        out, probe_matched, bmatch = phase2(
+            build,
+            probe,
+            build_order,
+            lower,
+            counts,
+            jnp.zeros(out_cap, jnp.int8),
+        )
+        if matched_acc is not None:
+            matched_acc["m"] = matched_acc["m"] | bmatch
+        if jt in ("left", "full"):
+            unmatched = (~probe_matched) & probe.row_mask()
+            extra = node._null_extend(probe, unmatched, "left")
+            if extra.row_count():
+                yield extra
+        if out.row_count():
+            yield out
 
 
 class TpuShuffledHashJoinExec(Exec):
@@ -169,11 +207,86 @@ class TpuShuffledHashJoinExec(Exec):
         )
 
     # ── execution ───────────────────────────────────────────────────────
+    def _try_broadcast_switch(self, ctx: ExecContext):
+        """AQE runtime join-strategy switch (Spark's DynamicJoinSelection +
+        local shuffle reader; GpuCustomShuffleReaderExec analogue): when
+        the build side's MEASURED map-output size fits the broadcast
+        threshold, join every probe partition against ONE concatenated
+        build table and read the probe side's exchange LOCALLY — its
+        all-to-all bucketing is skipped entirely. Returns
+        ``(switched_partition_set | None, reusable_build_parts | None)`` —
+        the second slot hands an already-executed build exchange back to
+        the normal path so declining never materializes it twice."""
+        from .. import config as cfg
+        from .tpu import TpuShuffleExchangeExec
+
+        if ctx.mesh is not None or not cfg.ADAPTIVE_ENABLED.get(ctx.conf):
+            return None, None
+        # broadcast-build-right is only sound when unmatched BUILD rows
+        # never surface (they would duplicate per probe partition)
+        if self.join_type not in ("inner", "left", "left_semi", "left_anti"):
+            return None, None
+        left, right = self.children
+        if not isinstance(right, TpuShuffleExchangeExec):
+            return None, None
+        thresh = cfg.ADAPTIVE_BROADCAST_THRESHOLD.get(ctx.conf)
+        if thresh < 0:
+            thresh = cfg.AUTO_BROADCAST_THRESHOLD.get(ctx.conf)
+        if thresh < 0:
+            return None, None
+        rparts = right.execute(ctx)
+        size_fn = ctx.aqe_size_providers.get(id(right))
+        if size_fn is None:  # exchange didn't take the AQE path
+            return None, rparts
+        if sum(size_fn()) > thresh:
+            # declined: hand the already-executed build partitions back so
+            # the normal path doesn't materialize the exchange twice
+            return None, rparts
+        self.aqe_broadcast_switched = True
+        # local shuffle read: bypass the probe exchange's bucketing (the
+        # broadcast build holds every key, so co-partitioning is moot)
+        probe_src = (
+            left.children[0] if isinstance(left, TpuShuffleExchangeExec) else left
+        )
+        lparts = probe_src.execute(ctx)
+        phase1 = self._phase1()
+        phase2 = self._phase2()
+        jt = self.join_type
+        bstate: dict = {}
+        block = threading.Lock()
+
+        def build_once() -> DeviceBatch:
+            with block:
+                if "b" not in bstate:
+                    batches = [db for p in rparts.parts for db in p()]
+                    bstate["b"] = (
+                        concat_device(batches)
+                        if batches
+                        else empty_batch(right.output)
+                    )
+                return bstate["b"]
+
+        def make(lt):
+            def it():
+                yield from _stream_probe_join(
+                    self, lambda _p: build_once(), lt, phase1, phase2, jt
+                )
+
+            return it
+
+        return PartitionSet([make(lt) for lt in lparts.parts]), None
+
     def execute(self, ctx: ExecContext) -> PartitionSet:
         left, right = self.children
+        # link BEFORE any side executes: the AQE coalesce/skew assignment
+        # must see its peer even when the broadcast-switch probe below
+        # executes the build exchange first (and then declines)
         _link_aqe_exchanges(left, right, self.join_type)
+        switched, reuse_rparts = self._try_broadcast_switch(ctx)
+        if switched is not None:
+            return switched
         lparts = left.execute(ctx)
-        rparts = right.execute(ctx)
+        rparts = reuse_rparts if reuse_rparts is not None else right.execute(ctx)
         assert lparts.num_partitions == rparts.num_partitions, (
             f"{lparts.num_partitions} vs {rparts.num_partitions}"
         )
@@ -189,34 +302,12 @@ class TpuShuffledHashJoinExec(Exec):
                     if bbatches
                     else empty_batch(right.output)
                 )
-                build_matched = jnp.zeros(build.capacity, dtype=bool)
-                for probe in lt():
-                    # mesh mode: the two sides can land on different devices
-                    # when only one side's exchange took the mesh path
-                    # (e.g. a complex-typed schema on the other) — one jit
-                    # needs one device
-                    probe = _colocate_with(probe, build)
-                    build_order, lower, counts = phase1(build, probe)
-                    total = int(counts.sum())
-                    out_cap = bucket_capacity(max(total, 1))
-                    out, probe_matched, bmatch = phase2(
-                        build,
-                        probe,
-                        build_order,
-                        lower,
-                        counts,
-                        jnp.zeros(out_cap, jnp.int8),
-                    )
-                    build_matched = build_matched | bmatch
-                    if jt in ("left", "full"):
-                        unmatched = (~probe_matched) & probe.row_mask()
-                        extra = self._null_extend(probe, unmatched, "left")
-                        if extra.row_count():
-                            yield extra
-                    if out.row_count():
-                        yield out
+                acc = {"m": jnp.zeros(build.capacity, dtype=bool)}
+                yield from _stream_probe_join(
+                    self, lambda _p: build, lt, phase1, phase2, jt, acc
+                )
                 if jt in ("right", "full"):
-                    unmatched = (~build_matched) & build.row_mask()
+                    unmatched = (~acc["m"]) & build.row_mask()
                     extra = self._null_extend(build, unmatched, "right")
                     if extra.row_count():
                         yield extra
@@ -305,28 +396,14 @@ class TpuBroadcastHashJoinExec(TpuShuffledHashJoinExec):
 
         def make(lt):
             def it():
-                build = None
-                for probe in lt():
-                    if build is None:
-                        build = right.broadcast_batch_like(ctx, probe)
-                    build_order, lower, counts = phase1(build, probe)
-                    total = int(counts.sum())
-                    out_cap = bucket_capacity(max(total, 1))
-                    out, probe_matched, _ = phase2(
-                        build,
-                        probe,
-                        build_order,
-                        lower,
-                        counts,
-                        jnp.zeros(out_cap, jnp.int8),
-                    )
-                    if jt == "left":
-                        unmatched = (~probe_matched) & probe.row_mask()
-                        extra = self._null_extend(probe, unmatched, "left")
-                        if extra.row_count():
-                            yield extra
-                    if out.row_count():
-                        yield out
+                yield from _stream_probe_join(
+                    self,
+                    lambda probe: right.broadcast_batch_like(ctx, probe),
+                    lt,
+                    phase1,
+                    phase2,
+                    jt,
+                )
 
             return it
 

@@ -192,3 +192,94 @@ def test_aqe_skew_split_disabled_for_full_join():
         ),
         conf=conf,
     )
+
+
+def _find_join(plan):
+    from spark_rapids_tpu.exec.tpu_join import TpuShuffledHashJoinExec
+
+    if isinstance(plan, TpuShuffledHashJoinExec):
+        return plan
+    for c in plan.children:
+        f = _find_join(c)
+        if f is not None:
+            return f
+    return None
+
+
+@pytest.mark.parametrize("how", ["inner", "left"])
+def test_aqe_runtime_broadcast_switch(how):
+    """Shuffled join re-plans as broadcast at RUNTIME when the measured
+    build side fits spark.sql.adaptive.autoBroadcastJoinThreshold (the
+    DynamicJoinSelection + local-shuffle-reader pair;
+    GpuCustomShuffleReaderExec analogue) — results stay identical."""
+    rng = np.random.default_rng(93)
+    n = 4000
+    lt = pa.table(
+        {"k": rng.integers(0, 200, n), "lv": rng.standard_normal(n)}
+    )
+    rt = pa.table({"k": np.arange(150), "rv": rng.standard_normal(150)})
+    conf = {
+        "spark.sql.adaptive.enabled": True,
+        # the static planner must NOT broadcast; only AQE may
+        "spark.sql.autoBroadcastJoinThreshold": "-1",
+        "spark.sql.adaptive.autoBroadcastJoinThreshold": "10m",
+    }
+    assert_cpu_and_tpu_equal(
+        lambda s: s.create_dataframe(lt, num_partitions=3).join(
+            s.create_dataframe(rt, num_partitions=3), on="k", how=how
+        ),
+        conf=conf,
+    )
+    s = tpu_session(conf)
+    df = s.create_dataframe(lt, num_partitions=3).join(
+        s.create_dataframe(rt, num_partitions=3), on="k", how=how
+    )
+    df.collect()
+    j = _find_join(s._last_plan)
+    assert j is not None and getattr(j, "aqe_broadcast_switched", False)
+
+
+def test_aqe_broadcast_switch_respects_threshold():
+    """Build side above the runtime threshold keeps the shuffled join."""
+    rng = np.random.default_rng(94)
+    lt = pa.table({"k": rng.integers(0, 50, 2000), "lv": rng.standard_normal(2000)})
+    rt = pa.table({"k": np.arange(50), "rv": rng.standard_normal(50)})
+    conf = {
+        "spark.sql.adaptive.enabled": True,
+        "spark.sql.autoBroadcastJoinThreshold": "-1",
+        "spark.sql.adaptive.autoBroadcastJoinThreshold": "64",  # bytes
+    }
+    s = tpu_session(conf)
+    df = s.create_dataframe(lt, num_partitions=3).join(
+        s.create_dataframe(rt, num_partitions=3), on="k", how="inner"
+    )
+    df.collect()
+    j = _find_join(s._last_plan)
+    assert j is not None and not getattr(j, "aqe_broadcast_switched", False)
+
+
+def test_aqe_broadcast_switch_never_for_right_outer():
+    """right/full joins surface unmatched BUILD rows — broadcasting the
+    build side would duplicate them per probe partition, so the switch
+    must not fire."""
+    rng = np.random.default_rng(95)
+    lt = pa.table({"k": rng.integers(0, 40, 1000), "lv": rng.standard_normal(1000)})
+    rt = pa.table({"k": np.arange(60), "rv": rng.standard_normal(60)})
+    conf = {
+        "spark.sql.adaptive.enabled": True,
+        "spark.sql.autoBroadcastJoinThreshold": "-1",
+        "spark.sql.adaptive.autoBroadcastJoinThreshold": "10m",
+    }
+    assert_cpu_and_tpu_equal(
+        lambda s: s.create_dataframe(lt, num_partitions=3).join(
+            s.create_dataframe(rt, num_partitions=3), on="k", how="right"
+        ),
+        conf=conf,
+    )
+    s = tpu_session(conf)
+    df = s.create_dataframe(lt, num_partitions=3).join(
+        s.create_dataframe(rt, num_partitions=3), on="k", how="right"
+    )
+    df.collect()
+    j = _find_join(s._last_plan)
+    assert j is not None and not getattr(j, "aqe_broadcast_switched", False)

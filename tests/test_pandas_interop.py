@@ -216,6 +216,19 @@ class TestWindowInPandas:
         assert got == [(1, 1, 1.0, 1.0), (1, 2, 2.0, 1.5), (1, 3, 3.0, 2.5),
                        (2, 1, 4.0, 4.0), (2, 2, 5.0, 4.5)]
 
+    def test_empty_frame_calls_udf(self):
+        """Frames with zero rows still invoke the UDF (Spark's
+        WindowInPandasExec passes an empty Series; a count-style UDF
+        returns 0, not NULL)."""
+        cnt = F.pandas_udf(lambda v: float(len(v)), DOUBLE, "grouped_agg")
+        dev, _ = _sessions()
+        t = pa.table({"k": [1, 1, 1], "d": [1, 2, 3], "v": [1.0, 2.0, 3.0]})
+        w = Window.partition_by("k").order_by("d").rows_between(-2, -1)
+        got = sorted(
+            dev.create_dataframe(t).with_column("c", cnt(col("v")).over(w)).collect()
+        )
+        assert got == [(1, 1, 1.0, 0.0), (1, 2, 2.0, 1.0), (1, 3, 3.0, 2.0)]
+
     def test_fallback_reason_logged(self):
         """The window UDF falls back with a reason; device sections remain
         around it (explain shows CpuWindowExec under device exchange)."""
