@@ -112,6 +112,13 @@ SQL_ENABLED = conf("spark.rapids.sql.enabled").doc(
     "Enable (true) or disable (false) TPU acceleration of SQL operators."
 ).boolean_conf(True)
 
+TASK_MAX_FAILURES = conf("spark.task.maxFailures").doc(
+    "Task-retry budget (Spark's key): a failed partition task re-runs from "
+    "its lineage up to this many total attempts before the query fails. "
+    "Deterministic semantic errors (ANSI arithmetic/cast errors, "
+    "assertions) are never retried."
+).int_conf(4)
+
 NATIVE_ENABLED = conf("spark.rapids.native.enabled").doc(
     "Use the native (C++) host data plane — Spark-exact murmur3 hashing, "
     "the best-fit staging-arena sub-allocator, and contiguous spill frames "

@@ -1971,6 +1971,19 @@ class TpuShuffleExchangeExec(Exec):
 
             def make_managed(p):
                 def it():
+                    with mgr_lock:
+                        if mgr_state.get("released"):
+                            # task retry AFTER the map output was freed: the
+                            # thunk must stay re-runnable (lineage recovery),
+                            # so re-run the map stage under a fresh shuffle
+                            # id — materialize() re-executes the child
+                            # pipeline since its buckets were handed to the
+                            # (now unregistered) catalog. Without this, the
+                            # retry would read an unknown shuffle id and
+                            # silently commit ZERO rows for this partition.
+                            mgr_state["shuffle_id"] = None
+                            mgr_state["released"] = False
+                            consumed.discard(p)
                     sid = ensure_written()
                     yield from ctx.shuffle_manager.get_reader().read_partitions(
                         sid, p, p + 1
@@ -1979,7 +1992,13 @@ class TpuShuffleExchangeExec(Exec):
                     # been drained (ShuffleBufferCatalog unregisterShuffle)
                     with mgr_lock:
                         consumed.add(p)
-                        done = len(consumed) == nparts
+                        done = (
+                            len(consumed) == nparts
+                            and not mgr_state.get("released")
+                            and mgr_state["shuffle_id"] == sid
+                        )
+                        if done:
+                            mgr_state["released"] = True
                     if done:
                         ctx.shuffle_manager.unregister_shuffle(sid)
 

@@ -238,7 +238,12 @@ class TpuShuffledHashJoinExec(Exec):
         size_fn = ctx.aqe_size_providers.get(id(right))
         if size_fn is None:  # exchange didn't take the AQE path
             return None, rparts
-        if sum(size_fn()) > thresh:
+        total = sum(size_fn())
+        # the measurement materialized the build side ON THIS thread; drop
+        # the device-semaphore permit it acquired or the main thread holds
+        # one task slot for the rest of the query
+        ctx.semaphore.release_if_necessary()
+        if total > thresh:
             # declined: hand the already-executed build partitions back so
             # the normal path doesn't materialize the exchange twice
             return None, rparts

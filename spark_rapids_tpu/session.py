@@ -74,6 +74,10 @@ class TpuSession:
         self.read = DataFrameReader(self)
         self._last_plan: Optional[Exec] = None
         self._last_overrides: Optional[TpuOverrides] = None
+        self._task_retries = 0
+        import threading as _threading
+
+        self._retry_lock = _threading.Lock()
 
     def mesh_context(self):
         """Lazily build the session's MeshContext (mesh mode only)."""
@@ -304,9 +308,43 @@ class TpuSession:
         with query_trace(cfg.PROFILE_PATH.get(self.conf)):
             return self._run_plan(final_plan, ctx)
 
+    def _run_task(self, thunk, attempts: int) -> List[pa.RecordBatch]:
+        """One partition task with Spark's retry model (spark.task.maxFailures;
+        SURVEY §5 failure detection): the lineage IS the recovery mechanism —
+        a partition thunk is a pure closure over its upstream pipeline, so a
+        failed attempt simply re-runs it. Results commit only on success (a
+        partial stream from a failed attempt is discarded). Deterministic
+        semantic errors surface immediately: retrying an ANSI overflow or an
+        assertion can only fail again."""
+        from .expr.base import AnsiError
+
+        last: Optional[Exception] = None
+        for attempt in range(max(1, attempts)):
+            try:
+                return list(thunk())
+            except (AssertionError, AnsiError):
+                raise
+            except Exception as e:  # noqa: BLE001 - Spark retries any task failure
+                last = e
+                with self._retry_lock:
+                    self._task_retries += 1
+                if attempt + 1 < attempts:
+                    import logging
+
+                    logging.getLogger(__name__).warning(
+                        "task failed (attempt %d/%d), retrying from lineage: %s",
+                        attempt + 1,
+                        attempts,
+                        e,
+                    )
+        assert last is not None
+        raise last
+
     def _run_plan(self, final_plan, ctx) -> pa.Table:
         parts = final_plan.execute(ctx)
         batches: List[pa.RecordBatch] = []
+        attempts = cfg.TASK_MAX_FAILURES.get(self.conf)
+        self._task_retries = 0
         n_threads = min(len(parts.parts), cfg.CONCURRENT_TPU_TASKS.get(self.conf))
         if n_threads > 1:
             # Run partition tasks concurrently (the reference's executor task
@@ -322,13 +360,15 @@ class TpuSession:
             prev_stack = threading.stack_size(512 * 1024 * 1024)
             try:
                 with ThreadPoolExecutor(max_workers=n_threads) as pool:
-                    results = list(pool.map(lambda t: list(t()), parts.parts))
+                    results = list(
+                        pool.map(lambda t: self._run_task(t, attempts), parts.parts)
+                    )
             finally:
                 threading.stack_size(prev_stack)
             batches = [rb for rbs in results for rb in rbs if rb.num_rows]
         else:
             for thunk in parts.parts:
-                for rb in thunk():
+                for rb in self._run_task(thunk, attempts):
                     if rb.num_rows:
                         batches.append(rb)
         schema = final_plan.output
