@@ -112,6 +112,12 @@ SQL_ENABLED = conf("spark.rapids.sql.enabled").doc(
     "Enable (true) or disable (false) TPU acceleration of SQL operators."
 ).boolean_conf(True)
 
+PALLAS_ENABLED = conf("spark.rapids.sql.pallas.enabled").doc(
+    "Use hand-written Pallas TPU kernels for hot string ops (substring "
+    "search over the padded byte planes) instead of the pure-XLA lowering. "
+    "Results are bit-identical; this only changes the kernel strategy."
+).boolean_conf(True)
+
 TASK_MAX_FAILURES = conf("spark.task.maxFailures").doc(
     "Task-retry budget (Spark's key): a failed partition task re-runs from "
     "its lineage up to this many total attempts before the query fails. "

@@ -1983,7 +1983,13 @@ class TpuShuffleExchangeExec(Exec):
                             # silently commit ZERO rows for this partition.
                             mgr_state["shuffle_id"] = None
                             mgr_state["released"] = False
-                            consumed.discard(p)
+                            # full reset: stale entries would re-trip the
+                            # release after ONE retried read, forcing every
+                            # other retried partition to re-run the whole
+                            # map stage again; the fresh generation frees
+                            # only when it fully drains (query end is the
+                            # backstop for partially-retried generations)
+                            consumed.clear()
                     sid = ensure_written()
                     yield from ctx.shuffle_manager.get_reader().read_partitions(
                         sid, p, p + 1

@@ -686,6 +686,12 @@ def _match_starts(ctx: Ctx, data, lengths, pat: bytes):
     L = len(pat)
     if L == 0 or L > w:
         return xp.zeros((ctx.n, w), dtype=bool)
+    from ..ops import pallas_strings as PS
+
+    if PS.usable_for(data):
+        # Pallas path: VMEM-resident shifted compares — no [n, S, L]
+        # window gather in HBM (multi-GB at scan scale)
+        return PS.match_starts(data, lengths, pat)
     S = w - L + 1
     idx = np.arange(S)[:, None] + np.arange(L)[None, :]
     windows = data[:, xp.asarray(idx)]  # [n, S, L]

@@ -66,9 +66,10 @@ class GuardedJit:
     signature takes a global compile lock; the compiled fast path stays
     lock-free."""
 
-    __slots__ = ("_fn", "_seen")
+    __slots__ = ("_fn", "_seen", "_orig")
 
     def __init__(self, fn):
+        self._orig = fn
         self._fn = jax.jit(fn)
         self._seen = set()
 
@@ -86,9 +87,62 @@ class GuardedJit:
         if sig in self._seen:
             return self._fn(*args)
         with _COMPILE_LOCK:
-            out = self._fn(*args)
+            out = self._first_call(args)
         self._seen.add(sig)
         return out
+
+    def _first_call(self, args):
+        """First execution per signature = trace + compile. Two recoveries:
+        a Mosaic (pallas) failure flips the pallas plane off and re-traces
+        through the bit-identical XLA lowering; transient remote-compile
+        errors (the tunneled compile service round-robins over helpers of
+        mixed health) retry with backoff."""
+        import logging
+        import time
+
+        log = logging.getLogger(__name__)
+        attempts = 4
+        for i in range(attempts):
+            try:
+                return self._fn(*args)
+            except Exception as e:  # noqa: BLE001 - classify, then re-raise
+                msg = str(e)
+                if "Mosaic" in msg:
+                    from .ops import pallas_strings as _ps
+
+                    if _ps.ENABLED:
+                        log.warning(
+                            "pallas kernel failed to compile; falling back "
+                            "to the XLA lowering for this process: %s",
+                            msg[:200],
+                        )
+                        _ps.set_enabled(False)
+                        self._fn = jax.jit(self._orig)
+                        # the swapped jit has an empty compile cache: old
+                        # signatures must NOT take the lock-free fast path
+                        # (concurrent first compiles SIGSEGV — that is this
+                        # class's reason to exist)
+                        self._seen.clear()
+                        continue  # retrace immediately, no backoff
+                transient = any(
+                    k in msg
+                    for k in (
+                        "remote_compile",
+                        "DEADLINE",
+                        "UNAVAILABLE",
+                        "response body",
+                    )
+                )
+                if not transient or i + 1 >= attempts:
+                    raise
+                log.warning(
+                    "kernel compile failed (attempt %d/%d), retrying: %s",
+                    i + 1,
+                    attempts,
+                    msg[:160],
+                )
+                time.sleep(2.0 * (i + 1))
+        raise AssertionError("unreachable")  # pragma: no cover
 
     def _cache_size(self):
         cs = getattr(self._fn, "_cache_size", None)

@@ -52,6 +52,9 @@ class TpuSession:
         from . import native as _native
 
         _native.set_enabled(cfg.NATIVE_ENABLED.get(self.conf))
+        from .ops import pallas_strings as _ps
+
+        _ps.set_enabled(cfg.PALLAS_ENABLED.get(self.conf))
         self._mesh_ctx = None
         if cfg.MESH_ENABLED.get(self.conf):
             # mesh mode: one exchange partition per chip, so the planner's
