@@ -324,16 +324,31 @@ CPU_ONLY = conf("spark.rapids.tpu.cpuOnly").doc(
     "Force the JAX CPU backend (testing; the virtual-device mesh path)."
 ).internal().boolean_conf(False)
 
+CLOUD_SCHEMES = conf("spark.rapids.cloudSchemes").doc(
+    "Comma-separated URI schemes treated as cloud storage: the AUTO reader "
+    "type picks MULTITHREADED for them (background prefetch hides object-"
+    "store latency) and COALESCING otherwise (reference: "
+    "RapidsConf.scala:651)."
+).string_conf("dbfs,s3,s3a,s3n,wasbs,gs,abfs,abfss")
+
+ALLUXIO_PATHS_TO_REPLACE = conf("spark.rapids.alluxio.pathsToReplace").doc(
+    "Comma-separated 'src->dst' prefix rewrites applied to read paths "
+    "before file listing — route cloud reads through an Alluxio-style "
+    "cache mount (reference: RapidsConf.scala:929)."
+).string_conf(None)
+
 PARQUET_READER_TYPE = conf("spark.rapids.sql.format.parquet.reader.type").doc(
-    "File reader strategy: PERFILE (one task per file), COALESCING (small "
-    "files stitched into shared partitions), or MULTITHREADED (cloud-style "
+    "File reader strategy: AUTO (COALESCING for local paths, MULTITHREADED "
+    "when any path scheme is in spark.rapids.cloudSchemes — the reference's "
+    "default), PERFILE (one task per file), COALESCING (small files "
+    "stitched into shared partitions), or MULTITHREADED (cloud-style "
     "thread-pool reads). The per-read option 'readerType' overrides this "
     "per DataFrame (reference: RapidsConf.scala:624-671)."
-).string_conf("PERFILE")
+).string_conf("AUTO")
 
 ORC_READER_TYPE = conf("spark.rapids.sql.format.orc.reader.type").doc(
     "ORC file reader strategy; same values as the parquet key."
-).string_conf("PERFILE")
+).string_conf("AUTO")
 
 MULTITHREADED_READ_NUM_THREADS = conf(
     "spark.rapids.sql.multiThreadedRead.numThreads"

@@ -388,9 +388,22 @@ class CpuFileScanExec(Exec):
         conf_key = (
             cfg.ORC_READER_TYPE if fmt == "orc" else cfg.PARQUET_READER_TYPE
         )
-        self.reader_type = options.get(
-            "readerType", conf_key.get(conf)
-        ).upper()
+        rt = options.get("readerType", conf_key.get(conf)).upper()
+        if rt == "AUTO":
+            # reference default: COALESCING locally, MULTITHREADED when any
+            # path lives on a cloud scheme (RapidsConf.scala:651)
+            schemes = {
+                s.strip().lower()
+                for s in cfg.CLOUD_SCHEMES.get(conf).split(",")
+                if s.strip()
+            }
+            # URI schemes are case-insensitive (RFC 3986)
+            is_cloud = any(
+                "://" in f and f.split("://", 1)[0].lower() in schemes
+                for f in files
+            )
+            rt = "MULTITHREADED" if is_cloud else "COALESCING"
+        self.reader_type = rt
         self.num_threads = cfg.MULTITHREADED_READ_NUM_THREADS.get(conf)
         # pushed-down conjuncts (name, op, literal) — set by the planner
         self.predicates: list = list(options.get("__predicates", ()))

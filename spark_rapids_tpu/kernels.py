@@ -84,46 +84,58 @@ class GuardedJit:
                 for x in leaves
             ),
         )
+        # capture _fn BEFORE the membership check: if another thread swaps
+        # in a fresh (empty-cache) jit and clears _seen concurrently, a
+        # passing check here implies our capture predates the clear, so we
+        # execute the OLD compiled fn — never a first compile off-lock
+        fn = self._fn
         if sig in self._seen:
-            return self._fn(*args)
+            return fn(*args)
         with _COMPILE_LOCK:
             out = self._first_call(args)
-        self._seen.add(sig)
+            self._seen.add(sig)
         return out
 
     def _first_call(self, args):
         """First execution per signature = trace + compile. Two recoveries:
-        a Mosaic (pallas) failure flips the pallas plane off and re-traces
-        through the bit-identical XLA lowering; transient remote-compile
-        errors (the tunneled compile service round-robins over helpers of
-        mixed health) retry with backoff."""
+        a Mosaic (pallas) failure flips the pallas plane off for the
+        process (one-shot) and re-traces through the bit-identical XLA
+        lowering; transient remote-compile errors (the tunneled compile
+        service round-robins over helpers of mixed health) retry with
+        backoff. Runs under _COMPILE_LOCK."""
         import logging
         import time
 
         log = logging.getLogger(__name__)
         attempts = 4
-        for i in range(attempts):
+        i = 0
+        mosaic_fallback_used = False
+        while True:
             try:
                 return self._fn(*args)
             except Exception as e:  # noqa: BLE001 - classify, then re-raise
                 msg = str(e)
-                if "Mosaic" in msg:
-                    from .ops import pallas_strings as _ps
+                from .ops import pallas_strings as _ps
 
-                    if _ps.ENABLED:
-                        log.warning(
-                            "pallas kernel failed to compile; falling back "
-                            "to the XLA lowering for this process: %s",
-                            msg[:200],
-                        )
-                        _ps.set_enabled(False)
-                        self._fn = jax.jit(self._orig)
-                        # the swapped jit has an empty compile cache: old
-                        # signatures must NOT take the lock-free fast path
-                        # (concurrent first compiles SIGSEGV — that is this
-                        # class's reason to exist)
-                        self._seen.clear()
-                        continue  # retrace immediately, no backoff
+                if (
+                    "Mosaic" in msg
+                    and not mosaic_fallback_used
+                    and _ps.ENABLED
+                    and not _ps._KILLED
+                ):
+                    log.warning(
+                        "pallas kernel failed to compile; falling back to "
+                        "the XLA lowering for this process: %s",
+                        msg[:200],
+                    )
+                    mosaic_fallback_used = True
+                    _ps.kill_for_process()
+                    # clear BEFORE swapping: a racing fast-path reader that
+                    # passes the (cleared) membership check must have
+                    # captured the old fn (see __call__)
+                    self._seen.clear()
+                    self._fn = jax.jit(self._orig)
+                    continue  # retrace; does not consume a retry attempt
                 transient = any(
                     k in msg
                     for k in (
@@ -133,16 +145,16 @@ class GuardedJit:
                         "response body",
                     )
                 )
-                if not transient or i + 1 >= attempts:
+                i += 1
+                if not transient or i >= attempts:
                     raise
                 log.warning(
                     "kernel compile failed (attempt %d/%d), retrying: %s",
-                    i + 1,
+                    i,
                     attempts,
                     msg[:160],
                 )
-                time.sleep(2.0 * (i + 1))
-        raise AssertionError("unreachable")  # pragma: no cover
+                time.sleep(2.0 * i)
 
     def _cache_size(self):
         cs = getattr(self._fn, "_cache_size", None)

@@ -17,7 +17,11 @@ from __future__ import annotations
 
 import numpy as np
 
-ENABLED = True  # session-level gate (spark.rapids.sql.pallas.enabled)
+ENABLED = True  # conf gate (spark.rapids.sql.pallas.enabled)
+# process-level kill switch set by GuardedJit after an in-process Mosaic
+# compile failure — deliberately NEVER re-armed by set_enabled: a new
+# session's default conf must not re-trigger the broken compile path
+_KILLED = False
 
 _BLOCK_ROWS = 256
 
@@ -25,6 +29,11 @@ _BLOCK_ROWS = 256
 def set_enabled(flag: bool) -> None:
     global ENABLED
     ENABLED = bool(flag)
+
+
+def kill_for_process() -> None:
+    global _KILLED
+    _KILLED = True
 
 
 def _backend_is_tpu() -> bool:
@@ -40,9 +49,13 @@ def _backend_is_tpu() -> bool:
 # block + iota + bool chain + i8 store) — a trivial kernel compiles on
 # helpers that still reject this shape
 _PROBE_CODE = """
+import sys
 import numpy as np, jax, jax.numpy as jnp
 from spark_rapids_tpu.ops import pallas_strings as PS
-assert jax.default_backend() == "tpu", "probe must exercise Mosaic, not interpret"
+if jax.default_backend() != "tpu":
+    # the parent may hold the chips exclusively (single-process libtpu on
+    # co-located hardware) — INCONCLUSIVE, not a compile failure
+    sys.exit(2)
 data = jnp.zeros((512, 128), jnp.uint8)
 lens = jnp.zeros((512,), jnp.int32)
 out = PS.match_starts(data, lens, b"ab")
@@ -107,7 +120,10 @@ def _mosaic_probe_ok() -> bool:
                 + os.environ.get("PYTHONPATH", ""),
             },
         ).returncode
-        ok = rc == 0
+        # rc 2 = inconclusive (child could not reach the TPU backend, e.g.
+        # the parent owns the chips exclusively): optimistically allow —
+        # GuardedJit's Mosaic fallback is the in-process safety net there
+        ok = rc in (0, 2)
     except Exception:
         ok = False
     _probe_result = ok
@@ -126,7 +142,9 @@ def usable_for(data) -> bool:
     environment passed the subprocess Mosaic probe."""
     return (
         ENABLED
+        and not _KILLED
         and getattr(data, "ndim", 0) == 2
+        and not isinstance(data, np.ndarray)  # host numpy stays host-side
         and data.shape[1] >= 128
         and data.shape[1] % 128 == 0
         and _backend_is_tpu()

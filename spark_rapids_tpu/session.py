@@ -418,10 +418,31 @@ class DataFrameReader:
         self._options[k] = v
         return self
 
+    def _rewrite(self, paths) -> tuple:
+        """spark.rapids.alluxio.pathsToReplace: 'src->dst' prefix rewrites
+        applied before file listing (RapidsConf.scala:929 — route cloud
+        reads through a cache mount)."""
+        raw = cfg.ALLUXIO_PATHS_TO_REPLACE.get(self._session.conf)
+        if not raw:
+            return tuple(paths)
+        rules = []
+        for part in raw.split(","):
+            if "->" in part:
+                src, dst = part.split("->", 1)
+                rules.append((src.strip(), dst.strip()))
+        out = []
+        for p in paths:
+            for src, dst in rules:
+                if p.startswith(src):
+                    p = dst + p[len(src) :]
+                    break
+            out.append(p)
+        return tuple(out)
+
     def parquet(self, *paths: str) -> "DataFrame":
         from .io.files import infer_schema, expand_paths
 
-        files = expand_paths(paths, "parquet")
+        files = expand_paths(self._rewrite(paths), "parquet")
         schema = infer_schema(files, "parquet", self._options)
         return DataFrame(
             self._session,
@@ -431,7 +452,7 @@ class DataFrameReader:
     def orc(self, *paths: str) -> "DataFrame":
         from .io.files import infer_schema, expand_paths
 
-        files = expand_paths(paths, "orc")
+        files = expand_paths(self._rewrite(paths), "orc")
         schema = infer_schema(files, "orc", self._options)
         return DataFrame(
             self._session, L.FileScan(files, "orc", schema, dict(self._options))
@@ -444,7 +465,7 @@ class DataFrameReader:
         opts.update(kwargs)
         # shim-routed default (SparkShims seam): what string reads as NULL
         opts.setdefault("nullValue", self._session.shim.csv_null_value())
-        files = expand_paths(paths, "csv")
+        files = expand_paths(self._rewrite(paths), "csv")
         schema = infer_schema(files, "csv", opts)
         return DataFrame(self._session, L.FileScan(files, "csv", schema, opts))
 

@@ -355,3 +355,45 @@ def test_no_pruning_on_float_columns(tmp_path):
     assert len(rows) == 10
     scan = _find_scan(s._last_plan)
     assert scan.pruned_row_groups == 0
+
+
+def test_reader_type_auto_selection(tmp_path):
+    """AUTO (the default, like the reference): COALESCING for local paths,
+    MULTITHREADED when a path scheme is in spark.rapids.cloudSchemes."""
+    import pyarrow.parquet as pq
+
+    from spark_rapids_tpu.io.files import CpuFileScanExec
+    from spark_rapids_tpu.config import TpuConf
+    from spark_rapids_tpu.types import Schema, StructField, LONG
+
+    p = str(tmp_path / "t.parquet")
+    pq.write_table(pa.table({"x": [1, 2, 3]}), p)
+    sch = Schema([StructField("x", LONG, True)])
+    conf = TpuConf({})
+    local = CpuFileScanExec([p], "parquet", sch, {}, conf)
+    assert local.reader_type == "COALESCING"
+    cloud = CpuFileScanExec(
+        ["s3a://bucket/t.parquet"], "parquet", sch, {}, conf
+    )
+    assert cloud.reader_type == "MULTITHREADED"
+    pinned = CpuFileScanExec(
+        [p], "parquet", sch, {"readerType": "PERFILE"}, conf
+    )
+    assert pinned.reader_type == "PERFILE"
+
+
+def test_alluxio_path_replacement(tmp_path):
+    """spark.rapids.alluxio.pathsToReplace rewrites read-path prefixes
+    before listing (RapidsConf.scala:929)."""
+    import pyarrow.parquet as pq
+
+    real = tmp_path / "mount"
+    real.mkdir()
+    pq.write_table(pa.table({"x": [1, 2, 3]}), str(real / "t.parquet"))
+    s = tpu_session(
+        {
+            "spark.rapids.alluxio.pathsToReplace": f"s3://my-bucket->{real}",
+        }
+    )
+    rows = sorted(s.read.parquet("s3://my-bucket/t.parquet").collect())
+    assert rows == [(1,), (2,), (3,)]
