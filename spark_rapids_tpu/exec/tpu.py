@@ -1157,6 +1157,29 @@ def _slice_head_impl(batch: DeviceBatch, take) -> DeviceBatch:
 slice_head = K.GuardedJit(_slice_head_impl)
 
 
+def _radix_select_kth(w: "jax.Array", k: int) -> "jax.Array":
+    """Exact k-th smallest of a uint64 vector, MSB→LSB radix select: fix
+    one bit per step by counting how many values share the built prefix
+    with the current bit 0. O(64·n) fully-vectorized elementwise work —
+    no sorting network, no top_k."""
+    def body(i, state):
+        prefix, kk = state
+        shift = jnp.uint64(63) - i.astype(jnp.uint64)
+        bit = jnp.uint64(1) << shift
+        # bits at/above the current position
+        hi_mask = ~(bit - jnp.uint64(1))
+        cnt0 = ((w & hi_mask) == prefix).sum(dtype=jnp.int64)
+        take1 = kk > cnt0
+        prefix = jnp.where(take1, prefix | bit, prefix)
+        kk = jnp.where(take1, kk - cnt0, kk)
+        return prefix, kk
+
+    prefix, _ = jax.lax.fori_loop(
+        0, 64, body, (jnp.uint64(0), jnp.asarray(k, jnp.int64))
+    )
+    return prefix
+
+
 class TpuTakeOrderedAndProjectExec(Exec):
     """TopN on device: per-partition sort + head(n), then merged final
     sort + head(n) (reference: GpuTakeOrderedAndProjectExec, limit.scala)."""
@@ -1168,6 +1191,7 @@ class TpuTakeOrderedAndProjectExec(Exec):
             SortOrder(bind(o.child, child.output), o.ascending, o.nulls_first)
             for o in order
         ]
+        self.prefilter_hits = 0  # observability: candidate fast path taken
 
     @property
     def output(self) -> Schema:
@@ -1177,14 +1201,109 @@ class TpuTakeOrderedAndProjectExec(Exec):
     def is_device(self) -> bool:
         return True
 
+    # below this capacity the full sort is cheap enough that the candidate
+    # pass's extra host sync would dominate
+    TOPK_MIN_CAPACITY = 1 << 15
+
+    def _candidate_fn(self):
+        """(mask, count) of rows whose FIRST radix word ties or beats the
+        n-th best — a superset of the true top-n (ties at the boundary are
+        kept; later sort keys only reorder within first-word ties). Lets
+        TopN avoid the full multi-word sort of a huge padded batch: top_k
+        is O(cap·log n), then only the candidates get sorted."""
+        order = self.order
+        k = self.n
+
+        def make():
+            def cand(batch: DeviceBatch):
+                c = Ctx.for_device(batch)
+                live = batch.row_mask()
+                o = order[0]
+                col = val_to_column(c, o.child.eval(c), o.child.data_type)
+                col = dc_replace(col, validity=col.validity & live)
+                from ..ops.sortkeys import column_radix_words
+
+                # value_only: for unpacked layouts (64-bit/string/double)
+                # word [0] would be the standalone VALIDITY word — a {0,1}
+                # threshold that degenerates the prefilter (sortkeys.py's
+                # docstring forbids slicing word 0). Nulls get explicit
+                # boundary keys per the null ordering instead.
+                w0 = column_radix_words(
+                    col,
+                    o.ascending,
+                    o.resolved_nulls_first(),
+                    value_only=True,
+                )[0]
+                dead = jnp.uint64(0xFFFFFFFFFFFFFFFF)
+                null_key = (
+                    jnp.uint64(0) if o.resolved_nulls_first() else dead
+                )
+                w0 = jnp.where(col.validity, w0, null_key)
+                w0 = jnp.where(live, w0, dead)
+                kk = min(k, int(w0.shape[0]))
+                # k-th smallest via radix-select: 64 masked count-reductions
+                # (lax.top_k at this size lowers to a pathological full
+                # sort on TPU — measured minutes at 2M rows)
+                kth = _radix_select_kth(w0, kk)
+                mask = live & (w0 <= kth)
+                return mask, mask.sum(dtype=jnp.int32)
+
+            return K.GuardedJit(cand)
+
+        return K.kernel(("topn_cand", _order_key(self.order), self.n), make)
+
     def execute(self, ctx: ExecContext) -> PartitionSet:
         n = jnp.asarray(self.n, jnp.int32)
         sort_fn = device_sort_fn(self.order)
+        cand_fn = self._candidate_fn()
+        limit = self.n
 
         def topn(batches):
             if not batches:
                 return None
             merged = batches[0] if len(batches) == 1 else concat_device(batches)
+            cand_cap = bucket_capacity(max(4 * limit, 4096))
+            if (
+                merged.capacity >= self.TOPK_MIN_CAPACITY
+                # the gathered candidate batch must be meaningfully smaller
+                # than the input or the pass does strictly more work
+                and cand_cap <= merged.capacity // 4
+            ):
+                mask, cnt = cand_fn(merged)
+                cnt = int(cnt)  # one host sync buys skipping the big sort
+                if cnt <= cand_cap:
+                    self.prefilter_hits += 1
+                    # fixed-size nonzero + gather: O(cap) scan, NO sorting
+                    # network over the huge padded batch (compact's argsort
+                    # would be exactly the cost this path exists to skip)
+                    def make_gather(cc=cand_cap):
+                        def g(b: DeviceBatch, m: jax.Array):
+                            idx = jnp.nonzero(
+                                m, size=cc, fill_value=b.capacity - 1
+                            )[0].astype(jnp.int32)
+                            taken = m.sum(dtype=jnp.int32)
+                            out = gather_batch(b, idx, taken)
+                            live = (
+                                jnp.arange(cc, dtype=jnp.int32) < taken
+                            )
+                            cols = [
+                                dc_replace(c2, validity=c2.validity & live)
+                                for c2 in out.columns
+                            ]
+                            return DeviceBatch(out.schema, cols, taken)
+
+                        return K.GuardedJit(g)
+
+                    gather_fn = K.kernel(
+                        (
+                            "topn_gather",
+                            merged.schema,
+                            merged.capacity,
+                            cand_cap,
+                        ),
+                        make_gather,
+                    )
+                    return slice_head(sort_fn(gather_fn(merged, mask)), n)
             return slice_head(sort_fn(merged), n)
 
         child_parts = self.children[0].execute(ctx)

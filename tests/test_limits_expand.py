@@ -1,5 +1,6 @@
 """Limit / TopN (TakeOrderedAndProject) / Expand / rollup / cube tests —
 mirrors the reference's limit.scala + GpuExpandExec coverage."""
+import numpy as np
 import pyarrow as pa
 import pytest
 
@@ -106,3 +107,65 @@ def test_cube_vs_manual_union():
     total = _df(s, t).agg(F.sum(col("v")).alias("s")).collect()
     want = sorted(grouped + [(None, total[0][0])], key=repr)
     assert sorted(cube_rows, key=repr) == want
+
+
+def _find_topn(plan):
+    from spark_rapids_tpu.exec.tpu import TpuTakeOrderedAndProjectExec
+
+    if isinstance(plan, TpuTakeOrderedAndProjectExec):
+        return plan
+    for c in plan.children:
+        f = _find_topn(c)
+        if f is not None:
+            return f
+    return None
+
+
+@pytest.mark.parametrize(
+    "dtype,desc",
+    [("int32", False), ("float64", True), ("int64", False)],
+)
+def test_topn_candidate_prefilter_large_batch(dtype, desc):
+    """TopN over a batch above TOPK_MIN_CAPACITY takes the radix-select
+    candidate path (first-word threshold + nonzero gather + small sort) —
+    results must be identical to the CPU oracle including boundary ties,
+    across packed (int32) and unpacked (int64/double) radix layouts."""
+    rng = np.random.default_rng(123)
+    n = 70000  # capacity buckets above TpuTakeOrderedAndProjectExec.TOPK_MIN_CAPACITY
+    if dtype == "float64":
+        a = rng.standard_normal(n)
+    else:
+        a = rng.integers(0, 1000000, n).astype(dtype)
+    t = pa.table(
+        {
+            "a": a,
+            "b": rng.integers(0, 1000000, n),
+            "v": rng.standard_normal(n),
+        }
+    )
+
+    def q(s):
+        key = col("a").desc() if desc else col("a")
+        return s.create_dataframe(t).order_by(key, col("b").desc()).limit(25)
+
+    assert_cpu_and_tpu_equal(q, sort_result=False)
+    # the candidate fast path must actually fire (regression: slicing the
+    # validity word made the threshold degenerate and the path dead)
+    s = tpu_session({})
+    q(s).collect()
+    topn = _find_topn(s._last_plan)
+    assert topn is not None and topn.prefilter_hits >= 1
+
+
+def test_topn_candidate_prefilter_all_ties():
+    """Constant first sort key: every row is a candidate, so the count
+    guard must route back to the full sort (still correct)."""
+    rng = np.random.default_rng(124)
+    n = 70000
+    t = pa.table(
+        {"a": np.zeros(n, dtype=np.int64), "b": rng.integers(0, 10**9, n)}
+    )
+    assert_cpu_and_tpu_equal(
+        lambda s: s.create_dataframe(t).order_by(col("a"), col("b")).limit(10),
+        sort_result=False,
+    )
