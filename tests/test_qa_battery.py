@@ -1,0 +1,300 @@
+"""QA battery: mixed-shape end-to-end queries, differential vs the CPU
+oracle — the qa_nightly_select_test analogue (reference
+integration_tests/src/main/python/qa_nightly_select_test.py): each case
+composes several subsystems (joins + aggregates + windows + subqueries +
+string ops + distinct + rollup) the way TPC-DS queries do, rather than
+testing one operator in isolation."""
+from __future__ import annotations
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu import functions as F
+from spark_rapids_tpu.functions import col
+from spark_rapids_tpu.window import Window
+
+from harness import assert_cpu_and_tpu_equal
+
+
+def _store_sales(n=20000, seed=50):
+    rng = np.random.default_rng(seed)
+    return pa.table(
+        {
+            "ss_item": rng.integers(0, 300, n),
+            "ss_store": rng.integers(0, 12, n),
+            "ss_cust": rng.integers(0, 800, n),
+            "ss_qty": rng.integers(1, 20, n).astype(np.int32),
+            "ss_price": (rng.random(n) * 90 + 10).round(2),
+            "ss_date": rng.integers(0, 730, n).astype(np.int32),
+            "ss_promo": pa.array(
+                np.asarray(["P-1", "P-2", "NONE", None], dtype=object)[
+                    rng.integers(0, 4, n)
+                ]
+            ),
+        }
+    )
+
+
+def _items(n=300, seed=51):
+    rng = np.random.default_rng(seed)
+    cats = ["Books", "Music", "Home", "Sports", "Electronics"]
+    return pa.table(
+        {
+            "i_item": np.arange(n, dtype=np.int64),
+            "i_cat": pa.array([cats[i % 5] for i in range(n)]),
+            "i_price": (rng.random(n) * 100).round(2),
+            "i_name": pa.array([f"item #{i:04d} {cats[i % 5].lower()}" for i in range(n)]),
+        }
+    )
+
+
+def _stores(n=12):
+    return pa.table(
+        {
+            "s_store": np.arange(n, dtype=np.int64),
+            "s_state": pa.array([["CA", "NY", "TX", "WA"][i % 4] for i in range(n)]),
+        }
+    )
+
+
+CONF = {"spark.sql.shuffle.partitions": 4}
+
+
+def test_q_join_agg_topn():
+    """Join two dims, group, order, limit (q3/q42 shape)."""
+    ss, it = _store_sales(), _items()
+
+    def q(s):
+        sales = s.create_dataframe(ss, num_partitions=3)
+        items = s.create_dataframe(it, num_partitions=2)
+        return (
+            sales.join(items, on=[("ss_item", "i_item")], how="inner")
+            .group_by("i_cat")
+            .agg(
+                F.sum(col("ss_qty") * col("ss_price")).alias("rev"),
+                F.count("*").alias("cnt"),
+                F.avg(col("ss_price")).alias("avg_price"),
+            )
+            .order_by(col("rev").desc())
+            .limit(3)
+        )
+
+    assert_cpu_and_tpu_equal(q, conf=CONF, sort_result=False, approx_float=True)
+
+
+def test_q_rollup_with_filter():
+    """Rollup over two keys with a HAVING-style post-filter (q18/q27 shape)."""
+    ss = _store_sales()
+
+    def q(s):
+        return (
+            s.create_dataframe(ss, num_partitions=3)
+            .rollup("ss_store", "ss_item")
+            .agg(F.sum(col("ss_price")).alias("t"))
+            .filter(col("t") > 500)
+        )
+
+    assert_cpu_and_tpu_equal(q, conf=CONF, approx_float=True)
+
+
+def test_q_window_rank_over_join():
+    """Rank within category by revenue (q47/q67 shape)."""
+    ss, it = _store_sales(8000), _items()
+
+    def q(s):
+        sales = s.create_dataframe(ss, num_partitions=2)
+        items = s.create_dataframe(it, num_partitions=2)
+        j = sales.join(items, on=[("ss_item", "i_item")], how="inner")
+        agg = j.group_by("i_cat", "ss_item").agg(
+            F.sum(col("ss_price")).alias("rev")
+        )
+        return agg.with_column("rnk", F.rank().over(
+            Window.partition_by("i_cat").order_by(col("rev").desc(), col("ss_item"))
+        )).filter(col("rnk") <= 5)
+
+    assert_cpu_and_tpu_equal(q, conf=CONF, approx_float=True)
+
+
+def test_q_scalar_subquery_filter():
+    """WHERE price > (SELECT avg(price)) (q9/q44 shape)."""
+    ss = _store_sales()
+
+    def q(s):
+        from spark_rapids_tpu.functions import scalar_subquery
+
+        df = s.create_dataframe(ss, num_partitions=3)
+        avg_price = df.agg(F.avg(col("ss_price")).alias("a"))
+        return (
+            df.filter(col("ss_price") > scalar_subquery(avg_price))
+            .group_by("ss_store")
+            .agg(F.count("*").alias("n"))
+        )
+
+    assert_cpu_and_tpu_equal(q, conf=CONF)
+
+
+def test_q_in_subquery_semi():
+    """WHERE item IN (SELECT item FROM expensive_items) (q14/q38 IN shape)."""
+    ss, it = _store_sales(), _items()
+
+    def q(s):
+        sales = s.create_dataframe(ss, num_partitions=3)
+        items = s.create_dataframe(it, num_partitions=2)
+        pricey = items.filter(col("i_price") > 60).select("i_item")
+        return (
+            sales.filter(col("ss_item").isin(pricey))
+            .group_by("ss_store")
+            .agg(F.sum(col("ss_qty")).alias("q"))
+        )
+
+    assert_cpu_and_tpu_equal(q, conf=CONF)
+
+
+def test_q_multi_distinct():
+    """count(distinct a), count(distinct b), sum(c) together (q14/q38/q87
+    RewriteDistinctAggregates shape)."""
+    ss = _store_sales()
+
+    def q(s):
+        return (
+            s.create_dataframe(ss, num_partitions=3)
+            .group_by("ss_store")
+            .agg(
+                F.count_distinct(col("ss_item")).alias("items"),
+                F.count_distinct(col("ss_cust")).alias("custs"),
+                F.sum(col("ss_price")).alias("rev"),
+            )
+        )
+
+    assert_cpu_and_tpu_equal(q, conf=CONF, approx_float=True)
+
+
+def test_q_string_ops_and_case():
+    """String predicates + conditional aggregation (promo analysis shape)."""
+    ss = _store_sales()
+
+    def q(s):
+        df = s.create_dataframe(ss, num_partitions=3)
+        return (
+            df.with_column(
+                "has_promo",
+                F.when(
+                    col("ss_promo").is_not_null()
+                    & col("ss_promo").startswith("P-"),
+                    1,
+                ).otherwise(0),
+            )
+            .group_by("ss_store")
+            .agg(
+                F.sum(col("has_promo")).alias("promo_sales"),
+                F.count("*").alias("total"),
+            )
+        )
+
+    assert_cpu_and_tpu_equal(q, conf=CONF)
+
+
+def test_q_three_way_join():
+    """sales ⋈ items ⋈ stores with mixed predicates (q17/q25 shape)."""
+    ss, it, st = _store_sales(), _items(), _stores()
+
+    def q(s):
+        sales = s.create_dataframe(ss, num_partitions=3)
+        items = s.create_dataframe(it, num_partitions=2)
+        stores = s.create_dataframe(st, num_partitions=1)
+        return (
+            sales.join(items, on=[("ss_item", "i_item")], how="inner")
+            .join(stores, on=[("ss_store", "s_store")], how="inner")
+            .filter((col("s_state") != "TX") & (col("ss_qty") >= 3))
+            .group_by("s_state", "i_cat")
+            .agg(F.sum(col("ss_price")).alias("rev"))
+        )
+
+    assert_cpu_and_tpu_equal(q, conf=CONF, approx_float=True)
+
+
+def test_q_left_join_null_handling():
+    """Left join with unmatched rows + coalesce over the null side."""
+    ss, it = _store_sales(), _items(150)  # half the items missing
+
+    def q(s):
+        sales = s.create_dataframe(ss, num_partitions=3)
+        items = s.create_dataframe(it, num_partitions=2)
+        return (
+            sales.join(items, on=[("ss_item", "i_item")], how="left")
+            .with_column("cat", F.coalesce(col("i_cat"), F.lit("UNKNOWN")))
+            .group_by("cat")
+            .agg(F.count("*").alias("n"))
+        )
+
+    assert_cpu_and_tpu_equal(q, conf=CONF)
+
+
+def test_q_date_bucketing():
+    """Date arithmetic + bucketed aggregation (monthly revenue shape)."""
+    ss = _store_sales()
+
+    def q(s):
+        df = s.create_dataframe(ss, num_partitions=3)
+        return (
+            df.with_column("month", (col("ss_date") / 30).cast(__import__("spark_rapids_tpu.types", fromlist=["INT"]).INT))
+            .group_by("month")
+            .agg(F.sum(col("ss_price")).alias("rev"))
+            .order_by(col("month"))
+        )
+
+    assert_cpu_and_tpu_equal(q, conf=CONF, sort_result=False, approx_float=True)
+
+
+def test_q_union_distinct_sort():
+    """UNION of two filtered branches + distinct + global sort."""
+    ss = _store_sales()
+
+    def q(s):
+        df = s.create_dataframe(ss, num_partitions=3)
+        hi = df.filter(col("ss_price") > 80).select("ss_store", "ss_item")
+        lo = df.filter(col("ss_price") < 20).select("ss_store", "ss_item")
+        return hi.union(lo).distinct().order_by("ss_store", "ss_item")
+
+    assert_cpu_and_tpu_equal(q, conf=CONF, sort_result=False)
+
+
+def test_q_window_moving_sum_after_join():
+    """Moving window over joined+aggregated data (q57 shape)."""
+    ss, it = _store_sales(8000), _items()
+
+    def q(s):
+        sales = s.create_dataframe(ss, num_partitions=2)
+        items = s.create_dataframe(it, num_partitions=2)
+        daily = (
+            sales.join(items, on=[("ss_item", "i_item")], how="inner")
+            .with_column("week", (col("ss_date") / 7).cast(__import__("spark_rapids_tpu.types", fromlist=["INT"]).INT))
+            .group_by("i_cat", "week")
+            .agg(F.sum(col("ss_price")).alias("rev"))
+        )
+        w = Window.partition_by("i_cat").order_by("week").rows_between(-3, 0)
+        return daily.with_column("rev4", F.sum(col("rev")).over(w))
+
+    assert_cpu_and_tpu_equal(q, conf=CONF, approx_float=True)
+
+
+def test_q_aqe_and_skew_conf_end_to_end():
+    """The battery's join shapes run under AQE with skew handling on."""
+    ss, it = _store_sales(), _items()
+    conf = {
+        **CONF,
+        "spark.sql.adaptive.enabled": True,
+        "spark.sql.adaptive.autoBroadcastJoinThreshold": "1m",
+    }
+
+    def q(s):
+        sales = s.create_dataframe(ss, num_partitions=4)
+        items = s.create_dataframe(it, num_partitions=4)
+        return (
+            sales.join(items, on=[("ss_item", "i_item")], how="inner")
+            .group_by("i_cat")
+            .agg(F.count("*").alias("n"))
+        )
+
+    assert_cpu_and_tpu_equal(q, conf=conf)
