@@ -220,3 +220,87 @@ def test_distributed_group_sum(n_chips):
     for k, (s, n) in expect.items():
         assert got[k][0] == s, f"group {k}: sum {got[k][0]} != {s}"
         assert got[k][1] == n, f"group {k}: count {got[k][1]} != {n}"
+
+
+@needs_8
+def test_mesh_rollup():
+    """Grouping sets ride the Expand exec then a mesh exchange."""
+    rng = np.random.default_rng(41)
+    t = pa.table(
+        {
+            "a": rng.integers(0, 5, 600),
+            "b": rng.integers(0, 7, 600),
+            "x": rng.integers(0, 100, 600),
+        }
+    )
+    assert_mesh_equals_cpu(
+        lambda s: s.create_dataframe(t, num_partitions=4)
+        .rollup("a", "b")
+        .agg(sum_(col("x")).alias("t"), count("*").alias("n"))
+    )
+
+
+@needs_8
+def test_mesh_window_after_exchange():
+    """Window over mesh-exchanged partitions (partition_by keys hash to
+    chips; frames never cross chip boundaries)."""
+    from spark_rapids_tpu import functions as F
+    from spark_rapids_tpu.window import Window
+
+    rng = np.random.default_rng(42)
+    t = pa.table(
+        {
+            "k": rng.integers(0, 16, 800),
+            "d": rng.integers(0, 50, 800),
+            "v": rng.integers(0, 1000, 800) / 8.0,  # dyadic: sums are exact
+        }
+    )
+
+    def q(s):
+        w = Window.partition_by("k").order_by("d", "v").rows_between(-2, 0)
+        return s.create_dataframe(t, num_partitions=4).with_column(
+            "m", F.sum(col("v")).over(w)
+        )
+
+    assert_mesh_equals_cpu(q)
+
+
+@needs_8
+def test_mesh_multi_distinct():
+    """Expand-based multi-DISTINCT rewrite under mesh execution."""
+    from spark_rapids_tpu import functions as F
+
+    rng = np.random.default_rng(43)
+    t = pa.table(
+        {
+            "k": rng.integers(0, 6, 700),
+            "a": rng.integers(0, 40, 700),
+            "b": rng.integers(0, 25, 700),
+        }
+    )
+    assert_mesh_equals_cpu(
+        lambda s: s.create_dataframe(t, num_partitions=4)
+        .group_by("k")
+        .agg(
+            F.count_distinct(col("a")).alias("da"),
+            F.count_distinct(col("b")).alias("db"),
+        )
+    )
+
+
+@needs_8
+def test_mesh_task_retry_interplay():
+    """Mesh-mode queries run under the task-retry wrapper without
+    double-executing collective programs (one clean pass == exact rows)."""
+    rng = np.random.default_rng(44)
+    t = pa.table({"k": rng.integers(0, 10, 500), "v": rng.integers(0, 9, 500)})
+    s = mesh_session({"spark.task.maxFailures": 3})
+    rows = s.create_dataframe(t, num_partitions=4).group_by("k").agg(
+        sum_(col("v")).alias("s")
+    ).collect()
+    assert s._task_retries == 0
+    exp = {}
+    ks, vs = t.column("k").to_pylist(), t.column("v").to_pylist()
+    for k, v in zip(ks, vs):
+        exp[k] = exp.get(k, 0) + v
+    assert sorted(rows) == sorted(exp.items())
