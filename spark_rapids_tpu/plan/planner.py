@@ -302,6 +302,12 @@ def _num_partitions_hint(e: Exec) -> int:
         return _num_partitions_hint(e.children[0]) * _num_partitions_hint(
             e.children[1]
         )
+    if isinstance(e, CpuUnionExec):
+        # union CONCATENATES its children's partitions — reporting only the
+        # first child's count made aggregates over a union of
+        # single-partition inputs skip their merge exchange and aggregate
+        # each branch separately (wrong results)
+        return sum(_num_partitions_hint(c) for c in e.children)
     if e.children:
         return _num_partitions_hint(e.children[0])
     return 1

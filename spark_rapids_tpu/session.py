@@ -782,6 +782,208 @@ class DataFrame:
         cols = [UnresolvedAttribute(n) for n in self.schema.names]
         return DataFrame(self._session, L.Aggregate(cols, list(cols), self._plan))
 
+    def drop(self, *cols: str) -> "DataFrame":
+        """Project out the named columns (pyspark: unknown names ignored)."""
+        gone = set(cols)
+        keep = [n for n in self.schema.names if n not in gone]
+        return self.select(*keep)
+
+    def with_column_renamed(self, existing: str, new: str) -> "DataFrame":
+        """Rename one column; no-op when absent (pyspark semantics)."""
+        if existing not in self.schema.names:
+            return self
+        exprs = [
+            Alias(UnresolvedAttribute(n), new) if n == existing else col(n)
+            for n in self.schema.names
+        ]
+        return self.select(*exprs)
+
+    withColumnRenamed = with_column_renamed
+
+    def fillna(self, value, subset: Optional[List[str]] = None) -> "DataFrame":
+        """Replace nulls with ``value`` in type-compatible columns
+        (pyspark DataFrameNaFunctions.fill: numeric values fill numeric
+        columns, strings fill strings, bools fill bools)."""
+        from .expr.base import Literal
+        from .expr.conditional import Coalesce
+        from .types import (
+            BooleanType,
+            FractionalType,
+            IntegralType,
+            NumericType,
+            StringType,
+        )
+
+        if isinstance(value, dict):
+            # pyspark's per-column form: {'a': 0, 'b': 'x'}
+            if subset is not None:
+                raise ValueError("cannot use subset with a dict value")
+            per_col = dict(value)
+        elif isinstance(value, (bool, int, float, str)):
+            per_col = None
+        else:
+            raise TypeError(
+                f"fillna value must be bool/int/float/str/dict, got {type(value)}"
+            )
+
+        def compatible(v, dt) -> bool:
+            return (
+                (isinstance(v, bool) and isinstance(dt, BooleanType))
+                or (
+                    isinstance(v, (int, float))
+                    and not isinstance(v, bool)
+                    and isinstance(dt, NumericType)
+                )
+                or (isinstance(v, str) and isinstance(dt, StringType))
+            )
+
+        names = set(subset) if subset is not None else None
+        exprs: List[Expression] = []
+        for f in self.schema:
+            dt = f.data_type
+            if per_col is not None:
+                v = per_col.get(f.name)
+                applies = v is not None and compatible(v, dt)
+            else:
+                v = value
+                applies = (names is None or f.name in names) and compatible(v, dt)
+            if applies:
+                if isinstance(dt, FractionalType):
+                    v = float(v)
+                elif isinstance(dt, IntegralType) and not isinstance(v, bool):
+                    v = int(v)
+                exprs.append(
+                    Alias(
+                        Coalesce(
+                            (UnresolvedAttribute(f.name), Literal(v, dt))
+                        ),
+                        f.name,
+                    )
+                )
+            else:
+                exprs.append(UnresolvedAttribute(f.name))
+        return self.select(*exprs)
+
+    def dropna(
+        self,
+        how: str = "any",
+        thresh: Optional[int] = None,
+        subset: Optional[List[str]] = None,
+    ) -> "DataFrame":
+        """Drop rows with nulls (pyspark DataFrameNaFunctions.drop):
+        ``how='any'`` drops rows with any null among the subset,
+        ``'all'`` only all-null rows; ``thresh`` keeps rows with at least
+        that many non-nulls."""
+        from .expr.base import Literal
+        from .expr.conditional import If
+        from .types import INT
+
+        if how not in ("any", "all"):
+            raise ValueError(f"how must be 'any' or 'all', got {how!r}")
+        names = subset if subset is not None else list(self.schema.names)
+        if not names:
+            return self
+        non_null_count: Optional[Expression] = None
+        for n in names:
+            one = If(
+                _e(col(n).is_not_null()), Literal(1, INT), Literal(0, INT)
+            )
+            non_null_count = (
+                one
+                if non_null_count is None
+                else _e(Column(non_null_count) + Column(one))
+            )
+        if thresh is None:
+            thresh = len(names) if how == "any" else 1
+        return self.filter(Column(non_null_count) >= thresh)
+
+    def sample(self, *args, **kwargs) -> "DataFrame":
+        """Bernoulli sample. Accepts pyspark's signatures:
+        ``sample(fraction, seed=0)`` or
+        ``sample(withReplacement, fraction, seed)`` (replacement must be
+        falsy — with-replacement sampling is not implemented)."""
+        from .functions import rand as rand_fn
+
+        a = list(args)
+        if a and isinstance(a[0], bool):
+            with_replacement = a.pop(0)
+            if with_replacement:
+                raise NotImplementedError(
+                    "sample(withReplacement=True) is not supported"
+                )
+        fraction = kwargs.get("fraction", a[0] if a else None)
+        if fraction is None:
+            raise TypeError("sample() requires a fraction")
+        seed = kwargs.get("seed", a[1] if len(a) > 1 else 0)
+        return self.filter(rand_fn(int(seed)) < float(fraction))
+
+    def head(self, n: Optional[int] = None):
+        """pyspark: head() → first row or None; head(n) → list of rows
+        (including head(1) → one-element list)."""
+        if n is None:
+            rows = self.limit(1).collect()
+            return rows[0] if rows else None
+        return self.limit(n).collect()
+
+    def first(self):
+        return self.head(1)
+
+    def take(self, n: int) -> List[tuple]:
+        return self.limit(n).collect()
+
+    def show(self, n: int = 20, truncate: bool = True) -> None:
+        """Print the first ``n`` rows in pyspark's grid format."""
+        rows = self.limit(n).collect()
+        names = list(self.schema.names)
+        def fmt(v):
+            s = "null" if v is None else str(v)
+            return s[:17] + "..." if truncate and len(s) > 20 else s
+        table = [[fmt(v) for v in r] for r in rows]
+        widths = [
+            max(len(names[i]), *(len(r[i]) for r in table)) if table else len(names[i])
+            for i in range(len(names))
+        ]
+        sep = "+" + "+".join("-" * (w + 2) for w in widths) + "+"
+        print(sep)
+        print("|" + "|".join(f" {names[i]:<{widths[i]}} " for i in range(len(names))) + "|")
+        print(sep)
+        for r in table:
+            print("|" + "|".join(f" {r[i]:<{widths[i]}} " for i in range(len(names))) + "|")
+        print(sep)
+
+    def _set_op(self, other: "DataFrame", keep_matched: bool) -> "DataFrame":
+        """Null-safe INTERSECT/EXCEPT: tag each side, union, group by all
+        columns (GROUP BY treats nulls as equal — exactly Spark's set-op
+        null semantics, which a hash join's null-skipping keys would NOT
+        give), then filter on side presence."""
+        from .functions import lit, max as max_fn
+
+        names = list(self.schema.names)
+        left = self.with_column("__side_l", lit(1)).with_column("__side_r", lit(0))
+        right = other.with_column("__side_l", lit(0)).with_column("__side_r", lit(1))
+        grouped = (
+            left.union(right)
+            .group_by(*names)
+            .agg(
+                max_fn(col("__side_l")).alias("__hl"),
+                max_fn(col("__side_r")).alias("__hr"),
+            )
+        )
+        cond = (col("__hl") == 1) & (
+            (col("__hr") == 1) if keep_matched else (col("__hr") == 0)
+        )
+        return grouped.filter(cond).select(*names)
+
+    def intersect(self, other: "DataFrame") -> "DataFrame":
+        """Distinct rows present in both frames (Spark INTERSECT,
+        null-safe: a (null, 1) row on both sides IS returned)."""
+        return self._set_op(other, keep_matched=True)
+
+    def subtract(self, other: "DataFrame") -> "DataFrame":
+        """Distinct rows of this frame absent from the other (Spark
+        EXCEPT, null-safe)."""
+        return self._set_op(other, keep_matched=False)
+
     def drop_duplicates(self, subset: Optional[List[str]] = None) -> "DataFrame":
         if subset is None:
             return self.distinct()

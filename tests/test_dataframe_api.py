@@ -1,0 +1,203 @@
+"""pyspark-surface DataFrame sugar: drop / rename / fillna / dropna /
+head / take / sample / intersect / subtract / show — each lowers onto
+existing plan nodes (project, filter, aggregate, semi/anti join), so
+device placement comes for free."""
+from __future__ import annotations
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu.functions import col
+
+from harness import assert_cpu_and_tpu_equal, cpu_session, tpu_session
+
+
+T = pa.table(
+    {
+        "a": pa.array([1, 2, None, 4, 5], type=pa.int64()),
+        "b": pa.array([1.5, None, 3.5, None, 5.5]),
+        "s": pa.array(["x", None, "z", "w", None]),
+    }
+)
+
+
+def test_drop_and_rename():
+    def q(s):
+        return (
+            s.create_dataframe(T)
+            .drop("b", "nope")
+            .with_column_renamed("s", "label")
+        )
+
+    dev = tpu_session({})
+    df = q(dev)
+    assert df.schema.names == ["a", "label"]
+    assert_cpu_and_tpu_equal(q)
+
+
+def test_fillna_typed():
+    """Numeric fill hits numeric columns only; string fill strings only
+    (pyspark DataFrameNaFunctions.fill)."""
+    def qnum(s):
+        return s.create_dataframe(T).fillna(0)
+
+    def qstr(s):
+        return s.create_dataframe(T).fillna("missing")
+
+    dev = tpu_session({})
+    rows = sorted(qnum(dev).collect(), key=lambda r: (r[0], str(r[2])))
+    assert (0, 0.0) in {(r[0], 0.0) for r in rows if r[0] == 0}
+    assert any(r[2] is None for r in rows)  # strings untouched by 0-fill
+    srows = qstr(dev).collect()
+    assert all(r[2] is not None for r in srows)
+    assert any(r[0] is None for r in srows)  # ints untouched by str-fill
+    assert_cpu_and_tpu_equal(qnum)
+    assert_cpu_and_tpu_equal(qstr)
+
+
+def test_fillna_subset():
+    dev = tpu_session({})
+    rows = dev.create_dataframe(T).fillna(9, subset=["a"]).collect()
+    assert all(r[0] is not None for r in rows)
+    assert any(r[1] is None for r in rows)
+
+
+def test_dropna_any_all_thresh():
+    def q_any(s):
+        return s.create_dataframe(T).dropna()
+
+    def q_all(s):
+        return s.create_dataframe(T).dropna(how="all")
+
+    def q_thresh(s):
+        return s.create_dataframe(T).dropna(thresh=2)
+
+    dev = tpu_session({})
+    assert len(q_any(dev).collect()) == 1  # only the fully-populated row...
+    assert len(q_all(dev).collect()) == 5  # no all-null rows
+    assert len(q_thresh(dev).collect()) == 4
+    for q in (q_any, q_all, q_thresh):
+        assert_cpu_and_tpu_equal(q)
+
+
+def test_head_first_take():
+    dev = tpu_session({})
+    df = dev.create_dataframe(T).sort("a")
+    assert df.first() is not None
+    assert len(df.take(3)) == 3
+    assert df.head() == df.take(1)[0]
+    assert len(df.head(2)) == 2
+
+
+def test_sample_differential_and_fraction():
+    rng = np.random.default_rng(0)
+    big = pa.table({"x": rng.integers(0, 100, 20000)})
+
+    def q(s):
+        return s.create_dataframe(big).sample(0.25, seed=7)
+
+    # rand() stays CPU-side by default (not bit-identical to Spark's
+    # XORShift stream on device); the filter falls back with a reason
+    assert_cpu_and_tpu_equal(q, allowed_non_tpu=["CpuFilter"])
+    n = len(q(tpu_session({"spark.rapids.sql.test.allowedNonGpu": "CpuFilter"})).collect())
+    assert 0.2 < n / 20000 < 0.3
+
+
+def test_intersect_subtract():
+    t1 = pa.table({"k": [1, 2, 3, 4, 4], "v": ["a", "b", "c", "d", "d"]})
+    t2 = pa.table({"k": [3, 4, 5], "v": ["c", "d", "e"]})
+
+    def qi(s):
+        return s.create_dataframe(t1).intersect(s.create_dataframe(t2))
+
+    def qs(s):
+        return s.create_dataframe(t1).subtract(s.create_dataframe(t2))
+
+    dev = tpu_session({})
+    assert sorted(qi(dev).collect()) == [(3, "c"), (4, "d")]
+    assert sorted(qs(dev).collect()) == [(1, "a"), (2, "b")]
+    assert_cpu_and_tpu_equal(qi)
+    assert_cpu_and_tpu_equal(qs)
+
+
+def test_show_smoke(capsys):
+    tpu_session({}).create_dataframe(T).show(3)
+    out = capsys.readouterr().out
+    assert "| a" in out and "null" in out and out.count("+") >= 4
+
+
+def test_intersect_subtract_null_safe():
+    """Spark set ops use null-safe equality: a (null, x) row on both sides
+    intersects, and is removed by EXCEPT (a hash join would skip it)."""
+    t1 = pa.table({"k": pa.array([None, 1, 2], type=pa.int64()), "v": ["a", "b", "c"]})
+    t2 = pa.table({"k": pa.array([None, 2], type=pa.int64()), "v": ["a", "c"]})
+
+    def qi(s):
+        return s.create_dataframe(t1).intersect(s.create_dataframe(t2))
+
+    def qs(s):
+        return s.create_dataframe(t1).subtract(s.create_dataframe(t2))
+
+    dev = tpu_session({})
+    key = lambda r: (r[0] is None, r[0] or 0, r[1])
+    assert sorted(qi(dev).collect(), key=key) == sorted(
+        [(2, "c"), (None, "a")], key=key
+    )
+    assert sorted(qs(dev).collect(), key=key) == [(1, "b")]
+    assert_cpu_and_tpu_equal(qi)
+    assert_cpu_and_tpu_equal(qs)
+
+
+def test_sample_pyspark_positional_form():
+    big = pa.table({"x": np.arange(1000)})
+    s = tpu_session({"spark.rapids.sql.test.allowedNonGpu": "CpuFilter"})
+    n = len(s.create_dataframe(big).sample(False, 0.5, 3).collect())
+    assert 350 < n < 650
+    with pytest.raises(NotImplementedError):
+        s.create_dataframe(big).sample(True, 0.5)
+
+
+def test_head_list_semantics():
+    s = tpu_session({})
+    df = s.create_dataframe(T).sort("a")
+    one = df.head(1)
+    assert isinstance(one, list) and len(one) == 1  # pyspark: head(1) is a LIST
+    assert df.head() == one[0]
+
+
+def test_fillna_dict_form():
+    def q(s):
+        return s.create_dataframe(T).fillna({"a": 0, "s": "missing"})
+
+    dev = tpu_session({})
+    rows = q(dev).collect()
+    assert all(r[0] is not None and r[2] is not None for r in rows)
+    assert any(r[1] is None for r in rows)  # 'b' untouched
+    assert_cpu_and_tpu_equal(q)
+    with pytest.raises(TypeError):
+        dev.create_dataframe(T).fillna([1, 2])
+
+
+def test_dropna_validates_how():
+    with pytest.raises(ValueError, match="any.*all|all.*any"):
+        tpu_session({}).create_dataframe(T).dropna(how="alls")
+
+
+def test_union_of_single_partitions_aggregates_globally():
+    """Regression: union CONCATENATES partitions, so an aggregate above a
+    union of two 1-partition frames still needs its merge exchange — the
+    partition hint once reported only the first child's count, and each
+    branch aggregated separately."""
+    from spark_rapids_tpu import functions as F
+
+    t1 = pa.table({"k": [1, 2, 3], "v": [10, 20, 30]})
+    t2 = pa.table({"k": [2, 3, 4], "v": [5, 6, 7]})
+
+    def q(s):
+        u = s.create_dataframe(t1).union(s.create_dataframe(t2))
+        return u.group_by("k").agg(F.sum(col("v")).alias("s"))
+
+    dev = tpu_session({})
+    assert sorted(q(dev).collect()) == [(1, 10), (2, 25), (3, 36), (4, 7)]
+    assert_cpu_and_tpu_equal(q)
