@@ -376,6 +376,114 @@ class StddevSamp(_CentralMoment):
 
 
 @dataclass(frozen=True)
+class _PairMoment(AggregateFunction):
+    """covar_pop / covar_samp / corr over (n, Σx, Σy, Σxy [, Σx², Σy²])
+    buffers — plain count/sum segment reductions, so the fused device
+    aggregate kernel serves them unchanged. Spark semantics: only rows
+    where BOTH operands are non-null contribute (Corr.scala /
+    Covariance.scala); the masked update expressions below encode that."""
+
+    x: Expression
+    y: Expression
+
+    sample = False
+    is_corr = False
+
+    @property
+    def data_type(self) -> DataType:
+        return DOUBLE
+
+    def _masked(self):
+        from .base import Literal
+        from .cast import Cast
+        from .conditional import If
+        from .predicates import And, IsNotNull
+
+        both = And(IsNotNull(self.x), IsNotNull(self.y))
+        null = Literal(None, DOUBLE)
+
+        def m(e):
+            if not isinstance(e.data_type, DoubleType):
+                e = Cast(e, DOUBLE)
+            return If(both, e, null)
+
+        return m(self.x), m(self.y)
+
+    @property
+    def update_exprs(self):
+        from .arithmetic import Multiply
+
+        mx, my = self._masked()
+        base = (mx, mx, my, Multiply(mx, my))
+        if self.is_corr:
+            return base + (Multiply(mx, mx), Multiply(my, my))
+        return base
+
+    @property
+    def buffer_types(self):
+        return (LONG,) + (DOUBLE,) * (5 if self.is_corr else 3)
+
+    @property
+    def update_ops(self):
+        return ("count",) + ("sum",) * (5 if self.is_corr else 3)
+
+    @property
+    def merge_ops(self):
+        return ("sum",) * (6 if self.is_corr else 4)
+
+    def evaluate(self, ctx: Ctx, buffers: Sequence[Val]) -> Val:
+        xp = ctx.xp
+        n = ctx.broadcast(buffers[0].data).astype(xp.float64)
+        sx = ctx.broadcast(buffers[1].data)
+        sy = ctx.broadcast(buffers[2].data)
+        sxy = ctx.broadcast(buffers[3].data)
+        safe_n = xp.where(n > 0, n, 1.0)
+        cxy = sxy / safe_n - (sx / safe_n) * (sy / safe_n)
+        if self.is_corr:
+            sxx = ctx.broadcast(buffers[4].data)
+            syy = ctx.broadcast(buffers[5].data)
+            vx = sxx / safe_n - (sx / safe_n) ** 2
+            vy = syy / safe_n - (sy / safe_n) ** 2
+            # Spark Corr: NaN when either side is constant (0/0)
+            data = cxy / xp.sqrt(xp.maximum(vx, 0.0) * xp.maximum(vy, 0.0))
+            valid = n >= 1
+        elif self.sample:
+            # covar_samp: (Σxy − ΣxΣy/n)/(n−1). At n == 1 the numerator is
+            # exactly 0, so 0/0 yields NaN — matching the engine's
+            # var_samp/stddev_samp convention (NaN at one sample, null at
+            # zero; the _CentralMoment family above)
+            data = (sxy - sx * sy / safe_n) / (n - 1)
+            valid = n >= 1
+        else:
+            data = cxy
+            valid = n >= 1
+        return Val(data.astype(xp.float64), valid)
+
+    def __str__(self):
+        name = (
+            "corr"
+            if self.is_corr
+            else ("covar_samp" if self.sample else "covar_pop")
+        )
+        return f"{name}({self.x}, {self.y})"
+
+
+@dataclass(frozen=True)
+class CovarPop(_PairMoment):
+    sample = False
+
+
+@dataclass(frozen=True)
+class CovarSamp(_PairMoment):
+    sample = True
+
+
+@dataclass(frozen=True)
+class Corr(_PairMoment):
+    is_corr = True
+
+
+@dataclass(frozen=True)
 class CollectList(AggregateFunction):
     """collect_list — gathers non-null values per group into an array
     (reference: AggregateFunctions.scala GpuCollectList). Runs on the CPU

@@ -429,6 +429,24 @@ def var_pop(c) -> Column:
     return Column(VariancePop(_e(c)))
 
 
+def covar_pop(x, y) -> Column:
+    from .expr.aggregates import CovarPop
+
+    return Column(CovarPop(_e(x), _e(y)))
+
+
+def covar_samp(x, y) -> Column:
+    from .expr.aggregates import CovarSamp
+
+    return Column(CovarSamp(_e(x), _e(y)))
+
+
+def corr(x, y) -> Column:
+    from .expr.aggregates import Corr
+
+    return Column(Corr(_e(x), _e(y)))
+
+
 def collect_list(c) -> Column:
     from .expr.aggregates import CollectList
 

@@ -205,7 +205,8 @@ _expr(agg.Average, check=_float_agg_check, sig=SIGS["numeric"])
 _expr(Cast, check=_cast_check)
 _expr(agg.Min, sig=SIGS["orderable"])
 _expr(agg.Max, sig=SIGS["orderable"])
-for _cls in (agg.StddevSamp, agg.StddevPop, agg.VarianceSamp, agg.VariancePop):
+for _cls in (agg.StddevSamp, agg.StddevPop, agg.VarianceSamp, agg.VariancePop,
+             agg.CovarPop, agg.CovarSamp, agg.Corr):
     _expr(_cls)
 
 

@@ -815,10 +815,10 @@ class DataFrame:
         )
 
         if isinstance(value, dict):
-            # pyspark's per-column form: {'a': 0, 'b': 'x'}
-            if subset is not None:
-                raise ValueError("cannot use subset with a dict value")
+            # pyspark's per-column form: {'a': 0, 'b': 'x'}; subset is
+            # documented as IGNORED for dict values
             per_col = dict(value)
+            subset = None
         elif isinstance(value, (bool, int, float, str)):
             per_col = None
         else:
@@ -905,12 +905,13 @@ class DataFrame:
         from .functions import rand as rand_fn
 
         a = list(args)
+        with_replacement = kwargs.pop("withReplacement", None)
         if a and isinstance(a[0], bool):
             with_replacement = a.pop(0)
-            if with_replacement:
-                raise NotImplementedError(
-                    "sample(withReplacement=True) is not supported"
-                )
+        if with_replacement:
+            raise NotImplementedError(
+                "sample(withReplacement=True) is not supported"
+            )
         fraction = kwargs.get("fraction", a[0] if a else None)
         if fraction is None:
             raise TypeError("sample() requires a fraction")
@@ -926,7 +927,8 @@ class DataFrame:
         return self.limit(n).collect()
 
     def first(self):
-        return self.head(1)
+        """pyspark: first() == head() — a single row, or None when empty."""
+        return self.head()
 
     def take(self, n: int) -> List[tuple]:
         return self.limit(n).collect()

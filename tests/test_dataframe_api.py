@@ -156,6 +156,8 @@ def test_sample_pyspark_positional_form():
     assert 350 < n < 650
     with pytest.raises(NotImplementedError):
         s.create_dataframe(big).sample(True, 0.5)
+    with pytest.raises(NotImplementedError):
+        s.create_dataframe(big).sample(withReplacement=True, fraction=0.5)
 
 
 def test_head_list_semantics():
@@ -177,6 +179,9 @@ def test_fillna_dict_form():
     assert_cpu_and_tpu_equal(q)
     with pytest.raises(TypeError):
         dev.create_dataframe(T).fillna([1, 2])
+    # pyspark: subset is IGNORED when value is a dict
+    rows = dev.create_dataframe(T).fillna({"a": 0}, subset=["s"]).collect()
+    assert all(r[0] is not None for r in rows)
 
 
 def test_dropna_validates_how():

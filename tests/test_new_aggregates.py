@@ -26,7 +26,8 @@ from spark_rapids_tpu.functions import (
 from spark_rapids_tpu.types import DOUBLE, INT, LONG, STRING
 
 from data_gen import gen_grouped_table, gen_table
-from harness import assert_cpu_and_tpu_equal
+from harness import assert_cpu_and_tpu_equal, tpu_session
+from spark_rapids_tpu import functions as F
 
 AGG_FALLBACK = ["HashAggregate", "ShuffleExchange", "CpuHashAggregate",
                 "CpuShuffleExchange", "CpuScan", "CpuCoalesce", "Coalesce"]
@@ -428,3 +429,92 @@ def test_drop_duplicates_subset():
     assert_cpu_and_tpu_equal(
         lambda s: s.create_dataframe(t).drop_duplicates(["k"]).select(col("k"))
     )
+
+
+class TestPairMoments:
+    """corr / covar_pop / covar_samp (Corr.scala / Covariance.scala
+    semantics: only rows with BOTH operands non-null contribute;
+    covar_samp is null below 2 pairs; corr of a constant side is NaN)."""
+
+    def _table(self, n=4000, seed=0):
+        rng = np.random.default_rng(seed)
+        x = rng.standard_normal(n)
+        y = 2 * x + rng.standard_normal(n) * 0.5
+        xnull = rng.random(n) < 0.15
+        ynull = rng.random(n) < 0.1
+        return pa.table(
+            {
+                "k": rng.integers(0, 5, n),
+                "x": pa.array(
+                    [None if m else float(v) for m, v in zip(xnull, x)],
+                    type=pa.float64(),
+                ),
+                "y": pa.array(
+                    [None if m else float(v) for m, v in zip(ynull, y)],
+                    type=pa.float64(),
+                ),
+            }
+        )
+
+    def test_differential(self):
+        t = self._table()
+
+        def q(s):
+            return (
+                s.create_dataframe(t, num_partitions=3)
+                .group_by("k")
+                .agg(
+                    F.corr(col("x"), col("y")).alias("r"),
+                    F.covar_pop(col("x"), col("y")).alias("cp"),
+                    F.covar_samp(col("x"), col("y")).alias("cs"),
+                    F.count("*").alias("n"),
+                )
+            )
+
+        assert_cpu_and_tpu_equal(q, approx_float=True)
+
+    def test_matches_numpy(self):
+        t = self._table()
+        s = tpu_session({})
+        rows = s.create_dataframe(t).agg(
+            F.corr(col("x"), col("y")).alias("r"),
+            F.covar_samp(col("x"), col("y")).alias("cs"),
+        ).collect()
+        xs = t.column("x").to_pylist()
+        ys = t.column("y").to_pylist()
+        pairs = [(a, b) for a, b in zip(xs, ys) if a is not None and b is not None]
+        gx = np.asarray([p[0] for p in pairs])
+        gy = np.asarray([p[1] for p in pairs])
+        assert abs(rows[0][0] - float(np.corrcoef(gx, gy)[0, 1])) < 1e-9
+        assert abs(rows[0][1] - float(np.cov(gx, gy)[0, 1])) < 1e-9
+
+    def test_edge_cases(self):
+        t = pa.table(
+            {
+                "k": [1, 1, 2, 3, 3, 3],
+                "x": pa.array([1.0, None, 5.0, 2.0, 2.0, 2.0]),
+                "y": pa.array([2.0, 3.0, None, 1.0, 4.0, 9.0]),
+            }
+        )
+
+        def q(s):
+            return (
+                s.create_dataframe(t)
+                .group_by("k")
+                .agg(
+                    F.covar_samp(col("x"), col("y")).alias("cs"),
+                    F.covar_pop(col("x"), col("y")).alias("cp"),
+                    F.corr(col("x"), col("y")).alias("r"),
+                )
+            )
+
+        s = tpu_session({})
+        rows = {r[0]: r[1:] for r in q(s).collect()}
+        # k=1: one valid pair -> covar_samp NaN (0/0, matching var_samp's
+        # one-sample convention), covar_pop 0
+        assert np.isnan(rows[1][0]) and rows[1][1] == 0.0
+        # k=2: zero valid pairs -> all null
+        assert rows[2][0] is None and rows[2][1] is None and rows[2][2] is None
+        # k=3: x constant -> corr NaN, covariances 0
+        assert np.isnan(rows[3][2]) and rows[3][1] == 0.0
+        assert_cpu_and_tpu_equal(q, approx_float=True)
