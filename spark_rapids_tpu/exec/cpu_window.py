@@ -20,9 +20,12 @@ from ..expr.windows import (
     CURRENT_ROW,
     UNBOUNDED_FOLLOWING,
     UNBOUNDED_PRECEDING,
+    CumeDist,
     DenseRank,
     Lag,
     Lead,
+    NTile,
+    PercentRank,
     Rank,
     RowNumber,
     WindowExpression,
@@ -123,19 +126,47 @@ class CpuWindowExec(Exec):
         fn = we.function
         frame = we.spec.resolved_frame()
 
+        def _peer_first0(s, e):
+            """0-based rank (index of each row's peer-group first row) —
+            shared by Rank and PercentRank."""
+            ranks = np.arange(e - s)
+            return np.maximum.accumulate(np.where(peer_start[s:e], ranks, 0))
+
         if isinstance(fn, (RowNumber, Rank, DenseRank)):
             out = np.zeros(n, dtype=np.int32)
             for s, e in zip(seg_bounds[:-1], seg_bounds[1:]):
                 if isinstance(fn, RowNumber):
                     out[s:e] = np.arange(1, e - s + 1)
                 elif isinstance(fn, Rank):
-                    ranks = np.arange(1, e - s + 1)
-                    firsts = np.maximum.accumulate(
-                        np.where(peer_start[s:e], ranks, 0)
-                    )
-                    out[s:e] = firsts
+                    out[s:e] = _peer_first0(s, e) + 1
                 else:  # DenseRank
                     out[s:e] = np.cumsum(peer_start[s:e].astype(np.int32))
+            return out, np.ones(n, dtype=bool)
+
+        if isinstance(fn, (PercentRank, CumeDist, NTile)):
+            is_frac = isinstance(fn, (PercentRank, CumeDist))
+            out = np.zeros(n, dtype=np.float64 if is_frac else np.int32)
+            for s, e in zip(seg_bounds[:-1], seg_bounds[1:]):
+                m = e - s
+                if isinstance(fn, PercentRank):
+                    out[s:e] = _peer_first0(s, e) / (m - 1) if m > 1 else 0.0
+                elif isinstance(fn, CumeDist):
+                    # rows <= current peer group == each row's peer-group
+                    # LAST index + 1 (next-group-start propagation)
+                    ends = np.append(peer_start[s + 1 : e], True)
+                    ends_idx = np.nonzero(ends)[0]
+                    last = ends_idx[np.searchsorted(ends_idx, np.arange(m))]
+                    out[s:e] = (last + 1) / m
+                else:  # NTile
+                    b = fn.buckets
+                    base, rem = divmod(m, b)
+                    rn0 = np.arange(m)
+                    big = rem * (base + 1)
+                    out[s:e] = np.where(
+                        rn0 < big,
+                        rn0 // max(base + 1, 1),
+                        rem + (rn0 - big) // max(base, 1),
+                    ) + 1
             return out, np.ones(n, dtype=bool)
 
         if isinstance(fn, (Lead, Lag)):

@@ -32,7 +32,10 @@ from ..expr.windows import (
     CURRENT_ROW,
     UNBOUNDED_FOLLOWING,
     UNBOUNDED_PRECEDING,
+    CumeDist,
     DenseRank,
+    NTile,
+    PercentRank,
     Lag,
     Lead,
     Rank,
@@ -204,6 +207,31 @@ def _compute_window_column(
     if isinstance(fn, DenseRank):
         out = _segscan(peer_start.astype(jnp.int32), seg_start, jnp.add)
         return DeviceColumn(we.data_type, out.astype(jnp.int32), live)
+    if isinstance(fn, (PercentRank, CumeDist, NTile)):
+        n = (seg_last - seg_first + 1).astype(jnp.float64)
+        if isinstance(fn, PercentRank):
+            rank = (peer_first - seg_first).astype(jnp.float64)
+            out = jnp.where(n > 1, rank / jnp.maximum(n - 1, 1.0), 0.0)
+            return DeviceColumn(we.data_type, out, live)
+        if isinstance(fn, CumeDist):
+            le = (peer_last - seg_first + 1).astype(jnp.float64)
+            return DeviceColumn(we.data_type, le / jnp.maximum(n, 1.0), live)
+        # NTile: first (n % b) buckets take one extra row
+        b = jnp.asarray(fn.buckets, jnp.int64)
+        ni = (seg_last - seg_first + 1).astype(jnp.int64)
+        rn0 = (idx - seg_first).astype(jnp.int64)  # 0-based row number
+        base = ni // b
+        rem = ni % b
+        big_span = rem * (base + 1)
+        in_big = rn0 < big_span
+        bucket = jnp.where(
+            in_big,
+            rn0 // jnp.maximum(base + 1, 1),
+            rem + (rn0 - big_span) // jnp.maximum(base, 1),
+        )
+        return DeviceColumn(
+            we.data_type, (bucket + 1).astype(jnp.int32), live
+        )
 
     if isinstance(fn, (Lead, Lag)):
         from ..types import NullType

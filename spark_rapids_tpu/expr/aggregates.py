@@ -437,6 +437,7 @@ class _PairMoment(AggregateFunction):
         sx = ctx.broadcast(buffers[1].data)
         sy = ctx.broadcast(buffers[2].data)
         sxy = ctx.broadcast(buffers[3].data)
+        nan = xp.float64(float("nan"))
         safe_n = xp.where(n > 0, n, 1.0)
         cxy = sxy / safe_n - (sx / safe_n) * (sy / safe_n)
         if self.is_corr:
@@ -444,15 +445,21 @@ class _PairMoment(AggregateFunction):
             syy = ctx.broadcast(buffers[5].data)
             vx = sxx / safe_n - (sx / safe_n) ** 2
             vy = syy / safe_n - (sy / safe_n) ** 2
-            # Spark Corr: NaN when either side is constant (0/0)
-            data = cxy / xp.sqrt(xp.maximum(vx, 0.0) * xp.maximum(vy, 0.0))
+            # Spark Corr: NaN when either side is constant — selected via
+            # where over a SAFE divisor (unguarded 0/0 spews numpy
+            # RuntimeWarnings on the CPU engine)
+            denom = xp.sqrt(xp.maximum(vx, 0.0) * xp.maximum(vy, 0.0))
+            data = xp.where(denom > 0, cxy / xp.where(denom > 0, denom, 1.0), nan)
             valid = n >= 1
         elif self.sample:
-            # covar_samp: (Σxy − ΣxΣy/n)/(n−1). At n == 1 the numerator is
-            # exactly 0, so 0/0 yields NaN — matching the engine's
-            # var_samp/stddev_samp convention (NaN at one sample, null at
-            # zero; the _CentralMoment family above)
-            data = (sxy - sx * sy / safe_n) / (n - 1)
+            # covar_samp: (Σxy − ΣxΣy/n)/(n−1); NaN at one pair — matching
+            # the engine's var_samp/stddev_samp convention (NaN at one
+            # sample, null at zero; the _CentralMoment family above)
+            data = xp.where(
+                n > 1,
+                (sxy - sx * sy / safe_n) / xp.where(n > 1, n - 1, 1.0),
+                nan,
+            )
             valid = n >= 1
         else:
             data = cxy

@@ -140,6 +140,45 @@ class DenseRank(RankingFunction):
 
 
 @dataclass(frozen=True)
+class PercentRank(RankingFunction):
+    """(rank − 1) / (partition rows − 1); 0.0 for single-row partitions."""
+
+    @property
+    def data_type(self) -> DataType:
+        from ..types import DOUBLE
+
+        return DOUBLE
+
+    def __str__(self):
+        return "percent_rank()"
+
+
+@dataclass(frozen=True)
+class CumeDist(RankingFunction):
+    """rows ≤ current peer group / partition rows."""
+
+    @property
+    def data_type(self) -> DataType:
+        from ..types import DOUBLE
+
+        return DOUBLE
+
+    def __str__(self):
+        return "cume_dist()"
+
+
+@dataclass(frozen=True)
+class NTile(RankingFunction):
+    """Spark NTile: n rows into ``buckets`` groups; the first n % buckets
+    groups get one extra row."""
+
+    buckets: int
+
+    def __str__(self):
+        return f"ntile({self.buckets})"
+
+
+@dataclass(frozen=True)
 class Lead(Expression):
     child: Expression
     offset: int = 1

@@ -308,6 +308,26 @@ def dense_rank() -> Column:
     return Column(DenseRank())
 
 
+def percent_rank() -> Column:
+    from .expr.windows import PercentRank
+
+    return Column(PercentRank())
+
+
+def cume_dist() -> Column:
+    from .expr.windows import CumeDist
+
+    return Column(CumeDist())
+
+
+def ntile(n: int) -> Column:
+    from .expr.windows import NTile
+
+    if n < 1:
+        raise ValueError("ntile buckets must be >= 1")
+    return Column(NTile(int(n)))
+
+
 def lag(c, offset: int = 1, default=None) -> Column:
     from .expr.windows import Lag
 

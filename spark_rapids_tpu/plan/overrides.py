@@ -383,7 +383,10 @@ def _window_check(e, conf: TpuConf) -> Optional[str]:
 
     fn = e.function
     fr = e.spec.resolved_frame()
-    if isinstance(fn, (W.Rank, W.DenseRank, W.RowNumber)):
+    if isinstance(
+        fn,
+        (W.Rank, W.DenseRank, W.RowNumber, W.PercentRank, W.CumeDist, W.NTile),
+    ):
         if not e.spec.order_by:
             return "ranking window functions require ORDER BY"
         return None
@@ -415,7 +418,8 @@ def _window_check(e, conf: TpuConf) -> Optional[str]:
 from ..expr import windows as _W  # noqa: E402
 
 _expr(_W.WindowExpression, check=_window_check)
-for _cls in (_W.RowNumber, _W.Rank, _W.DenseRank, _W.Lead, _W.Lag):
+for _cls in (_W.RowNumber, _W.Rank, _W.DenseRank, _W.Lead, _W.Lag,
+             _W.PercentRank, _W.CumeDist, _W.NTile):
     _expr(_cls)
 
 

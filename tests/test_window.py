@@ -8,7 +8,7 @@ from spark_rapids_tpu import functions as F
 from spark_rapids_tpu.functions import col
 from spark_rapids_tpu.window import Window
 
-from harness import assert_cpu_and_tpu_equal
+from harness import assert_cpu_and_tpu_equal, tpu_session
 
 
 def _table(n=300, groups=12, seed=21, with_ties=True):
@@ -306,3 +306,48 @@ def test_decimal_range_frame():
     )
     got = {str(r[1]): r[3] for r in rows}
     assert got == {"1.00": 30, "4.00": 70, "9.00": 60}, got
+
+
+def test_percent_rank_cume_dist_ntile():
+    """percent_rank / cume_dist / ntile (Spark ranking family; device via
+    the segment-scan kernel). Oracle check against hand-computed values,
+    plus differential vs the CPU engine with ties."""
+    t = pa.table(
+        {
+            "k": [1, 1, 1, 1, 2, 2, 2],
+            "d": [10, 20, 20, 30, 5, 5, 7],
+            "v": [1.0, 2.0, 3.0, 4.0, 5.0, 6.0, 7.0],
+        }
+    )
+
+    def q(s):
+        w = Window.partition_by("k").order_by("d")
+        return (
+            s.create_dataframe(t)
+            .with_column("pr", F.percent_rank().over(w))
+            .with_column("cd", F.cume_dist().over(w))
+            .with_column("nt", F.ntile(2).over(w))
+        )
+
+    assert_cpu_and_tpu_equal(q)
+    s = tpu_session({})
+    rows = {(r[0], r[1], r[2]): r[3:] for r in q(s).collect()}
+    # k=1: d=[10,20,20,30] -> pr = [0, 1/3, 1/3, 1]; cd = [.25, .75, .75, 1]
+    assert rows[(1, 10, 1.0)] == (0.0, 0.25, 1)
+    assert rows[(1, 20, 2.0)][0] == pytest.approx(1 / 3)
+    assert rows[(1, 20, 2.0)][1] == 0.75
+    assert rows[(1, 30, 4.0)] == (1.0, 1.0, 2)
+    # k=2: 3 rows, 2 buckets -> sizes [2, 1]
+    assert [rows[(2, 5, 5.0)][2], rows[(2, 5, 6.0)][2], rows[(2, 7, 7.0)][2]] == [1, 1, 2]
+
+
+def test_ntile_more_buckets_than_rows():
+    t = pa.table({"k": [1, 1], "d": [1, 2]})
+
+    def q(s):
+        w = Window.partition_by("k").order_by("d")
+        return s.create_dataframe(t).with_column("nt", F.ntile(5).over(w))
+
+    assert_cpu_and_tpu_equal(q)
+    s = tpu_session({})
+    assert sorted(r[2] for r in q(s).collect()) == [1, 2]
