@@ -113,15 +113,17 @@ def test_config_defaults_parse_roundtrip():
     from spark_rapids_tpu.config import TpuConf
 
     conf = TpuConf({})
-    for entry in cfg.ALL_ENTRIES if hasattr(cfg, "ALL_ENTRIES") else []:
-        entry.get(conf)
-    # fallback: walk module attributes
     n = 0
-    for name in dir(cfg):
-        e = getattr(cfg, name)
-        if hasattr(e, "get") and hasattr(e, "key") and hasattr(e, "doc_text"):
-            e.get(conf)
-            n += 1
+    for key, entry in cfg._REGISTRY.items():
+        got = entry.get(conf)
+        assert got == entry.default, f"{key}: default {entry.default!r} -> {got!r}"
+        if entry.default is not None:
+            # the string form of the default must survive the converter
+            rt = entry.conv(str(entry.default))
+            assert rt == entry.default, (
+                f"{key}: str(default) {entry.default!r} round-trips to {rt!r}"
+            )
+        n += 1
     assert n >= 40
 
 
