@@ -1,0 +1,11 @@
+"""TPC-H rig: scalable data generator + all 22 queries as DataFrame builders.
+
+The reference ships no TPC-H rig (its only in-repo benchmark is the mortgage
+ETL job — integration_tests/.../mortgage/Benchmarks.scala); BASELINE.md's
+north star is TPC-derived, so this framework builds its own. ``datagen``
+produces the eight TPC-H tables at any scale factor as Parquet (or in-memory
+Arrow), ``queries`` holds hand-written DataFrame translations of Q1-Q22
+(dates resolved per the spec's validation parameters).
+"""
+from .datagen import TABLES, gen_table, write_tables  # noqa: F401
+from .queries import QUERIES, tpch_query  # noqa: F401

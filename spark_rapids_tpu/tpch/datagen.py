@@ -1,0 +1,332 @@
+"""TPC-H table generator (dbgen-shaped, vectorized numpy, deterministic).
+
+Produces the eight standard tables at any scale factor with the spec's
+cardinalities and value distributions where queries depend on them:
+selective text columns (p_name words, p_type triples, comment trigger
+phrases for Q13/Q16), the customer-without-orders thirds rule (Q13/Q22),
+date chains o_orderdate -> l_shipdate/commitdate/receiptdate (Q1/Q4/Q12),
+returnflag/linestatus derivation (Q1), and o_orderstatus/o_totalprice
+computed exactly from the order's lineitems (Q18/Q21).
+
+Monetary columns are float64 ("useDoubleForDecimal" variant, the common
+columnar-benchmark configuration) so aggregation rides the TPU's native
+f64 path instead of emulated decimal128.
+"""
+from __future__ import annotations
+
+import os
+from datetime import date
+from typing import Dict, List
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+
+EPOCH = date(1970, 1, 1)
+
+
+def _d(y: int, m: int, d_: int) -> int:
+    return (date(y, m, d_) - EPOCH).days
+
+
+START_DATE = _d(1992, 1, 1)
+END_DATE = _d(1998, 8, 2)  # o_orderdate upper bound (spec: end.date - 121)
+CURRENT_DATE = _d(1995, 6, 17)
+
+REGIONS = ["AFRICA", "AMERICA", "ASIA", "EUROPE", "MIDDLE EAST"]
+NATIONS = [
+    ("ALGERIA", 0), ("ARGENTINA", 1), ("BRAZIL", 1), ("CANADA", 1),
+    ("EGYPT", 4), ("ETHIOPIA", 0), ("FRANCE", 3), ("GERMANY", 3),
+    ("INDIA", 2), ("INDONESIA", 2), ("IRAN", 4), ("IRAQ", 4),
+    ("JAPAN", 2), ("JORDAN", 4), ("KENYA", 0), ("MOROCCO", 0),
+    ("MOZAMBIQUE", 0), ("PERU", 1), ("CHINA", 2), ("ROMANIA", 3),
+    ("SAUDI ARABIA", 4), ("VIETNAM", 2), ("RUSSIA", 3),
+    ("UNITED KINGDOM", 3), ("UNITED STATES", 1),
+]
+
+# p_name is 5 words from this list (dbgen's colour list, abridged but
+# including every colour a TPC-H query predicate names).
+P_NAME_WORDS = [
+    "almond", "antique", "aquamarine", "azure", "beige", "bisque", "black",
+    "blanched", "blue", "blush", "brown", "burlywood", "burnished", "chartreuse",
+    "chiffon", "chocolate", "coral", "cornflower", "cornsilk", "cream", "cyan",
+    "dark", "deep", "dim", "dodger", "drab", "firebrick", "floral", "forest",
+    "frosted", "gainsboro", "ghost", "goldenrod", "green", "grey", "honeydew",
+    "hot", "indian", "ivory", "khaki", "lace", "lavender", "lawn", "lemon",
+    "light", "lime", "linen", "magenta", "maroon", "medium", "metallic",
+    "midnight", "mint", "misty", "moccasin", "navajo", "navy", "olive",
+    "orange", "orchid", "pale", "papaya", "peach", "peru", "pink", "plum",
+    "powder", "puff", "purple", "red", "rose", "rosy", "royal", "saddle",
+    "salmon", "sandy", "seashell", "sienna", "sky", "slate", "smoke", "snow",
+    "spring", "steel", "tan", "thistle", "tomato", "turquoise", "violet",
+    "wheat", "white", "yellow",
+]
+TYPE_SYL1 = ["STANDARD", "SMALL", "MEDIUM", "LARGE", "ECONOMY", "PROMO"]
+TYPE_SYL2 = ["ANODIZED", "BURNISHED", "PLATED", "POLISHED", "BRUSHED"]
+TYPE_SYL3 = ["TIN", "NICKEL", "BRASS", "STEEL", "COPPER"]
+CONTAINER_SYL1 = ["SM", "LG", "MED", "JUMBO", "WRAP"]
+CONTAINER_SYL2 = ["CASE", "BOX", "BAG", "JAR", "PKG", "PACK", "CAN", "DRUM"]
+SEGMENTS = ["AUTOMOBILE", "BUILDING", "FURNITURE", "MACHINERY", "HOUSEHOLD"]
+PRIORITIES = ["1-URGENT", "2-HIGH", "3-MEDIUM", "4-NOT SPECIFIED", "5-LOW"]
+SHIP_MODES = ["REG AIR", "AIR", "RAIL", "SHIP", "TRUCK", "MAIL", "FOB"]
+SHIP_INSTRUCT = [
+    "DELIVER IN PERSON", "COLLECT COD", "NONE", "TAKE BACK RETURN",
+]
+_FILLER = [
+    "carefully", "final", "deposits", "accounts", "packages", "ideas",
+    "quickly", "furiously", "slyly", "blithely", "pending", "express",
+    "regular", "even", "silent", "bold", "unusual", "ironic", "special",
+    "requests", "theodolites", "instructions", "foxes", "platelets",
+    "dependencies", "excuses", "waters", "sauternes", "asymptotes",
+]
+
+TABLES = [
+    "region", "nation", "supplier", "customer", "part", "partsupp",
+    "orders", "lineitem",
+]
+
+
+def _words(rng: np.random.Generator, vocab: List[str], n_rows: int,
+           n_words: int) -> np.ndarray:
+    """n_rows strings of n_words space-joined words from vocab."""
+    idx = rng.integers(0, len(vocab), (n_rows, n_words))
+    voc = np.asarray(vocab, dtype=object)
+    parts = voc[idx]
+    out = parts[:, 0]
+    for j in range(1, n_words):
+        out = out + " " + parts[:, j]
+    return out
+
+
+def _money(rng: np.random.Generator, lo: float, hi: float, n: int) -> np.ndarray:
+    return np.round(rng.uniform(lo, hi, n), 2)
+
+
+def gen_table(name: str, sf: float, seed: int = 19980802) -> pa.Table:
+    """One TPC-H table at scale factor ``sf`` as an Arrow table."""
+    rng = np.random.default_rng([seed, TABLES.index(name)])
+    if name == "region":
+        return pa.table({
+            "r_regionkey": np.arange(5, dtype=np.int64),
+            "r_name": pa.array(REGIONS),
+            "r_comment": pa.array([" ".join(REGIONS)] * 5),
+        })
+    if name == "nation":
+        return pa.table({
+            "n_nationkey": np.arange(25, dtype=np.int64),
+            "n_name": pa.array([n for n, _ in NATIONS]),
+            "n_regionkey": np.asarray([r for _, r in NATIONS], dtype=np.int64),
+            "n_comment": _words(rng, _FILLER, 25, 6),
+        })
+    if name == "supplier":
+        n = max(int(sf * 10_000), 25)
+        keys = np.arange(1, n + 1, dtype=np.int64)
+        comments = _words(rng, _FILLER, n, 8)
+        # dbgen: 5 per 10k get "Customer ... Complaints" (Q16 excludes them)
+        bad = rng.choice(n, size=max(n // 2000, 1), replace=False)
+        comments[bad] = comments[bad] + " Customer stuff Complaints"
+        return pa.table({
+            "s_suppkey": keys,
+            "s_name": pa.array([f"Supplier#{k:09d}" for k in keys]),
+            "s_address": _words(rng, _FILLER, n, 3),
+            "s_nationkey": rng.integers(0, 25, n).astype(np.int64),
+            "s_phone": pa.array(
+                [f"{nk + 10}-{p:03d}-{q:03d}-{r:04d}" for nk, p, q, r in zip(
+                    rng.integers(0, 25, n), rng.integers(100, 1000, n),
+                    rng.integers(100, 1000, n), rng.integers(1000, 10000, n))]
+            ),
+            "s_acctbal": _money(rng, -999.99, 9999.99, n),
+            "s_comment": pa.array(comments),
+        })
+    if name == "customer":
+        n = max(int(sf * 150_000), 30)
+        keys = np.arange(1, n + 1, dtype=np.int64)
+        nk = rng.integers(0, 25, n)
+        return pa.table({
+            "c_custkey": keys,
+            "c_name": pa.array([f"Customer#{k:09d}" for k in keys]),
+            "c_address": _words(rng, _FILLER, n, 3),
+            "c_nationkey": nk.astype(np.int64),
+            "c_phone": pa.array(
+                [f"{k + 10}-{p:03d}-{q:03d}-{r:04d}" for k, p, q, r in zip(
+                    nk, rng.integers(100, 1000, n), rng.integers(100, 1000, n),
+                    rng.integers(1000, 10000, n))]
+            ),
+            "c_acctbal": _money(rng, -999.99, 9999.99, n),
+            "c_mktsegment": pa.array(
+                np.asarray(SEGMENTS, dtype=object)[rng.integers(0, 5, n)]
+            ),
+            "c_comment": _words(rng, _FILLER, n, 8),
+        })
+    if name == "part":
+        n = max(int(sf * 200_000), 50)
+        keys = np.arange(1, n + 1, dtype=np.int64)
+        m = rng.integers(1, 6, n)
+        nn = rng.integers(1, 6, n)
+        return pa.table({
+            "p_partkey": keys,
+            "p_name": _words(rng, P_NAME_WORDS, n, 5),
+            "p_mfgr": pa.array([f"Manufacturer#{v}" for v in m]),
+            "p_brand": pa.array([f"Brand#{a}{b}" for a, b in zip(m, nn)]),
+            "p_type": (
+                _words(rng, TYPE_SYL1, n, 1) + " "
+                + _words(rng, TYPE_SYL2, n, 1) + " "
+                + _words(rng, TYPE_SYL3, n, 1)
+            ),
+            "p_size": rng.integers(1, 51, n).astype(np.int32),
+            "p_container": (
+                _words(rng, CONTAINER_SYL1, n, 1) + " "
+                + _words(rng, CONTAINER_SYL2, n, 1)
+            ),
+            "p_retailprice": np.round(
+                (90000 + (keys % 200) * 100 + keys % 1000) / 100.0, 2
+            ),
+            "p_comment": _words(rng, _FILLER, n, 4),
+        })
+    if name == "partsupp":
+        n_part = max(int(sf * 200_000), 50)
+        n_supp = max(int(sf * 10_000), 25)
+        pk = np.repeat(np.arange(1, n_part + 1, dtype=np.int64), 4)
+        i = np.tile(np.arange(4, dtype=np.int64), n_part)
+        # dbgen's supplier spread: 4 distinct suppliers per part
+        sk = (pk + i * ((n_supp // 4) + 1)) % n_supp + 1
+        n = len(pk)
+        return pa.table({
+            "ps_partkey": pk,
+            "ps_suppkey": sk,
+            "ps_availqty": rng.integers(1, 10_000, n).astype(np.int32),
+            "ps_supplycost": _money(rng, 1.0, 1000.0, n),
+            "ps_comment": _words(rng, _FILLER, n, 6),
+        })
+    if name == "orders":
+        return _gen_orders_lineitem(sf, seed)[0]
+    if name == "lineitem":
+        return _gen_orders_lineitem(sf, seed)[1]
+    raise KeyError(name)
+
+
+_OL_CACHE: Dict[tuple, tuple] = {}
+
+
+def _gen_orders_lineitem(sf: float, seed: int) -> tuple:
+    """orders + lineitem generated together: lineitem dates chain off
+    o_orderdate and o_orderstatus/o_totalprice are exact reductions of the
+    order's lineitems (spec 4.2.3) — Q18's sum filter and Q21's 'F' status
+    then behave the way the published query parameters assume."""
+    if (sf, seed) in _OL_CACHE:
+        return _OL_CACHE[(sf, seed)]
+    rng = np.random.default_rng([seed, 101])
+    n_ord = max(int(sf * 1_500_000), 150)
+    n_cust = max(int(sf * 150_000), 30)
+    n_part = max(int(sf * 200_000), 50)
+    n_supp = max(int(sf * 10_000), 25)
+
+    okey = np.arange(1, n_ord + 1, dtype=np.int64)
+    # only customers with custkey % 3 != 0 place orders (dbgen rule; Q13/Q22
+    # depend on a third of customers having none)
+    ck = rng.integers(1, n_cust + 1, n_ord).astype(np.int64)
+    ck = np.where(ck % 3 == 0, np.maximum((ck + 1) % (n_cust + 1), 1), ck)
+    ck = np.where(ck % 3 == 0, np.maximum((ck + 1) % (n_cust + 1), 1), ck)
+    odate = rng.integers(START_DATE, END_DATE + 1, n_ord).astype(np.int32)
+
+    n_li = rng.integers(1, 8, n_ord)
+    starts = np.concatenate([[0], np.cumsum(n_li)[:-1]])
+    total = int(n_li.sum())
+    li_order = np.repeat(okey, n_li)
+    li_odate = np.repeat(odate, n_li)
+
+    lk = rng.integers(1, n_part + 1, total).astype(np.int64)
+    supp_i = rng.integers(0, 4, total).astype(np.int64)
+    lsk = (lk + supp_i * ((n_supp // 4) + 1)) % n_supp + 1
+    linenumber = (np.arange(total) - np.repeat(starts, n_li) + 1).astype(np.int32)
+
+    qty = rng.integers(1, 51, total).astype(np.float64)
+    retail = np.round((90000 + (lk % 200) * 100 + lk % 1000) / 100.0, 2)
+    eprice = np.round(qty * retail, 2)
+    disc = np.round(rng.integers(0, 11, total) / 100.0, 2)
+    tax = np.round(rng.integers(0, 9, total) / 100.0, 2)
+
+    sdate = (li_odate + rng.integers(1, 122, total)).astype(np.int32)
+    cdate = (li_odate + rng.integers(30, 91, total)).astype(np.int32)
+    rdate = (sdate + rng.integers(1, 31, total)).astype(np.int32)
+
+    returned = rdate <= CURRENT_DATE
+    rf = np.where(
+        returned, np.where(rng.random(total) < 0.5, "R", "A"), "N"
+    ).astype(object)
+    shipped = sdate > CURRENT_DATE
+    ls = np.where(shipped, "O", "F").astype(object)
+
+    # exact per-order reductions
+    li_rev = eprice * (1.0 + tax) * (1.0 - disc)
+    totalprice = np.round(np.add.reduceat(li_rev, starts), 2)
+    n_open = np.add.reduceat(shipped.astype(np.int64), starts)
+    ostatus = np.where(
+        n_open == 0, "F", np.where(n_open == n_li, "O", "P")
+    ).astype(object)
+
+    comments = _words(rng, _FILLER, n_ord, 6)
+    special = rng.random(n_ord) < 0.01  # Q13's exclusion phrase
+    comments[special] = comments[special] + " special packages requests"
+
+    orders = pa.table({
+        "o_orderkey": okey,
+        "o_custkey": ck,
+        "o_orderstatus": pa.array(ostatus),
+        "o_totalprice": totalprice,
+        "o_orderdate": pa.array(odate, type=pa.date32()),
+        "o_orderpriority": pa.array(
+            np.asarray(PRIORITIES, dtype=object)[rng.integers(0, 5, n_ord)]
+        ),
+        "o_clerk": pa.array([f"Clerk#{v:09d}" for v in
+                             rng.integers(1, max(int(sf * 1000), 10) + 1, n_ord)]),
+        "o_shippriority": np.zeros(n_ord, dtype=np.int32),
+        "o_comment": pa.array(comments),
+    })
+    lineitem = pa.table({
+        "l_orderkey": li_order,
+        "l_partkey": lk,
+        "l_suppkey": lsk,
+        "l_linenumber": linenumber,
+        "l_quantity": qty,
+        "l_extendedprice": eprice,
+        "l_discount": disc,
+        "l_tax": tax,
+        "l_returnflag": pa.array(rf),
+        "l_linestatus": pa.array(ls),
+        "l_shipdate": pa.array(sdate, type=pa.date32()),
+        "l_commitdate": pa.array(cdate, type=pa.date32()),
+        "l_receiptdate": pa.array(rdate, type=pa.date32()),
+        "l_shipinstruct": pa.array(
+            np.asarray(SHIP_INSTRUCT, dtype=object)[rng.integers(0, 4, total)]
+        ),
+        "l_shipmode": pa.array(
+            np.asarray(SHIP_MODES, dtype=object)[rng.integers(0, 7, total)]
+        ),
+        "l_comment": _words(rng, _FILLER, total, 4),
+    })
+    if sf <= 1.0:
+        _OL_CACHE[(sf, seed)] = (orders, lineitem)
+    return orders, lineitem
+
+
+def write_tables(root: str, sf: float, files_per_table: int = 8,
+                 seed: int = 19980802) -> Dict[str, str]:
+    """Write all eight tables as Parquet under ``root/<table>/part-N.parquet``.
+
+    ``files_per_table`` splits each big table into independent files so scans
+    parallelize across partitions (PERFILE/COALESCING/MULTITHREADED readers
+    all see real multi-file inputs)."""
+    paths = {}
+    for name in TABLES:
+        t = gen_table(name, sf, seed)
+        d = os.path.join(root, name)
+        os.makedirs(d, exist_ok=True)
+        k = files_per_table if t.num_rows >= files_per_table * 64 else 1
+        step = -(-t.num_rows // k)
+        for i in range(k):
+            chunk = t.slice(i * step, step)
+            if chunk.num_rows:
+                pq.write_table(chunk, os.path.join(d, f"part-{i:03d}.parquet"))
+        paths[name] = d
+    return paths
