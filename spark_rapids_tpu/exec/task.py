@@ -56,20 +56,36 @@ def get_or_create(partition_id: int = 0) -> TaskInfo:
     return t
 
 
-def set_input_file(path: str) -> None:
-    """Record the file currently being scanned. A thread-local *separate*
-    from TaskInfo, exactly like Spark's InputFileBlockHolder — every pipeline
-    stage of the partition sees the same value regardless of which nested
-    TaskInfo is active."""
+def set_input_file(path: str, start: int = 0, length: int = -1) -> None:
+    """Record the file (and block) currently being scanned. A thread-local
+    *separate* from TaskInfo, exactly like Spark's InputFileBlockHolder —
+    every pipeline stage of the partition sees the same value regardless of
+    which nested TaskInfo is active. Scans read whole files here, so the
+    block is (0, file size); -1 length means unknown."""
+    if length < 0:
+        try:
+            import os
+
+            length = os.path.getsize(path)
+        except OSError:
+            length = -1
     _LOCAL.input_file = path
+    _LOCAL.input_block = (start, length)
 
 
 def input_file() -> str:
     return getattr(_LOCAL, "input_file", "")
 
 
+def input_file_block() -> tuple:
+    """(start, length) of the current block; (-1, -1) outside a scan
+    (Spark's InputFileBlockHolder defaults)."""
+    return getattr(_LOCAL, "input_block", (-1, -1))
+
+
 def reset_input_file() -> None:
     _LOCAL.input_file = ""
+    _LOCAL.input_block = (-1, -1)
 
 
 @dataclasses.dataclass
@@ -84,9 +100,18 @@ class TaskVals:
     row_base: object  # int64 scalar
     file_bytes: object  # uint8[w]
     file_len: object  # int32 scalar
+    block_start: object = None  # int64 scalar (-1 outside a scan)
+    block_length: object = None  # int64 scalar (-1 outside a scan)
 
     def tree_flatten(self):
-        return (self.part_id, self.row_base, self.file_bytes, self.file_len), None
+        return (
+            self.part_id,
+            self.row_base,
+            self.file_bytes,
+            self.file_len,
+            self.block_start,
+            self.block_length,
+        ), None
 
     @classmethod
     def tree_unflatten(cls, aux, children):
@@ -116,11 +141,14 @@ def task_vals(xp, row_base: Optional[int] = None) -> TaskVals:
     base = row_base if row_base is not None else (t.row_base if t else 0)
     fname = input_file()
     fb, fl = _encode_file(fname, xp)
+    bs, bl = input_file_block()
     return TaskVals(
         xp.asarray(pid, dtype=xp.int32),
         xp.asarray(base, dtype=xp.int64),
         fb,
         fl,
+        xp.asarray(bs, dtype=xp.int64),
+        xp.asarray(bl, dtype=xp.int64),
     )
 
 
@@ -133,6 +161,8 @@ def zero_vals(xp) -> TaskVals:
         xp.asarray(0, dtype=xp.int64),
         xp.zeros(DEFAULT_WIDTH, dtype=xp.uint8),
         xp.asarray(0, dtype=xp.int32),
+        xp.asarray(-1, dtype=xp.int64),
+        xp.asarray(-1, dtype=xp.int64),
     )
 
 

@@ -428,4 +428,19 @@ def to_expr(v: Union[Expression, int, float, str, bool, None]) -> Expression:
         return Literal(v, DOUBLE)
     if isinstance(v, str):
         return Literal(v, STRING)
+    import datetime as _dt
+
+    if isinstance(v, _dt.datetime):
+        from ..types import TIMESTAMP
+
+        if v.tzinfo is None:  # naive timestamps are UTC in this engine
+            v = v.replace(tzinfo=_dt.timezone.utc)
+        epoch = _dt.datetime(1970, 1, 1, tzinfo=_dt.timezone.utc)
+        return Literal(
+            (v - epoch) // _dt.timedelta(microseconds=1), TIMESTAMP
+        )
+    if isinstance(v, _dt.date):
+        from ..types import DATE
+
+        return Literal((v - _dt.date(1970, 1, 1)).days, DATE)
     raise TypeError(f"cannot lift {type(v)} to an expression")

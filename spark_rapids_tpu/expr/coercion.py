@@ -83,6 +83,35 @@ def _common_type(a: DataType, b: DataType) -> DataType:
 def coerce(e: Expression) -> Expression:
     """Rewrite one (already child-resolved) node with the casts Spark's
     analyzer would insert."""
+    from ..types import CalendarInterval, CalendarIntervalType
+
+    if isinstance(e, (Add, Subtract)) and (
+        isinstance(e.l.data_type, CalendarIntervalType)
+        or isinstance(e.r.data_type, CalendarIntervalType)
+    ):
+        # analyzer's DateTimeOperations: date/timestamp ± INTERVAL becomes
+        # DateAddInterval / TimeAdd (intervals must be literals, like the
+        # reference's GpuTimeAdd gate)
+        from .datetime import DateAddInterval, TimeAdd
+
+        if isinstance(e.l.data_type, CalendarIntervalType):
+            if isinstance(e, Subtract):
+                raise TypeError("cannot subtract a date/timestamp from an interval")
+            base, itv = e.r, e.l
+        else:
+            base, itv = e.l, e.r
+        if isinstance(e, Subtract):
+            if not isinstance(itv, Literal):
+                raise TypeError("interval operand must be a literal")
+            m, d, us = CalendarInterval(*itv.value)
+            itv = Literal(CalendarInterval(-m, -d, -us), itv.data_type)
+        if isinstance(base.data_type, DateType):
+            return DateAddInterval(base, itv)
+        if isinstance(base.data_type, TimestampType):
+            return TimeAdd(base, itv)
+        raise TypeError(
+            f"cannot add an interval to a {base.data_type} operand"
+        )
     if isinstance(e, _ARITH) or isinstance(e, _CMP):
         lt, rt = e.l.data_type, e.r.data_type
         if lt == rt and not isinstance(lt, NullType):

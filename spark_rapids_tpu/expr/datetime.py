@@ -248,6 +248,97 @@ class AddMonths(BinaryExpression):
         return days_from_civil(xp, ny, nm, nd)
 
 
+def _add_months_days(xp, days, months, delta_days):
+    """Civil months-then-days add with day-of-month clamping (java
+    plusMonths/plusDays at UTC). ``months``/``delta_days`` are python ints
+    (literal intervals), so XLA folds them into the fused kernel."""
+    if months:
+        y, m, d = civil_from_days(xp, days)
+        total = y.astype(xp.int64) * 12 + (m - 1) + months
+        ny = xp.floor_divide(total, 12).astype(xp.int32)
+        nm = (xp.mod(total, 12) + 1).astype(xp.int32)
+        ny2 = xp.where(nm == 12, ny + 1, ny)
+        nm2 = xp.where(nm == 12, 1, nm + 1)
+        last = days_from_civil(xp, ny2, nm2, xp.full_like(nm2, 1)) - days_from_civil(
+            xp, ny, nm, xp.full_like(nm, 1)
+        )
+        nd = xp.minimum(d, last.astype(xp.int32))
+        days = days_from_civil(xp, ny, nm, nd)
+    return days + xp.asarray(delta_days, dtype=xp.int32)
+
+
+def _interval_literal(e: Expression):
+    from ..types import CalendarInterval, CalendarIntervalType
+    from .base import Literal
+
+    if not (isinstance(e, Literal) and isinstance(e.data_type, CalendarIntervalType)):
+        raise ValueError("interval operand must be a literal CalendarInterval")
+    return CalendarInterval(*e.value)
+
+
+@dataclass(frozen=True)
+class TimeAdd(BinaryExpression):
+    """timestamp + literal interval (Spark TimeAdd at UTC: plusMonths with
+    day clamping, then days, then exact microseconds).
+
+    Reference: GpuTimeAdd — GpuOverrides.scala:1348 (literal-interval gated,
+    same restriction here)."""
+
+    start: Expression
+    interval: Expression
+
+    @property
+    def data_type(self) -> DataType:
+        from ..types import TIMESTAMP
+
+        return TIMESTAMP
+
+    def eval(self, ctx: Ctx) -> Val:
+        # interval is a plan-time literal: don't evaluate it columnar
+        c = self.start.eval(ctx)
+        return Val(self._compute(ctx, c.data, None), c.valid)
+
+    def _compute(self, ctx: Ctx, l, r):
+        xp = ctx.xp
+        iv = _interval_literal(self.interval)
+        us = ctx.broadcast(l).astype(xp.int64)
+        if iv.months:
+            day = xp.floor_divide(us, US_PER_DAY)
+            tod = us - day * US_PER_DAY
+            day = _add_months_days(xp, day.astype(xp.int32), iv.months, 0)
+            us = day.astype(xp.int64) * US_PER_DAY + tod
+        return us + iv.days * US_PER_DAY + iv.microseconds
+
+
+@dataclass(frozen=True)
+class DateAddInterval(BinaryExpression):
+    """date + literal interval (months/days only — a sub-day component is an
+    error, matching Spark's DateAddInterval and the reference's
+    GpuDateAddInterval gate, GpuOverrides.scala:1369)."""
+
+    start: Expression
+    interval: Expression
+
+    @property
+    def data_type(self) -> DataType:
+        return DATE
+
+    def eval(self, ctx: Ctx) -> Val:
+        c = self.start.eval(ctx)
+        return Val(self._compute(ctx, c.data, None), c.valid)
+
+    def _compute(self, ctx: Ctx, l, r):
+        xp = ctx.xp
+        iv = _interval_literal(self.interval)
+        if iv.microseconds != 0:
+            raise ValueError(
+                "Cannot add hours, minutes or seconds, milliseconds, "
+                "microseconds to a date"
+            )
+        days = ctx.broadcast(l).astype(xp.int32)
+        return _add_months_days(xp, days, iv.months, iv.days)
+
+
 class _TimeField(UnaryExpression):
     """Unary int field from a timestamp (UTC)."""
 

@@ -69,6 +69,24 @@ Cosh = _mk_double_fn("Cosh", lambda xp, x: xp.cosh(x))
 Tanh = _mk_double_fn("Tanh", lambda xp, x: xp.tanh(x))
 ToDegrees = _mk_double_fn("ToDegrees", lambda xp, x: xp.degrees(x))
 ToRadians = _mk_double_fn("ToRadians", lambda xp, x: xp.radians(x))
+# Inverse hyperbolics use Spark's literal formulas (StrictMath compositions,
+# Asinh/Acosh/Atanh in mathExpressions.scala) rather than np.arcsinh etc. —
+# same NaN domains AND the same rounding as the Java implementations.
+Acosh = _mk_double_fn(
+    "Acosh", lambda xp, x: xp.log(x + xp.sqrt(x * x - 1.0)),
+    "Spark ``acosh`` — log(x + sqrt(x^2-1)), NaN below 1.",
+)
+Asinh = _mk_double_fn(
+    "Asinh", lambda xp, x: xp.log(x + xp.sqrt(x * x + 1.0)),
+    "Spark ``asinh`` — log(x + sqrt(x^2+1)) (Spark's exact formula).",
+)
+Atanh = _mk_double_fn(
+    "Atanh", lambda xp, x: 0.5 * xp.log((1.0 + x) / (1.0 - x)),
+    "Spark ``atanh`` — 0.5*log((1+x)/(1-x)), NaN outside (-1, 1).",
+)
+Cot = _mk_double_fn(
+    "Cot", lambda xp, x: 1.0 / xp.tan(x), "Spark ``cot`` — 1/tan(x)."
+)
 Rint = _mk_double_fn("Rint", lambda xp, x: xp.rint(x))
 Signum = _mk_double_fn(
     "Signum", lambda xp, x: xp.sign(x), "Sign as double (NaN → NaN)."
@@ -119,6 +137,32 @@ class Log1p(_DomainLog):
     c: Expression
     lower = -1.0
     _fn = staticmethod(lambda xp, x: xp.log1p(x))
+
+
+@dataclass(frozen=True)
+class Logarithm(BinaryExpression):
+    """``log(base, x)`` — NULL when base <= 0 or x <= 0 (Spark Logarithm's
+    nullSafeEval; reference rule GpuOverrides.scala:1274)."""
+
+    base: Expression
+    x: Expression
+
+    @property
+    def data_type(self) -> DataType:
+        return DOUBLE
+
+    @property
+    def nullable(self) -> bool:
+        return True
+
+    def _compute(self, ctx: Ctx, l, r):
+        xp = ctx.xp
+        b = l.astype(xp.float64)
+        x = r.astype(xp.float64)
+        # NaN operands are not <= 0 in Java, so they flow through as NaN
+        ok = ((b > 0.0) | xp.isnan(b)) & ((x > 0.0) | xp.isnan(x))
+        data = xp.log(xp.where(ok, x, 1.0)) / xp.log(xp.where(ok, b, 2.0))
+        return data, ok
 
 
 @dataclass(frozen=True)

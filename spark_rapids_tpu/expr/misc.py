@@ -216,6 +216,44 @@ class InputFileName(Expression, TaskDependent):
         return "input_file_name()"
 
 
+class _InputFileBlockField(Expression, TaskDependent):
+    """Base of ``input_file_block_start()``/``_length()`` — reference:
+    GpuInputFileBlockStart/Length (GpuInputFileBlock.scala, rule rows
+    GpuOverrides.scala:2138). Reads the InputFileBlockHolder analogue from
+    TaskVals; -1 outside a scan, exactly like Spark."""
+
+    @property
+    def data_type(self) -> DataType:
+        from ..types import LONG
+
+        return LONG
+
+    @property
+    def nullable(self) -> bool:
+        return False
+
+    def eval(self, ctx: Ctx) -> Val:
+        xp = ctx.xp
+        t = _require_task(ctx, str(self))
+        return Val(xp.asarray(self._field(t), dtype=xp.int64), xp.asarray(True))
+
+
+@dataclass(frozen=True)
+class InputFileBlockStart(_InputFileBlockField):
+    _field = staticmethod(lambda t: t.block_start)
+
+    def __str__(self):
+        return "input_file_block_start()"
+
+
+@dataclass(frozen=True)
+class InputFileBlockLength(_InputFileBlockField):
+    _field = staticmethod(lambda t: t.block_length)
+
+    def __str__(self):
+        return "input_file_block_length()"
+
+
 @dataclass(frozen=True)
 class Rand(Expression, TaskDependent):
     """``rand(seed)`` — uniform [0, 1) doubles.

@@ -823,6 +823,94 @@ class Contains(_SearchBase):
 
 
 @dataclass(frozen=True)
+class SubstringIndex(Expression):
+    """Spark ``substring_index(str, delim, count)`` — prefix before the
+    count-th occurrence of delim (suffix after the count-th-from-last for
+    negative counts); whole string when there are fewer occurrences.
+    Byte-wise overlapping search, exactly UTF8String.subStringIndex.
+
+    Reference rule: GpuOverrides.scala:2325 (GpuSubstringIndex; same
+    literal-delim/count device gate)."""
+
+    child: Expression
+    delim: Expression
+    count: Expression
+
+    @property
+    def data_type(self) -> DataType:
+        return STRING
+
+    @staticmethod
+    def _cpu_one(s: str, delim: str, count: int) -> str:
+        b, d = s.encode("utf-8"), delim.encode("utf-8")
+        if not d or count == 0:
+            return ""
+        if count > 0:
+            idx = -1
+            for _ in range(count):
+                idx = b.find(d, idx + 1)
+                if idx < 0:
+                    return s
+            return b[:idx].decode("utf-8", "replace")
+        k = -count
+        idx = len(b) - len(d) + 1
+        for _ in range(k):
+            # search window end so that match starts are <= idx - 1
+            idx = b.rfind(d, 0, idx - 1 + len(d))
+            if idx < 0:
+                return s
+        return b[idx + len(d):].decode("utf-8", "replace")
+
+    def eval(self, ctx: Ctx) -> Val:
+        c = self.child.eval(ctx)
+        dv = self.delim.eval(ctx)
+        cv = self.count.eval(ctx)
+        valid = and_valid(ctx, c.valid, dv.valid, cv.valid)
+        if not ctx.is_device:
+            s = _cpu_strs(ctx, c)
+            ds = np.broadcast_to(np.asarray(dv.data, dtype=object), (ctx.n,))
+            cs = np.broadcast_to(np.asarray(cv.data), (ctx.n,))
+            out = [
+                None if x is None or d is None else self._cpu_one(x, d, int(k))
+                for x, d, k in zip(s, ds, cs.tolist())
+            ]
+            return Val(np.asarray(out, dtype=object), valid)
+        xp = ctx.xp
+        data, lengths = dev_str(ctx, c)
+        pat = _lit_bytes(self.delim)
+        count = int(self.count.value)
+        w = data.shape[1]
+        if not pat or count == 0:
+            return Val(
+                xp.zeros((ctx.n, w), dtype=xp.uint8),
+                valid,
+                xp.zeros(ctx.n, dtype=xp.int32),
+            )
+        m = _match_starts(ctx, data, lengths, pat)
+        cum = xp.cumsum(m.astype(xp.int32), axis=1)
+        total = cum[:, -1]
+        L = len(pat)
+        pos_j = xp.arange(w, dtype=xp.int32)[None, :]
+        if count > 0:
+            sel = m & (cum == count)
+            has = total >= count
+            j = xp.argmax(sel, axis=1).astype(xp.int32)
+            new_len = xp.where(has, j, lengths).astype(xp.int32)
+            keep = pos_j < new_len[:, None]
+            out = xp.where(keep, data, 0).astype(xp.uint8)
+            return Val(out, valid, new_len)
+        k = -count
+        rcount = total[:, None] - cum + m.astype(xp.int32)
+        sel = m & (rcount == k)
+        has = total >= k
+        j = xp.argmax(sel, axis=1).astype(xp.int32)
+        start = xp.where(has, j + L, 0)
+        keep = (pos_j >= start[:, None]) & (pos_j < lengths[:, None])
+        out, new_len = compact_bytes(ctx, data, keep)
+        return Val(out, valid, new_len)
+
+
+@dataclass(frozen=True)
 class StringLocate(Expression):
     """Spark ``locate(substr, str, pos)``: 1-based char position of the first
     occurrence at or after char position ``pos``; 0 if absent; ``pos`` and the

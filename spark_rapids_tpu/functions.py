@@ -53,9 +53,14 @@ from .expr.bitwise import (
 )
 from .expr.math import (
     Acos,
+    Acosh,
     Asin,
+    Asinh,
     Atan,
     Atan2,
+    Atanh,
+    Cot,
+    Logarithm,
     BRound,
     Cbrt,
     Ceil,
@@ -538,6 +543,12 @@ def substring(c, pos, length) -> Column:  # noqa: A002
     return Column(Substring(_e(c), _e(pos), _e(length)))
 
 
+def substring_index(c, delim: str, count: int) -> Column:
+    from .expr.strings import SubstringIndex
+
+    return Column(SubstringIndex(_e(c), _e(delim), _e(count)))
+
+
 def concat(*cols) -> Column:
     return Column(Concat(tuple(_e(c) for c in cols)))
 
@@ -683,6 +694,39 @@ def last_day(c) -> Column:
     return Column(LastDay(_e(c)))
 
 
+def make_interval(
+    years: int = 0,
+    months: int = 0,
+    weeks: int = 0,
+    days: int = 0,
+    hours: int = 0,
+    mins: int = 0,
+    secs: float = 0.0,
+) -> Column:
+    """A literal CalendarInterval (pyspark ``make_interval``). Adding it to a
+    date/timestamp column resolves to DateAddInterval/TimeAdd, the reference's
+    interval arithmetic (GpuOverrides.scala:1348,1369)."""
+    from .expr.base import Literal
+    from .types import CALENDAR_INTERVAL, CalendarInterval
+
+    import builtins
+
+    iv = CalendarInterval(
+        years * 12 + months,
+        weeks * 7 + days,
+        int(builtins.round((hours * 3600 + mins * 60 + secs) * 1_000_000)),
+    )
+    return Column(Literal(iv, CALENDAR_INTERVAL))
+
+
+def expr_interval(months: int = 0, days: int = 0, microseconds: int = 0) -> Column:
+    """A literal CalendarInterval from Spark's internal (months, days, us)."""
+    from .expr.base import Literal
+    from .types import CALENDAR_INTERVAL, CalendarInterval
+
+    return Column(Literal(CalendarInterval(months, days, microseconds), CALENDAR_INTERVAL))
+
+
 def date_add(c, days) -> Column:
     return Column(DateAdd(_e(c), _e(days)))
 
@@ -746,6 +790,10 @@ atan = _unary_fn(Atan)
 sinh = _unary_fn(Sinh)
 cosh = _unary_fn(Cosh)
 tanh = _unary_fn(Tanh)
+asinh = _unary_fn(Asinh)
+acosh = _unary_fn(Acosh)
+atanh = _unary_fn(Atanh)
+cot = _unary_fn(Cot)
 degrees = _unary_fn(ToDegrees)
 radians = _unary_fn(ToRadians)
 rint = _unary_fn(Rint)
@@ -757,8 +805,12 @@ floor = _unary_fn(Floor)
 ceil = _unary_fn(Ceil)
 
 
-def log(c) -> Column:
-    return Column(Log(_e(c)))
+def log(arg1, arg2=None) -> Column:
+    """``log(x)`` natural log, or ``log(base, x)`` (pyspark's two-arg form,
+    Spark's Logarithm)."""
+    if arg2 is None:
+        return Column(Log(_e(arg1)))
+    return Column(Logarithm(_e(arg1), _e(arg2)))
 
 
 def pow(l, r) -> Column:  # noqa: A001
@@ -854,6 +906,18 @@ def input_file_name() -> Column:
     from .expr.misc import InputFileName
 
     return Column(InputFileName())
+
+
+def input_file_block_start() -> Column:
+    from .expr.misc import InputFileBlockStart
+
+    return Column(InputFileBlockStart())
+
+
+def input_file_block_length() -> Column:
+    from .expr.misc import InputFileBlockLength
+
+    return Column(InputFileBlockLength())
 
 
 def rand(seed: int = 0) -> Column:

@@ -310,11 +310,38 @@ _expr(st.Like, check=_like_check)
 _expr(st.StringReplace, check=_replace_check)
 _expr(st.StringRepeat, check=_repeat_check)
 _expr(st.StringLocate, check=_locate_check)
+
+
+def _substring_index_check(e, conf: TpuConf) -> Optional[str]:
+    if not st.is_string_literal(e.delim):
+        return "substring_index delimiter must be a string literal for the device path"
+    if not isinstance(e.count, Literal):
+        return "substring_index count must be a literal for the device path"
+    return None
+
+
+_expr(st.SubstringIndex, check=_substring_index_check)
 _expr(st.StringLPad, check=_pad_check)
 _expr(st.StringRPad, check=_pad_check)
 _expr(st.StringTrim, check=_trim_check)
 _expr(st.StringTrimLeft, check=_trim_check)
 _expr(st.StringTrimRight, check=_trim_check)
+
+def _interval_check(e, conf: TpuConf) -> Optional[str]:
+    """Literal-interval gate, the reference's GpuTimeAdd/GpuDateAddInterval
+    restriction (GpuOverrides.scala:1348,1369)."""
+    from ..types import CalendarIntervalType
+
+    itv = e.interval
+    if not (isinstance(itv, Literal) and isinstance(itv.data_type, CalendarIntervalType)):
+        return "interval operand must be a literal CalendarInterval for the device path"
+    if isinstance(e, dtx.DateAddInterval) and itv.value[2] != 0:
+        return "date + interval with a sub-day component is an error in Spark"
+    return None
+
+
+_expr(dtx.TimeAdd, check=_interval_check)
+_expr(dtx.DateAddInterval, check=_interval_check)
 
 for _cls in (
     dtx.Year,
@@ -339,8 +366,9 @@ for _cls in (
 for _cls in (
     mx.Sqrt, mx.Cbrt, mx.Exp, mx.Expm1, mx.Sin, mx.Cos, mx.Tan,
     mx.Asin, mx.Acos, mx.Atan, mx.Sinh, mx.Cosh, mx.Tanh,
+    mx.Asinh, mx.Acosh, mx.Atanh, mx.Cot,
     mx.ToDegrees, mx.ToRadians, mx.Rint, mx.Signum,
-    mx.Log, mx.Log10, mx.Log2, mx.Log1p,
+    mx.Log, mx.Log10, mx.Log2, mx.Log1p, mx.Logarithm,
     mx.Pow, mx.Atan2, mx.Hypot, mx.Floor, mx.Ceil,
     nx.NaNvl, nx.Nvl2, nx.AtLeastNNonNulls,
 ):
@@ -443,6 +471,8 @@ for _cls in (
     msc.SparkPartitionID,
     msc.MonotonicallyIncreasingID,
     msc.InputFileName,
+    msc.InputFileBlockStart,
+    msc.InputFileBlockLength,
     msc.NormalizeNaNAndZero,
 ):
     _expr(_cls)

@@ -135,6 +135,38 @@ class NullType(DataType):
         return pa.null()
 
 
+class CalendarInterval(tuple):
+    """Spark's CalendarInterval value: (months, days, microseconds).
+
+    Appears only as a literal operand of interval arithmetic (the reference
+    gates GpuTimeAdd/GpuDateAddInterval to literal intervals too —
+    GpuOverrides.scala:1348,1369)."""
+
+    def __new__(cls, months: int = 0, days: int = 0, microseconds: int = 0):
+        return super().__new__(cls, (int(months), int(days), int(microseconds)))
+
+    months = property(lambda self: self[0])
+    days = property(lambda self: self[1])
+    microseconds = property(lambda self: self[2])
+
+    def __repr__(self) -> str:
+        return (
+            f"INTERVAL {self.months} MONTHS {self.days} DAYS "
+            f"{self.microseconds} MICROSECONDS"
+        )
+
+
+class CalendarIntervalType(DataType):
+    """Interval literals for date/timestamp arithmetic. Not a storable column
+    type on device (matches the reference: CALENDAR appears in TypeSigs only
+    as a literal-gated operand)."""
+
+    np_dtype = np.dtype(np.int64)  # placeholder; never stored columnar
+
+    def to_arrow(self) -> pa.DataType:
+        return pa.month_day_nano_interval()
+
+
 @dataclasses.dataclass(frozen=True)
 class DecimalType(FractionalType):
     """DECIMAL64 only, like the reference (unscaled int64 storage).
@@ -353,6 +385,7 @@ STRING = StringType()
 DATE = DateType()
 TIMESTAMP = TimestampType()
 NULL = NullType()
+CALENDAR_INTERVAL = CalendarIntervalType()
 
 _INTEGRAL_ORDER = [ByteType, ShortType, IntegerType, LongType]
 _NUMERIC_ORDER = _INTEGRAL_ORDER + [FloatType, DoubleType]
