@@ -194,6 +194,13 @@ CAST_STRING_TO_TIMESTAMP = conf(
     "gated support (GpuCast.scala castStringToTimestamp)."
 ).boolean_conf(False)
 
+EXCHANGE_REUSE_ENABLED = conf("spark.sql.exchange.reuse").doc(
+    "Deduplicate identical exchange subtrees so repeated subplans "
+    "(self-joins of an aggregate, CTE fan-out) materialize once "
+    "(Spark's ReuseExchange; reference GpuExec.doCanonicalize — "
+    "GpuExec.scala:251-276)."
+).boolean_conf(True)
+
 ADAPTIVE_ENABLED = conf("spark.sql.adaptive.enabled").doc(
     "Adaptive query execution (Spark's key, honored here): exchanges "
     "coalesce small output partitions at runtime from measured sizes "

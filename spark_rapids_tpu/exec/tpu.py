@@ -1044,6 +1044,7 @@ class TpuSortExec(Exec):
         from ..mem.spill import SpillPriorities, with_oom_retry
 
         _sort = device_sort_fn(self.order)
+        _merge = device_merge_fn(self.order)
         threshold = cfg.OUT_OF_CORE_SORT_THRESHOLD.get(ctx.conf)
         catalog = ctx.catalog
 
@@ -1078,8 +1079,9 @@ class TpuSortExec(Exec):
                 del pending
                 yield with_oom_retry(catalog, _sort, merged)
                 return
-            # Pairwise merge of sorted runs; a merge reuses the sort kernel
-            # over the concatenation of exactly two runs, which get_batch()
+            # Staged binary merge of sorted runs — a TRUE merge kernel
+            # (binary-search ranks, linear work per level, O(n log k) total)
+            # instead of re-sorting each concatenation; operands get_batch()
             # pins so the retry-spill cannot evict what it is merging.
             while len(runs) > 1:
                 nxt = []
@@ -1096,7 +1098,7 @@ class TpuSortExec(Exec):
                             2 * (a.size_bytes + b.size_bytes),
                             _batch_device(ba),
                         )
-                        return _sort(concat_device([ba, bb]))
+                        return _merge(ba, bb)
 
                     out = with_oom_retry(catalog, merge_pair)
                     a.close(), b.close()
@@ -1140,6 +1142,38 @@ def device_sort_fn(order: List[SortOrder]):
         return _sort
 
     return K.jit_kernel(("sort", _order_key(order)), make)
+
+
+def device_merge_fn(order: List[SortOrder]):
+    """Jitted two-run merge: concat the sorted runs (live segments land at
+    [0, na) and [na, na+nb)), rebuild radix words once, and gather through
+    ``merge_permutation``'s binary-search ranks. Linear work per merge level
+    — the reference's true out-of-core merge (GpuSortExec.scala:212-510)
+    rather than a re-sort."""
+    order = list(order)
+
+    def make():
+        def _merge(ba: DeviceBatch, bb: DeviceBatch) -> DeviceBatch:
+            import jax.numpy as jnp
+
+            from ..ops.sortkeys import column_radix_words, merge_permutation
+
+            na, nb = ba.num_rows, bb.num_rows
+            merged = concat_device([ba, bb])
+            c = Ctx.for_device(merged)
+            words = []
+            for o in order:
+                col = val_to_column(c, o.child.eval(c), o.child.data_type)
+                col = dc_replace(col, validity=col.validity & merged.row_mask())
+                words.extend(
+                    column_radix_words(col, o.ascending, o.resolved_nulls_first())
+                )
+            perm = merge_permutation(words, na, nb)
+            return gather_batch(merged, perm, na + nb)
+
+        return _merge
+
+    return K.jit_kernel(("merge_runs", _order_key(order)), make)
 
 
 def _slice_head_impl(batch: DeviceBatch, take) -> DeviceBatch:
@@ -1933,6 +1967,17 @@ class TpuShuffleExchangeExec(Exec):
         return align_word_groups(group_lists, self.partitioning.order, jnp)
 
     def execute(self, ctx: ExecContext) -> PartitionSet:
+        # exchange reuse (plan/reuse.py): a node shared by several consumers
+        # materializes once per query — the ReuseExchange analogue
+        if getattr(self, "_reuse_shared", False):
+            cached = ctx.reuse_cache.get(id(self))
+            if cached is None:
+                cached = self._execute_impl(ctx)
+                ctx.reuse_cache[id(self)] = cached
+            return cached
+        return self._execute_impl(ctx)
+
+    def _execute_impl(self, ctx: ExecContext) -> PartitionSet:
         from ..mem.spill import with_oom_retry
         from ..plan.partitioning import SAMPLE_PER_BATCH, compute_range_bounds
 
@@ -2121,6 +2166,9 @@ class TpuShuffleExchangeExec(Exec):
                             len(consumed) == nparts
                             and not mgr_state.get("released")
                             and mgr_state["shuffle_id"] == sid
+                            # a reused exchange is drained once per consumer;
+                            # early release would force a map-stage re-run
+                            and not getattr(self, "_reuse_shared", False)
                         )
                         if done:
                             mgr_state["released"] = True

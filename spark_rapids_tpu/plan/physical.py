@@ -100,6 +100,9 @@ class ExecContext:
         # assignment (Spark applies identical CoalescedPartitionSpecs to
         # both shuffle reads of a join).
         self.aqe_size_providers: dict = {}
+        # Exchange reuse (plan/reuse.py): shared exchange nodes memoize
+        # their PartitionSet here so every consumer reads one materialization
+        self.reuse_cache: dict = {}
         # Mesh execution: session-held MeshContext (stable across queries so
         # exchange programs stay compile-cached); None = single-device mode.
         self.mesh = None

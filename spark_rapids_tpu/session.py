@@ -298,6 +298,12 @@ class TpuSession:
         cpu_plan = plan_physical(lp, self.conf)
         overrides = TpuOverrides(self.conf)
         final_plan = overrides.apply(cpu_plan)
+        if cfg.EXCHANGE_REUSE_ENABLED.get(self.conf):
+            from .plan.reuse import reuse_exchanges
+
+            final_plan, self._last_reused_exchanges = reuse_exchanges(final_plan)
+        else:
+            self._last_reused_exchanges = 0
         self._last_plan = final_plan
         self._last_overrides = overrides
         self._assert_test_mode(overrides, final_plan)
