@@ -28,6 +28,10 @@ import sys
 import tempfile
 import time
 
+# Local dry-runs: BENCH_PLATFORM=cpu pins the jax platform (the axon
+# sitecustomize otherwise forces the tunneled TPU, which hangs when the
+# tunnel is down). The driver's real run leaves this unset.
+BENCH_PLATFORM = os.environ.get("BENCH_PLATFORM", "")
 BENCH_SF = float(os.environ.get("BENCH_SF", "1.0"))
 PARTITIONS = int(os.environ.get("BENCH_PARTITIONS", "8"))
 SHUFFLE_PARTITIONS = int(os.environ.get("BENCH_SHUFFLE_PARTITIONS", "8"))
@@ -49,8 +53,13 @@ def ensure_backend(total_budget_s: float = 300.0) -> dict:
     exponential backoff. The r3 BENCH failure was an in-process
     'Unable to initialize backend' — and this session also observed
     jax.devices() HANGING >420s; neither may take down the rig."""
+    pin = (
+        f"import jax; jax.config.update('jax_platforms', '{BENCH_PLATFORM}'); "
+        if BENCH_PLATFORM
+        else "import jax; "
+    )
     probe = (
-        "import jax, json; ds = jax.devices(); "
+        pin + "import json; ds = jax.devices(); "
         "print(json.dumps({'platform': ds[0].platform, 'n': len(ds)}))"
     )
     deadline = time.monotonic() + total_budget_s
@@ -147,6 +156,10 @@ def geomean(xs) -> float:
 
 def main() -> None:
     t_start = time.monotonic()
+    if BENCH_PLATFORM:
+        import jax
+
+        jax.config.update("jax_platforms", BENCH_PLATFORM)
     backend = ensure_backend()
     from spark_rapids_tpu import TpuSession
     from spark_rapids_tpu.tpch import tpch_query
@@ -177,9 +190,19 @@ def main() -> None:
             build_t = lambda: tpch_query(n, accessor(tpu), sf=BENCH_SF)  # noqa: E731
             build_c = lambda: tpch_query(n, accessor(cpu), sf=BENCH_SF)  # noqa: E731
             t_tpu = time_query(build_t)
-            # fallback accounting from the device session's last plan
+            # fallback accounting from the device session's last plan —
+            # source scans excluded: Parquet/Arrow decode is host-side by
+            # design (SURVEY §7 v1 I/O), compute fallbacks are what matter
             ov = getattr(tpu, "_last_overrides", None)
-            entry["fallback_nodes"] = len(ov.fallback_execs()) if ov else None
+            entry["fallback_nodes"] = (
+                sum(
+                    1
+                    for e in ov.explain
+                    if not e.on_device and "Scan" not in e.node
+                )
+                if ov
+                else None
+            )
             t_cpu = time_query(build_c)
             sp = t_cpu / t_tpu if t_tpu > 0 else 0.0
             entry.update(

@@ -942,9 +942,15 @@ def _dev_str_to_int(ctx: Ctx, ch, start, end, to: DataType, ansi: bool = False):
     digit_region = (idx >= dstart[:, None]) & (idx < int_end[:, None])
     frac_region = (idx > int_end[:, None]) & (idx < end[:, None])
     ok_chars = xp.where(digit_region | frac_region, is_digit, True).all(axis=1)
-    has_digit = (is_digit & digit_region).any(axis=1)
+    # UTF8String.toInt: the integer part may be EMPTY when a separator is
+    # present ('.5' → 0, '-.5' → 0 on CPU Spark); only sign-alone /
+    # fully-empty inputs are rejected
+    has_digit = (is_digit & digit_region).any(axis=1) | (
+        has_dot & (dstart < end)
+    )
     if ansi:
         ok_chars = ok_chars & ~has_dot
+        has_digit = (is_digit & digit_region).any(axis=1)
     limit = xp.where(
         neg,
         xp.asarray(I64_MIN, dtype=xp.int64),
@@ -1379,19 +1385,24 @@ def _cpu_parse(s: str, to: DataType, ansi: bool = False):
         except (TypeError, ValueError):
             return None
     if isinstance(to, IntegralType):
-        body = s[1:] if s[:1] in "+-" else s
+        sign = s[:1] if s[:1] in "+-" else ""
+        body = s[1:] if sign else s
+        had_dot = False
         if not ansi and "." in body:
             # UTF8String.toLong truncation: '1.5' → 1 when the tail after
-            # '.' is all digits (or empty); ANSI rejects like toLongExact
+            # '.' is all digits (or empty); the integer part may itself be
+            # empty ('.5' → 0); ANSI rejects like toLongExact
             intpart, _, frac = body.partition(".")
             if frac and not frac.isdigit():
                 return None
             body = intpart
-            s = (s[:1] if s[:1] in "+-" else "") + intpart
+            had_dot = True
         if not body.isdigit():
-            return None
+            if not (had_dot and body == ""):
+                return None
+            body = "0"
         try:
-            val = int(s)
+            val = int(sign + body)
         except (TypeError, ValueError):
             return None
         lo, hi = _INT_BOUNDS[to.np_dtype]

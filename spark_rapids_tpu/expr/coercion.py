@@ -62,6 +62,12 @@ def _common_type(a: DataType, b: DataType) -> DataType:
         return b
     if isinstance(b, NullType):
         return a
+    if isinstance(a, DecimalType) and isinstance(b, DecimalType):
+        # Spark DecimalPrecision.widerDecimalType: keep every integral and
+        # fractional digit of both sides
+        s = max(a.scale, b.scale)
+        p = max(a.precision - a.scale, b.precision - b.scale) + s
+        return DecimalType(min(p, DecimalType.MAX_PRECISION), s)
     if isinstance(a, DecimalType) and isinstance(b, IntegralType) and not isinstance(b, (DateType, TimestampType)):
         # Spark: integral promotes to decimal of exact width
         widths = {1: 3, 2: 5, 4: 10, 8: 19}
@@ -114,6 +120,28 @@ def coerce(e: Expression) -> Expression:
         )
     if isinstance(e, _ARITH) or isinstance(e, _CMP):
         lt, rt = e.l.data_type, e.r.data_type
+        if isinstance(e, Multiply) and (
+            isinstance(lt, DecimalType) or isinstance(rt, DecimalType)
+        ):
+            # Spark multiplies decimals at their ORIGINAL types (result
+            # p1+p2+1, s1+s2); widening to a common type first would
+            # inflate the result precision past what Spark produces. An
+            # integral operand is promoted to its exact-width Decimal(p,0)
+            # only; fractional operands fall through to the double path.
+            def _exact(side, dt):
+                if isinstance(dt, DecimalType):
+                    return side
+                if isinstance(dt, IntegralType) and not isinstance(
+                    dt, (DateType, TimestampType)
+                ):
+                    widths = {1: 3, 2: 5, 4: 10, 8: 19}
+                    p = min(widths[dt.np_dtype.itemsize], DecimalType.MAX_PRECISION)
+                    return _cast_to(side, DecimalType(p, 0))
+                return None
+
+            nl, nr = _exact(e.l, lt), _exact(e.r, rt)
+            if nl is not None and nr is not None:
+                return dataclasses.replace(e, l=nl, r=nr)
         if lt == rt and not isinstance(lt, NullType):
             return e
         ct = _common_type(lt, rt)

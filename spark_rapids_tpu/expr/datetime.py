@@ -151,6 +151,35 @@ class DayOfYear(_DateField):
 
 
 @dataclass(frozen=True)
+class WeekOfYear(_DateField):
+    """ISO-8601 week number (Spark ``weekofyear``): the week containing the
+    year's first Thursday is week 1; Monday-based weeks."""
+
+    c: Expression
+
+    def _field(self, ctx: Ctx, days):
+        xp = ctx.xp
+        y, _, _ = civil_from_days(xp, days)
+        # ISO weekday 1..7 (1970-01-01 was a Thursday = 4)
+        dow = (xp.mod(days.astype(xp.int64), 7) + 3) % 7 + 1
+        jan1 = days_from_civil(xp, y, xp.full_like(y, 1), xp.full_like(y, 1))
+        doy = (days - jan1 + 1).astype(xp.int64)
+        w = xp.floor_divide(doy - dow + 10, 7)
+
+        def weeks_in(year):
+            j1 = days_from_civil(
+                xp, year, xp.full_like(year, 1), xp.full_like(year, 1)
+            )
+            jdow = (xp.mod(j1.astype(xp.int64), 7) + 3) % 7 + 1
+            leap = ((year % 4 == 0) & (year % 100 != 0)) | (year % 400 == 0)
+            return 52 + ((jdow == 4) | (leap & (jdow == 3))).astype(xp.int64)
+
+        w = xp.where(w < 1, weeks_in(y - 1), w)
+        w = xp.where(w > weeks_in(y), 1, w)
+        return w.astype(xp.int32)
+
+
+@dataclass(frozen=True)
 class LastDay(UnaryExpression):
     """Last day of the month of the given date (returns DATE)."""
 

@@ -32,37 +32,51 @@ from .cast import (
 from .datetime import civil_from_days, days_from_civil
 
 _TOKENS = {"yyyy": 4, "MM": 2, "dd": 2, "HH": 2, "mm": 2, "ss": 2}
+# single-letter variants print UNPADDED (SimpleDateFormat count-1 fields);
+# they are format-only — parsing them would need variable-width scanning
+_UNPADDED = {"y": 4, "M": 2, "d": 2, "H": 2, "m": 2, "s": 2}
 
 
-def parse_pattern(fmt: str) -> Tuple[Tuple[str, str], ...]:
-    """Pattern → ((kind, text)…); kind is 'tok' or 'lit'. Raises ValueError
-    for tokens outside the supported subset (planner check catches it)."""
+def parse_pattern(
+    fmt: str, for_parse: bool = False
+) -> Tuple[Tuple[str, str], ...]:
+    """Pattern → ((kind, text)…); kind is 'tok' (zero-padded), 'unp'
+    (unpadded single-letter) or 'lit'. Raises ValueError for tokens outside
+    the supported subset (planner check catches it); unpadded tokens are
+    rejected when ``for_parse`` (fixed-offset parsers can't scan them)."""
     out = []
     i = 0
     while i < len(fmt):
-        matched = False
-        for tok in sorted(_TOKENS, key=len, reverse=True):
-            if fmt.startswith(tok, i):
-                out.append(("tok", tok))
-                i += len(tok)
-                matched = True
-                break
-        if matched:
-            continue
         ch = fmt[i]
         if ch.isalpha():
-            raise ValueError(
-                f"datetime pattern token at {i!r} in {fmt!r} is outside the "
-                f"supported subset {sorted(_TOKENS)}"
-            )
+            # SimpleDateFormat groups by letter RUN: 'yy'/'MMM' are distinct
+            # fields, not two of ours — consume the whole run and only
+            # accept exact widths (silent mis-tokenization would format
+            # wrong data instead of falling back)
+            j = i
+            while j < len(fmt) and fmt[j] == ch:
+                j += 1
+            run = fmt[i:j]
+            if run in _TOKENS:
+                out.append(("tok", run))
+            elif len(run) == 1 and run in _UNPADDED and not for_parse:
+                out.append(("unp", run))
+            else:
+                raise ValueError(
+                    f"datetime pattern token {run!r} at {i} in {fmt!r} is "
+                    f"outside the supported subset "
+                    f"{sorted(_TOKENS) + sorted(_UNPADDED)}"
+                )
+            i = j
+            continue
         out.append(("lit", ch))
         i += 1
     return tuple(out)
 
 
-def pattern_supported(fmt: str) -> bool:
+def pattern_supported(fmt: str, for_parse: bool = False) -> bool:
     try:
-        parse_pattern(fmt)
+        parse_pattern(fmt, for_parse)
         return True
     except ValueError:
         return False
@@ -84,24 +98,43 @@ def _fields_from_micros(xp, micros):
     }
 
 
+_UNP_FIELD = {"y": "yyyy", "M": "MM", "d": "dd", "H": "HH", "m": "mm", "s": "ss"}
+
+
 def _format_device(ctx: Ctx, micros, pattern) -> tuple:
+    """One fused byte-layout kernel: fixed-width digit slots per token;
+    unpadded tokens drop leading zeros via the keep mask (the last digit
+    always stays)."""
     xp = ctx.xp
     fields = _fields_from_micros(xp, micros)
     n = micros.shape[0]
-    slots = []
+    slots, keeps = [], []
     width = 0
     for kind, text in pattern:
         if kind == "tok":
             k = _TOKENS[text]
-            slots.append((_digits_msd(xp, fields[text], k) + 48).astype(xp.uint8))
+            d = _digits_msd(xp, fields[text], k)
+            slots.append((d + 48).astype(xp.uint8))
+            keeps.append(xp.ones((n, k), dtype=bool))
+            width += k
+        elif kind == "unp":
+            k = _UNPADDED[text]
+            val = fields[_UNP_FIELD[text]]
+            d = _digits_msd(xp, val, k)
+            # keep digit j iff some digit at position <= j is nonzero, or
+            # it's the last digit
+            nz = (xp.cumsum((d != 0).astype(xp.int32), axis=1) > 0) | (
+                xp.arange(k)[None, :] == k - 1
+            )
+            slots.append((d + 48).astype(xp.uint8))
+            keeps.append(nz)
             width += k
         else:
-            slots.append(
-                xp.full((n, 1), ord(text), dtype=xp.uint8)
-            )
+            slots.append(xp.full((n, 1), ord(text), dtype=xp.uint8))
+            keeps.append(xp.ones((n, 1), dtype=bool))
             width += 1
     mat = xp.concatenate(slots, axis=1)
-    keep = xp.ones(mat.shape, dtype=bool)
+    keep = xp.concatenate(keeps, axis=1)
     return _pack(ctx, mat, keep, width)
 
 
@@ -130,6 +163,8 @@ def _format_cpu(micros: int, pattern) -> str:
     for kind, text in pattern:
         if kind == "tok":
             out.append(f"{vals[text] % (10 ** _TOKENS[text]):0{_TOKENS[text]}d}")
+        elif kind == "unp":
+            out.append(str(vals[_UNP_FIELD[text]] % (10 ** _UNPADDED[text])))
         else:
             out.append(text)
     return "".join(out)
@@ -307,7 +342,7 @@ class ToUnixTimestamp(Expression):
 
     def eval(self, ctx: Ctx) -> Val:
         v = self.child.eval(ctx)
-        pattern = parse_pattern(self.fmt.value)
+        pattern = parse_pattern(self.fmt.value, for_parse=True)
         if isinstance(self.child.data_type, (DateType, TimestampType)):
             from .cast import Cast
 
@@ -356,7 +391,7 @@ class ParseToDate(Expression):
 
     def eval(self, ctx: Ctx) -> Val:
         v = self.child.eval(ctx)
-        pattern = parse_pattern(self.fmt.value)
+        pattern = parse_pattern(self.fmt.value, for_parse=True)
         xp = ctx.xp
         if ctx.is_device:
             micros, ok = _parse_device(ctx, v, pattern)

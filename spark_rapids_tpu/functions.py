@@ -686,6 +686,12 @@ def weekday(c) -> Column:
     return Column(WeekDay(_e(c)))
 
 
+def weekofyear(c) -> Column:
+    from .expr.datetime import WeekOfYear
+
+    return Column(WeekOfYear(_e(c)))
+
+
 def dayofyear(c) -> Column:
     return Column(DayOfYear(_e(c)))
 
@@ -823,6 +829,12 @@ def atan2(l, r) -> Column:
 
 def hypot(l, r) -> Column:
     return Column(Hypot(_e(l), _e(r)))
+
+
+def pmod(dividend, divisor) -> Column:
+    from .expr.arithmetic import Pmod
+
+    return Column(Pmod(_e(dividend), _e(divisor)))
 
 
 def round(c, scale: int = 0) -> Column:  # noqa: A001

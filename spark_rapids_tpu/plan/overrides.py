@@ -350,6 +350,7 @@ for _cls in (
     dtx.Quarter,
     dtx.DayOfWeek,
     dtx.WeekDay,
+    dtx.WeekOfYear,
     dtx.DayOfYear,
     dtx.LastDay,
     dtx.DateAdd,
@@ -527,10 +528,14 @@ def _cpu_regex_check(what: str):
 def _fmt_check(e, conf: TpuConf) -> Optional[str]:
     if not st.is_string_literal(e.fmt):
         return "datetime pattern must be a string literal"
-    if not df.pattern_supported(e.fmt.value):
+    # parsers scan fixed offsets, so unpadded single-letter tokens are
+    # format-only (ToUnixTimestamp/ParseToDate reject them)
+    for_parse = isinstance(e, (df.ToUnixTimestamp, df.ParseToDate))
+    if not df.pattern_supported(e.fmt.value, for_parse=for_parse):
         return (
             f"datetime pattern {e.fmt.value!r} is outside the device-"
-            "supported token subset (yyyy MM dd HH mm ss + literals)"
+            "supported token subset (yyyy MM dd HH mm ss + literals; "
+            "y M d H m s when formatting)"
         )
     return None
 

