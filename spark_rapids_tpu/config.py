@@ -427,6 +427,23 @@ SHUFFLE_MANAGER_ENABLED = conf("spark.rapids.shuffle.manager.enabled").doc(
     "in-process default path (reference: RapidsShuffleManager)."
 ).boolean_conf(False)
 
+MULTIPROC_DRIVER = conf("spark.rapids.shuffle.multiproc.driver").doc(
+    "host:port of the cross-process driver service (heartbeat registry + "
+    "map-output tracker — shuffle/driver_service.py). When set, this "
+    "session is ONE executor of a multi-process query: exchanges run only "
+    "the map/reduce partitions this rank owns and fetch peer map output "
+    "over the TCP transport (the DCN path; reference: "
+    "RapidsShuffleHeartbeatManager + UCX executor-to-executor traffic)."
+).string_conf("")
+
+MULTIPROC_RANK = conf("spark.rapids.shuffle.multiproc.rank").doc(
+    "This executor's rank in the multi-process query (0-based)."
+).int_conf(0)
+
+MULTIPROC_SIZE = conf("spark.rapids.shuffle.multiproc.size").doc(
+    "Total executors cooperating on the multi-process query."
+).int_conf(1)
+
 
 class TpuConf:
     """An immutable-ish view over a key→string dict, with typed access.

@@ -1145,21 +1145,22 @@ def device_sort_fn(order: List[SortOrder]):
 
 
 def device_merge_fn(order: List[SortOrder]):
-    """Jitted two-run merge: concat the sorted runs (live segments land at
-    [0, na) and [na, na+nb)), rebuild radix words once, and gather through
-    ``merge_permutation``'s binary-search ranks. Linear work per merge level
-    — the reference's true out-of-core merge (GpuSortExec.scala:212-510)
-    rather than a re-sort."""
+    """Two-run merge: concat the sorted runs (live segments land at [0, na)
+    and [na, na+nb)), then ONE jitted kernel rebuilds radix words and
+    gathers through ``merge_permutation``'s binary-search ranks — O(n log n)
+    GATHERS per level instead of re-running the sort, whose TPU lowering is
+    a sorting network with per-pass cost far above a gather sweep (see
+    sort_permutation's compile-time notes). The reference's true
+    out-of-core merge (GpuSortExec.scala:212-510). Caveat measured on the
+    XLA-CPU backend: its lax.sort is a fast comparison sort, so there the
+    re-sort wins — the merge is sized for TPU economics. The concat runs as
+    its own cached kernel (kernels must not nest compiles)."""
     order = list(order)
 
     def make():
-        def _merge(ba: DeviceBatch, bb: DeviceBatch) -> DeviceBatch:
-            import jax.numpy as jnp
-
+        def _merge(merged: DeviceBatch, na, nb) -> DeviceBatch:
             from ..ops.sortkeys import column_radix_words, merge_permutation
 
-            na, nb = ba.num_rows, bb.num_rows
-            merged = concat_device([ba, bb])
             c = Ctx.for_device(merged)
             words = []
             for o in order:
@@ -1173,7 +1174,20 @@ def device_merge_fn(order: List[SortOrder]):
 
         return _merge
 
-    return K.jit_kernel(("merge_runs", _order_key(order)), make)
+    kernel = K.jit_kernel(("merge_runs", _order_key(order)), make)
+
+    def merge(ba: DeviceBatch, bb: DeviceBatch) -> DeviceBatch:
+        import jax.numpy as jnp
+
+        na, nb = ba.num_rows, bb.num_rows
+        merged = concat_device([ba, bb])
+        return kernel(
+            merged,
+            jnp.asarray(na, jnp.int32),
+            jnp.asarray(nb, jnp.int32),
+        )
+
+    return merge
 
 
 def _slice_head_impl(batch: DeviceBatch, take) -> DeviceBatch:
@@ -2015,6 +2029,27 @@ class TpuShuffleExchangeExec(Exec):
         kind, fn = self._scatter_fns(nparts, pre_filter)
         catalog = ctx.catalog
         child_parts = exchange_child.execute(ctx)
+        from .. import config as cfg
+
+        # Multi-process query (spark.rapids.shuffle.multiproc.*): this
+        # executor maps only the child partitions its rank owns; peers map
+        # the rest and serve them over the TCP transport (DCN path).
+        mp_size = cfg.MULTIPROC_SIZE.get(ctx.conf)
+        mp_rank = cfg.MULTIPROC_RANK.get(ctx.conf)
+        in_broadcast = getattr(ctx, "broadcast_depth", 0) > 0
+        multiproc = (
+            bool(cfg.MULTIPROC_DRIVER.get(ctx.conf))
+            and mp_size > 1
+            and cfg.SHUFFLE_MANAGER_ENABLED.get(ctx.conf)
+            and not in_broadcast
+        )
+        if multiproc:
+            child_parts = PartitionSet(
+                [
+                    t if i % mp_size == mp_rank else (lambda: iter(()))
+                    for i, t in enumerate(child_parts.parts)
+                ]
+            )
         state = {"buckets": None}
         mat_lock = threading.Lock()
 
@@ -2108,11 +2143,24 @@ class TpuShuffleExchangeExec(Exec):
 
         from .. import config as cfg
 
-        if cfg.SHUFFLE_MANAGER_ENABLED.get(ctx.conf):
+        if cfg.SHUFFLE_MANAGER_ENABLED.get(ctx.conf) and not in_broadcast:
             # Accelerated path: park partition buckets in the spillable
             # shuffle catalog and read them back through the caching
-            # reader (RapidsShuffleManager writer/reader protocol).
-            mgr_state = {"shuffle_id": None}
+            # reader (RapidsShuffleManager writer/reader protocol). A
+            # broadcast-build subtree stays on the in-process path: its
+            # results are per-executor by definition (no shared catalog /
+            # registry traffic).
+            #
+            # The shuffle id is minted HERE, during the single-threaded
+            # plan-execution walk — identical plans mint identical ids in
+            # every rank. Minting lazily inside ensure_written would let
+            # the partition THREAD POOL order decide which exchange gets
+            # which id (nondeterministic across ranks → peers would pair
+            # the wrong exchanges). Retries re-run the map stage under a
+            # deterministic per-generation offset within the query's id
+            # namespace.
+            base_sid = ctx.next_shuffle_id()
+            mgr_state = {"shuffle_id": None, "generation": 0}
             mgr_lock = threading.Lock()
 
             def ensure_written():
@@ -2120,8 +2168,11 @@ class TpuShuffleExchangeExec(Exec):
                     if mgr_state["shuffle_id"] is not None:
                         return mgr_state["shuffle_id"]
                     manager = ctx.shuffle_manager
-                    sid = ctx.next_shuffle_id()
-                    writer = manager.get_writer(sid, map_id=0, num_partitions=nparts)
+                    sid = base_sid + mgr_state["generation"] * 10_000
+                    writer = manager.get_writer(
+                        sid, map_id=mp_rank if multiproc else 0,
+                        num_partitions=nparts,
+                    )
                     for p, bucket in enumerate(materialize()):
                         for db in bucket:
                             if db.row_count():
@@ -2139,13 +2190,15 @@ class TpuShuffleExchangeExec(Exec):
                         if mgr_state.get("released"):
                             # task retry AFTER the map output was freed: the
                             # thunk must stay re-runnable (lineage recovery),
-                            # so re-run the map stage under a fresh shuffle
-                            # id — materialize() re-executes the child
-                            # pipeline since its buckets were handed to the
-                            # (now unregistered) catalog. Without this, the
-                            # retry would read an unknown shuffle id and
-                            # silently commit ZERO rows for this partition.
+                            # so re-run the map stage under the next
+                            # generation's shuffle id — materialize()
+                            # re-executes the child pipeline since its
+                            # buckets were handed to the (now unregistered)
+                            # catalog. Without this, the retry would read an
+                            # unknown shuffle id and silently commit ZERO
+                            # rows for this partition.
                             mgr_state["shuffle_id"] = None
+                            mgr_state["generation"] += 1
                             mgr_state["released"] = False
                             # full reset: stale entries would re-trip the
                             # release after ONE retried read, forcing every
@@ -2155,8 +2208,13 @@ class TpuShuffleExchangeExec(Exec):
                             # backstop for partially-retried generations)
                             consumed.clear()
                     sid = ensure_written()
+                    if multiproc and p % mp_size != mp_rank:
+                        # a peer owns this reduce partition; this executor
+                        # only had to contribute its map output (above)
+                        return
                     yield from ctx.shuffle_manager.get_reader().read_partitions(
-                        sid, p, p + 1
+                        sid, p, p + 1,
+                        expected_maps=mp_size if multiproc else 0,
                     )
                     # free catalog-held map output once every partition has
                     # been drained (ShuffleBufferCatalog unregisterShuffle)
@@ -2167,8 +2225,11 @@ class TpuShuffleExchangeExec(Exec):
                             and not mgr_state.get("released")
                             and mgr_state["shuffle_id"] == sid
                             # a reused exchange is drained once per consumer;
-                            # early release would force a map-stage re-run
+                            # early release would force a map-stage re-run.
+                            # Multi-process: peers fetch on their own clock —
+                            # map output lives until the executor exits.
                             and not getattr(self, "_reuse_shared", False)
+                            and not multiproc
                         )
                         if done:
                             mgr_state["released"] = True

@@ -351,8 +351,16 @@ class TpuBroadcastExchangeExec(Exec):
     def broadcast_batch(self, ctx: ExecContext) -> DeviceBatch:
         with self._lock:
             if self._cache is None:
-                parts = self.children[0].execute(ctx)
-                batches = [b for t in parts.parts for b in t()]
+                # exchanges under a broadcast build run WHOLE in every
+                # process: the build table must be complete per executor
+                # (multiproc rank-splitting or shared-registry map statuses
+                # here would broadcast a partial table)
+                ctx.broadcast_depth += 1
+                try:
+                    parts = self.children[0].execute(ctx)
+                    batches = [b for t in parts.parts for b in t()]
+                finally:
+                    ctx.broadcast_depth -= 1
                 self._cache = (
                     concat_device(batches) if batches else empty_batch(self.output)
                 )

@@ -55,6 +55,14 @@ def _cast_to(e: Expression, dt: DataType) -> Expression:
     return Cast(e, dt)
 
 
+def _integral_decimal(dt: DataType) -> DecimalType:
+    """Exact-width Decimal(p, 0) of an integral type (Spark's
+    DecimalType.forType): byte→3, short→5, int→10, long→19 (capped)."""
+    widths = {1: 3, 2: 5, 4: 10, 8: 19}
+    p = min(widths[dt.np_dtype.itemsize], DecimalType.MAX_PRECISION)
+    return DecimalType(p, 0)
+
+
 def _common_type(a: DataType, b: DataType) -> DataType:
     if a == b:
         return a
@@ -70,8 +78,7 @@ def _common_type(a: DataType, b: DataType) -> DataType:
         return DecimalType(min(p, DecimalType.MAX_PRECISION), s)
     if isinstance(a, DecimalType) and isinstance(b, IntegralType) and not isinstance(b, (DateType, TimestampType)):
         # Spark: integral promotes to decimal of exact width
-        widths = {1: 3, 2: 5, 4: 10, 8: 19}
-        p = min(widths[b.np_dtype.itemsize], DecimalType.MAX_PRECISION)
+        p = _integral_decimal(b).precision
         return DecimalType(max(a.precision, min(p + a.scale, DecimalType.MAX_PRECISION)), a.scale)
     if isinstance(b, DecimalType):
         return _common_type(b, a)
@@ -134,9 +141,7 @@ def coerce(e: Expression) -> Expression:
                 if isinstance(dt, IntegralType) and not isinstance(
                     dt, (DateType, TimestampType)
                 ):
-                    widths = {1: 3, 2: 5, 4: 10, 8: 19}
-                    p = min(widths[dt.np_dtype.itemsize], DecimalType.MAX_PRECISION)
-                    return _cast_to(side, DecimalType(p, 0))
+                    return _cast_to(side, _integral_decimal(dt))
                 return None
 
             nl, nr = _exact(e.l, lt), _exact(e.r, rt)

@@ -53,7 +53,9 @@ def kernel(key: tuple, builder: Callable):
     return fn
 
 
-_COMPILE_LOCK = threading.Lock()
+# Reentrant: tracing one kernel may invoke another GuardedJit (e.g. a fused
+# kernel built from cached sub-kernels); a plain lock would self-deadlock.
+_COMPILE_LOCK = threading.RLock()
 
 
 class GuardedJit:

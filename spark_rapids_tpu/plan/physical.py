@@ -94,7 +94,17 @@ class ExecContext:
 
         self._shuffle_manager = None
         self._shuffle_mgr_lock = threading.Lock()
-        self._shuffle_ids = itertools.count(1)
+        # Shuffle ids are namespaced by a per-session query sequence: the
+        # multi-process driver registry outlives one query, and all ranks
+        # must mint IDENTICAL ids for the same exchange (both run the same
+        # driver program, so the (query_seq, per-query counter) pair is
+        # deterministic across processes).
+        seq = session._next_query_seq() if session is not None else 0
+        self._shuffle_ids = itertools.count(seq * 1_000_000 + 1)
+        # depth counter: >0 while building a broadcast batch — exchanges
+        # below a broadcast must run WHOLE in every process (no rank split,
+        # no shared-registry map statuses)
+        self.broadcast_depth = 0
         # AQE: per-exchange measured-size providers, so the two exchanges
         # feeding a co-partitioned join can compute ONE shared coalesce
         # assignment (Spark applies identical CoalescedPartitionSpecs to
@@ -125,6 +135,52 @@ class ExecContext:
             from ..shuffle.local import InProcessRegistry, InProcessTransport
             from ..shuffle.manager import MapOutputRegistry, ShuffleEnv, TpuShuffleManager
 
+            driver = cfg.MULTIPROC_DRIVER.get(self.conf)
+            if driver:
+                # one executor of a multi-process query: TCP data plane +
+                # driver-service control plane (shuffle/driver_service.py).
+                # The manager lives on the SESSION, not the query context —
+                # a real executor keeps ONE shuffle server for its lifetime;
+                # per-query servers would re-register the executor id with a
+                # new port peers never re-learn, and map output must stay
+                # servable across queries (the release path is query-local).
+                cached = getattr(self.session, "_mp_shuffle_manager", None)
+                if cached is not None:
+                    self._shuffle_manager = cached
+                    return self._shuffle_manager
+                from ..shuffle import driver_service as ds
+                from ..shuffle.tcp import TcpTransport
+
+                host, _, port = driver.rpartition(":")
+                heartbeats, registry = ds.connect((host, int(port)))
+                rank = cfg.MULTIPROC_RANK.get(self.conf)
+                executor_id = f"executor-{rank}"
+                transport = TcpTransport(executor_id)
+                from ..mem.spill import BufferCatalog
+
+                # executor-lifetime store, NOT a query's catalog: shuffle
+                # output outlives the query that wrote it (peers fetch on
+                # their own clock), and pinning the first query's catalog
+                # would account later queries' shuffle bytes against a
+                # dead context (Spark's shuffle files are executor-scoped
+                # the same way)
+                shuffle_store = BufferCatalog.from_conf(self.conf)
+                env = ShuffleEnv(
+                    executor_id,
+                    transport,
+                    shuffle_store,
+                    heartbeats,
+                    codec=cfg.SHUFFLE_COMPRESSION_CODEC.get(self.conf),
+                    max_inflight_bytes=cfg.SHUFFLE_MAX_RECEIVE_INFLIGHT.get(self.conf),
+                    fetch_timeout_s=cfg.SHUFFLE_FETCH_TIMEOUT_S.get(self.conf),
+                    bounce_buffer_size=cfg.SHUFFLE_BOUNCE_BUFFER_SIZE.get(self.conf),
+                    bounce_buffer_count=cfg.SHUFFLE_BOUNCE_BUFFER_COUNT.get(self.conf),
+                    address=tuple(transport.address),
+                )
+                self._shuffle_manager = TpuShuffleManager(env, registry)
+                if self.session is not None:
+                    self.session._mp_shuffle_manager = self._shuffle_manager
+                return self._shuffle_manager
             reg = InProcessRegistry()
             env = ShuffleEnv(
                 "driver-executor",

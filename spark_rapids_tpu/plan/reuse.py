@@ -104,14 +104,25 @@ def reuse_exchanges(plan: Exec) -> Tuple[Exec, int]:
     from ..exec.tpu import TpuShuffleExchangeExec
     from ..exec.tpu_join import TpuBroadcastExchangeExec
 
-    seen: List[Tuple[object, Exec]] = []
     rebuilt: dict = {}  # id(old node) -> new node (ancestors of a dedupe)
     reused = 0
+    # One `seen` scope per broadcast-build boundary: a shuffle exchange
+    # executes differently inside a broadcast build (whole, in-process)
+    # than outside (managed / rank-split), and its memoized PartitionSet
+    # captures that decision — sharing one node across the boundary would
+    # leak a rank-split set into a broadcast (partial build table) or an
+    # unsplit set into a regular consumer (duplicated rows).
+    scopes: List[List[Tuple[object, Exec]]] = [[]]
 
     def walk(node: Exec) -> Exec:
         nonlocal reused
         old = node
+        is_bcast = isinstance(node, TpuBroadcastExchangeExec)
+        if is_bcast:
+            scopes.append([])
         new_children = [walk(c) for c in node.children]
+        if is_bcast:
+            scopes.pop()
         if any(nc is not oc for nc, oc in zip(new_children, node.children)):
             node = node.with_new_children(new_children)
             rebuilt[id(old)] = node
@@ -120,6 +131,7 @@ def reuse_exchanges(plan: Exec) -> Tuple[Exec, int]:
                 k = canonical_key(node)
             except _NotCanonical:
                 return node
+            seen = scopes[-1]
             for k2, hit in seen:
                 if _keys_equal(k, k2):
                     hit._reuse_shared = True

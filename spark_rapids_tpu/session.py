@@ -78,9 +78,29 @@ class TpuSession:
         self._last_plan: Optional[Exec] = None
         self._last_overrides: Optional[TpuOverrides] = None
         self._task_retries = 0
+        self._query_seq = 0
         import threading as _threading
 
         self._retry_lock = _threading.Lock()
+        if cfg.MULTIPROC_DRIVER.get(self.conf):
+            # fail fast on inconsistent multi-process settings — a missing
+            # piece silently double-counts (every rank runs the full query)
+            size = cfg.MULTIPROC_SIZE.get(self.conf)
+            rank = cfg.MULTIPROC_RANK.get(self.conf)
+            if not cfg.SHUFFLE_MANAGER_ENABLED.get(self.conf):
+                raise ValueError(
+                    "spark.rapids.shuffle.multiproc.driver requires "
+                    "spark.rapids.shuffle.manager.enabled=true"
+                )
+            if size < 2 or not (0 <= rank < size):
+                raise ValueError(
+                    f"multiproc rank/size invalid: rank={rank} size={size}"
+                )
+
+    def _next_query_seq(self) -> int:
+        with self._retry_lock:
+            self._query_seq += 1
+            return self._query_seq
 
     def mesh_context(self):
         """Lazily build the session's MeshContext (mesh mode only)."""
@@ -136,9 +156,24 @@ class TpuSession:
         from .expr.base import Literal
         from .expr.subquery import InSet, InSubquery, ScalarSubquery
 
+        def run_whole(plan):
+            """Subqueries resolve to literals every executor needs — under a
+            multi-process query each process computes the WHOLE subquery
+            locally (rank-splitting it would inline a partial aggregate)."""
+            if cfg.MULTIPROC_DRIVER.get(self.conf):
+                saved = self.conf
+                try:
+                    self.conf = saved.set(cfg.MULTIPROC_DRIVER.key, "").set(
+                        cfg.MULTIPROC_SIZE.key, "1"
+                    )
+                    return self._execute(plan)
+                finally:
+                    self.conf = saved
+            return self._execute(plan)
+
         def fix(e):
             if isinstance(e, ScalarSubquery):
-                tbl = self._execute(e.plan)
+                tbl = run_whole(e.plan)
                 if tbl.num_columns != 1:
                     raise ValueError(
                         "scalar subquery must return one column, got "
@@ -159,7 +194,7 @@ class TpuSession:
                     val = InSet._encode_values([val], e.data_type)[0]
                 return Literal(val, e.data_type)
             if isinstance(e, InSubquery):
-                tbl = self._execute(e.plan)
+                tbl = run_whole(e.plan)
                 if tbl.num_columns != 1:
                     raise ValueError(
                         "IN-subquery must return one column, got "

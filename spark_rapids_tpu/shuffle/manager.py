@@ -144,9 +144,28 @@ class CachingReader:
         self._registry = registry
 
     def read_partitions(
-        self, shuffle_id: int, start_part: int, end_part: int
+        self,
+        shuffle_id: int,
+        start_part: int,
+        end_part: int,
+        expected_maps: int = 0,
     ) -> Iterator[DeviceBatch]:
         statuses = self._registry.outputs_for(shuffle_id)
+        if expected_maps > len(statuses):
+            # multi-process: peers register their MapStatus only after their
+            # map stage commits — poll the driver-side tracker like Spark
+            # reducers block on MapOutputTracker (fetch timeout bounds it)
+            import time as _time
+
+            deadline = _time.monotonic() + self._env.fetch_timeout_s
+            while len(statuses) < expected_maps:
+                if _time.monotonic() > deadline:
+                    raise TimeoutError(
+                        f"shuffle {shuffle_id}: {len(statuses)}/{expected_maps} "
+                        "map outputs registered before fetch timeout"
+                    )
+                _time.sleep(0.05)
+                statuses = self._registry.outputs_for(shuffle_id)
         # group remote requests per peer executor (one metadata round trip
         # per peer, the RapidsShuffleIterator batching)
         remote: Dict[str, List[M.BlockId]] = {}
