@@ -201,6 +201,14 @@ EXCHANGE_REUSE_ENABLED = conf("spark.sql.exchange.reuse").doc(
     "GpuExec.scala:251-276)."
 ).boolean_conf(True)
 
+GET_JSON_OBJECT_DEVICE = conf("spark.rapids.sql.getJsonObject.enabled").doc(
+    "Run get_json_object on device via the span-extraction kernel. Like the "
+    "reference's cudf get_json_object (GpuOverrides.scala:2519) it returns "
+    "nested results as written (no re-serialization) and does not unescape "
+    "string values — exact on compact escape-free JSON; off by default "
+    "because CPU Spark normalizes through Jackson (docs/compatibility.md)."
+).boolean_conf(False)
+
 ADAPTIVE_ENABLED = conf("spark.sql.adaptive.enabled").doc(
     "Adaptive query execution (Spark's key, honored here): exchanges "
     "coalesce small output partitions at runtime from measured sizes "

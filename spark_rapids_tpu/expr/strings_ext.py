@@ -481,11 +481,45 @@ def _json_path_steps(path: str):
     return steps
 
 
+def _json_masks(xp, b):
+    """Per-byte structural masks of a padded JSON byte plane [n, w]:
+    (in_str inclusive of the opening quote, depth AFTER this byte,
+    depth BEFORE this byte). Escaped quotes are handled by the
+    prev-byte-backslash rule — a double-backslash before a closing quote
+    is the documented divergence of the span-based device path."""
+    prev = xp.pad(b[:, :-1], ((0, 0), (1, 0)))
+    quote = (b == ord('"')) & (prev != ord("\\"))
+    cums = xp.cumsum(quote.astype(xp.int32), axis=1)
+    in_str = (cums % 2) == 1  # opening quote .. char before closing quote
+    # brackets inside string literals are data, not structure
+    struct = ~in_str
+    opens = ((b == ord("{")) | (b == ord("["))) & struct
+    closes = ((b == ord("}")) | (b == ord("]"))) & struct
+    depth = xp.cumsum(opens.astype(xp.int32) - closes.astype(xp.int32), axis=1)
+    depth_before = depth - opens.astype(xp.int32) + closes.astype(xp.int32)
+    return in_str, depth, depth_before
+
+
+def _first_at_or_after(xp, mask, start, w):
+    """Per row: smallest position >= start where mask holds, else w."""
+    pos = xp.arange(w, dtype=xp.int32)[None, :]
+    cand = xp.where(mask & (pos >= start[:, None]), pos, w)
+    return cand.min(axis=1).astype(xp.int32)
+
+
 @dataclass(frozen=True)
 class GetJsonObject(Expression):
-    """``get_json_object(json, '$.path')`` (GpuGetJsonObject.scala) — CPU
-    engine; scalars come back unquoted, objects/arrays re-serialized
-    compactly (Jackson's writeValueAsString shape)."""
+    """``get_json_object(json, '$.path')`` (reference rule
+    GpuOverrides.scala:2519, GpuGetJsonObject.scala → cudf's span-based
+    get_json_object). CPU engine normalizes through a JSON parser (Jackson
+    shape: scalars unquoted, objects/arrays re-serialized compactly).
+
+    The DEVICE path (gated by ``spark.rapids.sql.getJsonObject.enabled``,
+    default off) extracts the RAW VALUE SPAN via vectorized depth/string
+    masks + per-step span narrowing — like the reference's cudf kernel it
+    returns nested results as written (no re-serialization) and does not
+    unescape string values; exact on compact JSON without escapes
+    (docs/compatibility.md)."""
 
     child: Expression
     path: Expression  # literal
@@ -494,9 +528,140 @@ class GetJsonObject(Expression):
     def data_type(self) -> DataType:
         return STRING
 
+    def _eval_device(self, ctx: Ctx, c) -> Val:
+        from .strings import _match_starts, _rev_cummin, compact_bytes, dev_str
+
+        xp = ctx.xp
+        data, lengths = dev_str(ctx, c)
+        n, w = data.shape
+        steps = _json_path_steps(self.path.value)
+        valid = c.full_valid(ctx)
+        if steps is None:
+            return Val(
+                xp.zeros((n, w), dtype=xp.uint8),
+                xp.zeros(n, dtype=bool),
+                xp.zeros(n, dtype=xp.int32),
+            )
+        in_str, depth, depth_before = _json_masks(xp, data)
+        pos = xp.arange(w, dtype=xp.int32)[None, :]
+        in_len = pos < lengths[:, None]
+        nonspace = in_len & (data != ord(" ")) & (data != ord("\t")) & (
+            data != ord("\n")
+        ) & (data != ord("\r"))
+        # loop-invariant: first nonspace at-or-after each position
+        next_ns = _rev_cummin(xp, xp.where(nonspace, pos, w))
+        # structural truncation guard: unbalanced brackets or an unclosed
+        # string at end-of-document → NULL like a real parser (cheap; full
+        # grammar validation is the CPU path's job)
+        last_i = xp.clip(lengths - 1, 0, w - 1)[:, None]
+        end_depth = xp.where(
+            lengths > 0, xp.take_along_axis(depth, last_i, axis=1)[:, 0], 0
+        )
+        # in_str is exclusive of closing quotes, so a well-formed document
+        # never ends inside a string (a trailing OPENING quote is in_str)
+        end_in_str = (lengths > 0) & xp.take_along_axis(
+            in_str, last_i, axis=1
+        )[:, 0]
+        well_formed = (end_depth == 0) & ~end_in_str
+        # value span [lo, hi) — the root value, trailing whitespace trimmed
+        lo = _first_at_or_after(xp, nonspace, xp.zeros(n, xp.int32), w)
+        hi = (xp.where(nonspace, pos, -1).max(axis=1) + 1).astype(xp.int32)
+        ok = (lo < hi) & well_formed
+        for kind, v in steps:
+            # container must open the span
+            first = xp.take_along_axis(
+                data, xp.clip(lo, 0, w - 1)[:, None], axis=1
+            )[:, 0]
+            d_entry = xp.take_along_axis(
+                depth, xp.clip(lo, 0, w - 1)[:, None], axis=1
+            )[:, 0]
+            span = (pos >= lo[:, None]) & (pos < hi[:, None])
+            if kind == "key":
+                ok = ok & (first == ord("{"))
+                pat = b'"' + str(v).encode("utf-8") + b'"'
+                m = _match_starts(ctx, data, lengths, pat)
+                # per-candidate ':' validation distinguishes a KEY from a
+                # string VALUE with the same bytes at the same depth
+                after_key = xp.clip(pos + len(pat), 0, w - 1)
+                colon_at = xp.take_along_axis(next_ns, after_key, axis=1)
+                colon_ch = xp.take_along_axis(
+                    data, xp.clip(colon_at, 0, w - 1), axis=1
+                )
+                cand = (
+                    m
+                    & span
+                    & (depth_before == d_entry[:, None])
+                    & (colon_ch == ord(":"))
+                    & (colon_at < hi[:, None])
+                )
+                kpos = xp.where(cand, pos, w).min(axis=1).astype(xp.int32)
+                ok = ok & (kpos < w)
+                colon = xp.take_along_axis(
+                    colon_at, xp.clip(kpos, 0, w - 1)[:, None], axis=1
+                )[:, 0]
+                vstart = _first_at_or_after(xp, nonspace, colon + 1, w)
+            else:  # index
+                ok = ok & (first == ord("["))
+                commas = (
+                    (data == ord(","))
+                    & ~in_str
+                    & span
+                    & (depth_before == d_entry[:, None])
+                )
+                if v == 0:
+                    vstart = _first_at_or_after(xp, nonspace, lo + 1, w)
+                else:
+                    ccount = xp.cumsum(commas.astype(xp.int32), axis=1)
+                    at_v = commas & (ccount == v)
+                    cpos = xp.where(at_v, pos, w).min(axis=1).astype(xp.int32)
+                    ok = ok & (cpos < w)
+                    vstart = _first_at_or_after(xp, nonspace, cpos + 1, w)
+                # the selected entry must exist (not past the close bracket)
+                close_ch = xp.take_along_axis(
+                    data, xp.clip(vstart, 0, w - 1)[:, None], axis=1
+                )[:, 0]
+                ok = ok & (vstart < hi) & (close_ch != ord("]"))
+            # value end: next separator/close at entry depth
+            sep = (
+                ((data == ord(",")) | (data == ord("}")) | (data == ord("]")))
+                & ~in_str
+                & (depth_before == d_entry[:, None])
+            )
+            vend = _first_at_or_after(xp, sep, vstart, w)
+            vend = xp.minimum(vend, hi)
+            # trim trailing whitespace: last nonspace in [vstart, vend)
+            lastns = xp.where(
+                nonspace & (pos >= vstart[:, None]) & (pos < vend[:, None]),
+                pos,
+                -1,
+            ).max(axis=1)
+            lo = vstart
+            hi = (lastns + 1).astype(xp.int32)
+            ok = ok & (lo < hi)
+        # unquote string results
+        first = xp.take_along_axis(data, xp.clip(lo, 0, w - 1)[:, None], axis=1)[:, 0]
+        last = xp.take_along_axis(
+            data, xp.clip(hi - 1, 0, w - 1)[:, None], axis=1
+        )[:, 0]
+        quoted = ok & (first == ord('"')) & (last == ord('"')) & (hi - lo >= 2)
+        lo = xp.where(quoted, lo + 1, lo)
+        hi = xp.where(quoted, hi - 1, hi)
+        # a JSON null VALUE is SQL NULL (Spark returns null, not 'null')
+        is_null_lit = ok & ~quoted & (hi - lo == 4)
+        for off, ch in enumerate(b"null"):
+            at = xp.take_along_axis(
+                data, xp.clip(lo + off, 0, w - 1)[:, None], axis=1
+            )[:, 0]
+            is_null_lit = is_null_lit & (at == ch)
+        ok = ok & ~is_null_lit
+        keep = (pos >= lo[:, None]) & (pos < hi[:, None]) & ok[:, None]
+        out, new_len = compact_bytes(ctx, data, keep)
+        return Val(out, valid & ok, new_len)
+
     def eval(self, ctx: Ctx) -> Val:
-        assert not ctx.is_device, "get_json_object executes on the CPU engine"
         c = self.child.eval(ctx)
+        if ctx.is_device:
+            return self._eval_device(ctx, c)
         steps = _json_path_steps(self.path.value)
         s = _cpu_strs(ctx, c)
         valid = ctx.broadcast_bool(c.valid)

@@ -560,7 +560,20 @@ _expr(sx.StringSplit, check=_split_check)
 _expr(sx.RLike, check=_cpu_regex_check("rlike"))
 _expr(sx.RegExpReplace, check=_cpu_regex_check("regexp_replace"))
 _expr(sx.RegExpExtract, check=_cpu_regex_check("regexp_extract"))
-_expr(sx.GetJsonObject, check=_cpu_regex_check("get_json_object"))
+def _get_json_check(e, conf: TpuConf) -> Optional[str]:
+    if not st.is_string_literal(e.path):
+        return "get_json_object path must be a string literal"
+    if not cfg.GET_JSON_OBJECT_DEVICE.get(conf):
+        return (
+            "device get_json_object returns raw value spans (no Jackson "
+            "re-serialization / unescaping, like the reference's cudf "
+            f"kernel); enable {cfg.GET_JSON_OBJECT_DEVICE.key} to accept "
+            "the divergence (docs/compatibility.md)"
+        )
+    return None
+
+
+_expr(sx.GetJsonObject, check=_get_json_check)
 _expr(df.DateFormatClass, check=_fmt_check)
 _expr(df.FromUnixTime, check=_fmt_check)
 _expr(df.ToUnixTimestamp, check=_fmt_check)
