@@ -201,6 +201,13 @@ EXCHANGE_REUSE_ENABLED = conf("spark.sql.exchange.reuse").doc(
     "GpuExec.scala:251-276)."
 ).boolean_conf(True)
 
+PYTHON_PREFETCH_BATCHES = conf("spark.rapids.sql.python.prefetchBatches").doc(
+    "Bounded producer/consumer queue depth between the engine's batch "
+    "pipeline and streaming python UDF execs (mapInPandas): upstream "
+    "production overlaps python compute on a producer thread (the "
+    "reference's BatchQueue, GpuArrowEvalPythonExec.scala:188). 0 disables."
+).int_conf(2)
+
 GET_JSON_OBJECT_DEVICE = conf("spark.rapids.sql.getJsonObject.enabled").doc(
     "Run get_json_object on device via the span-extraction kernel. Like the "
     "reference's cudf get_json_object (GpuOverrides.scala:2519) it returns "

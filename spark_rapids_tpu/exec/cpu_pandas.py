@@ -19,6 +19,72 @@ from ..plan.physical import Exec, ExecContext, PartitionSet
 from ..types import Schema
 
 
+def prefetched(it: Iterator, depth: int) -> Iterator:
+    """BatchQueue analogue (GpuArrowEvalPythonExec.scala:188): a producer
+    thread drains the upstream batch pipeline into a bounded queue while
+    the python function consumes — device production and python compute
+    overlap instead of serializing. The WHOLE upstream iterator advances on
+    the producer thread (task thread-locals re-assert per pull, so the
+    stage scoping is thread-consistent); errors propagate to the consumer;
+    an abandoned consumer releases the producer via the stop flag."""
+    if depth <= 0:
+        return it
+    import queue as _q
+    import threading
+
+    buf: "_q.Queue" = _q.Queue(maxsize=depth)
+    stop = threading.Event()
+    DONE = object()
+
+    class _Err:
+        def __init__(self, e):
+            self.e = e
+
+    def produce():
+        try:
+            for x in it:
+                while not stop.is_set():
+                    try:
+                        buf.put(x, timeout=0.1)
+                        break
+                    except _q.Full:
+                        continue
+                if stop.is_set():
+                    return
+            item = DONE
+        except BaseException as e:  # noqa: BLE001 - relayed to the consumer
+            item = _Err(e)
+        while not stop.is_set():
+            try:
+                buf.put(item, timeout=0.1)
+                return
+            except _q.Full:
+                continue
+
+    def consume():
+        # lazy start: a consumer generator that is never advanced never
+        # runs its finally, so an eager producer would busy-poll forever
+        threading.Thread(target=produce, daemon=True).start()
+        try:
+            while True:
+                x = buf.get()
+                if x is DONE:
+                    return
+                if isinstance(x, _Err):
+                    raise x.e
+                yield x
+        finally:
+            stop.set()
+
+    return consume()
+
+
+def _prefetch_depth(ctx: ExecContext) -> int:
+    from .. import config as cfg
+
+    return cfg.PYTHON_PREFETCH_BATCHES.get(ctx.conf)
+
+
 def _df_to_batches(df, schema: Schema, what: str) -> Iterator[pa.RecordBatch]:
     import pandas as pd
 
@@ -57,10 +123,13 @@ class CpuMapInPandasExec(Exec):
 
     def execute(self, ctx: ExecContext) -> PartitionSet:
         fn, schema = self.fn, self._schema
+        depth = _prefetch_depth(ctx)
 
         def run(it: Iterator[pa.RecordBatch]):
+            src = prefetched(it, depth)
+
             def dfs():
-                for rb in it:
+                for rb in src:
                     yield rb.to_pandas()
 
             for df in fn(dfs()):

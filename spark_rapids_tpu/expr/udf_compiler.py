@@ -31,6 +31,11 @@ class _Untranslatable(Exception):
     pass
 
 
+#: a local variable defined on only one branch of an ``if`` — readable on
+#: no path-independent basis, so any later read aborts translation
+_POISON = object()
+
+
 def _fn_ast(fn) -> Optional[ast.AST]:
     """The Lambda or FunctionDef node of ``fn``, or None."""
     try:
@@ -91,24 +96,21 @@ def try_translate(
     node = _fn_ast(fn)
     if node is None:
         return None
-    if isinstance(node, ast.Lambda):
-        params = [a.arg for a in node.args.args]
-        body = node.body
-    else:
-        params = [a.arg for a in node.args.args]
-        stmts = [
-            s for s in node.body
-            if not isinstance(s, (ast.Expr,))  # skip docstrings
-            or not isinstance(getattr(s, "value", None), ast.Constant)
-        ]
-        if len(stmts) != 1 or not isinstance(stmts[0], ast.Return):
-            return None
-        body = stmts[0].value
+    params = [a.arg for a in node.args.args]
     if len(params) != len(args) or node.args.vararg or node.args.kwarg:
         return None
     env = dict(zip(params, args))
     try:
-        out = _tx(body, env, fn)
+        if isinstance(node, ast.Lambda):
+            out = _tx(node.body, env, fn)
+        else:
+            # multi-statement bodies: local assignments and if/elif/else
+            # control flow translate through the block walker — the AST
+            # analogue of the reference's bytecode CFG → Catalyst
+            # translation (CFG.scala + CatalystExpressionBuilder.scala)
+            kind, out = _tx_block(list(node.body), env, fn)
+            if kind != "value":
+                return None  # fell off the end without a return
     except _Untranslatable:
         return None
     from .cast import Cast
@@ -120,6 +122,83 @@ def try_translate(
     if needs_cast:
         out = Cast(out, return_type)
     return out
+
+
+def _tx_block(stmts, env: dict, fn):
+    """Translate a statement list. Returns ('value', expr) when every path
+    through the block returns, or ('env', new_env) when control falls off
+    the end with updated local bindings. Branches merge SSA-style: a
+    variable assigned under an ``if`` becomes ``If(cond, then_val,
+    else_val)`` in the continuation — the same φ-node construction the
+    reference's CFG walk performs on JVM bytecode."""
+    from .conditional import If
+
+    i = 0
+    while i < len(stmts):
+        s = stmts[i]
+        if isinstance(s, ast.Expr) and isinstance(s.value, ast.Constant):
+            i += 1  # docstring
+            continue
+        if isinstance(s, ast.Return):
+            if s.value is None:
+                raise _Untranslatable("bare return")
+            return "value", _tx(s.value, env, fn)
+        if isinstance(s, ast.Assign):
+            if len(s.targets) != 1 or not isinstance(s.targets[0], ast.Name):
+                raise _Untranslatable("assignment target")
+            env = {**env, s.targets[0].id: _tx(s.value, env, fn)}
+            i += 1
+            continue
+        if isinstance(s, ast.AugAssign):
+            if not isinstance(s.target, ast.Name):
+                raise _Untranslatable("augassign target")
+            synth = ast.BinOp(
+                left=ast.Name(id=s.target.id, ctx=ast.Load()),
+                op=s.op,
+                right=s.value,
+            )
+            env = {**env, s.target.id: _tx(synth, env, fn)}
+            i += 1
+            continue
+        if isinstance(s, ast.If):
+            cond = _tx(s.test, env, fn)
+            rest = stmts[i + 1 :]
+            t_kind, t_out = _tx_block(list(s.body), dict(env), fn)
+            e_kind, e_out = (
+                _tx_block(list(s.orelse), dict(env), fn)
+                if s.orelse
+                else ("env", dict(env))
+            )
+            if t_kind == "value" and e_kind == "value":
+                return "value", If(cond, t_out, e_out)
+            if t_kind == "value":
+                k2, v2 = _tx_block(rest, e_out, fn)
+                if k2 != "value":
+                    raise _Untranslatable("missing return on else path")
+                return "value", If(cond, t_out, v2)
+            if e_kind == "value":
+                k2, v2 = _tx_block(rest, t_out, fn)
+                if k2 != "value":
+                    raise _Untranslatable("missing return on then path")
+                return "value", If(cond, v2, e_out)
+            # both fall through: φ-merge every binding that changed. A name
+            # defined on ONE path only is POISONED — a later read must not
+            # fall through to a same-named global (never translate-wrong);
+            # t_out/e_out are supersets of env, so a missing side really
+            # means branch-only definition.
+            merged = dict(env)
+            for name in set(t_out) | set(e_out):
+                tv = t_out.get(name)
+                ev = e_out.get(name)
+                if tv is None or ev is None:
+                    merged[name] = _POISON
+                    continue
+                merged[name] = tv if tv is ev else If(cond, tv, ev)
+            env = merged
+            i += 1
+            continue
+        raise _Untranslatable(type(s).__name__)
+    return "env", env
 
 
 _MATH_CALLS = {
@@ -145,6 +224,10 @@ def _tx(node: ast.AST, env: dict, fn) -> Expression:
 
     if isinstance(node, ast.Name):
         if node.id in env:
+            if env[node.id] is _POISON:
+                raise _Untranslatable(
+                    f"{node.id} is defined on only one branch"
+                )
             return env[node.id]
         return to_expr(_const(_closure_value(fn, node.id)))
     if isinstance(node, ast.Constant):
@@ -215,6 +298,13 @@ def _tx(node: ast.AST, env: dict, fn) -> Expression:
                 out = pred.And(out, p)
             return out
         l = _tx(node.left, env, fn)
+        if isinstance(node.ops[0], (ast.In, ast.NotIn)):
+            comp = node.comparators[0]
+            if not isinstance(comp, (ast.Tuple, ast.List, ast.Set)):
+                raise _Untranslatable("in over non-literal collection")
+            vals = tuple(_tx(e, env, fn) for e in comp.elts)
+            out = pred.In(l, vals)
+            return pred.Not(out) if isinstance(node.ops[0], ast.NotIn) else out
         r = _tx(node.comparators[0], env, fn)
         table = {
             ast.Lt: pred.LessThan,
@@ -272,6 +362,15 @@ def _tx_call(node: ast.Call, env: dict, fn) -> Expression:
             return getattr(st, _STR_METHODS[name])(obj)
         if name == "strip" and not args:
             return st.StringTrim(obj)
+        if name == "lstrip" and not args:
+            return st.StringTrimLeft(obj)
+        if name == "rstrip" and not args:
+            return st.StringTrimRight(obj)
+        if name in ("startswith", "endswith") and len(args) == 1:
+            cls = st.StartsWith if name == "startswith" else st.EndsWith
+            return cls(obj, args[0])
+        if name == "replace" and len(args) == 2:
+            return st.StringReplace(obj, args[0], args[1])
         raise _Untranslatable(f".{name}()")
     if not isinstance(node.func, ast.Name):
         raise _Untranslatable("call target")
@@ -285,6 +384,15 @@ def _tx_call(node: ast.Call, env: dict, fn) -> Expression:
     if name in ("min", "max") and len(args) >= 2:
         cls = nx.Least if name == "min" else nx.Greatest
         return cls(tuple(args))
+    if name in ("int", "float") and len(args) == 1:
+        from .cast import Cast
+
+        # python int() truncates toward zero — Spark's fractional→integral
+        # cast does the same. str()/bool() are NOT mapped: Spark's cast
+        # formats floats/booleans differently from python ('1.0E20' vs
+        # '1e+20', 'true' vs 'True') and bool('false') is python-True —
+        # silent wrong results, so those fall back.
+        return Cast(args[0], LONG if name == "int" else DOUBLE)
     raise _Untranslatable(name)
 
 

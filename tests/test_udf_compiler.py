@@ -355,3 +355,150 @@ def test_apply_in_pandas_null_keys_form_group():
 
     assert sorted(build(cpu_session()).collect()) == [(2,), (2,)]
     assert sorted(build(tpu_session(strict=False)).collect()) == [(2,), (2,)]
+
+
+# ── round 4: multi-statement bodies + control flow (CFG-style) ─────────────
+def test_tx_local_variables():
+    t = pa.table({"x": [1.0, 4.0, 9.0, 16.0]})
+
+    def f_impl(v):
+        half = v / 2
+        quarter = half / 2
+        return quarter + 1
+
+    f = udf(f_impl, returnType=DOUBLE)
+    _check_translated(
+        lambda s: s.create_dataframe(t).select(f(col("x")).alias("r")),
+        [(1.25,), (2.0,), (3.25,), (5.0,)],
+    )
+
+
+def test_tx_if_else_returns():
+    t = pa.table({"x": [-5, 0, 3, 12]})
+
+    def f_impl(v):
+        if v < 0:
+            return -v
+        elif v > 10:
+            return 10
+        else:
+            return v
+
+    f = udf(f_impl, returnType=LONG)
+    _check_translated(
+        lambda s: s.create_dataframe(t).select(f(col("x")).alias("r")),
+        [(5,), (0,), (3,), (10,)],
+    )
+
+
+def test_tx_early_return_with_fallthrough():
+    t = pa.table({"x": [1, 50, 200]})
+
+    def f_impl(v):
+        if v > 100:
+            return 100
+        scaled = v * 2
+        return scaled
+
+    f = udf(f_impl, returnType=LONG)
+    _check_translated(
+        lambda s: s.create_dataframe(t).select(f(col("x")).alias("r")),
+        [(2,), (100,), (100,)],
+    )
+
+
+def test_tx_branch_assignment_phi_merge():
+    t = pa.table({"x": [-3, 0, 7]})
+
+    def f_impl(v):
+        sign = 1
+        if v < 0:
+            sign = -1
+        mag = v * sign
+        return mag + sign
+
+    f = udf(f_impl, returnType=LONG)
+    _check_translated(
+        lambda s: s.create_dataframe(t).select(f(col("x")).alias("r")),
+        [(2,), (1,), (8,)],
+    )
+
+
+def test_tx_augassign_and_in():
+    t = pa.table({"x": [1, 2, 3, 9]})
+
+    def f_impl(v):
+        acc = v
+        acc += 10
+        if v in (2, 9):
+            acc *= 2
+        return acc
+
+    f = udf(f_impl, returnType=LONG)
+    _check_translated(
+        lambda s: s.create_dataframe(t).select(f(col("x")).alias("r")),
+        [(11,), (24,), (13,), (38,)],
+    )
+
+
+def test_tx_str_methods_and_casts():
+    t = pa.table({"s": ["  Alpha ", "beta", "GAMMA-x"], "x": [1.7, -2.9, 3.0]})
+
+    def f_impl(s, v):
+        name = s.strip().lower()
+        if name.startswith("al"):
+            name = name.replace("a", "@")
+        if int(v) > 0:
+            name = name + "+"
+        return name
+
+    f = udf(f_impl, returnType=STRING)
+    _check_translated(
+        lambda s: s.create_dataframe(t).select(
+            f(col("s"), col("x")).alias("r")
+        ),
+        [("@lph@+",), ("beta",), ("gamma-x+",)],
+    )
+
+
+def test_tx_untranslatable_loop_falls_back():
+    """A while loop stays row-at-a-time python (translate-or-fallback,
+    never translate-wrong)."""
+    t = pa.table({"x": [3, 5]})
+
+    def f_impl(v):
+        out = 0
+        while v > 0:
+            out += v
+            v -= 1
+        return out
+
+    f = udf(f_impl, returnType=LONG)
+
+    def build(s):
+        return s.create_dataframe(t).select(f(col("x")).alias("r"))
+
+    want = build(cpu_session()).collect()
+    s = tpu_session({**TRANSLATE, "spark.rapids.sql.test.enabled": False})
+    got = build(s).collect()
+    assert sorted(got) == sorted(want) == [(6,), (15,)]
+
+
+limit = 99  # a global that a buggy phi-merge would capture
+
+
+def test_tx_one_branch_variable_poisoned():
+    """A variable defined on only one branch must ABORT translation, not
+    resolve to a same-named module global (never translate-wrong)."""
+    t = pa.table({"x": [1, -1]})
+
+    def f_impl(v):
+        if v > 0:
+            limit = v
+        return limit  # noqa: F821 - intentionally partial
+
+    f = udf(f_impl, returnType=LONG)
+    from spark_rapids_tpu.expr.udf_compiler import try_translate
+    from spark_rapids_tpu.expr.base import UnresolvedAttribute
+
+    assert try_translate(f_impl, [UnresolvedAttribute("x")], LONG) is None
