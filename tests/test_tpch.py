@@ -48,6 +48,15 @@ def test_tpch_differential(n, tables):
     tpu = tpu_session({"spark.sql.shuffle.partitions": 2})
     rows_c = tpch_query(n, _accessor(cpu, tables), sf=Q11_SF).collect()
     rows_t = tpch_query(n, _accessor(tpu, tables), sf=Q11_SF).collect()
+    # full-device-placement evidence at zero extra cost: the only nodes off
+    # device may be source scans (host Arrow decode is the v1 I/O design,
+    # SURVEY §7); reasons are kept for diagnosis
+    bad = [
+        (e.node, e.reasons)
+        for e in tpu._last_overrides.explain
+        if not e.on_device and not e.node.startswith("CpuScan")
+    ]
+    assert not bad, f"q{n} compute fallbacks: {bad}"
     rows_c, rows_t = _normalize(rows_c, True), _normalize(rows_t, True)
     assert len(rows_c) == len(rows_t), (
         f"q{n}: row count cpu={len(rows_c)} tpu={len(rows_t)}\n"
@@ -91,3 +100,5 @@ def test_tpch_nonempty_results(tables):
         rows = tpch_query(n, _accessor(cpu, tables), sf=Q11_SF).collect()
         if n not in empty_ok:
             assert rows, f"q{n} returned no rows at SF={SF}"
+
+
