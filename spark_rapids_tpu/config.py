@@ -276,6 +276,13 @@ POOL_SIZE_FRACTION = conf("spark.rapids.memory.gpu.allocFraction").doc(
     "(reference: RapidsConf.scala RMM_ALLOC_FRACTION)."
 ).double_conf(0.9)
 
+MEMORY_DEBUG = conf("spark.rapids.memory.tpu.debug").doc(
+    "Debug-allocator mode (the reference's spark.rapids.memory.gpu.debug + "
+    "ai.rapids.refcount.debug): the spill catalog records the registration "
+    "site of every spillable buffer, logs tier transitions, and reports any "
+    "buffer still registered at query end as a LEAK with its origin."
+).boolean_conf(False)
+
 HOST_SPILL_STORAGE_SIZE = conf("spark.rapids.memory.host.spillStorageSize").doc(
     "Amount of host memory to use for spilled device buffers before "
     "overflowing to disk."

@@ -28,6 +28,7 @@ Tier currencies:
 from __future__ import annotations
 
 import json
+import logging
 import os
 import tempfile
 import threading
@@ -105,7 +106,7 @@ class SpillableBatch:
 
 class _Buffer:
     __slots__ = ("id", "size", "priority", "tier", "device", "host", "path",
-                 "aux", "pinned", "dev")
+                 "aux", "pinned", "dev", "origin")
 
     def __init__(self, buf_id: int, size: int, priority: int):
         self.id = buf_id
@@ -118,6 +119,7 @@ class _Buffer:
         self.aux = None  # pytree treedef
         self.pinned = False
         self.dev = None  # jax device holding the batch (mesh accounting)
+        self.origin: Optional[str] = None  # registration site (debug mode)
 
 
 def _batch_device(batch: DeviceBatch):
@@ -146,6 +148,10 @@ class BufferCatalog:
         self._lock = threading.RLock()
         self._buffers: dict[int, _Buffer] = {}
         self._next_id = 0
+        #: debug-allocator mode (spark.rapids.memory.tpu.debug — the
+        #: reference's RMM debug allocator + cudf refcount.debug analogue):
+        #: registration sites recorded, leaks reported at query end
+        self.debug = False
         self.device_limit = device_limit  # None = unlimited (tests / CPU)
         self.host_limit = host_limit
         self._spill_dir = spill_dir
@@ -162,11 +168,13 @@ class BufferCatalog:
 
     @classmethod
     def from_conf(cls, conf) -> "BufferCatalog":
-        return cls(
+        cat = cls(
             device_limit=None,
             host_limit=cfg.HOST_SPILL_STORAGE_SIZE.get(conf),
             spill_dir=cfg.SPILL_DIR.get(conf),
         )
+        cat.debug = cfg.MEMORY_DEBUG.get(conf)
+        return cat
 
     def _dir(self) -> str:
         if self._spill_dir is None:
@@ -189,10 +197,37 @@ class BufferCatalog:
             self._next_id += 1
             buf.device = batch
             buf.dev = dev
+            if self.debug:
+                import traceback
+
+                frames = [
+                    f"{os.path.basename(f.filename)}:{f.lineno}({f.name})"
+                    for f in traceback.extract_stack(limit=9)[:-1]
+                ]
+                buf.origin = " <- ".join(reversed(frames))
+                logging.getLogger(__name__).debug(
+                    "register buffer %d (%d B) at %s", buf.id, size, buf.origin
+                )
             self._buffers[buf.id] = buf
             self.device_bytes += size
             self._dev_add(dev, size)
         return SpillableBatch(self, buf.id, batch.schema, size)
+
+    def leak_report(self) -> list:
+        """Buffers still registered — at query end every operator should
+        have closed its spillables; survivors are leaks (the debug-mode
+        analogue of cudf's MemoryCleaner leak log)."""
+        with self._lock:
+            return [
+                {
+                    "id": b.id,
+                    "size": b.size,
+                    "tier": StorageTier.NAMES.get(b.tier, b.tier),
+                    "pinned": b.pinned,
+                    "origin": b.origin,
+                }
+                for b in self._buffers.values()
+            ]
 
     # ── acquire / remove ────────────────────────────────────────────────
     def _acquire_device(self, buf_id: int) -> DeviceBatch:
@@ -240,6 +275,11 @@ class BufferCatalog:
 
     # ── spilling ────────────────────────────────────────────────────────
     def _device_to_host(self, buf: _Buffer):
+        if self.debug:
+            logging.getLogger(__name__).debug(
+                "spill buffer %d DEVICE->HOST (%d B, origin %s)",
+                buf.id, buf.size, buf.origin,
+            )
         leaves, aux = jax.tree_util.tree_flatten(buf.device)
         host_leaves = jax.device_get(leaves)
         buf.host = host_leaves
@@ -253,6 +293,11 @@ class BufferCatalog:
         self.spill_count += 1
 
     def _host_to_disk(self, buf: _Buffer):
+        if self.debug:
+            logging.getLogger(__name__).debug(
+                "spill buffer %d HOST->DISK (%d B, origin %s)",
+                buf.id, buf.size, buf.origin,
+            )
         from .. import native
 
         if native.available():

@@ -349,8 +349,21 @@ class TpuSession:
             instrument_plan(final_plan)
         from .profiling import query_trace
 
-        with query_trace(cfg.PROFILE_PATH.get(self.conf)):
-            return self._run_plan(final_plan, ctx)
+        try:
+            with query_trace(cfg.PROFILE_PATH.get(self.conf)):
+                return self._run_plan(final_plan, ctx)
+        finally:
+            if ctx.catalog.debug:
+                leaks = ctx.catalog.leak_report()
+                if leaks:
+                    import logging
+
+                    logging.getLogger(__name__).warning(
+                        "spillable-buffer LEAKS at query end (%d, %d bytes): %s",
+                        len(leaks),
+                        sum(l["size"] for l in leaks),
+                        leaks[:10],
+                    )
 
     def _run_task(self, thunk, attempts: int) -> List[pa.RecordBatch]:
         """One partition task with Spark's retry model (spark.task.maxFailures;

@@ -1,0 +1,68 @@
+"""Debug-allocator / leak-detection mode — the analogue of the reference's
+RMM debug allocator (spark.rapids.memory.gpu.debug, RapidsConf.scala:307)
+and cudf's refcount leak log (ai.rapids.refcount.debug).
+"""
+from __future__ import annotations
+
+import logging
+
+import numpy as np
+import pyarrow as pa
+
+from spark_rapids_tpu import TpuSession
+from spark_rapids_tpu import functions as F
+from spark_rapids_tpu.functions import col
+
+DEBUG_CONF = {
+    "spark.rapids.sql.enabled": True,
+    "spark.rapids.memory.tpu.debug": True,
+}
+
+
+def _batch(n=64):
+    from spark_rapids_tpu.columnar.device import host_to_device
+
+    rb = pa.record_batch({"a": pa.array(np.arange(n, dtype=np.int64))})
+    return host_to_device(rb)
+
+
+def test_leak_report_tracks_origin_and_close():
+    from spark_rapids_tpu.mem.spill import BufferCatalog, SpillPriorities
+
+    cat = BufferCatalog()
+    cat.debug = True
+    h1 = cat.register(_batch(), SpillPriorities.WORKING)
+    h2 = cat.register(_batch(), SpillPriorities.WORKING)
+    leaks = cat.leak_report()
+    assert len(leaks) == 2
+    assert all(l["origin"] for l in leaks), "debug mode must record origins"
+    assert "test_memory_debug" in leaks[0]["origin"]
+    h1.close()
+    assert len(cat.leak_report()) == 1
+    h2.close()
+    assert cat.leak_report() == []
+
+
+def test_origin_not_recorded_outside_debug():
+    from spark_rapids_tpu.mem.spill import BufferCatalog
+
+    cat = BufferCatalog()
+    h = cat.register(_batch())
+    assert cat.leak_report()[0]["origin"] is None
+    h.close()
+
+
+def test_clean_query_reports_no_leaks(caplog):
+    """An out-of-core sort registers and closes many spillable runs; debug
+    mode must end the query with an empty leak report."""
+    rng = np.random.default_rng(3)
+    t = pa.table({"k": rng.integers(0, 100, 2000).astype(np.int64)})
+    s = TpuSession({
+        **DEBUG_CONF,
+        "spark.rapids.tpu.sort.outOfCoreThresholdBytes": "1",
+        "spark.rapids.sql.batchSizeRows": "128",
+    })
+    with caplog.at_level(logging.WARNING, logger="spark_rapids_tpu.session"):
+        rows = s.create_dataframe(t, num_partitions=2).sort("k").collect()
+    assert len(rows) == 2000
+    assert not [r for r in caplog.records if "LEAK" in r.getMessage()]
