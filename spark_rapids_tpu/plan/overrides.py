@@ -1063,19 +1063,39 @@ class TpuOverrides:
         return converted
 
     # transition insertion (GpuTransitionOverrides)
-    def _insert_transitions(self, plan: Exec, want_device: bool) -> Exec:
+    #
+    # (helper lives at module level: _node_has_input_file_expr)
+    def _insert_transitions(
+        self, plan: Exec, want_device: bool, under_input_file: bool = False
+    ) -> Exec:
+        # input_file_name()/_block_*() read per-batch task state, so the
+        # scan→expression path must keep per-file batches: the coalesce
+        # disable propagates DOWN from the expression-bearing node and
+        # resets at exchanges (batches above a shuffle are mixed-file
+        # already — Spark reports "" there). Scoped like the reference's
+        # GpuTransitionOverrides input-file handling (:84-170), not
+        # plan-wide: transitions on other branches keep coalescing.
+        local = _node_has_input_file_expr(plan)
+        is_exchange = isinstance(
+            plan, (T.TpuShuffleExchangeExec, C.CpuShuffleExchangeExec)
+        )
+        child_flag = False if is_exchange else (under_input_file or local)
         new_children = [
-            self._insert_transitions(c, want_device=plan.is_device)
+            self._insert_transitions(
+                c, want_device=plan.is_device, under_input_file=child_flag
+            )
             for c in plan.children
         ]
         plan = plan.with_new_children(new_children)
         if plan.is_device and not want_device:
             return T.DeviceToHostExec(plan)
         if not plan.is_device and want_device:
+            h2d = T.HostToDeviceExec(plan)
+            if under_input_file or local:
+                return h2d
             # post-transition coalesce (GpuTransitionOverrides:84-91 +
             # GpuCoalesceBatches): a many-small-file scan otherwise pushes
             # one tiny batch per file through every downstream kernel
-            h2d = T.HostToDeviceExec(plan)
             return T.TpuCoalesceBatchesExec(
                 h2d, T.CoalesceGoal(cfg.BATCH_SIZE_BYTES.get(self.conf))
             )
@@ -1097,3 +1117,34 @@ class TpuOverrides:
 
     def fallback_execs(self) -> List[str]:
         return [e.node for e in self.explain if not e.on_device]
+
+
+def _node_has_input_file_expr(node: Exec) -> bool:
+    """Whether THIS node's own expressions read the input-file task state
+    (input_file_name / input_file_block_start / input_file_block_length) —
+    the GpuTransitionOverrides condition that disables batch coalescing so
+    file boundaries survive to the expression."""
+    targets = (msc.InputFileName, msc.InputFileBlockStart, msc.InputFileBlockLength)
+
+    def expr_has(e) -> bool:
+        if isinstance(e, targets):
+            return True
+        try:
+            kids = e.children()
+        except Exception:
+            return False
+        return any(expr_has(c) for c in kids)
+
+    def scan_value(v) -> bool:
+        if isinstance(v, Expression):
+            return expr_has(v)
+        if isinstance(v, (list, tuple)):
+            return any(scan_value(x) for x in v)
+        return False
+
+    for k, v in vars(node).items():
+        if k == "_children":
+            continue
+        if scan_value(v):
+            return True
+    return False

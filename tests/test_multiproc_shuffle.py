@@ -102,11 +102,18 @@ _CHILD = textwrap.dedent(
             .agg(F.count("*").alias("c"), F.sum(col("mv")).alias("sm"))
         ).collect()
     print("ROWS" + json.dumps([list(r) for r in out]), flush=True)
+    # stay alive until the parent says every executor finished: a peer may
+    # still be fetching this executor's map output over TCP (a real
+    # executor outlives its own last task the same way)
+    sys.stdin.read()
     """
 )
 
 
 def _run_multiproc(which: str, tmp_path):
+    """Returns (per_rank_rows, logs). Children hold their shuffle servers
+    open until BOTH have produced results (parent closes stdin to release
+    them) — exiting early would break a slower peer's fetch mid-stream."""
     from spark_rapids_tpu.shuffle.driver_service import DriverService
 
     svc = DriverService()
@@ -118,6 +125,7 @@ def _run_multiproc(which: str, tmp_path):
     procs = [
         subprocess.Popen(
             [sys.executable, str(script), addr, str(rank), which],
+            stdin=subprocess.PIPE,
             stdout=subprocess.PIPE,
             stderr=subprocess.PIPE,
             text=True,
@@ -125,27 +133,66 @@ def _run_multiproc(which: str, tmp_path):
         )
         for rank in (0, 1)
     ]
-    rows = []
-    logs = []
+    import threading
+    import time as _time
+
+    per_rank = [None, None]
+    err_buf = [[], []]
+
+    def reader(i, p):
+        for ln in p.stdout:
+            if ln.startswith("ROWS"):
+                per_rank[i] = json.loads(ln[4:])
+                return
+
+    def drain_err(i, p):
+        for ln in p.stderr:
+            err_buf[i].append(ln)
+            if len(err_buf[i]) > 400:
+                del err_buf[i][:200]
+
+    threads = [
+        threading.Thread(target=reader, args=(i, p), daemon=True)
+        for i, p in enumerate(procs)
+    ] + [
+        threading.Thread(target=drain_err, args=(i, p), daemon=True)
+        for i, p in enumerate(procs)
+    ]
     try:
+        for t in threads:
+            t.start()
+        deadline = _time.monotonic() + 1200
+        for t in threads[:2]:
+            t.join(timeout=max(1, deadline - _time.monotonic()))
+        for i, p in enumerate(procs):
+            if per_rank[i] is None:
+                raise AssertionError(
+                    f"rank {i} produced no ROWS (rc={p.poll()}):\n"
+                    f"{''.join(err_buf[i])[-4000:]}"
+                )
+        # both done: release the children, then collect exit statuses
         for p in procs:
-            out, err = p.communicate(timeout=900)
-            logs.append(err[-2000:])
-            assert p.returncode == 0, f"executor failed:\n{err[-4000:]}"
-            marker = [ln for ln in out.splitlines() if ln.startswith("ROWS")]
-            assert marker, f"no ROWS line in executor output:\n{out[-2000:]}"
-            rows.extend(json.loads(marker[0][4:]))
+            try:
+                p.stdin.close()
+            except OSError:
+                pass
+        for i, p in enumerate(procs):
+            p.wait(timeout=60)
+            assert p.returncode == 0, (
+                f"rank {i} failed:\n{''.join(err_buf[i])[-4000:]}"
+            )
     finally:
         for p in procs:
             if p.poll() is None:
                 p.kill()
         svc.close()
-    return rows, logs
+    return per_rank, ["".join(b) for b in err_buf]
 
 
 @pytest.mark.parametrize("which", ["agg", "join", "bcast"])
 def test_multiproc_query_over_tcp(which, tmp_path):
-    merged, _logs = _run_multiproc(which, tmp_path)
+    per_rank, _logs = _run_multiproc(which, tmp_path)
+    merged = per_rank[0] + per_rank[1]
 
     t = _table()
     cpu = cpu_session()
@@ -189,33 +236,7 @@ def test_multiproc_query_over_tcp(which, tmp_path):
 def test_multiproc_results_are_split_across_executors(tmp_path):
     """Both executors must contribute rows (the reduce ownership split is
     real, not one process doing all the work)."""
-    from spark_rapids_tpu.shuffle.driver_service import DriverService
-
-    svc = DriverService()
-    addr = f"{svc.address[0]}:{svc.address[1]}"
-    script = tmp_path / "executor_child.py"
-    script.write_text(_CHILD.format(seed=SEED, n_rows=N_ROWS))
-    env = dict(os.environ)
-    env["PYTHONPATH"] = os.path.dirname(os.path.dirname(__file__))
-    procs = [
-        subprocess.Popen(
-            [sys.executable, str(script), addr, str(rank), "agg"],
-            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True, env=env,
-        )
-        for rank in (0, 1)
-    ]
-    per_rank = []
-    try:
-        for p in procs:
-            out, err = p.communicate(timeout=900)
-            assert p.returncode == 0, err[-3000:]
-            marker = [ln for ln in out.splitlines() if ln.startswith("ROWS")]
-            per_rank.append(json.loads(marker[0][4:]))
-    finally:
-        for p in procs:
-            if p.poll() is None:
-                p.kill()
-        svc.close()
+    per_rank, _logs = _run_multiproc("agg", tmp_path)
     assert len(per_rank[0]) > 0 and len(per_rank[1]) > 0
     keys0 = {tuple(r[:2]) for r in per_rank[0]}
     keys1 = {tuple(r[:2]) for r in per_rank[1]}

@@ -95,7 +95,7 @@ def test_tpch_nonempty_results(tables):
     """Guard the generator's selectivity: every query must return rows at
     tiny SF (an empty result would make the differential test vacuous)."""
     cpu = cpu_session()
-    empty_ok = {20, 21}  # noqa: E501  # tight multi-way EXISTS chains can be empty at SF<0.01
+    empty_ok = {20, 21}  # tight multi-way EXISTS chains at SF<0.01
     for n in sorted(QUERIES):
         rows = tpch_query(n, _accessor(cpu, tables), sf=Q11_SF).collect()
         if n not in empty_ok:
