@@ -190,6 +190,7 @@ def test_spill_disk_contiguous_frame(tmp_path):
     from spark_rapids_tpu.mem import spill as S
 
     cat = S.BufferCatalog.__new__(S.BufferCatalog)
+    cat.debug = False
     cat.spill_dir = str(tmp_path)
     cat._dir = lambda: str(tmp_path)
     cat.host_bytes = 100
