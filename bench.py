@@ -128,9 +128,22 @@ def rows_equal(rows_t, rows_c) -> str:
         return f"row count {len(rows_t)} vs {len(rows_c)}"
 
     def key(row):
-        return tuple(
-            (v is None, type(v).__name__, repr(v)) for v in row
-        )
+        # quantize floats in the sort key: a tiny engine-to-engine float
+        # divergence must not reorder the two row lists and pair unrelated
+        # rows (the approx comparison below then flags spurious mismatches)
+        def k(v):
+            if isinstance(v, float):
+                # (isnan, value) keeps the key comparable when a column
+                # mixes NaN and finite floats
+                if math.isnan(v):
+                    return (False, "float", (True, 0.0))
+                # ~5 significant digits: RELATIVE quantization to match the
+                # relative mismatch tolerance below — absolute rounding
+                # would still reorder large-magnitude aggregates
+                return (False, "float", (False, float(f"{v:.5g}")))
+            return (v is None, type(v).__name__, repr(v))
+
+        return tuple(k(v) for v in row)
 
     for rt, rc in zip(sorted(rows_t, key=key), sorted(rows_c, key=key)):
         for vt, vc in zip(rt, rc):

@@ -406,7 +406,7 @@ class CpuShuffleExchangeExec(Exec):
                     batches.append(rb)
                     group_lists.append(self._np_word_groups(rb, schema))
             # align per-batch string word counts (see align_word_groups)
-            all_words = align_word_groups(group_lists, part.order, np)
+            all_words, _targets = align_word_groups(group_lists, part.order, np)
             samples = []
             for rb, words in zip(batches, all_words):
                 idx = np.arange(0, rb.num_rows, max(1, rb.num_rows // SAMPLE_PER_BATCH))

@@ -1978,7 +1978,10 @@ class TpuShuffleExchangeExec(Exec):
         from ..plan.partitioning import align_word_groups
 
         group_lists = [words_jit(b) for b in per_chip]
-        return align_word_groups(group_lists, self.partitioning.order, jnp)
+        aligned, _targets = align_word_groups(
+            group_lists, self.partitioning.order, jnp
+        )
+        return aligned
 
     def execute(self, ctx: ExecContext) -> PartitionSet:
         # exchange reuse (plan/reuse.py): a node shared by several consumers
@@ -2062,7 +2065,11 @@ class TpuShuffleExchangeExec(Exec):
                 return state["buckets"]
             buckets = [[] for _ in range(nparts)]
             if kind == "range":
-                from ..plan.partitioning import align_word_groups
+                from ..plan.partitioning import (
+                    align_word_groups,
+                    merge_sampled_word_groups,
+                    pad_flat_words,
+                )
 
                 words_jit, range_slice = fn
                 order = self.partitioning.order
@@ -2073,7 +2080,9 @@ class TpuShuffleExchangeExec(Exec):
                         group_lists.append(with_oom_retry(catalog, words_jit, db))
                 # string columns may encode to different word counts per
                 # batch (bucketed widths) — align before sampling/bucketing
-                all_words = align_word_groups(group_lists, order, jnp)
+                all_words, local_targets = align_word_groups(
+                    group_lists, order, jnp
+                )
                 del group_lists
                 # Sample on device, then fetch everything in ONE transfer —
                 # per-batch np.asarray syncs are lethal over slow PJRT links.
@@ -2089,7 +2098,7 @@ class TpuShuffleExchangeExec(Exec):
                     dev_valid.append(
                         jnp.broadcast_to(db.num_rows > 0, (SAMPLE_PER_BATCH,))
                     )
-                bounds = None
+                sample_words = None
                 if batches:
                     host_samples, host_valid = jax.device_get((dev_samples, dev_valid))
                     sample_words = [
@@ -2098,8 +2107,41 @@ class TpuShuffleExchangeExec(Exec):
                         )
                         for i in range(len(all_words[0]))
                     ]
-                    if sample_words[0].size:
-                        bounds = compute_range_bounds(sample_words, nparts)
+                bounds = None
+                if multiproc:
+                    # Every rank sees only its own child partitions, so
+                    # per-rank bounds would send the same key range to
+                    # different reduce partitions on different ranks —
+                    # globally wrong ORDER BY results. Gather all ranks'
+                    # samples through the driver service and replay one
+                    # deterministic merge so every rank buckets with
+                    # identical bounds (the bounds-on-the-Spark-driver
+                    # analogue, GpuRangePartitioner.createRangeBounds).
+                    payload = {
+                        "targets": local_targets,
+                        "words": [w.tolist() for w in (sample_words or [])],
+                    }
+                    contribs = ctx.shuffle_manager.registry.range_bounds_sync(
+                        key=f"{base_sid}:range",
+                        rank=mp_rank,
+                        size=mp_size,
+                        payload=payload,
+                    )
+                    merged, gtargets = merge_sampled_word_groups(contribs, order)
+                    if merged is not None:
+                        bounds = compute_range_bounds(merged, nparts)
+                        if gtargets != local_targets and batches:
+                            # peers saw wider string keys: re-pad this
+                            # rank's words to the agreed global widths so
+                            # rows and bounds compare word-for-word
+                            all_words = [
+                                pad_flat_words(
+                                    w, local_targets, gtargets, order, jnp
+                                )
+                                for w in all_words
+                            ]
+                elif sample_words is not None and sample_words[0].size:
+                    bounds = compute_range_bounds(sample_words, nparts)
                 jb = None if bounds is None else [jnp.asarray(b) for b in bounds]
                 for db, words in zip(batches, all_words):
                     if jb is None:

@@ -190,7 +190,10 @@ def _tx_block(stmts, env: dict, fn):
             for name in set(t_out) | set(e_out):
                 tv = t_out.get(name)
                 ev = e_out.get(name)
-                if tv is None or ev is None:
+                if tv is None or ev is None or tv is _POISON or ev is _POISON:
+                    # a nested if can leave _POISON on one side; embedding
+                    # the sentinel in If(cond, _POISON, expr) would crash at
+                    # plan time instead of falling back to the python UDF
                     merged[name] = _POISON
                     continue
                 merged[name] = tv if tv is ev else If(cond, tv, ev)

@@ -71,27 +71,76 @@ def align_word_groups(per_batch_groups, orders, xp):
     with that constant *before* the trailing length word.
 
     ``per_batch_groups``: list over batches of per-order-column word lists.
-    Returns a list over batches of flat, aligned word lists.
+    Returns ``(aligned, targets)``: a list over batches of flat, aligned
+    word lists, plus the per-column word counts everything was padded to
+    (cross-rank gathers re-pad against these — keep the two in lockstep).
     """
-    if not per_batch_groups:
-        return []
     ncols = len(orders)
+    if not per_batch_groups:
+        return [], [0] * ncols
     targets = [
         max(len(g[ci]) for g in per_batch_groups) for ci in range(ncols)
     ]
     out = []
     for groups in per_batch_groups:
-        flat = []
-        for ci, o in enumerate(orders):
-            g = list(groups[ci])
-            missing = targets[ci] - len(g)
-            if missing:
-                zero = xp.zeros_like(g[0])
-                pad = zero if o.ascending else ~zero
-                g = g[:-1] + [pad] * missing + [g[-1]]
-            flat.extend(g)
-        out.append(flat)
+        flat = [w for ci in range(ncols) for w in groups[ci]]
+        locals_ = [len(groups[ci]) for ci in range(ncols)]
+        out.append(pad_flat_words(flat, locals_, targets, orders, xp))
+    return out, targets
+
+
+def pad_flat_words(flat_words, local_targets, global_targets, orders, xp):
+    """Re-pad a flat aligned word list from per-column ``local_targets`` word
+    counts up to ``global_targets`` (the cross-rank maxima). Same padding rule
+    as :func:`align_word_groups`: a narrower string column's missing char
+    words are inserted *before* its trailing length word, as zeros (all-ones
+    under descending order, where value words are complemented)."""
+    pos, out = 0, []
+    for ci, o in enumerate(orders):
+        g = list(flat_words[pos : pos + local_targets[ci]])
+        pos += local_targets[ci]
+        missing = global_targets[ci] - local_targets[ci]
+        if missing:
+            zero = xp.zeros_like(g[0])
+            pad = zero if o.ascending else ~zero
+            g = g[:-1] + [pad] * missing + [g[-1]]
+        out.extend(g)
     return out
+
+
+def merge_sampled_word_groups(contribs, orders):
+    """Merge per-rank sampled radix-word contributions into one flat sample.
+
+    Multi-process range exchanges must agree on ONE set of range bounds —
+    per-rank bounds would route the same key range to different reduce
+    partitions on different ranks (globally wrong sort). Each rank samples
+    its own rows, publishes ``{"targets": [words-per-column], "words":
+    [[int,...] per flat word]}`` through the driver service, and every rank
+    runs this same deterministic merge over the gathered contributions
+    (GpuRangePartitioner computes bounds once on the Spark driver; here the
+    merge is replicated instead, driver service only gathers).
+
+    Returns ``(sample_words, global_targets)`` — flat uint64 arrays ready
+    for :func:`compute_range_bounds` — or ``(None, None)`` when no rank
+    contributed rows.
+    """
+    # a rank with no input batches posts targets=[0,...], words=[] — it
+    # contributes nothing and must not reach pad_flat_words (g[0] on [])
+    live = [c for c in contribs if c and c.get("targets") and c.get("words")]
+    if not live:
+        return None, None
+    ncols = len(orders)
+    gtargets = [max(c["targets"][ci] for c in live) for ci in range(ncols)]
+    merged: List[List[np.ndarray]] = [[] for _ in range(sum(gtargets))]
+    for c in live:
+        flat = [np.asarray(w, dtype=np.uint64) for w in c["words"]]
+        padded = pad_flat_words(flat, c["targets"], gtargets, orders, np)
+        for i, w in enumerate(padded):
+            merged[i].append(w)
+    sample_words = [np.concatenate(ws) for ws in merged]
+    if sample_words[0].size == 0:
+        return None, None
+    return sample_words, gtargets
 
 
 def compute_range_bounds(

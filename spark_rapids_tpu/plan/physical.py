@@ -103,8 +103,13 @@ class ExecContext:
         self._shuffle_ids = itertools.count(seq * 1_000_000 + 1)
         # depth counter: >0 while building a broadcast batch — exchanges
         # below a broadcast must run WHOLE in every process (no rank split,
-        # no shared-registry map statuses)
-        self.broadcast_depth = 0
+        # no shared-registry map statuses). Thread-LOCAL: broadcast builds
+        # fire lazily from partition thunks on pool threads, and the nested
+        # execute() always runs synchronously on the building thread; a
+        # shared counter would let two concurrent builds race the += and a
+        # sibling exchange observe depth 0 mid-build (rank-splitting a
+        # broadcast build subtree → partial build table).
+        self._broadcast_tls = threading.local()
         # AQE: per-exchange measured-size providers, so the two exchanges
         # feeding a co-partitioned join can compute ONE shared coalesce
         # assignment (Spark applies identical CoalescedPartitionSpecs to
@@ -118,6 +123,14 @@ class ExecContext:
         self.mesh = None
         if cfg.MESH_ENABLED.get(conf) and session is not None:
             self.mesh = session.mesh_context()
+
+    @property
+    def broadcast_depth(self) -> int:
+        return getattr(self._broadcast_tls, "depth", 0)
+
+    @broadcast_depth.setter
+    def broadcast_depth(self, value: int) -> None:
+        self._broadcast_tls.depth = value
 
     @property
     def shuffle_manager(self):

@@ -54,6 +54,13 @@ class DriverService:
     def __init__(self, host: str = "127.0.0.1", port: int = 0):
         self.heartbeats = ShuffleHeartbeatManager()
         self.registry = MapOutputRegistry()
+        # range-bounds sample gather: key -> {rank: payload}. The driver
+        # only GATHERS; every rank replays the same deterministic merge
+        # (plan/partitioning.merge_sampled_word_groups) so all ranks bucket
+        # with identical bounds (the Spark-driver-computed bounds analogue,
+        # GpuRangePartitioner.createRangeBounds).
+        self._range_samples: Dict[str, dict] = {}
+        self._range_lock = threading.Lock()
         self._srv = socket.create_server((host, port))
         self.address: Tuple[str, int] = self._srv.getsockname()[:2]
         self._stop = threading.Event()
@@ -115,7 +122,58 @@ class DriverService:
             }
         if op == "remove_shuffle":
             self.registry.remove_shuffle(req["shuffle_id"])
+            with self._range_lock:
+                prefix = f"{req['shuffle_id']}:"
+                for k in [k for k in self._range_samples if k.startswith(prefix)]:
+                    del self._range_samples[k]
             return {"ok": True}
+        if op in ("range_samples", "range_poll"):
+            # range_samples: idempotent per-rank post (retries overwrite);
+            # range_poll: payload-free wait so slow-peer polling does not
+            # re-ship the full sample every 50ms. Replies with the full
+            # gather once all ``size`` ranks have contributed.
+            size = int(req["size"])
+            with self._range_lock:
+                slot = self._range_samples.setdefault(
+                    req["key"], {"size": size, "ranks": {}}
+                )
+                ranks = slot["ranks"]
+                if op == "range_samples":
+                    rank = int(req["rank"])
+                    if (
+                        len(ranks) >= slot["size"]
+                        and ranks.get(rank) != req["payload"]
+                    ):
+                        # a COMPLETE slot being re-posted with DIFFERENT
+                        # data is a key collision from a new job on a
+                        # long-lived driver (per-session query seqs
+                        # restart) — serving the stale gather would give
+                        # ranks divergent bounds. Start a fresh gather.
+                        # Identical re-posts (generation retries, which
+                        # re-sample deterministically) keep the slot.
+                        ranks = {}
+                        slot = {"size": size, "ranks": ranks}
+                        self._range_samples[req["key"]] = slot
+                    ranks[rank] = req["payload"]
+                # bounded: one entry per range exchange; the release path
+                # never fires in multiproc (map output is executor-lifetime),
+                # so cap instead of leak on long-lived drivers. Only evict
+                # COMPLETE gathers — dropping an in-flight slot would strand
+                # its ranks (range_poll never re-posts the payload).
+                if len(self._range_samples) > 1024:
+                    done = [
+                        k
+                        for k, s in self._range_samples.items()
+                        if k != req["key"] and len(s["ranks"]) >= s["size"]
+                    ]
+                    for k in done[: len(self._range_samples) - 1024]:
+                        del self._range_samples[k]
+                if len(ranks) >= slot["size"]:
+                    return {
+                        "ready": True,
+                        "contribs": [ranks[r] for r in sorted(ranks)],
+                    }
+            return {"ready": False}
         raise ValueError(f"unknown op {op!r}")
 
     def close(self):
@@ -193,6 +251,28 @@ class RemoteMapOutputRegistry:
 
     def remove_shuffle(self, shuffle_id: int):
         self._client.call(op="remove_shuffle", shuffle_id=shuffle_id)
+
+    def range_bounds_sync(
+        self, key: str, rank: int, size: int, payload, timeout_s: float = 120.0
+    ):
+        """Post this rank's range-bounds sample and block until every rank's
+        contribution is gathered. Returns the contributions in rank order."""
+        import time
+
+        deadline = time.monotonic() + timeout_s
+        out = self._client.call(
+            op="range_samples", key=key, rank=rank, size=size, payload=payload
+        )
+        while not out.get("ready"):
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"range-bounds gather {key!r}: peers did not contribute "
+                    f"within {timeout_s}s"
+                )
+            time.sleep(0.05)
+            # payload-free poll: the sample was already posted above
+            out = self._client.call(op="range_poll", key=key, size=size)
+        return out["contribs"]
 
 
 def connect(address: Tuple[str, int]):

@@ -56,6 +56,12 @@ class MapOutputRegistry:
             for k in [k for k in self._statuses if k[0] == shuffle_id]:
                 del self._statuses[k]
 
+    def range_bounds_sync(
+        self, key: str, rank: int, size: int, payload, timeout_s: float = 120.0
+    ):
+        # in-process: one executor, its sample IS the gather
+        return [payload]
+
 
 class ShuffleEnv:
     """Per-executor shuffle environment (GpuShuffleEnv analogue)."""

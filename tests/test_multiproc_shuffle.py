@@ -40,6 +40,7 @@ def _table():
             "k": rng.integers(0, 100, N_ROWS).astype(np.int64),
             "v": rng.integers(-50, 50, N_ROWS).astype(np.int64),
             "s": pa.array([f"g{i % 13}" for i in range(N_ROWS)]),
+            "id": np.arange(N_ROWS, dtype=np.int64),
         }
     )
 
@@ -60,6 +61,7 @@ _CHILD = textwrap.dedent(
         "k": rng.integers(0, 100, n).astype(np.int64),
         "v": rng.integers(-50, 50, n).astype(np.int64),
         "s": pa.array([f"g{{i % 13}}" for i in range(n)]),
+        "id": np.arange(n, dtype=np.int64),
     }})
     s = TpuSession({{
         "spark.rapids.sql.enabled": True,
@@ -88,6 +90,14 @@ _CHILD = textwrap.dedent(
             .with_column_renamed("k", "k2")
         )
         out = a.join(b, on=[("k", "k2")], how="left").collect()
+    elif which == "sort":
+        # ORDER BY = range exchange + per-partition sort. Every rank must
+        # bucket with the SAME range bounds (gathered through the driver
+        # service): per-rank bounds would route one key range to different
+        # reduce partitions per mapping rank — a globally unsorted result.
+        # (id makes the sort key total, so the parent can verify each
+        # rank's output is contiguous slices of THE global order.)
+        out = df.order_by(col("v").desc(), "id").collect()
     else:  # bcast: broadcast whose BUILD side contains an exchange — it
         # must run whole per executor (a rank-split build would broadcast
         # a partial table); the top-level aggregate still rank-splits
@@ -231,6 +241,41 @@ def test_multiproc_query_over_tcp(which, tmp_path):
         f"{which}: first diffs: "
         f"{[p for p in zip(got, want) if p[0] != p[1]][:5]}"
     )
+
+
+def test_multiproc_global_sort_shared_bounds(tmp_path):
+    """ORDER BY across processes: the range exchange must gather ONE set of
+    bounds via the driver service. With shared bounds, reduce partition p is
+    exactly the p-th contiguous slice of the global order, so each rank's
+    flat output (its owned pids, ascending) must decompose into contiguous
+    slices of the single-process sorted result — per-rank bounds would mix
+    key ranges inside a partition and break the decomposition."""
+    per_rank, _logs = _run_multiproc("sort", tmp_path)
+
+    t = _table()
+    cpu = cpu_session()
+    g = [
+        tuple(r)
+        for r in cpu.create_dataframe(t, num_partitions=4)
+        .order_by(col("v").desc(), "id")
+        .collect()
+    ]
+    flat = [[tuple(r) for r in rows] for rows in per_rank]
+    assert sorted(flat[0] + flat[1]) == sorted(g)
+
+    def lcp(xs, ref):
+        n = 0
+        while n < len(xs) and n < len(ref) and xs[n] == ref[n]:
+            n += 1
+        return n
+
+    # reconstruct the 4 partition slices: rank0 owns pids {0,2}, rank1 {1,3}
+    c1 = lcp(flat[0], g)
+    c2 = lcp(flat[1], g[c1:])
+    tail0, tail1 = flat[0][c1:], flat[1][c2:]
+    p2_end = c1 + c2 + len(tail0)
+    assert tail0 == g[c1 + c2 : p2_end], "rank0's 2nd slice not contiguous"
+    assert tail1 == g[p2_end:], "rank1's 2nd slice not contiguous"
 
 
 def test_multiproc_results_are_split_across_executors(tmp_path):

@@ -502,3 +502,23 @@ def test_tx_one_branch_variable_poisoned():
     from spark_rapids_tpu.expr.base import UnresolvedAttribute
 
     assert try_translate(f_impl, [UnresolvedAttribute("x")], LONG) is None
+
+
+def test_tx_nested_if_poison_propagates_through_outer_phi():
+    """A name poisoned by an INNER if (one-branch definition) must stay
+    poisoned through the outer φ-merge — embedding the sentinel in
+    If(cond, _POISON, expr) would crash at plan time instead of falling
+    back to the plain python UDF."""
+
+    def f_impl(v):
+        if v > 0:
+            if v > 10:
+                y = v * 2
+        else:
+            y = 0
+        return y  # noqa: F821 - poisoned on the (v>0, v<=10) path
+
+    from spark_rapids_tpu.expr.base import UnresolvedAttribute
+    from spark_rapids_tpu.expr.udf_compiler import try_translate
+
+    assert try_translate(f_impl, [UnresolvedAttribute("x")], LONG) is None
