@@ -38,6 +38,7 @@ SHUFFLE_PARTITIONS = int(os.environ.get("BENCH_SHUFFLE_PARTITIONS", "8"))
 N_WARM = 1
 N_RUN = int(os.environ.get("BENCH_RUNS", "2"))
 BASELINE_TYPICAL = 4.0  # reference docs/FAQ.md:87-88 "4x typical"
+V5E_HBM_GBPS = 819.0  # TPU v5e HBM bandwidth roofline (public spec)
 
 # Scan benchmark subset (from-disk Parquet; host pyarrow decode feeds H2D —
 # SURVEY §7 v1 I/O architecture)
@@ -122,6 +123,65 @@ def time_query(build, n_warm: int = N_WARM, n_run: int = N_RUN) -> float:
     return best
 
 
+def time_query_split(build, n_run: int = N_RUN):
+    """(first_s, best_s): the first collect pays XLA compilation, later runs
+    hit the compile cache — first-best ≈ the compile cost (the
+    tunnel-independent split VERDICT r4 asks for)."""
+    t0 = time.perf_counter()
+    _collect_retry(build)
+    first = time.perf_counter() - t0
+    best = float("inf")
+    for _ in range(max(1, n_run)):
+        t0 = time.perf_counter()
+        _collect_retry(build)
+        best = min(best, time.perf_counter() - t0)
+    return first, best
+
+
+def plan_diagnostics(session, wall_s: float) -> dict:
+    """Per-query diagnostics from the device session's LAST executed plan:
+    device-input rows/s, effective H2D GB/s against the v5e HBM roofline,
+    per-op device-time attribution, transfer byte counts, and the host
+    overhead fraction. All of it works on the CPU backend too — a dead
+    tunnel round still yields regression-findable numbers (VERDICT r4
+    weak-spot #2; metric taxonomy per the reference's GpuExec metric set)."""
+    plan = getattr(session, "_last_plan", None)
+    if plan is None:
+        return {}
+    from spark_rapids_tpu.profiling import device_host_breakdown, walk
+
+    bd = device_host_breakdown(plan)
+    input_rows = 0
+    for node in walk(plan):
+        if type(node).__name__ == "HostToDeviceExec":
+            m = node.metrics.get("numInputRows")
+            if m is not None:
+                input_rows += m.value
+    device_ms = bd["op_time_ms"] + bd["h2d_time_ms"] + bd["d2h_time_ms"]
+    out = {
+        "input_rows": input_rows,
+        "rows_per_s": round(input_rows / wall_s) if wall_s > 0 else 0,
+        "h2d_bytes": bd["h2d_bytes"],
+        "d2h_bytes": bd["d2h_bytes"],
+        "h2d_gbps": round(bd["h2d_bytes"] / wall_s / 1e9, 4) if wall_s else 0,
+        "hbm_roofline_frac": round(
+            bd["h2d_bytes"] / wall_s / 1e9 / V5E_HBM_GBPS, 6
+        )
+        if wall_s
+        else 0,
+        "op_time_ms": round(bd["op_time_ms"], 1),
+        "h2d_ms": round(bd["h2d_time_ms"], 1),
+        "d2h_ms": round(bd["d2h_time_ms"], 1),
+        "host_overhead_frac": round(
+            max(0.0, 1.0 - device_ms / (wall_s * 1000.0)), 3
+        )
+        if wall_s
+        else 0,
+        "top_ops_ms": dict(list(bd["per_node_ms"].items())[:6]),
+    }
+    return out
+
+
 def rows_equal(rows_t, rows_c) -> str:
     """'' if equal else a short mismatch description (sorted, approx float)."""
     if len(rows_t) != len(rows_c):
@@ -167,13 +227,123 @@ def geomean(xs) -> float:
     return math.exp(sum(math.log(x) for x in xs) / len(xs))
 
 
+def _suite_args():
+    suite = os.environ.get("BENCH_SUITE", "tpch")
+    smoke = os.environ.get("BENCH_SMOKE", "") == "1"
+    argv = sys.argv[1:]
+    if "--smoke" in argv:
+        smoke = True
+    if "--suite" in argv:
+        suite = argv[argv.index("--suite") + 1]
+    return suite, smoke
+
+
+def run_query_pair(name, build_t, build_c, tpu, n_run, speedups, detail):
+    """Time one query on both engines, attach per-plan diagnostics, and
+    differentially verify results."""
+    entry: dict = {}
+    try:
+        first, best = time_query_split(build_t, n_run=n_run)
+        ov = getattr(tpu, "_last_overrides", None)
+        entry["fallback_nodes"] = (
+            sum(1 for e in ov.explain if not e.on_device and "Scan" not in e.node)
+            if ov
+            else None
+        )
+        entry["diag"] = plan_diagnostics(tpu, best)
+        t_cpu = time_query(build_c, n_warm=1, n_run=n_run)
+        sp = t_cpu / best if best > 0 else 0.0
+        entry.update(
+            tpu_s=round(best, 3),
+            tpu_first_s=round(first, 3),
+            compile_s=round(max(0.0, first - best), 3),
+            cpu_s=round(t_cpu, 3),
+            speedup=round(sp, 3),
+        )
+        mismatch = rows_equal(_collect_retry(build_t), _collect_retry(build_c))
+        if mismatch:
+            entry["mismatch"] = mismatch
+        else:
+            speedups.append(sp)
+    except Exception as e:  # noqa: BLE001 - keep the rig alive per query
+        entry["error"] = str(e)[-300:]
+    detail[name] = entry
+    log({name: entry})
+
+
+def run_tpch(tpu, cpu, sf, partitions, qids, n_run):
+    from spark_rapids_tpu.tpch import tpch_query
+    from spark_rapids_tpu.tpch.datagen import TABLES, gen_table
+
+    tables = {name: gen_table(name, sf) for name in TABLES}
+    log({"tpch_datagen": {"sf": sf, "lineitem_rows": tables["lineitem"].num_rows}})
+
+    def accessor(session):
+        def t(name):
+            n = partitions if tables[name].num_rows > 100_000 else 1
+            return session.create_dataframe(tables[name], num_partitions=n)
+
+        return t
+
+    detail, speedups = {}, []
+    for n in qids:
+        run_query_pair(
+            f"q{n}",
+            lambda: tpch_query(n, accessor(tpu), sf=sf),
+            lambda: tpch_query(n, accessor(cpu), sf=sf),
+            tpu,
+            n_run,
+            speedups,
+            detail,
+        )
+    return speedups, detail, tables
+
+
+def run_tpcds(tpu, cpu, sf, partitions, qids, n_run):
+    """TPC-DS from SQL text through the sql/ front-end (the north-star
+    workload — BASELINE.json: TPC-DS, 99 queries)."""
+    from spark_rapids_tpu.tpcds import register_tables, tpcds_sql
+
+    register_tables(tpu, sf, num_partitions=partitions)
+    register_tables(cpu, sf, num_partitions=partitions)
+    from spark_rapids_tpu.tpcds.datagen import gen_table as ds_gen
+
+    log({"tpcds_datagen": {"sf": sf,
+                           "store_sales_rows": ds_gen("store_sales", sf).num_rows}})
+    detail, speedups = {}, []
+    for n in qids:
+        text = tpcds_sql(n)
+        run_query_pair(
+            f"ds_q{n}",
+            lambda: tpu.sql(text),
+            lambda: cpu.sql(text),
+            tpu,
+            n_run,
+            speedups,
+            detail,
+        )
+    return speedups, detail
+
+
+# representative TPC-DS slice for the default combined run: covers comma
+# joins, rollup+grouping ranks, window ratios, channel unions, decorrelated
+# subqueries, day-bucket pivots — full 99 via BENCH_SUITE=tpcds
+TPCDS_DEFAULT_SLICE = (3, 7, 12, 19, 27, 34, 42, 52, 55, 68, 96, 98)
+
+
 def main() -> None:
     t_start = time.monotonic()
+    suite, smoke = _suite_args()
     if BENCH_PLATFORM:
         import jax
 
         jax.config.update("jax_platforms", BENCH_PLATFORM)
-    backend = ensure_backend()
+    backend = ensure_backend(total_budget_s=60.0 if smoke else 300.0)
+    metric_name = {
+        "tpch": "tpch_22q_geomean_speedup_vs_cpu_engine",
+        "tpcds": "tpcds_99q_geomean_speedup_vs_cpu_engine",
+        "both": "tpch_22q_geomean_speedup_vs_cpu_engine",
+    }.get(suite, "tpch_22q_geomean_speedup_vs_cpu_engine")
     if backend.get("platform") == "unavailable":
         # constructing a session would re-touch the hung backend in-process
         # (jax.default_backend() during cache setup) and turn a diagnosable
@@ -181,127 +351,111 @@ def main() -> None:
         print(
             json.dumps(
                 {
-                    "metric": "tpch_22q_geomean_speedup_vs_cpu_engine",
+                    "metric": metric_name,
                     "value": 0.0,
                     "unit": "x",
                     "vs_baseline": 0.0,
                     "detail": {
                         "backend": backend,
                         "error": "backend unavailable after init retries",
+                        "hint": "run BENCH_PLATFORM=cpu bench.py [--smoke] for "
+                                "tunnel-independent diagnostics",
                     },
                 }
             ),
             flush=True,
         )
         return
+
     from spark_rapids_tpu import TpuSession
-    from spark_rapids_tpu.tpch import tpch_query
-    from spark_rapids_tpu.tpch.datagen import TABLES, gen_table
 
-    log({"datagen": {"sf": BENCH_SF}})
-    tables = {name: gen_table(name, BENCH_SF) for name in TABLES}
-    log({"datagen_done_s": round(time.monotonic() - t_start, 1),
-         "lineitem_rows": tables["lineitem"].num_rows})
+    sf = BENCH_SF
+    tpcds_sf = float(os.environ.get("BENCH_TPCDS_SF", "0.05"))
+    n_run = N_RUN
+    partitions = PARTITIONS
+    if smoke:
+        # <60s of tunnel uptime: 3 queries per suite, 1 timed run, small SF
+        sf = min(sf, 0.05)
+        tpcds_sf = min(tpcds_sf, 0.01)
+        n_run = 1
+        partitions = 2
 
-    shuffle_conf = {"spark.sql.shuffle.partitions": SHUFFLE_PARTITIONS}
+    shuffle_conf = {"spark.sql.shuffle.partitions": SHUFFLE_PARTITIONS if not smoke else 2}
     tpu = TpuSession({"spark.rapids.sql.enabled": True, **shuffle_conf})
     cpu = TpuSession({"spark.rapids.sql.enabled": False, **shuffle_conf})
 
-    def accessor(session):
-        def t(name):
-            n = PARTITIONS if tables[name].num_rows > 100_000 else 1
-            return session.create_dataframe(tables[name], num_partitions=n)
-
-        return t
-
-    queries_detail = {}
+    detail: dict = {"backend": backend, "suite": suite, "smoke": smoke}
     speedups = []
-    for n in range(1, 23):
-        name = f"q{n}"
-        entry: dict = {}
-        try:
-            build_t = lambda: tpch_query(n, accessor(tpu), sf=BENCH_SF)  # noqa: E731
-            build_c = lambda: tpch_query(n, accessor(cpu), sf=BENCH_SF)  # noqa: E731
-            t_tpu = time_query(build_t)
-            # fallback accounting from the device session's last plan —
-            # source scans excluded: Parquet/Arrow decode is host-side by
-            # design (SURVEY §7 v1 I/O), compute fallbacks are what matter
-            ov = getattr(tpu, "_last_overrides", None)
-            entry["fallback_nodes"] = (
-                sum(
-                    1
-                    for e in ov.explain
-                    if not e.on_device and "Scan" not in e.node
-                )
-                if ov
-                else None
-            )
-            t_cpu = time_query(build_c)
-            sp = t_cpu / t_tpu if t_tpu > 0 else 0.0
-            entry.update(
-                tpu_s=round(t_tpu, 3), cpu_s=round(t_cpu, 3),
-                speedup=round(sp, 3),
-            )
-            mismatch = rows_equal(
-                _collect_retry(build_t), _collect_retry(build_c)
-            )
-            if mismatch:
-                entry["mismatch"] = mismatch
-            else:
-                speedups.append(sp)
-        except Exception as e:  # noqa: BLE001 - keep the rig alive per query
-            entry["error"] = str(e)[-300:]
-        queries_detail[name] = entry
-        log({name: entry})
+
+    tpch_tables = None
+    if suite in ("tpch", "both"):
+        qids = (1, 6, 3) if smoke else tuple(range(1, 23))
+        sp, qdetail, tpch_tables = run_tpch(tpu, cpu, sf, partitions, qids, n_run)
+        speedups.extend(sp)
+        detail["sf"] = sf
+        detail["queries_ok"] = len(sp)
+        detail["queries"] = qdetail
+
+    if suite in ("tpcds", "both"):
+        if suite == "tpcds":
+            ds_qids = (3, 42, 52) if smoke else tuple(range(1, 100))
+        else:
+            ds_qids = (3, 42, 52) if smoke else TPCDS_DEFAULT_SLICE
+        ds_sp, ds_detail = run_tpcds(tpu, cpu, tpcds_sf, partitions, ds_qids, n_run)
+        detail["tpcds"] = {
+            "sf": tpcds_sf,
+            "queries_ok": len(ds_sp),
+            "geomean_speedup": round(geomean(ds_sp), 3),
+            "queries": ds_detail,
+        }
+        if suite == "tpcds":
+            speedups = ds_sp
 
     # scan-from-disk: real multi-file Parquet, host decode + H2D
-    scan_detail = {}
-    try:
-        with tempfile.TemporaryDirectory(prefix="tpch_bench_") as root:
-            from spark_rapids_tpu.tpch.datagen import write_tables
+    if suite in ("tpch", "both") and not smoke and tpch_tables is not None:
+        scan_detail = {}
+        try:
+            with tempfile.TemporaryDirectory(prefix="tpch_bench_") as root:
+                from spark_rapids_tpu.tpch import tpch_query
+                from spark_rapids_tpu.tpch.datagen import write_tables
 
-            write_tables(root, min(BENCH_SF, 1.0), files_per_table=PARTITIONS)
+                write_tables(root, min(sf, 1.0), files_per_table=partitions)
 
-            def disk_accessor(session):
-                def t(name):
-                    return session.read.parquet(os.path.join(root, name))
+                def disk_accessor(session):
+                    def t(name):
+                        return session.read.parquet(os.path.join(root, name))
 
-                return t
+                    return t
 
-            for n in SCAN_QUERIES:
-                st = time_query(
-                    lambda: tpch_query(n, disk_accessor(tpu)), n_run=max(1, N_RUN - 1)
-                )
-                sc = time_query(
-                    lambda: tpch_query(n, disk_accessor(cpu)), n_run=max(1, N_RUN - 1)
-                )
-                scan_detail[f"q{n}"] = {
-                    "tpu_s": round(st, 3),
-                    "cpu_s": round(sc, 3),
-                    "speedup": round(sc / st if st > 0 else 0.0, 3),
-                }
-                log({"scan": {f"q{n}": scan_detail[f"q{n}"]}})
-    except Exception as e:  # noqa: BLE001
-        scan_detail["error"] = str(e)[-300:]
+                for n in SCAN_QUERIES:
+                    st = time_query(
+                        lambda: tpch_query(n, disk_accessor(tpu)),
+                        n_run=max(1, n_run - 1),
+                    )
+                    sc = time_query(
+                        lambda: tpch_query(n, disk_accessor(cpu)),
+                        n_run=max(1, n_run - 1),
+                    )
+                    scan_detail[f"q{n}"] = {
+                        "tpu_s": round(st, 3),
+                        "cpu_s": round(sc, 3),
+                        "speedup": round(sc / st if st > 0 else 0.0, 3),
+                    }
+                    log({"scan": {f"q{n}": scan_detail[f"q{n}"]}})
+        except Exception as e:  # noqa: BLE001
+            scan_detail["error"] = str(e)[-300:]
+        detail["scan"] = scan_detail
 
     geo = geomean(speedups)
+    detail["wall_s"] = round(time.monotonic() - t_start, 1)
     print(
         json.dumps(
             {
-                "metric": "tpch_22q_geomean_speedup_vs_cpu_engine",
+                "metric": metric_name,
                 "value": round(geo, 3),
                 "unit": "x",
                 "vs_baseline": round(geo / BASELINE_TYPICAL, 3),
-                "detail": {
-                    "sf": BENCH_SF,
-                    "partitions": PARTITIONS,
-                    "lineitem_rows": tables["lineitem"].num_rows,
-                    "backend": backend,
-                    "queries_ok": len(speedups),
-                    "queries": queries_detail,
-                    "scan": scan_detail,
-                    "wall_s": round(time.monotonic() - t_start, 1),
-                },
+                "detail": detail,
             }
         ),
         flush=True,

@@ -168,6 +168,10 @@ class DeviceBatch:
     def column(self, i: int) -> DeviceColumn:
         return self.columns[i]
 
+    def by_name(self, name: str) -> DeviceColumn:
+        """Column lookup for the ``to_jax()`` export path."""
+        return self.columns[self.schema.index_of(name)]
+
     def with_columns(self, schema: Schema, columns: list[DeviceColumn]) -> "DeviceBatch":
         return DeviceBatch(schema, columns, self.num_rows)
 

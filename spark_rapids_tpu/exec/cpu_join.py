@@ -107,7 +107,16 @@ class CpuShuffledHashJoinExec(Exec):
             [make(lt, rt) for lt, rt in zip(lparts.parts, rparts.parts)]
         )
 
-    def _join_partition(self, lrb: pa.RecordBatch, rrb: pa.RecordBatch) -> pa.RecordBatch:
+    def _join_partition(
+        self,
+        lrb: pa.RecordBatch,
+        rrb: pa.RecordBatch,
+        build_matched_acc=None,
+    ) -> pa.RecordBatch:
+        """``build_matched_acc`` (np bool array over build rows): broadcast
+        right/full mode — build match bits are ACCUMULATED instead of
+        null-extending per partition (which would duplicate unmatched build
+        rows across stream partitions); the caller emits the tail once."""
         left, right = self.children
         lcodes, lvalid = _key_codes(self.left_keys, lrb, left.output)
         rcodes, rvalid = _key_codes(self.right_keys, rrb, right.output)
@@ -158,10 +167,12 @@ class CpuShuffledHashJoinExec(Exec):
             extra_l = np.nonzero(~lmatched)[0]
         else:
             extra_l = np.zeros(0, dtype=np.int64)
-        if jt in ("right", "full"):
+        if jt in ("right", "full") and build_matched_acc is None:
             extra_r = np.nonzero(~rmatched)[0]
         else:
             extra_r = np.zeros(0, dtype=np.int64)
+        if build_matched_acc is not None:
+            build_matched_acc |= rmatched
         return self._outer_batch(lrb, rrb, li_a, ri_a, extra_l, extra_r)
 
     def _right_cols(self, rrb: pa.RecordBatch):
@@ -249,14 +260,74 @@ class CpuBroadcastHashJoinExec(CpuShuffledHashJoinExec):
         lschema = left.output
         assert isinstance(right, CpuBroadcastExchangeExec)
 
-        def make(lt):
+        if self.join_type not in ("right", "full"):
+            def make(lt):
+                def it():
+                    lrb = concat_batches(lschema, list(lt()))
+                    yield self._join_partition(lrb, right.broadcast_batch(ctx))
+
+                return it
+
+            return PartitionSet([make(lt) for lt in lparts.parts])
+
+        # right/full outer: accumulate build match bits across stream
+        # partitions; the last finisher emits the unmatched-build tail once
+        # (mirrors TpuBroadcastHashJoinExec — see its docstring)
+        import threading
+
+        state = {"remaining": len(lparts.parts), "mask": None, "emitted": False}
+        lock = threading.Lock()
+
+        def make_outer(lt):
             def it():
-                lrb = concat_batches(lschema, list(lt()))
-                yield self._join_partition(lrb, right.broadcast_batch(ctx))
+                rrb = right.broadcast_batch(ctx)
+                local = np.zeros(rrb.num_rows, dtype=bool)
+                done = False
+                abandoned = False
+                try:
+                    lrb = concat_batches(lschema, list(lt()))
+                    yield self._join_partition(
+                        lrb, rrb, build_matched_acc=local
+                    )
+                    done = True
+                except GeneratorExit:
+                    abandoned = True
+                    raise
+                finally:
+                    with lock:
+                        state["mask"] = (
+                            local
+                            if state["mask"] is None
+                            else state["mask"] | local
+                        )
+                        # once per FINISHED partition — a failed attempt gets
+                        # retried and must not consume the countdown (see
+                        # TpuBroadcastHashJoinExec)
+                        last = False
+                        if done or abandoned:
+                            state["remaining"] -= 1
+                            last = (
+                                state["remaining"] == 0
+                                and not state["emitted"]
+                            )
+                            if last:
+                                state["emitted"] = True
+                    if last and done:
+                        extra_r = np.nonzero(~state["mask"])[0]
+                        if len(extra_r):
+                            empty_l = concat_batches(lschema, [])
+                            yield self._outer_batch(
+                                empty_l,
+                                rrb,
+                                np.zeros(0, dtype=np.int64),
+                                np.zeros(0, dtype=np.int64),
+                                np.zeros(0, dtype=np.int64),
+                                extra_r,
+                            )
 
             return it
 
-        return PartitionSet([make(lt) for lt in lparts.parts])
+        return PartitionSet([make_outer(lt) for lt in lparts.parts])
 
     def node_string(self):
         return (

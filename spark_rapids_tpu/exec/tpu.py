@@ -150,6 +150,7 @@ class HostToDeviceExec(Exec):
         max_str = cfg.STRING_MAX_BYTES.get(ctx.conf)
         rows_m = self.metric("numInputRows", "ESSENTIAL")
         time_m = self.metric("hostToDeviceTime", "MODERATE")
+        bytes_m = self.metric("hostToDeviceBytes", "MODERATE")
         timing = self.metrics_on(ctx, "MODERATE")
 
         def fn(it):
@@ -157,6 +158,7 @@ class HostToDeviceExec(Exec):
                 if rb.num_rows == 0:
                     continue
                 rows_m.add(rb.num_rows)
+                bytes_m.add(rb.nbytes)
                 for off in range(0, rb.num_rows, max_rows):
                     chunk = (
                         rb
@@ -255,6 +257,7 @@ class DeviceToHostExec(Exec):
     def execute(self, ctx: ExecContext) -> PartitionSet:
         rows_m = self.metric("numOutputRows", "ESSENTIAL")
         time_m = self.metric("deviceToHostTime", "MODERATE")
+        bytes_m = self.metric("deviceToHostBytes", "MODERATE")
         timing = self.metrics_on(ctx, "MODERATE")
 
         # speculate only below execs whose results are usually tiny
@@ -307,6 +310,7 @@ class DeviceToHostExec(Exec):
                         ctx.semaphore.release_if_necessary()
                         if rb.num_rows:
                             rows_m.add(rb.num_rows)
+                            bytes_m.add(rb.nbytes)
                             yield rb
                         continue
                     if n_true is not None:
@@ -333,6 +337,7 @@ class DeviceToHostExec(Exec):
                     ctx.semaphore.release_if_necessary()
                     if rb.num_rows:
                         rows_m.add(rb.num_rows)
+                        bytes_m.add(rb.nbytes)
                         yield rb
 
         return self.children[0].execute(ctx).map_partitions(fn)

@@ -394,33 +394,114 @@ class TpuBroadcastExchangeExec(Exec):
 
 class TpuBroadcastHashJoinExec(TpuShuffledHashJoinExec):
     """Hash join with a broadcast build (right) side: stream partitions stay
-    put, each joins the one broadcast batch (GpuBroadcastHashJoinExec shims).
-    Join types requiring build-side null-extension (right/full) are not
-    planned onto this exec."""
+    put, each joins the one broadcast batch (GpuBroadcastHashJoinExec shims;
+    build-side selection per the reference's
+    shims/spark301/.../GpuBroadcastHashJoinExec.scala:63-75).
+
+    right/full outer need BUILD-side null-extension: unmatched build rows
+    must surface exactly ONCE globally even though every stream partition
+    probes the same broadcast batch. Each partition accumulates its build
+    match bits (host-side — per-device broadcast copies share row order);
+    the LAST partition to finish ORs them and emits the unmatched tail. A
+    partition abandoned early (its consumer stopped — e.g. a satisfied
+    limit) skips the tail via GeneratorExit, which is sound: every consumer
+    had stopped wanting rows."""
 
     def execute(self, ctx: ExecContext) -> PartitionSet:
         left, right = self.children
         assert isinstance(right, TpuBroadcastExchangeExec)
-        assert self.join_type in ("inner", "left", "left_semi", "left_anti")
+        assert self.join_type in (
+            "inner", "left", "left_semi", "left_anti", "right", "full",
+        )
         lparts = left.execute(ctx)
         phase1 = self._phase1()
         phase2 = self._phase2()
         jt = self.join_type
 
-        def make(lt):
+        if jt not in ("right", "full"):
+            def make(lt):
+                def it():
+                    yield from _stream_probe_join(
+                        self,
+                        lambda probe: right.broadcast_batch_like(ctx, probe),
+                        lt,
+                        phase1,
+                        phase2,
+                        jt,
+                    )
+
+                return it
+
+            return PartitionSet([make(lt) for lt in lparts.parts])
+
+        import numpy as np
+
+        state = {"remaining": len(lparts.parts), "mask": None, "emitted": False}
+        lock = threading.Lock()
+
+        def make_outer(lt):
             def it():
-                yield from _stream_probe_join(
-                    self,
-                    lambda probe: right.broadcast_batch_like(ctx, probe),
-                    lt,
-                    phase1,
-                    phase2,
-                    jt,
-                )
+                acc = {"m": None}
+                seen_build = {}
+
+                def get_build(probe):
+                    b = right.broadcast_batch_like(ctx, probe)
+                    seen_build["b"] = b
+                    if acc["m"] is None:
+                        acc["m"] = jnp.zeros(b.capacity, dtype=bool)
+                    return b
+
+                done = False
+                abandoned = False
+                try:
+                    yield from _stream_probe_join(
+                        self, get_build, lt, phase1, phase2, jt, acc
+                    )
+                    done = True
+                except GeneratorExit:
+                    # consumer stopped wanting rows (e.g. satisfied limit):
+                    # this partition is FINISHED for tail purposes
+                    abandoned = True
+                    raise
+                finally:
+                    with lock:
+                        if acc["m"] is not None:
+                            # merging a partial mask (failed/abandoned
+                            # attempt) is safe: recorded matches are real,
+                            # and a retry re-merges the complete mask
+                            local = np.asarray(acc["m"])
+                            state["mask"] = (
+                                local
+                                if state["mask"] is None
+                                else state["mask"] | local
+                            )
+                        # decrement once per FINISHED partition, never for a
+                        # failed attempt — task retry (_run_task) re-runs the
+                        # thunk and a per-attempt decrement would emit the
+                        # tail early (duplicates) or mark it emitted with an
+                        # incomplete mask (lost rows)
+                        last = False
+                        if done or abandoned:
+                            state["remaining"] -= 1
+                            last = (
+                                state["remaining"] == 0
+                                and not state["emitted"]
+                            )
+                            if last:
+                                state["emitted"] = True
+                    if last and done:
+                        build = seen_build.get("b") or right.broadcast_batch(ctx)
+                        mask = state["mask"]
+                        if mask is None:
+                            mask = np.zeros(build.capacity, dtype=bool)
+                        unmatched = jnp.asarray(~mask) & build.row_mask()
+                        extra = self._null_extend(build, unmatched, "right")
+                        if extra.row_count():
+                            yield extra
 
             return it
 
-        return PartitionSet([make(lt) for lt in lparts.parts])
+        return PartitionSet([make_outer(lt) for lt in lparts.parts])
 
     def node_string(self):
         return (

@@ -353,8 +353,13 @@ def _finalize_result_expr(e: Expression, num_keys: int, key_exprs) -> Expression
     if isinstance(e, _AggResultRef):
         return BoundReference(num_keys + e.index, e.fn.data_type, e.fn.nullable)
     for i, k in enumerate(key_exprs):
-        if e == k:
-            return BoundReference(i, k.data_type, k.nullable)
+        # grouping exprs may arrive Alias-wrapped (SQL compiler emits
+        # Alias(expr, "__g0") keys); the result expr references the BARE
+        # expr — match through the alias or the ordinal binds to the CHILD
+        # schema and reads the wrong post-aggregation column
+        kc = k.child if isinstance(k, Alias) else k
+        if e == k or e == kc:
+            return BoundReference(i, kc.data_type, kc.nullable)
     if not e.children():
         return e
     from ..expr.base import map_child_exprs
@@ -512,7 +517,10 @@ def _rewrite_distinct(lp: L.Aggregate) -> L.Aggregate:
         target = e.child if isinstance(e, Alias) else e
         mapped = None
         for i, g in enumerate(lp.grouping):
-            if target == g:
+            # grouping items may be Alias-wrapped (SQL compiler) — match
+            # through the alias like _finalize_result_expr does
+            gc = g.child if isinstance(g, Alias) else g
+            if target == g or target == gc:
                 mapped = UnresolvedAttribute(key_names[i])
                 break
         if mapped is None:
@@ -649,7 +657,10 @@ def _rewrite_multi_distinct(
         target = e.child if isinstance(e, Alias) else e
         mapped = None
         for i, g in enumerate(lp.grouping):
-            if target == g:
+            # grouping items may be Alias-wrapped (SQL compiler) — match
+            # through the alias like _finalize_result_expr does
+            gc = g.child if isinstance(g, Alias) else g
+            if target == g or target == gc:
                 mapped = UnresolvedAttribute(key_names[i])
                 break
         if mapped is None:
@@ -796,17 +807,23 @@ def _plan_join(lp: L.Join, conf: TpuConf) -> Exec:
     if lp.left_keys:
         jt = lp.join_type
         # Build-side selection (hint, or estimated size under the threshold).
-        # The build side must never need null-extension: build-right supports
-        # inner/left/semi/anti; build-left supports inner/right and is
-        # realized by swapping sides + a column-reordering projection.
+        # build-right supports every type: right/full ride the broadcast
+        # exec's global build-matched tracking, which emits the
+        # unmatched-build tail exactly once across stream partitions.
+        # build-left is realized by swapping sides + a column-reordering
+        # projection.
         threshold = cfg.AUTO_BROADCAST_THRESHOLD.get(conf)
         l_hint, r_hint = _has_broadcast_hint(lp.left), _has_broadcast_hint(lp.right)
 
         def fits(sz):
             return threshold >= 0 and sz is not None and sz <= threshold
 
-        bc_right_ok = jt in ("inner", "left", "left_semi", "left_anti")
-        bc_left_ok = jt in ("inner", "right") and not lp.using
+        # right/full on build-right ride the broadcast exec's global
+        # build-matched tracking (exactly-once unmatched-build tail)
+        bc_right_ok = jt in (
+            "inner", "left", "left_semi", "left_anti", "right", "full",
+        )
+        bc_left_ok = jt in ("inner", "right", "left", "full") and not lp.using
         want_right = bc_right_ok and (r_hint or fits(_estimate_size(lp.right)))
         want_left = bc_left_ok and (l_hint or fits(_estimate_size(lp.left)))
         if want_left and (not want_right or (l_hint and not r_hint)):
@@ -815,7 +832,8 @@ def _plan_join(lp: L.Join, conf: TpuConf) -> Exec:
                 swapped = L.Join(
                     lp.right,
                     lp.left,
-                    {"inner": "inner", "right": "left"}[jt],
+                    {"inner": "inner", "right": "left", "left": "right",
+                     "full": "full"}[jt],
                     lp.right_keys,
                     lp.left_keys,
                     lp.residual,

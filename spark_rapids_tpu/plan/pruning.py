@@ -100,21 +100,68 @@ def prune_columns(plan: L.LogicalPlan, required: Optional[Set[str]] = None):
         )
     if isinstance(plan, L.Window):
         # output = child columns ++ window columns: the child must provide
-        # the required pass-through names plus every spec/function input
+        # the required pass-through names plus every spec/function input.
+        # Window exprs are BOUND at select time (_extract_windows), so (a)
+        # collect their inputs by ordinal→name, and (b) after pruning, remap
+        # surviving BoundReference ordinals — dropping ANY earlier child
+        # column shifts them (this broke `select few_cols, rank() over
+        # (partition by unprojected_col ...)`).
+        old_names = list(plan.child.schema.names)
+
+        def _win_exprs(we):
+            yield we
+            for p in we.spec.partition_by:
+                yield p
+            for o in we.spec.order_by:
+                yield o.child
+
+        def _bound_names(e: Expression, out: Set[str]) -> None:
+            from ..expr.base import BoundReference
+
+            if isinstance(e, BoundReference):
+                out.add(old_names[e.ordinal])
+            for c in e.children():
+                _bound_names(c, out)
+
         if required is None:
             req = None
         else:
             win_names = {name for name, _ in plan.window_cols}
             req = set(required) - win_names
             for _, we in plan.window_cols:
-                # children() covers only the function; the spec's partition
-                # and order expressions are separate fields
-                _expr_names(we, req)
-                for p in we.spec.partition_by:
-                    _expr_names(p, req)
-                for o in we.spec.order_by:
-                    _expr_names(o.child, req)
-        return dataclasses.replace(plan, child=prune_columns(plan.child, req))
+                for e in _win_exprs(we):
+                    _expr_names(e, req)
+                    _bound_names(e, req)
+        child = prune_columns(plan.child, req)
+        new_names = list(child.schema.names)
+        if new_names != old_names:
+            from ..expr.base import BoundReference, map_child_exprs
+            from ..expr.windows import WindowExpression, WindowOrder, WindowSpec
+
+            index = {n: i for i, n in enumerate(new_names)}
+
+            def remap(e: Expression) -> Expression:
+                if isinstance(e, BoundReference):
+                    return dataclasses.replace(
+                        e, ordinal=index[old_names[e.ordinal]]
+                    )
+                if not e.children():
+                    return e
+                return map_child_exprs(e, remap)
+
+            new_cols = []
+            for name, we in plan.window_cols:
+                spec = WindowSpec(
+                    tuple(remap(p) for p in we.spec.partition_by),
+                    tuple(
+                        WindowOrder(remap(o.child), o.ascending, o.nulls_first)
+                        for o in we.spec.order_by
+                    ),
+                    we.spec.frame,
+                )
+                new_cols.append((name, WindowExpression(remap(we.function), spec)))
+            return dataclasses.replace(plan, window_cols=new_cols, child=child)
+        return dataclasses.replace(plan, child=child)
     # unmodeled node: recurse with "all columns" required beneath it
     kids = list(plan.children())
     if not kids:

@@ -125,6 +125,8 @@ def device_host_breakdown(plan: Exec) -> dict:
         "op_time_ms": 0.0,
         "h2d_time_ms": 0.0,
         "d2h_time_ms": 0.0,
+        "h2d_bytes": 0,
+        "d2h_bytes": 0,
         "per_node_ms": {},
     }
     for node in walk(plan):
@@ -138,6 +140,10 @@ def device_host_breakdown(plan: Exec) -> dict:
                 out["h2d_time_ms"] += m.value / 1e6
             elif m.name == "deviceToHostTime":
                 out["d2h_time_ms"] += m.value / 1e6
+            elif m.name == "hostToDeviceBytes":
+                out["h2d_bytes"] += m.value
+            elif m.name == "deviceToHostBytes":
+                out["d2h_bytes"] += m.value
     out["per_node_ms"] = dict(
         sorted(out["per_node_ms"].items(), key=lambda kv: -kv[1])
     )

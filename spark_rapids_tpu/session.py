@@ -75,6 +75,7 @@ class TpuSession:
             # sets one partition per chip instead.
             self.conf = self.conf.set(cfg.SHUFFLE_PARTITIONS.key, 1)
         self.read = DataFrameReader(self)
+        self._temp_views: dict = {}  # lower-case name -> DataFrame
         self._last_plan: Optional[Exec] = None
         self._last_overrides: Optional[TpuOverrides] = None
         self._task_retries = 0
@@ -96,6 +97,23 @@ class TpuSession:
                 raise ValueError(
                     f"multiproc rank/size invalid: rank={rank} size={size}"
                 )
+
+    def sql(self, text: str) -> "DataFrame":
+        """Run a SELECT statement over registered temp views (sql/ package —
+        the standalone analogue of riding Spark's parser; reference QA
+        battery: integration_tests/src/main/python/qa_nightly_sql.py)."""
+        from .sql import Compiler, parse
+
+        return Compiler(self).compile(parse(text))
+
+    def create_or_replace_temp_view(self, name: str, df: "DataFrame"):
+        self._temp_views[name.lower()] = df
+
+    def table(self, name: str) -> "DataFrame":
+        try:
+            return self._temp_views[name.lower()]
+        except KeyError:
+            raise ValueError(f"unknown table {name!r}") from None
 
     def _next_query_seq(self) -> int:
         with self._retry_lock:
@@ -298,6 +316,32 @@ class TpuSession:
                 h2d.pop(k, None)
 
     def _execute(self, lp: L.LogicalPlan) -> pa.Table:
+        final_plan, ctx = self._prepare_plan(lp)
+        from .profiling import query_trace
+
+        try:
+            with query_trace(cfg.PROFILE_PATH.get(self.conf)):
+                return self._run_plan(final_plan, ctx)
+        finally:
+            self._leak_check(ctx)
+
+    def _leak_check(self, ctx) -> None:
+        if ctx.catalog.debug:
+            leaks = ctx.catalog.leak_report()
+            if leaks:
+                import logging
+
+                logging.getLogger(__name__).warning(
+                    "spillable-buffer LEAKS at query end (%d, %d bytes): %s",
+                    len(leaks),
+                    sum(l["size"] for l in leaks),
+                    leaks[:10],
+                )
+
+    def _prepare_plan(self, lp: L.LogicalPlan):
+        """Analysis + physical planning + overrides: everything _execute
+        does before running the plan. Split out so ``DataFrame.to_jax`` can
+        execute the same plan WITHOUT the final device→host transition."""
         from .plan.pruning import prune_columns
 
         lp = self._resolve_cached(lp)
@@ -347,23 +391,7 @@ class TpuSession:
             from .profiling import instrument_plan
 
             instrument_plan(final_plan)
-        from .profiling import query_trace
-
-        try:
-            with query_trace(cfg.PROFILE_PATH.get(self.conf)):
-                return self._run_plan(final_plan, ctx)
-        finally:
-            if ctx.catalog.debug:
-                leaks = ctx.catalog.leak_report()
-                if leaks:
-                    import logging
-
-                    logging.getLogger(__name__).warning(
-                        "spillable-buffer LEAKS at query end (%d, %d bytes): %s",
-                        len(leaks),
-                        sum(l["size"] for l in leaks),
-                        leaks[:10],
-                    )
+        return final_plan, ctx
 
     def _run_task(self, thunk, attempts: int) -> List[pa.RecordBatch]:
         """One partition task with Spark's retry model (spark.task.maxFailures;
@@ -1057,6 +1085,59 @@ class DataFrame:
         return DataFrame(self._session, L.Aggregate(keys, aggs, self._plan))
 
     dropDuplicates = drop_duplicates
+
+    def create_or_replace_temp_view(self, name: str) -> None:
+        self._session.create_or_replace_temp_view(name, self)
+
+    createOrReplaceTempView = create_or_replace_temp_view
+
+    def to_jax(self):
+        """Zero-copy device export: run the query and hand out the LIVE
+        device-resident result as one :class:`DeviceBatch` — a jax pytree
+        (per-column ``data``/``validity``/``lengths`` arrays) consumable by
+        a jitted function with NO host round trip. The TPU-natural analogue
+        of the reference's ML export path (ColumnarRdd.scala,
+        InternalColumnarRddConverter.scala:1-579, docs/ml-integration.md),
+        where cuDF tables are handed to XGBoost without leaving the GPU.
+
+        The batch is padded to capacity: rows ``[0, num_rows)`` are live
+        (``num_rows`` is a device scalar — ``row_count()`` syncs it);
+        padding rows have ``validity == False``. Use ``batch.by_name(c)``
+        for column access.
+        """
+        from .exec.tpu import DeviceToHostExec
+        from .ops.concat import concat_device
+        from .ops.gather import bulk_shrink
+
+        final_plan, ctx = self._session._prepare_plan(self._plan)
+        plan = final_plan
+        if isinstance(plan, DeviceToHostExec):
+            plan = plan.children[0]
+        else:
+            raise ValueError(
+                "to_jax(): plan does not end on the device (fell back to "
+                "CPU?) — use to_arrow() instead"
+            )
+        try:
+            parts = plan.execute(ctx)
+            # same retry model as collect(): partition thunks re-run from
+            # lineage on transient failures (spark.task.maxFailures)
+            attempts = cfg.TASK_MAX_FAILURES.get(self._session.conf)
+            batches = [
+                db
+                for t in parts.parts
+                for db in self._session._run_task(t, attempts)
+            ]
+            batches = [b for b in bulk_shrink(batches) if b.capacity]
+            if not batches:
+                from .columnar.device import empty_batch
+
+                return empty_batch(plan.output)
+            if len(batches) == 1:
+                return batches[0]
+            return concat_device(batches)
+        finally:
+            self._session._leak_check(ctx)
 
     # ── actions ─────────────────────────────────────────────────────────
     def to_arrow(self) -> pa.Table:

@@ -40,9 +40,36 @@ _ERROR = 4
 
 _HEADER = struct.Struct("<bqqi")  # kind, a (req_id|tag), b (req_type|unused), len
 
+# DCN condition injection: loopback multiproc tests exercise throttle and
+# bounce-buffer sizing under realistic latency/bandwidth (the reference
+# validates its shuffle client against a MOCKED transport the same way —
+# RapidsShuffleClientSuite.scala). One-way latency is added per frame and
+# bandwidth caps serialize inside the socket write lock, so concurrent
+# senders contend for the simulated link exactly like a real NIC.
+# Env (read at import so executor subprocesses inherit):
+#   SRT_TCP_INJECT_LATENCY_MS  — one-way per-frame latency
+#   SRT_TCP_INJECT_BW_MBPS     — link bandwidth cap (payload MB/s)
+import os as _os
+import time as _time
+
+_INJECT = {
+    "latency_s": float(_os.environ.get("SRT_TCP_INJECT_LATENCY_MS", "0")) / 1e3,
+    "bw_bps": float(_os.environ.get("SRT_TCP_INJECT_BW_MBPS", "0")) * 1e6,
+}
+
+
+def set_injection(latency_ms: float = 0.0, bandwidth_mbps: float = 0.0) -> None:
+    """Configure simulated DCN conditions for this process's transports."""
+    _INJECT["latency_s"] = latency_ms / 1e3
+    _INJECT["bw_bps"] = bandwidth_mbps * 1e6
+
 
 def _send_frame(sock: socket.socket, lock: threading.Lock, kind: int, a: int, b: int, payload: bytes):
     with lock:
+        if _INJECT["latency_s"] > 0:
+            _time.sleep(_INJECT["latency_s"])
+        if _INJECT["bw_bps"] > 0 and payload:
+            _time.sleep(len(payload) / _INJECT["bw_bps"])
         sock.sendall(_HEADER.pack(kind, a, b, len(payload)) + payload)
 
 

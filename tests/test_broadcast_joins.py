@@ -53,12 +53,48 @@ def test_broadcast_join_in_plan():
     assert "BroadcastHashJoin" not in df2.explain()
 
 
-def test_right_join_never_broadcast_right():
-    # right/full need build-side null extension → must stay shuffled
+def test_right_join_broadcasts_with_build_side_tail():
+    # right/full broadcast: the exec tracks build match bits globally and
+    # emits unmatched BUILD rows exactly once (r5; previously gated off)
     lt, rt = _two_tables(53)
     s = tpu_session()
-    df = s.create_dataframe(lt).join(s.create_dataframe(rt), on=[("k", "k")], how="right")
-    assert "BroadcastHashJoin" not in df.explain()
+    df = s.create_dataframe(lt).join(
+        s.create_dataframe(rt), on=[("k", "k")], how="right"
+    )
+    assert "BroadcastHashJoin" in df.explain()
+
+
+@pytest.mark.parametrize("how", ["right", "full"])
+def test_broadcast_outer_join_matches_cpu(how):
+    lt, rt = _two_tables(63)
+    # widen the build key range so some build rows NEVER match: the
+    # unmatched-build tail must appear exactly once across 3 stream parts
+    rt = gen_grouped_table([("rv", LONG)], 150, num_groups=45, seed=64)
+    assert_cpu_and_tpu_equal(
+        lambda s: s.create_dataframe(lt, num_partitions=3).join(
+            broadcast(s.create_dataframe(rt, num_partitions=2)),
+            on=[("k", "k")],
+            how=how,
+        )
+    )
+
+
+@pytest.mark.parametrize("how", ["right", "full"])
+def test_broadcast_outer_join_all_null_build_keys(how):
+    # all-null build keys: nothing matches; every build row must surface
+    # exactly once null-extended (VERDICT r4 item 5's acceptance case)
+    lt, _ = _two_tables(65)
+    rt = pa.table(
+        {
+            "k": pa.array([None] * 40, type=pa.int64()),
+            "rv": pa.array(list(range(40)), type=pa.int64()),
+        }
+    )
+    assert_cpu_and_tpu_equal(
+        lambda s: s.create_dataframe(lt, num_partitions=3).join(
+            broadcast(s.create_dataframe(rt)), on=[("k", "k")], how=how
+        )
+    )
 
 
 def test_broadcast_left_hint_swaps_build_side():

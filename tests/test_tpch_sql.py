@@ -1,0 +1,57 @@
+"""TPC-H from spec SQL text: every query parsed + compiled by the sql/
+front-end must match the hand-written DataFrame translation row-for-row
+(VERDICT r4 item 3's acceptance bar). Runs on the CPU engine — this suite
+checks the FRONT-END (parser, scope resolution, decorrelation); device
+semantics are covered by test_tpch.py's differential battery.
+"""
+from __future__ import annotations
+
+import pytest
+
+from spark_rapids_tpu.tpch import QUERIES, gen_table, tpch_query
+from spark_rapids_tpu.tpch.sql_queries import tpch_sql
+from tests.harness import cpu_session, _normalize, _values_equal
+
+SF = 0.003
+Q11_SF = 1.0  # see test_tpch.py: spec fraction at tiny SF empties the result
+
+
+@pytest.fixture(scope="module")
+def session_with_views():
+    from spark_rapids_tpu.tpch.datagen import TABLES
+
+    s = cpu_session()
+    for name in TABLES:
+        s.create_dataframe(gen_table(name, SF)).create_or_replace_temp_view(
+            name
+        )
+    return s
+
+
+@pytest.mark.parametrize("n", sorted(QUERIES))
+def test_tpch_sql_matches_dataframe(n, session_with_views):
+    s = session_with_views
+
+    def t(name):
+        return s.table(name)
+
+    hand = tpch_query(n, t, sf=Q11_SF)
+    sql_df = s.sql(tpch_sql(n, sf=Q11_SF))
+    # the hand translations don't preserve the spec's column ORDER (agg()
+    # puts grouping keys first); align by name before comparing values
+    by_name = {c.lower(): c for c in hand.columns}
+    missing = [c for c in sql_df.columns if c.lower() not in by_name]
+    assert not missing, f"q{n}: sql columns {missing} absent from hand version"
+    expect = hand.select(*[by_name[c.lower()] for c in sql_df.columns]).collect()
+    got = sql_df.collect()
+    expect, got = _normalize(expect, True), _normalize(got, True)
+    assert len(expect) == len(got), (
+        f"q{n}: rows df={len(expect)} sql={len(got)}\n"
+        f"df={expect[:5]}\nsql={got[:5]}"
+    )
+    for i, (er, gr) in enumerate(zip(expect, got)):
+        assert len(er) == len(gr), f"q{n} row {i}: arity {len(er)} vs {len(gr)}"
+        for j, (ev, gv) in enumerate(zip(er, gr)):
+            assert _values_equal(ev, gv, approx_float=True), (
+                f"q{n} row {i} col {j}: df={ev!r} sql={gv!r}"
+            )
