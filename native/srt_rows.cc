@@ -1,0 +1,196 @@
+// Row materialization for collect(): assemble python row tuples straight
+// from columnar buffers in one C pass.
+//
+// Role in the design: the reference accelerates the columnar->row boundary
+// with a device-assisted packed row format decoded natively
+// (sql-plugin/src/main/java/com/nvidia/spark/rapids/CudfUnsafeRow.java:399,
+// UnsafeRowToColumnarBatchIterator.java). On the TPU build the device side
+// already ships one packed D2H transfer (exec/tpu.py DeviceToHostExec);
+// what remained python-slow was the row-tuple assembly loop
+// (session.py collect: n_rows x n_cols python-level ops). This extension
+// moves that loop into C: one call builds the full list of tuples from
+// numpy views / arrow string buffers.
+//
+// Scope is deliberately lean: fixed-width primitives, bools, and UTF-8
+// strings decode from raw buffers; every other type arrives pre-converted
+// as a python object list ("obj" kind) and is just re-referenced. The
+// loader (spark_rapids_tpu/native/__init__.py rows_decode) always has the
+// pure-python fallback, so this module is never required.
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+
+#include <cstdint>
+#include <cstring>
+#include <vector>
+
+namespace {
+
+enum Kind : int {
+  K_I8, K_I16, K_I32, K_I64, K_F32, K_F64, K_BOOL, K_STR, K_OBJ
+};
+
+struct Col {
+  int kind = K_OBJ;
+  const uint8_t* data = nullptr;     // primitive values / utf8 bytes
+  const uint8_t* valid = nullptr;    // bool-per-row, may be null (all valid)
+  const int64_t* offsets = nullptr;  // K_STR: n+1 byte offsets
+  PyObject* objs = nullptr;          // K_OBJ: list of python objects
+  Py_buffer data_buf{}, valid_buf{}, off_buf{};
+  bool has_data = false, has_valid = false, has_off = false;
+};
+
+int kind_from_str(const char* s) {
+  if (!strcmp(s, "i8")) return K_I8;
+  if (!strcmp(s, "i16")) return K_I16;
+  if (!strcmp(s, "i32")) return K_I32;
+  if (!strcmp(s, "i64")) return K_I64;
+  if (!strcmp(s, "f32")) return K_F32;
+  if (!strcmp(s, "f64")) return K_F64;
+  if (!strcmp(s, "bool")) return K_BOOL;
+  if (!strcmp(s, "str")) return K_STR;
+  if (!strcmp(s, "obj")) return K_OBJ;
+  return -1;
+}
+
+void release_cols(std::vector<Col>& cols) {
+  for (auto& c : cols) {
+    if (c.has_data) PyBuffer_Release(&c.data_buf);
+    if (c.has_valid) PyBuffer_Release(&c.valid_buf);
+    if (c.has_off) PyBuffer_Release(&c.off_buf);
+  }
+}
+
+PyObject* cell(const Col& c, Py_ssize_t r) {
+  if (c.valid && !c.valid[r]) Py_RETURN_NONE;
+  switch (c.kind) {
+    case K_I8:
+      return PyLong_FromLong(reinterpret_cast<const int8_t*>(c.data)[r]);
+    case K_I16:
+      return PyLong_FromLong(reinterpret_cast<const int16_t*>(c.data)[r]);
+    case K_I32:
+      return PyLong_FromLong(reinterpret_cast<const int32_t*>(c.data)[r]);
+    case K_I64:
+      return PyLong_FromLongLong(
+          reinterpret_cast<const int64_t*>(c.data)[r]);
+    case K_F32:
+      return PyFloat_FromDouble(
+          reinterpret_cast<const float*>(c.data)[r]);
+    case K_F64:
+      return PyFloat_FromDouble(
+          reinterpret_cast<const double*>(c.data)[r]);
+    case K_BOOL:
+      return PyBool_FromLong(c.data[r]);
+    case K_STR: {
+      const int64_t a = c.offsets[r], b = c.offsets[r + 1];
+      return PyUnicode_DecodeUTF8(
+          reinterpret_cast<const char*>(c.data) + a, b - a, "replace");
+    }
+    case K_OBJ: {
+      PyObject* o = PyList_GET_ITEM(c.objs, r);
+      Py_INCREF(o);
+      return o;
+    }
+  }
+  Py_RETURN_NONE;
+}
+
+// decode(cols, n) -> list[tuple]
+// cols: sequence of (kind:str, data, valid, offsets, objs) where data /
+// valid / offsets are contiguous buffers or None, objs a list or None.
+PyObject* decode(PyObject*, PyObject* args) {
+  PyObject* col_seq;
+  Py_ssize_t n;
+  if (!PyArg_ParseTuple(args, "On", &col_seq, &n)) return nullptr;
+  PyObject* fast = PySequence_Fast(col_seq, "cols must be a sequence");
+  if (!fast) return nullptr;
+  const Py_ssize_t ncols = PySequence_Fast_GET_SIZE(fast);
+  std::vector<Col> cols(static_cast<size_t>(ncols));
+
+  auto fail = [&](const char* msg) -> PyObject* {
+    release_cols(cols);
+    Py_DECREF(fast);
+    if (msg) PyErr_SetString(PyExc_ValueError, msg);
+    return nullptr;
+  };
+
+  for (Py_ssize_t i = 0; i < ncols; i++) {
+    PyObject* spec = PySequence_Fast_GET_ITEM(fast, i);
+    const char* kind_s;
+    PyObject *data_o, *valid_o, *off_o, *objs_o;
+    if (!PyArg_ParseTuple(spec, "sOOOO", &kind_s, &data_o, &valid_o,
+                          &off_o, &objs_o))
+      return fail(nullptr);
+    Col& c = cols[static_cast<size_t>(i)];
+    c.kind = kind_from_str(kind_s);
+    if (c.kind < 0) return fail("unknown column kind");
+    if (c.kind == K_OBJ) {
+      if (!PyList_Check(objs_o) || PyList_GET_SIZE(objs_o) < n)
+        return fail("obj column needs a list of >= n items");
+      c.objs = objs_o;
+      continue;
+    }
+    if (PyObject_GetBuffer(data_o, &c.data_buf, PyBUF_SIMPLE) < 0)
+      return fail(nullptr);
+    c.has_data = true;
+    c.data = static_cast<const uint8_t*>(c.data_buf.buf);
+    if (valid_o != Py_None) {
+      if (PyObject_GetBuffer(valid_o, &c.valid_buf, PyBUF_SIMPLE) < 0)
+        return fail(nullptr);
+      c.has_valid = true;
+      if (c.valid_buf.len < n) return fail("validity buffer too short");
+      c.valid = static_cast<const uint8_t*>(c.valid_buf.buf);
+    }
+    if (c.kind == K_STR) {
+      if (off_o == Py_None) return fail("str column needs offsets");
+      if (PyObject_GetBuffer(off_o, &c.off_buf, PyBUF_SIMPLE) < 0)
+        return fail(nullptr);
+      c.has_off = true;
+      if (c.off_buf.len < static_cast<Py_ssize_t>((n + 1) * sizeof(int64_t)))
+        return fail("offsets buffer too short");
+      c.offsets = static_cast<const int64_t*>(c.off_buf.buf);
+    } else {
+      const int w = (c.kind == K_I8 || c.kind == K_BOOL)  ? 1
+                    : (c.kind == K_I16)                   ? 2
+                    : (c.kind == K_I32 || c.kind == K_F32) ? 4
+                                                           : 8;
+      if (c.data_buf.len < n * static_cast<Py_ssize_t>(w))
+        return fail("data buffer too short");
+    }
+  }
+
+  PyObject* out = PyList_New(n);
+  if (!out) return fail(nullptr);
+  for (Py_ssize_t r = 0; r < n; r++) {
+    PyObject* row = PyTuple_New(ncols);
+    if (!row) {
+      Py_DECREF(out);
+      return fail(nullptr);
+    }
+    for (Py_ssize_t i = 0; i < ncols; i++) {
+      PyObject* v = cell(cols[static_cast<size_t>(i)], r);
+      if (!v) {
+        Py_DECREF(row);
+        Py_DECREF(out);
+        return fail(nullptr);
+      }
+      PyTuple_SET_ITEM(row, i, v);
+    }
+    PyList_SET_ITEM(out, r, row);
+  }
+  release_cols(cols);
+  Py_DECREF(fast);
+  return out;
+}
+
+PyMethodDef methods[] = {
+    {"decode", decode, METH_VARARGS,
+     "decode(cols, n) -> list of row tuples from columnar buffers"},
+    {nullptr, nullptr, 0, nullptr}};
+
+PyModuleDef mod = {PyModuleDef_HEAD_INIT, "srt_rows",
+                   "native row materialization for collect()", -1, methods,
+                   nullptr, nullptr, nullptr, nullptr};
+
+}  // namespace
+
+PyMODINIT_FUNC PyInit_srt_rows(void) { return PyModule_Create(&mod); }

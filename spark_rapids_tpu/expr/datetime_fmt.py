@@ -33,17 +33,15 @@ from .datetime import civil_from_days, days_from_civil
 
 _TOKENS = {"yyyy": 4, "MM": 2, "dd": 2, "HH": 2, "mm": 2, "ss": 2}
 # single-letter variants print UNPADDED (SimpleDateFormat count-1 fields);
-# they are format-only — parsing them would need variable-width scanning
+# parsing consumes a greedy 1..k digit run behind a per-row cursor
 _UNPADDED = {"y": 4, "M": 2, "d": 2, "H": 2, "m": 2, "s": 2}
 
 
-def parse_pattern(
-    fmt: str, for_parse: bool = False
-) -> Tuple[Tuple[str, str], ...]:
+def parse_pattern(fmt: str) -> Tuple[Tuple[str, str], ...]:
     """Pattern → ((kind, text)…); kind is 'tok' (zero-padded), 'unp'
     (unpadded single-letter) or 'lit'. Raises ValueError for tokens outside
-    the supported subset (planner check catches it); unpadded tokens are
-    rejected when ``for_parse`` (fixed-offset parsers can't scan them)."""
+    the supported subset (planner check catches it). Unpadded tokens format
+    AND parse: the parser runs a per-row cursor with greedy digit runs."""
     out = []
     i = 0
     while i < len(fmt):
@@ -59,7 +57,7 @@ def parse_pattern(
             run = fmt[i:j]
             if run in _TOKENS:
                 out.append(("tok", run))
-            elif len(run) == 1 and run in _UNPADDED and not for_parse:
+            elif len(run) == 1 and run in _UNPADDED:
                 out.append(("unp", run))
             else:
                 raise ValueError(
@@ -74,9 +72,9 @@ def parse_pattern(
     return tuple(out)
 
 
-def pattern_supported(fmt: str, for_parse: bool = False) -> bool:
+def pattern_supported(fmt: str) -> bool:
     try:
-        parse_pattern(fmt, for_parse)
+        parse_pattern(fmt)
         return True
     except ValueError:
         return False
@@ -238,33 +236,74 @@ class FromUnixTime(Expression):
 
 
 def _parse_device(ctx: Ctx, val: Val, pattern):
-    """Fixed-offset parse of the pattern → (micros, ok). Tokens sit at
-    static byte offsets (all supported tokens are fixed-width)."""
+    """Parse the pattern → (micros, ok). Fixed-width tokens sit at static
+    offsets from the trim start; unpadded single-letter tokens ('M/d/yyyy')
+    consume a greedy 1..k digit run behind a per-row cursor (SimpleDateFormat
+    numeric-field semantics)."""
+    from .cast import _char_at
     from .strings import dev_str
 
     xp = ctx.xp
     ch, lengths = dev_str(ctx, val)
     start, end, has_any = _dev_trim(ctx, ch, lengths)
-    total = sum(_TOKENS[t] if k == "tok" else 1 for k, t in pattern)
-    ok = has_any & ((end - start) == total)
+    has_unp = any(k == "unp" for k, _ in pattern)
     # tokens absent from the pattern default like Java: month/day 1, rest 0
     fields = {
         t: xp.full(ctx.n, 1 if t in ("MM", "dd") else 0, dtype=xp.int64)
         for t in _TOKENS
     }
-    off = 0
-    for kind, text in pattern:
-        if kind == "tok":
-            k = _TOKENS[text]
-            v, seg_ok = _parse_digits(ctx, ch, start + off, start + off + k)
-            fields[text] = v
-            ok = ok & seg_ok
-            off += k
-        else:
-            from .cast import _char_at
+    if not has_unp:
+        total = sum(_TOKENS[t] if k == "tok" else 1 for k, t in pattern)
+        ok = has_any & ((end - start) == total)
+        off = 0
+        for kind, text in pattern:
+            if kind == "tok":
+                k = _TOKENS[text]
+                v, seg_ok = _parse_digits(
+                    ctx, ch, start + off, start + off + k
+                )
+                fields[text] = v
+                ok = ok & seg_ok
+                off += k
+            else:
+                ok = ok & (_char_at(ctx, ch, start + off) == ord(text))
+                off += 1
+    else:
+        cur = start
+        ok = has_any
+        for kind, text in pattern:
+            if kind == "tok":
+                k = _TOKENS[text]
+                v, seg_ok = _parse_digits(ctx, ch, cur, cur + k)
+                fields[text] = v
+                ok = ok & seg_ok & (cur + k <= end)
+                cur = cur + k
+            elif kind == "unp":
+                k = _UNPADDED[text]
+                run = None
+                acc = xp.zeros(ctx.n, dtype=xp.int64)
+                width = xp.zeros(ctx.n, dtype=xp.int32)
+                for j in range(k):
+                    c = _char_at(ctx, ch, cur + j)
+                    isd = (c >= 48) & (c <= 57) & ((cur + j) < end)
+                    run = isd if run is None else (run & isd)
+                    acc = xp.where(
+                        run, acc * 10 + (c - 48).astype(xp.int64), acc
+                    )
+                    width = width + run.astype(xp.int32)
+                fields[_UNP_FIELD[text]] = acc
+                ok = ok & (width >= 1)
+                cur = cur + width
+            else:
+                ok = (
+                    ok
+                    & (_char_at(ctx, ch, cur) == ord(text))
+                    & (cur < end)
+                )
+                cur = cur + 1
+        ok = ok & (cur == end)
+    from .cast import _days_in_month
 
-            ok = ok & (_char_at(ctx, ch, start + off) == ord(text))
-            off += 1
     y = fields["yyyy"].astype(xp.int32)
     mo = xp.clip(fields["MM"], 1, 12).astype(xp.int32)
     d = xp.clip(fields["dd"], 1, 31).astype(xp.int32)
@@ -273,7 +312,8 @@ def _parse_device(ctx: Ctx, val: Val, pattern):
         & (fields["MM"] >= 1)
         & (fields["MM"] <= 12)
         & (fields["dd"] >= 1)
-        & (fields["dd"] <= 31)
+        # per-month bound: Feb 29 of a non-leap year must NOT parse
+        & (fields["dd"] <= _days_in_month(xp, y, mo))
         & (fields["HH"] < 24)
         & (fields["mm"] < 60)
         & (fields["ss"] < 60)
@@ -289,23 +329,32 @@ def _parse_cpu(s, pattern):
     if s is None:
         return None
     s = s.strip()
-    total = sum(_TOKENS[t] if k == "tok" else 1 for k, t in pattern)
-    if len(s) != total:
-        return None
     fields = {t: (1 if t in ("MM", "dd") else 0) for t in _TOKENS}
     off = 0
     for kind, text in pattern:
         if kind == "tok":
             k = _TOKENS[text]
             seg = s[off : off + k]
-            if not (seg.isascii() and seg.isdigit()):
+            if len(seg) != k or not (seg.isascii() and seg.isdigit()):
                 return None
             fields[text] = int(seg)
             off += k
+        elif kind == "unp":
+            # greedy 1..k digit run (SimpleDateFormat numeric field)
+            k = _UNPADDED[text]
+            j = off
+            while j < len(s) and j - off < k and s[j].isascii() and s[j].isdigit():
+                j += 1
+            if j == off:
+                return None
+            fields[_UNP_FIELD[text]] = int(s[off:j])
+            off = j
         else:
-            if s[off] != text:
+            if off >= len(s) or s[off] != text:
                 return None
             off += 1
+    if off != len(s):
+        return None
     if not (
         1 <= fields["MM"] <= 12
         and 1 <= fields["dd"] <= 31
@@ -313,6 +362,11 @@ def _parse_cpu(s, pattern):
         and fields["mm"] < 60
         and fields["ss"] < 60
     ):
+        return None
+    # per-month day bound (Feb 29 of a non-leap year must not parse)
+    import calendar
+
+    if fields["dd"] > calendar.monthrange(fields["yyyy"], fields["MM"])[1]:
         return None
 
     def dfc(y, m, d):
@@ -342,7 +396,7 @@ class ToUnixTimestamp(Expression):
 
     def eval(self, ctx: Ctx) -> Val:
         v = self.child.eval(ctx)
-        pattern = parse_pattern(self.fmt.value, for_parse=True)
+        pattern = parse_pattern(self.fmt.value)
         if isinstance(self.child.data_type, (DateType, TimestampType)):
             from .cast import Cast
 
@@ -391,7 +445,7 @@ class ParseToDate(Expression):
 
     def eval(self, ctx: Ctx) -> Val:
         v = self.child.eval(ctx)
-        pattern = parse_pattern(self.fmt.value, for_parse=True)
+        pattern = parse_pattern(self.fmt.value)
         xp = ctx.xp
         if ctx.is_device:
             micros, ok = _parse_device(ctx, v, pattern)

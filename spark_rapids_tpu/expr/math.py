@@ -287,7 +287,36 @@ class _RoundBase(Expression):
                 up = (rem2 > p) | ((rem2 == p) & (x >= 0))
             out = q + up.astype(xp.int64)
             return Val((out * p).astype(dt.np_dtype), c.valid)
-        # double/float: CPU-only (override-gated); java BigDecimal semantics
+        if ctx.is_device:
+            # incompat-gated device path (reference GpuRound/GpuBRound via
+            # cudf round — "may round slightly differently"): arithmetic in
+            # f64 binary, not java BigDecimal's shortest-decimal-repr space,
+            # so decimal-boundary ties can land one ulp differently.
+            x = ctx.broadcast(c.data).astype(xp.float64)
+            if d >= 309:
+                # 10**d overflows float64; every double is unchanged at
+                # this scale (largest exponent span is ±308)
+                return Val(x.astype(dt.np_dtype), c.valid)
+            if d <= -309:
+                # |x|/10**309 < 1 for every finite double: rounds to zero
+                out = xp.where(xp.isfinite(x), xp.zeros_like(x), x)
+                return Val(out.astype(dt.np_dtype), c.valid)
+            if d >= 0:
+                p = float(10 ** d)
+                if self.half_even:
+                    out = xp.round(x * p) / p
+                else:
+                    out = xp.sign(x) * xp.floor(xp.abs(x) * p + 0.5) / p
+            else:
+                q = float(10 ** (-d))
+                if self.half_even:
+                    out = xp.round(x / q) * q
+                else:
+                    out = xp.sign(x) * xp.floor(xp.abs(x) / q + 0.5) * q
+            # NaN/±inf pass through sign*floor unscathed except sign(nan)=nan
+            out = xp.where(xp.isfinite(x), out, x)
+            return Val(out.astype(dt.np_dtype), c.valid)
+        # CPU engine keeps exact java BigDecimal semantics
         import decimal as _dec
 
         data = np.asarray(ctx.broadcast(c.data), dtype=np.float64)

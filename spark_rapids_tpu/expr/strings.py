@@ -1002,8 +1002,10 @@ def like_tokens(pattern: str, escape: str = "\\"):
 @dataclass(frozen=True)
 class Like(Expression):
     """SQL LIKE with literal pattern (reference GpuLike also requires a
-    scalar pattern). ``_`` is bytewise (exact for ASCII; the reference
-    documents the same class of divergence for exotic patterns)."""
+    scalar pattern). ``_`` consumes one CODE POINT (UTF8String semantics):
+    the byte-NFA gives each ``_`` an in-character state that enters on a
+    lead byte, self-loops on continuation bytes, and hands off to the next
+    pattern state only at a character boundary (one-byte lookahead)."""
 
     child: Expression
     pattern: Expression
@@ -1047,25 +1049,48 @@ class Like(Expression):
 
         reach0 = jnp.zeros((n, P + 1), dtype=bool).at[:, 0].set(True)
         reach0 = closure(reach0)
+        # in-character states for '_' tokens (entered on a lead byte,
+        # self-looping on continuation bytes)
+        u0 = jnp.zeros((n, P), dtype=bool)
 
-        def step(reach, i):
+        def step(carry, i):
+            reach, u = carry
             b = jax.lax.dynamic_index_in_dim(data, i, axis=1, keepdims=False)
             within = i < lengths
+            is_cont = (b & 0xC0) == 0x80
+            nb = jnp.where(
+                i + 1 < w,
+                jax.lax.dynamic_index_in_dim(
+                    data, jnp.minimum(i + 1, w - 1), axis=1, keepdims=False
+                ),
+                jnp.zeros_like(b),
+            )
+            # this byte ends its character iff the next in-string byte is
+            # not a continuation byte (or the string ends here)
+            ends = (i + 1 >= lengths) | ((nb & 0xC0) != 0x80)
             new = jnp.zeros((n, P + 1), dtype=bool)
+            u_new = jnp.zeros((n, P), dtype=bool)
             for k in range(P):
                 kind = kinds[k]
                 if kind == 0:
                     t = reach[:, k] & (b == lits[k])
                 elif kind == 1:
-                    t = reach[:, k]
+                    inchar = (reach[:, k] & ~is_cont) | (u[:, k] & is_cont)
+                    u_new = u_new.at[:, k].set(inchar)
+                    t = inchar & ends
                 else:  # '%' consumes via self-loop on the post-% state
                     t = reach[:, k + 1]
                 new = new.at[:, k + 1].set(t)
             new = closure(new)
-            out = jnp.where(within[:, None], new, reach)
-            return out, None
+            keep = within[:, None]
+            return (
+                jnp.where(keep, new, reach),
+                jnp.where(keep, u_new, u),
+            ), None
 
-        reach, _ = jax.lax.scan(step, reach0, jnp.arange(w, dtype=jnp.int32))
+        (reach, _u), _ = jax.lax.scan(
+            step, (reach0, u0), jnp.arange(w, dtype=jnp.int32)
+        )
         return Val(reach[:, P], valid)
 
 

@@ -382,7 +382,10 @@ def sum(c) -> Column:  # noqa: A001 - pyspark parity
 
 
 def count(c="*") -> Column:
-    if c == "*":
+    # isinstance guard first: ``c == "*"`` on a Column builds a comparison
+    # EXPRESSION (truthy), which silently turned count(col) into count(*)
+    # and made COUNT include nulls — caught by the whole-query golden corpus
+    if isinstance(c, str) and c == "*":
         return Column(Count(Literal(1, INT)))
     return Column(Count(_e(c)))
 

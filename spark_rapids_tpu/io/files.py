@@ -408,8 +408,10 @@ class CpuFileScanExec(Exec):
         # pushed-down conjuncts (name, op, literal) — set by the planner
         self.predicates: list = list(options.get("__predicates", ()))
         self.part_schema, self._part_values = discover_partitions(files)
+        self.bucket_spec = options.get("__bucket_spec")
         self.pruned_row_groups = 0
         self.pruned_files = 0
+        self.pruned_buckets = 0
         self._prune_lock = threading.Lock()
 
     @property
@@ -421,7 +423,17 @@ class CpuFileScanExec(Exec):
             self.pruned_row_groups += n
 
     def _surviving_files(self):
-        """(path, partition values) pairs after partition-value pruning."""
+        """(path, partition values) pairs after partition-value and bucket
+        pruning (bucket pruning: GpuFileSourceScanExec.scala:148-149 — when
+        every bucket column carries an equality conjunct, matching rows can
+        only live in the literals' bucket file)."""
+        target = None
+        if self.bucket_spec and self.predicates:
+            from .bucketing import parse_bucket_id, target_bucket
+
+            target = target_bucket(
+                self.bucket_spec, self.predicates, self._schema
+            )
         out = []
         for path, vals in zip(self.files, self._part_values):
             if self.predicates and not partition_value_survives(
@@ -429,6 +441,12 @@ class CpuFileScanExec(Exec):
             ):
                 self.pruned_files += 1
                 continue
+            if target is not None:
+                b = parse_bucket_id(os.path.basename(path))
+                if b is not None and b != target:
+                    self.pruned_files += 1
+                    self.pruned_buckets += 1
+                    continue
             out.append((path, vals))
         return out
 

@@ -152,6 +152,22 @@ class CpuWriteFilesExec(Exec):
         self._data_names = [
             n for n in child_schema.names if n not in self.partition_by
         ]
+        self.bucket_spec = options.get("__bucket_spec")
+        if self.bucket_spec:
+            bad = [
+                c for c in self.bucket_spec["cols"]
+                if c not in child_schema.names or c in self.partition_by
+            ]
+            if bad:
+                raise ValueError(
+                    f"bucketBy columns must be non-partition data columns: {bad}"
+                )
+        self._child_schema = child_schema
+        from ..types import Schema as _Schema
+
+        self._data_schema = _Schema(
+            [f for f in child_schema.fields if f.name in self._data_names]
+        )
 
     @property
     def output(self) -> Schema:
@@ -166,23 +182,44 @@ class CpuWriteFilesExec(Exec):
                 writers: dict = {}
                 run_id = uuid.uuid4().hex[:12]
 
-                def writer_for(subdir: str, schema: pa.Schema) -> _FormatWriter:
-                    w = writers.get(subdir)
+                def writer_for(
+                    subdir: str, schema: pa.Schema, bucket: int = None
+                ) -> _FormatWriter:
+                    key = (subdir, bucket)
+                    w = writers.get(key)
                     if w is None:
                         d = os.path.join(self.path, subdir) if subdir else self.path
                         os.makedirs(d, exist_ok=True)
-                        fname = f"part-{pid:05d}-{run_id}{ext}"
+                        suffix = "" if bucket is None else f"_b{bucket:05d}"
+                        fname = f"part-{pid:05d}-{run_id}{suffix}{ext}"
                         w = _FormatWriter(
                             self.fmt, os.path.join(d, fname), schema, self.w_options
                         )
-                        writers[subdir] = w
+                        writers[key] = w
                     return w
+
+                def write_bucketed(subdir: str, rb2: pa.RecordBatch, schema):
+                    """Route rows to per-bucket files by the exchange's own
+                    hash (io/bucketing.py — keeps bucket placement and
+                    shuffle placement in agreement)."""
+                    from .bucketing import bucket_ids
+
+                    bids = bucket_ids(rb2, schema, self.bucket_spec)
+                    tbl2 = pa.Table.from_batches([rb2])
+                    for b in sorted(set(bids.tolist())):
+                        sub2 = tbl2.filter(pa.array(bids == b))
+                        for srb2 in sub2.combine_chunks().to_batches():
+                            if srb2.num_rows:
+                                writer_for(subdir, srb2.schema, b).write(srb2)
 
                 for rb in thunk():
                     if rb.num_rows == 0:
                         continue
                     if not self.partition_by:
-                        writer_for("", rb.schema).write(rb)
+                        if self.bucket_spec:
+                            write_bucketed("", rb, self._child_schema)
+                        else:
+                            writer_for("", rb.schema).write(rb)
                         continue
                     # dynamic partitioning: group rows by partition tuple
                     # (DynamicPartitionDataWriter's sorted-loop analogue)
@@ -227,7 +264,12 @@ class CpuWriteFilesExec(Exec):
                         )
                         for srb in sub.combine_chunks().to_batches():
                             if srb.num_rows:
-                                writer_for(subdir, srb.schema).write(srb)
+                                if self.bucket_spec:
+                                    write_bucketed(
+                                        subdir, srb, self._data_schema
+                                    )
+                                else:
+                                    writer_for(subdir, srb.schema).write(srb)
                 for w in writers.values():
                     w.close()
                 stats = pa.record_batch(
@@ -261,6 +303,7 @@ class DataFrameWriter:
         self._df = df
         self._mode = "error"
         self._partition_by: List[str] = []
+        self._bucket_spec = None
         self._options: dict = {}
 
     def mode(self, m: str) -> "DataFrameWriter":
@@ -276,6 +319,18 @@ class DataFrameWriter:
         return self
 
     partitionBy = partition_by
+
+    def bucket_by(self, num_buckets: int, *cols: str) -> "DataFrameWriter":
+        """Bucketed layout: rows route to ``num_buckets`` files per task by
+        pmod(murmur3(cols), n); a ``_bucket_spec.json`` sidecar records the
+        spec for the scan's bucket pruning (io/bucketing.py)."""
+        if num_buckets <= 0 or not cols:
+            raise ValueError("bucketBy needs num_buckets > 0 and columns")
+        self._bucket_spec = {"num_buckets": int(num_buckets),
+                             "cols": list(cols)}
+        return self
+
+    bucketBy = bucket_by
 
     def _reads_from(self, path: str) -> bool:
         """True when the DataFrame's plan scans ``path`` (or a file inside
@@ -317,10 +372,17 @@ class DataFrameWriter:
         opts = dict(self._options)
         # shim-routed write semantics (SparkShims seam)
         opts.setdefault("__rebase", session.shim.parquet_rebase_write())
+        if self._bucket_spec:
+            opts["__bucket_spec"] = self._bucket_spec
         lp = L.WriteFiles(
             self._df._plan, path, fmt, list(self._partition_by), opts
         )
         stats = session._execute(lp)
+        if self._bucket_spec:
+            from .bucketing import write_spec
+
+            write_spec(path, self._bucket_spec["num_buckets"],
+                       self._bucket_spec["cols"])
         # driver commit marker (FileFormatWriter's _SUCCESS)
         open(os.path.join(path, "_SUCCESS"), "w").close()
         return stats

@@ -330,3 +330,149 @@ def frame_unpack(data: bytes) -> List[memoryview]:
         raise ValueError("malformed srt frame")
     mv = memoryview(data)
     return [mv[int(o) : int(o) + int(l)] for o, l in zip(offs, lens)]
+
+
+# ---------------------------------------------------------------------------
+# row materialization (native/srt_rows.cc — CudfUnsafeRow.java:399 analogue)
+
+_ROWS_SRC = os.path.join(_REPO, "native", "srt_rows.cc")
+_ROWS_LIB = os.path.join(_REPO, "native", "build", "srt_rows.so")
+_rows_mod = None
+_rows_tried = False
+
+
+def _build_rows() -> bool:
+    import sysconfig
+
+    inc = sysconfig.get_paths()["include"]
+    try:
+        os.makedirs(os.path.dirname(_ROWS_LIB), exist_ok=True)
+        tmp = f"{_ROWS_LIB}.{os.getpid()}.tmp"
+        subprocess.run(
+            ["g++", "-O3", "-fPIC", "-shared", "-std=c++17", f"-I{inc}",
+             "-o", tmp, _ROWS_SRC],
+            check=True,
+            capture_output=True,
+            timeout=120,
+        )
+        os.rename(tmp, _ROWS_LIB)
+        return True
+    except Exception:
+        return False
+
+
+def _load_rows():
+    global _rows_mod, _rows_tried
+    if _rows_mod is not None or _rows_tried:
+        return _rows_mod
+    with _lock:
+        if _rows_mod is not None or _rows_tried:
+            return _rows_mod
+        _rows_tried = True
+        if os.environ.get("SRT_NATIVE_DISABLE"):
+            return None
+        stale = not os.path.exists(_ROWS_LIB) or (
+            os.path.exists(_ROWS_SRC)
+            and os.path.getmtime(_ROWS_SRC) > os.path.getmtime(_ROWS_LIB)
+        )
+        if stale and not _build_rows():
+            return None
+        try:
+            import importlib.machinery
+            import importlib.util
+
+            loader = importlib.machinery.ExtensionFileLoader(
+                "srt_rows", _ROWS_LIB
+            )
+            spec = importlib.util.spec_from_file_location(
+                "srt_rows", _ROWS_LIB, loader=loader
+            )
+            mod = importlib.util.module_from_spec(spec)
+            loader.exec_module(mod)
+            _rows_mod = mod
+        except Exception:
+            _rows_mod = None
+        return _rows_mod
+
+
+_ROWS_PRIM = {
+    "int8": "i8", "int16": "i16", "int32": "i32", "int64": "i64",
+    "float": "f32", "double": "f64",
+}
+
+
+def rows_decode(table) -> Optional[list]:
+    """``collect()``'s row materialization: one C pass assembles the row
+    tuples from columnar buffers (primitives/strings zero-copy; other
+    types pre-converted per column). Returns None when the extension is
+    unavailable so the caller keeps its pure-python path."""
+    if not _enabled:
+        return None
+    mod = _load_rows()
+    if mod is None:
+        return None
+    n = table.num_rows
+    specs = []
+    try:
+        _build_specs(table, specs, n)
+    except Exception:
+        return None  # contract: fall back to the pure-python path
+    try:
+        return mod.decode(specs, n)
+    except Exception:
+        return None
+
+
+def _build_specs(table, specs, n):
+    import pyarrow as pa
+    import pyarrow.compute as pc
+
+    for col in table.columns:
+        a = col.combine_chunks() if col.num_chunks != 1 else col.chunk(0)
+        if isinstance(a, pa.ChunkedArray):  # zero chunks (empty table)
+            a = pa.concat_arrays([c for c in a.chunks]) if a.num_chunks else (
+                pa.array([], type=a.type)
+            )
+        t = a.type
+        valid = None
+        if a.null_count:
+            valid = np.ascontiguousarray(
+                pc.is_valid(a).to_numpy(zero_copy_only=False)
+            ).view(np.uint8)
+        if str(t) in _ROWS_PRIM:
+            # raw data buffer, never to_numpy: a nullable int64 column
+            # would round-trip through float64 there and corrupt values
+            # beyond 2**53 (null slots hold garbage but sit under `valid`)
+            want = {"i8": np.int8, "i16": np.int16, "i32": np.int32,
+                    "i64": np.int64, "f32": np.float32, "f64": np.float64}[
+                        _ROWS_PRIM[str(t)]]
+            buf = a.buffers()[1]
+            data = (
+                np.frombuffer(buf, dtype=want, count=n + a.offset)[a.offset:]
+                if buf is not None and n
+                else np.zeros(n, dtype=want)
+            )
+            specs.append((_ROWS_PRIM[str(t)], data, valid, None, None))
+        elif t == pa.bool_():
+            data = np.ascontiguousarray(
+                a.to_numpy(zero_copy_only=False)
+            )
+            if data.dtype == object:
+                data = np.asarray(
+                    [bool(x) if x is not None else False for x in data]
+                )
+            specs.append(("bool", data.view(np.uint8), valid, None, None))
+        elif t in (pa.string(), pa.large_string()):
+            if t == pa.large_string():
+                a = a.cast(pa.string())
+            bufs = a.buffers()
+            offsets = np.frombuffer(
+                bufs[1], dtype=np.int32,
+                count=n + 1 + a.offset,
+            )[a.offset:].astype(np.int64)
+            data = np.frombuffer(bufs[2], dtype=np.uint8) if bufs[2] else (
+                np.zeros(0, dtype=np.uint8)
+            )
+            specs.append(("str", data, valid, offsets, None))
+        else:
+            specs.append(("obj", None, None, None, a.to_pylist()))

@@ -386,10 +386,13 @@ def _round_check(e, conf: TpuConf) -> Optional[str]:
 
     if not isinstance(e.scale, Literal):
         return "round scale must be a literal for the device path"
-    if not isinstance(e.child.data_type, _IT):
+    if not isinstance(e.child.data_type, _IT) and not cfg.INCOMPATIBLE_OPS.get(conf):
+        # reference gates float round the same way: "may round slightly
+        # differently" under isIncompatEnabled (GpuOverrides.scala:2036-2077)
         return (
-            "round on floating point is CPU-only (java BigDecimal semantics; "
-            "the reference has no GPU Round either)"
+            "round on floating point may round slightly differently than "
+            "Spark's java BigDecimal semantics; enable "
+            "spark.rapids.sql.incompatibleOps.enabled"
         )
     return None
 
@@ -530,8 +533,7 @@ def _fmt_check(e, conf: TpuConf) -> Optional[str]:
         return "datetime pattern must be a string literal"
     # parsers scan fixed offsets, so unpadded single-letter tokens are
     # format-only (ToUnixTimestamp/ParseToDate reject them)
-    for_parse = isinstance(e, (df.ToUnixTimestamp, df.ParseToDate))
-    if not df.pattern_supported(e.fmt.value, for_parse=for_parse):
+    if not df.pattern_supported(e.fmt.value):
         return (
             f"datetime pattern {e.fmt.value!r} is outside the device-"
             "supported token subset (yyyy MM dd HH mm ss + literals; "

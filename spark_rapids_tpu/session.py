@@ -521,23 +521,42 @@ class DataFrameReader:
             out.append(p)
         return tuple(out)
 
+    def _bucket_options(self, paths) -> dict:
+        """Attach the _bucket_spec.json sidecar (one consistent spec across
+        all roots) so the scan can bucket-prune (io/bucketing.py)."""
+        import os
+
+        from .io.bucketing import read_spec
+
+        opts = dict(self._options)
+        specs = [read_spec(p) for p in paths if os.path.isdir(p)]
+        specs = [s for s in specs if s is not None]
+        if specs and all(s == specs[0] for s in specs) and len(specs) == len(
+            [p for p in paths if os.path.isdir(p)]
+        ):
+            opts["__bucket_spec"] = specs[0]
+        return opts
+
     def parquet(self, *paths: str) -> "DataFrame":
         from .io.files import infer_schema, expand_paths
 
-        files = expand_paths(self._rewrite(paths), "parquet")
+        roots = self._rewrite(paths)
+        files = expand_paths(roots, "parquet")
         schema = infer_schema(files, "parquet", self._options)
         return DataFrame(
             self._session,
-            L.FileScan(files, "parquet", schema, dict(self._options)),
+            L.FileScan(files, "parquet", schema, self._bucket_options(roots)),
         )
 
     def orc(self, *paths: str) -> "DataFrame":
         from .io.files import infer_schema, expand_paths
 
-        files = expand_paths(self._rewrite(paths), "orc")
+        roots = self._rewrite(paths)
+        files = expand_paths(roots, "orc")
         schema = infer_schema(files, "orc", self._options)
         return DataFrame(
-            self._session, L.FileScan(files, "orc", schema, dict(self._options))
+            self._session,
+            L.FileScan(files, "orc", schema, self._bucket_options(roots)),
         )
 
     def csv(self, *paths: str, **kwargs) -> "DataFrame":
@@ -1145,6 +1164,11 @@ class DataFrame:
 
     def collect(self) -> List[tuple]:
         t = self.to_arrow()
+        from . import native
+
+        rows = native.rows_decode(t)  # C row assembly (srt_rows.cc)
+        if rows is not None:
+            return rows
         cols = [c.to_pylist() for c in t.columns]
         return [tuple(c[i] for c in cols) for i in range(t.num_rows)]
 

@@ -255,6 +255,9 @@ class Compiler:
         # expression-level subqueries (scalar/IN inside general exprs)
         # resolve against the innermost entry
         self._views_stack: List[dict] = []
+        # correlated SELECT-list scalar subqueries decorrelated by the
+        # pre-pass: ast node id → replacement Column over the joined rel
+        self._scalar_subs: Dict[int, Column] = {}
 
     def _current_views(self) -> dict:
         if self._views_stack:
@@ -532,6 +535,10 @@ class Compiler:
 
         # 3. aggregation / select compilation ----------------------------
         items = self._expand_stars(sel.items, scope)
+        rel2 = self._decorrelate_scalar_selects(items, rel, scope, views)
+        if rel2 is not rel:
+            rel = rel2
+            scope = Scope(rel.entries, outer)
         has_agg = (
             sel.group_by is not None
             or any(_has_aggregate(e) for e, _ in items)
@@ -693,6 +700,75 @@ class Compiler:
                 n.f["r"]
             )
         return [n]
+
+    def _decorrelate_scalar_selects(
+        self, items, rel: Rel, scope: Scope, views
+    ) -> Rel:
+        """Correlated scalar subqueries in the SELECT list: group the inner
+        side by its correlation keys, LEFT JOIN onto the outer rel, and
+        replace the subquery with the joined aggregate column (Spark's
+        RewriteCorrelatedScalarSubquery). COUNT over an empty group is 0,
+        not NULL — the classic count bug — so count-like aggregates ride a
+        post-join coalesce."""
+        from ..expr.aggregates import Count
+
+        for e, _name in items:
+            for node in _walk(e):
+                if node.kind != "scalar_query":
+                    continue
+                if id(node) in self._scalar_subs:
+                    continue
+                q = node.f["query"]
+                try:
+                    inner_rel, keys, residual, inner_scope, isel = (
+                        self._subquery_parts(q, views, scope)
+                    )
+                except SqlError:
+                    continue  # shape the splitter can't take apart: the
+                    # uncorrelated path will compile it (or error honestly)
+                if not keys and not residual:
+                    continue  # uncorrelated: normal scalar_subquery path
+                if residual:
+                    raise SqlError(
+                        "correlated scalar subquery supports only equality "
+                        "correlation"
+                    )
+                if len(isel.items) != 1:
+                    raise SqlError(
+                        "scalar subquery must select exactly one column"
+                    )
+                if isel.group_by or isel.distinct or isel.having:
+                    raise SqlError(
+                        "unsupported correlated scalar subquery shape"
+                    )
+                item_ast, _alias = isel.items[0]
+                if item_ast.kind != "func":
+                    raise SqlError(
+                        "correlated scalar subquery must select one "
+                        "aggregate"
+                    )
+                agg_col = self.compile_agg_func(item_ast, inner_scope)
+                i = next(self._uid)
+                vname = f"__sq{i}_v"
+                knames = [f"__sq{i}_k{j}" for j in range(len(keys))]
+                gdf = inner_rel.df.group_by(
+                    *[Column(ie).alias(kn)
+                      for (_oe, ie), kn in zip(keys, knames)]
+                ).agg(agg_col.alias(vname))
+                left_df, onames = rel.df, []
+                for j, (oe, _ie) in enumerate(keys):
+                    on_ = f"__sq{i}_o{j}"
+                    left_df = left_df.with_column(on_, Column(oe))
+                    onames.append(on_)
+                joined = left_df.join(
+                    gdf, on=list(zip(onames, knames)), how="left"
+                )
+                val = col(vname)
+                if isinstance(agg_col.expr, Count):
+                    val = F.coalesce(val, lit(0))
+                self._scalar_subs[id(node)] = val
+                rel = Rel(joined, rel.entries)
+        return rel
 
     def _subquery_parts(self, q: QueryExpr, views, outer_scope: Scope):
         """Compile a (possibly correlated) subquery's FROM+WHERE. Returns
@@ -1439,6 +1515,9 @@ class Compiler:
             c = self.compile_expr(f["e"], scope).isin(inner)
             return ~c if f["negated"] else c
         if k == "scalar_query":
+            hit = self._scalar_subs.get(id(n))
+            if hit is not None:  # decorrelated by the SELECT-list pre-pass
+                return hit
             inner = self.compile_query(f["query"], self._current_views(), None).df
             return F.scalar_subquery(inner)
         if k == "case":
@@ -1680,16 +1759,31 @@ class Compiler:
         if name == "cume_dist":
             return F.cume_dist()
         if name == "ntile":
-            return F.ntile(args[0].f["value"])
+            return F.ntile(self._lit_arg(args[0], scope, "ntile"))
         if name in ("lag", "lead"):
             c = self.compile_expr(args[0], scope)
-            offset = args[1].f["value"] if len(args) > 1 else 1
+            offset = (
+                self._lit_arg(args[1], scope, name) if len(args) > 1 else 1
+            )
             default = None
             if len(args) > 2:
-                default = args[2].f["value"]
+                default = self._lit_arg(args[2], scope, name)
             fn = F.lag if name == "lag" else F.lead
             return fn(c, offset, default)
         raise SqlError(f"unknown window function {name!r}")
+
+    def _lit_arg(self, node: Node, scope: Scope, fname: str):
+        """Literal argument value — folds signs (LEAD(x, 1, -1) parses the
+        default as unary minus over a literal, not a literal node)."""
+        from ..expr.arithmetic import UnaryMinus
+        from ..expr.base import Literal
+
+        e = self.compile_expr(node, scope).expr
+        if isinstance(e, Literal):
+            return e.value
+        if isinstance(e, UnaryMinus) and isinstance(e.child, Literal):
+            return -e.child.value
+        raise SqlError(f"{fname} argument must be a literal")
 
     def compile_window(self, n: Node, scope: Scope) -> Column:
         fn_ast = n.f["fn"]

@@ -584,6 +584,547 @@ def build_arith():
     return cases
 
 
+def spark_substring(s: str, pos: int, length: int) -> str:
+    """UTF8String.substringSQL: 1-based char positions, pos<=0 quirks,
+    negative pos counts from the end; start clamps at 0 (so a window that
+    begins before the string keeps its absolute END: substring('abc',-5,4)
+    = s[0:max(0,-2+4)] = 'ab')."""
+    n = len(s)
+    start = pos - 1 if pos > 0 else (n + pos if pos < 0 else 0)
+    end = start + length
+    return s[max(0, start):max(0, end)] if length > 0 else ""
+
+
+def spark_locate(sub: str, s: str, pos: int) -> int:
+    """StringLocate: 1-based char result, 0 when absent or pos < 1
+    (UTF8String.indexOf over code points)."""
+    if pos < 1:
+        return 0
+    if sub == "":
+        return pos if pos <= len(s) + 1 else 0
+    i = s.find(sub, pos - 1)
+    return i + 1
+
+
+def spark_initcap(s: str) -> str:
+    """InitCap: lowercase everything, then uppercase the first letter of
+    each space-separated word (single-space separator, like UTF8String
+    .toTitleCase + toLowerCase)."""
+    return " ".join(
+        w[:1].upper() + w[1:] if w else w for w in s.lower().split(" ")
+    )
+
+
+def spark_pad(s: str, ln: int, pad: str, left: bool) -> str:
+    """UTF8String.lpad/rpad: char-count semantics; truncates when the
+    target is shorter; empty pad returns the (possibly truncated) input."""
+    if ln <= 0:
+        return ""
+    if len(s) >= ln:
+        return s[:ln]
+    if pad == "":
+        return s
+    fill = (pad * ((ln - len(s)) // len(pad) + 1))[: ln - len(s)]
+    return fill + s if left else s + fill
+
+
+def spark_substring_index(s: str, delim: str, count: int) -> str:
+    """SubstringIndex (MySQL semantics)."""
+    if delim == "" or count == 0:
+        return ""
+    parts = s.split(delim)
+    if count > 0:
+        return delim.join(parts[:count])
+    return delim.join(parts[count:])
+
+
+def spark_translate(s: str, frm: str, to: str) -> str:
+    """StringTranslate: first occurrence of a char in ``frm`` wins; chars
+    beyond ``to``'s length are deleted."""
+    m: dict = {}
+    for i, ch in enumerate(frm):
+        if ch not in m:
+            m[ch] = to[i] if i < len(to) else None  # None = delete
+    out = []
+    for ch in s:
+        if ch not in m:
+            out.append(ch)
+        elif m[ch] is not None:
+            out.append(m[ch])
+    return "".join(out)
+
+
+def build_strings():
+    """UTF-8 string-kernel fixtures: code-point semantics over multi-byte
+    data — exactly where byte-plane engines and Spark's UTF8String can
+    disagree (VERDICT r4 Missing #4). Case ops stay ASCII: non-ASCII case
+    mapping is a documented bytewise divergence (docs/compatibility.md)."""
+    cases = []
+    # multi-byte workhorses: 1B ascii, 2B é/ü, 3B 中/€, 4B 𝄞 (U+1D11E)
+    S = ["", "a", "abc", "héllo", "中文字符", "a€b€c", "𝄞music", "mix中é𝄞!",
+         "  padded  ", "tab\there", "a" * 40, "日本語のテキスト"]
+    for s in S:
+        cases.append({"op": "length", "input": s, "expected": len(s)})
+        cases.append({"op": "reverse", "input": s, "expected": s[::-1]})
+        if s:
+            cases.append({"op": "ascii", "input": s, "expected": ord(s[0])})
+    cases.append({"op": "ascii", "input": "", "expected": 0})
+    for s in ["héllo", "中文字符", "𝄞music", "abcdef", "ab", ""]:
+        for pos in (-7, -3, -1, 0, 1, 2, 4, 7):
+            for ln in (0, 1, 2, 5):
+                cases.append({
+                    "op": "substring", "input": s, "pos": pos, "len": ln,
+                    "expected": spark_substring(s, pos, ln),
+                })
+    for sub, s, pos in [
+        ("l", "héllo", 1), ("l", "héllo", 4), ("l", "héllo", 5),
+        ("文", "中文字符", 1), ("字符", "中文字符", 2), ("中", "中文字符", 2),
+        ("€", "a€b€c", 1), ("€", "a€b€c", 3), ("missing", "héllo", 1),
+        ("music", "𝄞music", 1), ("𝄞", "𝄞music", 1), ("", "abc", 1),
+        ("", "abc", 3), ("a", "", 1), ("", "", 1), ("o", "héllo", 0),
+        ("o", "héllo", -2),
+    ]:
+        cases.append({"op": "locate", "sub": sub, "input": s, "pos": pos,
+                      "expected": spark_locate(sub, s, pos)})
+    for s in ["hello world", "HELLO", "miXed CaSe words", "a1b c2d", "",
+              " lead trail ", "one  two"]:
+        cases.append({"op": "upper", "input": s, "expected": s.upper()})
+        cases.append({"op": "lower", "input": s, "expected": s.lower()})
+        cases.append({"op": "initcap", "input": s,
+                      "expected": spark_initcap(s)})
+    for s, ln, pad in [
+        ("abc", 6, "*"), ("abc", 6, "xy"), ("abc", 2, "*"), ("abc", 3, "*"),
+        ("中文", 5, "文"), ("中文", 4, "ab"), ("", 3, "z"), ("abc", 0, "*"),
+        ("é", 4, "𝄞"), ("abc", 6, ""),
+    ]:
+        cases.append({"op": "lpad", "input": s, "n": ln, "pad": pad,
+                      "expected": spark_pad(s, ln, pad, True)})
+        cases.append({"op": "rpad", "input": s, "n": ln, "pad": pad,
+                      "expected": spark_pad(s, ln, pad, False)})
+    for s, d, c in [
+        ("a.b.c.d", ".", 2), ("a.b.c.d", ".", -2), ("a.b.c.d", ".", 0),
+        ("a.b.c.d", ".", 9), ("a.b.c.d", ".", -9), ("www.a.com", ".", 1),
+        ("中:文:字", ":", 2), ("a€b€c", "€", -1), ("nodelim", ".", 3),
+        ("", ".", 1), ("a..b", ".", 2), ("a..b", "..", 1),
+    ]:
+        cases.append({"op": "substring_index", "input": s, "delim": d,
+                      "count": c, "expected": spark_substring_index(s, d, c)})
+    for s, a, b in [
+        ("hello", "l", "L"), ("hello", "helo", "HELO"), ("abcba", "ab", "ba"),
+        ("中文中", "中", "外"), ("aaa", "a", ""), ("mix", "", "x"),
+        ("translate", "rnlt", "123"),
+    ]:
+        cases.append({"op": "translate", "input": s, "frm": a, "to": b,
+                      "expected": spark_translate(s, a, b)})
+    for s, a, b in [
+        ("hello", "l", "L"), ("ababab", "ab", "c"), ("aaa", "aa", "b"),
+        ("中文字", "文", "letters"), ("none", "x", "y"), ("aaaa", "a", "aa"),
+    ]:
+        # StringReplace: non-overlapping left-to-right replacement
+        cases.append({"op": "replace", "input": s, "search": a, "repl": b,
+                      "expected": s.replace(a, b)})
+    for s, n in [("ab", 3), ("中", 4), ("", 5), ("xy", 0), ("xy", -1)]:
+        cases.append({"op": "repeat", "input": s, "n": n,
+                      "expected": s * n if n > 0 else ""})
+    for s in ["  trim me  ", "\t tab ", "no-trim", "   ", "", " 中文 "]:
+        # Spark trim family strips SPACES only (0x20), not java whitespace
+        cases.append({"op": "trim", "input": s, "expected": s.strip(" ")})
+        cases.append({"op": "ltrim", "input": s, "expected": s.lstrip(" ")})
+        cases.append({"op": "rtrim", "input": s, "expected": s.rstrip(" ")})
+    for s, pre in [("héllo", "hé"), ("héllo", "llo"), ("中文", "中"),
+                   ("中文", "文"), ("abc", ""), ("", "a"), ("𝄞m", "𝄞")]:
+        cases.append({"op": "startswith", "input": s, "pre": pre,
+                      "expected": s.startswith(pre)})
+        cases.append({"op": "endswith", "input": s, "pre": pre,
+                      "expected": s.endswith(pre)})
+        cases.append({"op": "contains", "input": s, "pre": pre,
+                      "expected": pre in s})
+    # LIKE over multi-byte data: _ is ONE character, % any run; \\ escapes
+    for s, pat, exp in [
+        ("héllo", "h_llo", True), ("héllo", "h%o", True),
+        ("héllo", "hello", False), ("中文字符", "中%", True),
+        ("中文字符", "_文__", True), ("中文字符", "_文", False),
+        ("a€c", "a_c", True), ("𝄞m", "_m", True), ("", "%", True),
+        ("", "_", False), ("a%b", "a\\%b", True), ("axb", "a\\%b", False),
+        ("50%", "%\\%", True), ("abc", "%", True), ("abc", "a%", True),
+        ("abc", "%c", True), ("abc", "%b%", True), ("abc", "_b_", True),
+    ]:
+        cases.append({"op": "like", "input": s, "pat": pat, "expected": exp})
+    # concat_ws skips NULLs (Spark semantics), keeps empties
+    for sep, parts, exp in [
+        (",", ["a", "b", "c"], "a,b,c"),
+        ("-", ["x", None, "z"], "x-z"),
+        ("", ["a", "b"], "ab"),
+        ("·", ["中", "文"], "中·文"),
+        (",", [None, None], ""),
+        (",", ["", "b"], ",b"),
+    ]:
+        cases.append({"op": "concat_ws", "sep": sep, "parts": parts,
+                      "expected": exp})
+    # split (limit -1: trailing empties KEPT) indexed via element_at
+    for s, d, idx, exp in [
+        ("a,b,c", ",", 1, "a"), ("a,b,c", ",", 3, "c"),
+        ("a,b,", ",", 3, ""), (",a", ",", 1, ""), ("中-文", "-", 2, "文"),
+        ("one", ",", 1, "one"),
+    ]:
+        cases.append({"op": "split_at", "input": s, "delim": d, "idx": idx,
+                      "expected": exp})
+    return cases
+
+
+def build_datetime_fmt():
+    """Datetime format-token round trips (VERDICT r4 Missing #4): every
+    supported date_format token over edge instants, unix_timestamp parse ↔
+    format inverses, from_unixtime, to_date with patterns. Oracle: python
+    datetime (proleptic Gregorian — same calendar Spark 3.x uses)."""
+    cases = []
+    instants = [
+        dt.datetime(1969, 12, 31, 23, 59, 59, tzinfo=dt.timezone.utc),
+        dt.datetime(1970, 1, 1, 0, 0, 0, tzinfo=dt.timezone.utc),
+        dt.datetime(2000, 2, 29, 12, 34, 56, tzinfo=dt.timezone.utc),
+        dt.datetime(1999, 12, 31, 23, 0, 1, tzinfo=dt.timezone.utc),
+        dt.datetime(2038, 1, 19, 3, 14, 7, tzinfo=dt.timezone.utc),
+        dt.datetime(1900, 1, 1, 6, 7, 8, tzinfo=dt.timezone.utc),
+        dt.datetime(2024, 7, 4, 1, 2, 3, tzinfo=dt.timezone.utc),
+        dt.datetime(1582, 10, 15, 10, 20, 30, tzinfo=dt.timezone.utc),
+    ]
+    pats = [
+        ("yyyy-MM-dd HH:mm:ss", "%Y-%m-%d %H:%M:%S"),
+        ("yyyy/MM/dd", "%Y/%m/%d"),
+        ("dd.MM.yyyy", "%d.%m.%Y"),
+        ("HH:mm", "%H:%M"),
+        ("yyyyMMdd", "%Y%m%d"),
+        ("ss mm HH", "%S %M %H"),
+    ]
+    for t in instants:
+        us = int(t.timestamp() * 1_000_000)
+        for spark_pat, py_pat in pats:
+            cases.append({"op": "date_format", "input": us, "fmt": spark_pat,
+                          "expected": t.strftime(py_pat)})
+        # unpadded tokens
+        cases.append({"op": "date_format", "input": us, "fmt": "d/M/yyyy",
+                      "expected": f"{t.day}/{t.month}/{t.year}"})
+        cases.append({"op": "date_format", "input": us, "fmt": "H:m:s",
+                      "expected": f"{t.hour}:{t.minute}:{t.second}"})
+    # parse round trip: to_unix_timestamp(format(t)) == epoch seconds
+    for t in instants:
+        us = int(t.timestamp() * 1_000_000)
+        s = t.strftime("%Y-%m-%d %H:%M:%S")
+        cases.append({"op": "to_unix_timestamp", "input": s,
+                      "fmt": "yyyy-MM-dd HH:mm:ss",
+                      "expected": us // 1_000_000})
+        cases.append({"op": "from_unixtime", "input": us // 1_000_000,
+                      "fmt": "yyyy-MM-dd HH:mm:ss", "expected": s})
+    # alternate-layout parses incl. unpadded fields
+    for s, fmt, t in [
+        ("31/12/1999 23:59", "dd/MM/yyyy HH:mm",
+         dt.datetime(1999, 12, 31, 23, 59, tzinfo=dt.timezone.utc)),
+        ("19990131", "yyyyMMdd",
+         dt.datetime(1999, 1, 31, tzinfo=dt.timezone.utc)),
+        ("2020.06.15 06", "yyyy.MM.dd HH",
+         dt.datetime(2020, 6, 15, 6, tzinfo=dt.timezone.utc)),
+        ("7/4/2024 9:8:7", "M/d/yyyy H:m:s",
+         dt.datetime(2024, 7, 4, 9, 8, 7, tzinfo=dt.timezone.utc)),
+    ]:
+        cases.append({"op": "to_unix_timestamp", "input": s, "fmt": fmt,
+                      "expected": int(t.timestamp())})
+    # invalid parses → NULL
+    for s, fmt in [
+        ("2020-13-01 00:00:00", "yyyy-MM-dd HH:mm:ss"),
+        ("2019-02-29 00:00:00", "yyyy-MM-dd HH:mm:ss"),
+        ("garbage", "yyyy-MM-dd HH:mm:ss"),
+        ("2020-01-01", "yyyy-MM-dd HH:mm:ss"),
+        ("2020-01-01 25:00:00", "yyyy-MM-dd HH:mm:ss"),
+        ("2020-01-01 00:61:00", "yyyy-MM-dd HH:mm:ss"),
+    ]:
+        cases.append({"op": "to_unix_timestamp", "input": s, "fmt": fmt,
+                      "expected": None})
+    # to_date with explicit patterns
+    epoch = dt.date(1970, 1, 1)
+    for s, fmt, d in [
+        ("1999/12/31", "yyyy/MM/dd", dt.date(1999, 12, 31)),
+        ("05.01.2020", "dd.MM.yyyy", dt.date(2020, 1, 5)),
+        ("20240229", "yyyyMMdd", dt.date(2024, 2, 29)),
+        ("20230229", "yyyyMMdd", None),
+        ("3/7/2021", "d/M/yyyy", dt.date(2021, 7, 3)),
+    ]:
+        cases.append({"op": "to_date_fmt", "input": s, "fmt": fmt,
+                      "expected": None if d is None else (d - epoch).days})
+    # date_format sweep: every day-of-month and month boundary of one year
+    d0 = dt.date(2021, 1, 1)
+    for off in range(0, 365, 13):
+        d = d0 + dt.timedelta(days=off)
+        t = dt.datetime(d.year, d.month, d.day, tzinfo=dt.timezone.utc)
+        us = int(t.timestamp() * 1_000_000)
+        cases.append({"op": "date_format", "input": us, "fmt": "yyyy-MM-dd",
+                      "expected": d.isoformat()})
+        cases.append({
+            "op": "to_unix_timestamp", "input": d.isoformat() + " 12:00:00",
+            "fmt": "yyyy-MM-dd HH:mm:ss",
+            "expected": int(t.timestamp()) + 12 * 3600,
+        })
+    return cases
+
+
+def build_queries():
+    """Whole-query fixtures (VERDICT r4 Weak #3): tiny literal inputs, SQL
+    text, and expected rows computed HERE by explicit python that implements
+    the SQL-spec semantics directly (nested loops for joins, explicit null
+    rules) — independent of both engines' planners/kernels. Engine-vs-engine
+    differential testing cannot catch a bug both engines share; these can.
+
+    Expected rows are stored SORTED by their repr unless ``ordered``; the
+    runner sorts engine output the same way before comparing."""
+    q = []
+
+    def add(name, tables, sql, expected, ordered=False):
+        q.append({"name": name, "tables": tables, "sql": sql,
+                  "expected": expected, "ordered": ordered})
+
+    def T(schema, rows):
+        return {"schema": schema, "rows": rows}
+
+    # ── outer joins: null keys never match; unmatched rows null-extend ──
+    L = T([["k", "int"], ["a", "string"]],
+          [[1, "l1"], [2, "l2"], [2, "l2b"], [None, "ln"], [5, "l5"]])
+    R = T([["k", "int"], ["b", "string"]],
+          [[2, "r2"], [2, "r2b"], [3, "r3"], [None, "rn"]])
+
+    def join_rows(jt):
+        lrows, rrows = L["rows"], R["rows"]
+        out, lmatched, rmatched = [], set(), set()
+        for i, (lk, la) in enumerate(lrows):
+            for j, (rk, rb) in enumerate(rrows):
+                if lk is not None and rk is not None and lk == rk:
+                    out.append([lk, la, rk, rb])
+                    lmatched.add(i)
+                    rmatched.add(j)
+        if jt in ("left", "full"):
+            out += [[lk, la, None, None]
+                    for i, (lk, la) in enumerate(lrows) if i not in lmatched]
+        if jt in ("right", "full"):
+            out += [[None, None, rk, rb]
+                    for j, (rk, rb) in enumerate(rrows) if j not in rmatched]
+        return out
+
+    for jt, kw in [("inner", "JOIN"), ("left", "LEFT JOIN"),
+                   ("right", "RIGHT JOIN"), ("full", "FULL OUTER JOIN")]:
+        add(f"join_{jt}_nullkeys", {"l": L, "r": R},
+            f"SELECT l.k, l.a, r.k, r.b FROM l {kw} r ON l.k = r.k",
+            join_rows(jt))
+
+    # semi/anti: existence semantics; null probe keys never match → anti keeps
+    add("join_semi", {"l": L, "r": R},
+        "SELECT l.k, l.a FROM l LEFT SEMI JOIN r ON l.k = r.k",
+        [[2, "l2"], [2, "l2b"]])
+    add("join_anti", {"l": L, "r": R},
+        "SELECT l.k, l.a FROM l LEFT ANTI JOIN r ON l.k = r.k",
+        [[1, "l1"], [None, "ln"], [5, "l5"]])
+    # NOT IN with a NULL in the subquery result → NO rows (three-valued logic)
+    add("not_in_null_subquery", {"l": L, "r": R},
+        "SELECT l.k FROM l WHERE l.k NOT IN (SELECT r.k FROM r)", [])
+    # IN matches only non-null equalities
+    add("in_subquery", {"l": L, "r": R},
+        "SELECT l.k, l.a FROM l WHERE l.k IN (SELECT r.k FROM r)",
+        [[2, "l2"], [2, "l2b"]])
+    # joins on empty sides
+    E = T([["k", "int"], ["b", "string"]], [])
+    add("join_left_empty_build", {"l": L, "r": E},
+        "SELECT l.k, l.a, r.b FROM l LEFT JOIN r ON l.k = r.k",
+        [[lk, la, None] for lk, la in L["rows"]])
+    add("join_inner_empty_build", {"l": L, "r": E},
+        "SELECT l.k, l.a, r.b FROM l JOIN r ON l.k = r.k", [])
+    add("join_full_empty_probe", {"l": E, "r": R},
+        "SELECT l.k, r.k, r.b FROM l FULL OUTER JOIN r ON l.k = r.k",
+        [[None, rk, rb] for rk, rb in R["rows"]])
+
+    # ── aggregation semantics ──
+    G = T([["g", "string"], ["x", "int"]],
+          [["a", 1], ["a", 2], ["b", None], ["b", 4], [None, 5], [None, 6],
+           ["c", None]])
+    # empty-input global aggregate returns ONE row: count 0, sum/avg NULL
+    add("agg_global_empty", {"t": T([["x", "int"]], [])},
+        "SELECT COUNT(*), COUNT(x), SUM(x), AVG(x), MIN(x), MAX(x) FROM t",
+        [[0, 0, None, None, None, None]])
+    # NULL group keys group together; count(x) skips nulls; avg is double
+    add("agg_group_nulls", {"t": G},
+        "SELECT g, COUNT(*), COUNT(x), SUM(x), AVG(x) FROM t GROUP BY g",
+        [["a", 2, 2, 3, 1.5], ["b", 2, 1, 4, 4.0], [None, 2, 2, 11, 5.5],
+         ["c", 1, 0, None, None]])
+    # all-null group: SUM/MIN/MAX NULL, COUNT(col) 0
+    add("agg_distinct", {"t": T([["g", "string"], ["x", "int"]],
+                                [["a", 1], ["a", 1], ["a", 2], ["b", None],
+                                 ["b", 3], ["b", 3]])},
+        "SELECT g, COUNT(DISTINCT x), SUM(DISTINCT x) FROM t GROUP BY g",
+        [["a", 2, 3], ["b", 1, 3]])
+    add("agg_having", {"t": G},
+        "SELECT g, SUM(x) AS s FROM t GROUP BY g HAVING SUM(x) > 3",
+        [["b", 4], [None, 11]])
+    # HAVING over a global aggregate that filters everything out
+    add("agg_having_empty", {"t": G},
+        "SELECT SUM(x) AS s FROM t HAVING SUM(x) > 100", [])
+
+    # ── grouping sets / rollup / cube: null markers + GROUPING() bits ──
+    S = T([["a", "string"], ["b", "string"], ["x", "int"]],
+          [["a1", "b1", 1], ["a1", "b2", 2], ["a2", "b1", 4]])
+    add("rollup_basic", {"t": S},
+        "SELECT a, b, SUM(x) FROM t GROUP BY ROLLUP(a, b)",
+        [["a1", "b1", 1], ["a1", "b2", 2], ["a2", "b1", 4],
+         ["a1", None, 3], ["a2", None, 4], [None, None, 7]])
+    add("cube_basic", {"t": S},
+        "SELECT a, b, SUM(x) FROM t GROUP BY CUBE(a, b)",
+        [["a1", "b1", 1], ["a1", "b2", 2], ["a2", "b1", 4],
+         ["a1", None, 3], ["a2", None, 4],
+         [None, "b1", 5], [None, "b2", 2], [None, None, 7]])
+    add("grouping_sets_id", {"t": S},
+        "SELECT a, b, GROUPING(a), GROUPING(b), SUM(x) FROM t "
+        "GROUP BY GROUPING SETS ((a), (b), ())",
+        [["a1", None, 0, 1, 3], ["a2", None, 0, 1, 4],
+         [None, "b1", 1, 0, 5], [None, "b2", 1, 0, 2],
+         [None, None, 1, 1, 7]])
+    # rollup groups a REAL null key separately from the rollup marker
+    SN = T([["a", "string"], ["x", "int"]], [["a1", 1], [None, 2], [None, 4]])
+    add("rollup_real_null_key", {"t": SN},
+        "SELECT a, GROUPING(a), SUM(x) FROM t GROUP BY ROLLUP(a)",
+        [["a1", 0, 1], [None, 0, 6], [None, 1, 7]])
+
+    # ── window semantics ──
+    W = T([["p", "string"], ["o", "int"], ["x", "int"]],
+          [["a", 1, 10], ["a", 2, 20], ["a", 2, 30], ["a", 3, 40],
+           ["b", 1, 5], ["b", 2, None]])
+    # default frame with ORDER BY = RANGE UNBOUNDED..CURRENT: PEERS included
+    add("window_default_frame_peers", {"t": W},
+        "SELECT p, o, x, SUM(x) OVER (PARTITION BY p ORDER BY o) FROM t",
+        [["a", 1, 10, 10], ["a", 2, 20, 60], ["a", 2, 30, 60],
+         ["a", 3, 40, 100], ["b", 1, 5, 5], ["b", 2, None, 5]])
+    # rank family on ties
+    add("window_rank_ties", {"t": W},
+        "SELECT p, o, RANK() OVER (PARTITION BY p ORDER BY o), "
+        "DENSE_RANK() OVER (PARTITION BY p ORDER BY o) FROM t",
+        [["a", 1, 1, 1], ["a", 2, 2, 2], ["a", 2, 2, 2], ["a", 3, 4, 3],
+         ["b", 1, 1, 1], ["b", 2, 2, 2]])
+    # explicit ROWS frame excludes peers
+    add("window_rows_frame", {"t": W},
+        "SELECT p, o, SUM(x) OVER (PARTITION BY p ORDER BY o, x "
+        "ROWS BETWEEN 1 PRECEDING AND CURRENT ROW) FROM t",
+        [["a", 1, 10], ["a", 2, 30], ["a", 2, 50], ["a", 3, 70],
+         ["b", 1, 5], ["b", 2, 5]])
+    # lead/lag defaults NULL; explicit default fills
+    add("window_lead_lag", {"t": W},
+        "SELECT p, o, x, LAG(x) OVER (PARTITION BY p ORDER BY o, x), "
+        "LEAD(x, 1, -1) OVER (PARTITION BY p ORDER BY o, x) FROM t",
+        [["a", 1, 10, None, 20], ["a", 2, 20, 10, 30],
+         ["a", 2, 30, 20, 40], ["a", 3, 40, 30, -1],
+         ["b", 1, 5, None, None], ["b", 2, None, 5, -1]])
+    # window with no ORDER BY: whole-partition frame
+    add("window_unordered", {"t": W},
+        "SELECT p, x, SUM(x) OVER (PARTITION BY p) FROM t",
+        [["a", 10, 100], ["a", 20, 100], ["a", 30, 100], ["a", 40, 100],
+         ["b", 5, 5], ["b", None, 5]])
+    # RANGE frame over numeric ORDER BY values
+    add("window_range_numeric", {"t": W},
+        "SELECT p, o, SUM(x) OVER (PARTITION BY p ORDER BY o "
+        "RANGE BETWEEN 1 PRECEDING AND CURRENT ROW) FROM t",
+        [["a", 1, 10], ["a", 2, 60], ["a", 2, 60], ["a", 3, 90],
+         ["b", 1, 5], ["b", 2, 5]])
+
+    # ── set operations ──
+    U1 = T([["x", "int"], ["y", "string"]], [[1, "a"], [2, "b"], [2, "b"],
+                                             [None, "n"]])
+    U2 = T([["x", "int"], ["y", "string"]], [[2, "b"], [3, "c"], [None, "n"]])
+    # UNION dedups (nulls equal for dedup purposes)
+    add("union_dedup", {"t1": U1, "t2": U2},
+        "SELECT x, y FROM t1 UNION SELECT x, y FROM t2",
+        [[1, "a"], [2, "b"], [None, "n"], [3, "c"]])
+    add("union_all", {"t1": U1, "t2": U2},
+        "SELECT x, y FROM t1 UNION ALL SELECT x, y FROM t2",
+        [[1, "a"], [2, "b"], [2, "b"], [None, "n"], [2, "b"], [3, "c"],
+         [None, "n"]])
+    add("intersect_nulls", {"t1": U1, "t2": U2},
+        "SELECT x, y FROM t1 INTERSECT SELECT x, y FROM t2",
+        [[2, "b"], [None, "n"]])
+    add("except_nulls", {"t1": U1, "t2": U2},
+        "SELECT x, y FROM t1 EXCEPT SELECT x, y FROM t2",
+        [[1, "a"]])
+
+    # ── null comparison / conditional semantics ──
+    N = T([["x", "int"], ["y", "int"]],
+          [[1, 1], [1, 2], [None, 1], [1, None], [None, None]])
+    # NULL = NULL is NULL → WHERE drops it; <=> (not tested) would keep
+    add("where_null_eq", {"t": N},
+        "SELECT x, y FROM t WHERE x = y", [[1, 1]])
+    add("where_null_neq", {"t": N},
+        "SELECT x, y FROM t WHERE x <> y", [[1, 2]])
+    # CASE WHEN NULL condition → ELSE branch; COALESCE first non-null
+    add("case_when_null", {"t": N},
+        "SELECT x, y, CASE WHEN x = y THEN 'eq' WHEN x < y THEN 'lt' "
+        "ELSE 'other' END, COALESCE(x, y, -1) FROM t",
+        [[1, 1, "eq", 1], [1, 2, "lt", 1], [None, 1, "other", 1],
+         [1, None, "other", 1], [None, None, "other", -1]])
+    # IS DISTINCT FROM-style filtering via IS NULL predicates
+    add("is_null_filters", {"t": N},
+        "SELECT x, y FROM t WHERE x IS NULL AND y IS NOT NULL", [[None, 1]])
+    # DISTINCT over rows with nulls: null rows dedup together
+    add("select_distinct_nulls", {"t": N},
+        "SELECT DISTINCT x FROM t", [[1], [None]])
+
+    # ── ordering semantics: ASC nulls FIRST, DESC nulls LAST (Spark) ──
+    O = T([["x", "int"]], [[3], [None], [1], [2], [None]])
+    add("orderby_asc_nulls_first", {"t": O},
+        "SELECT x FROM t ORDER BY x",
+        [[None], [None], [1], [2], [3]], ordered=True)
+    add("orderby_desc_nulls_last", {"t": O},
+        "SELECT x FROM t ORDER BY x DESC",
+        [[3], [2], [1], [None], [None]], ordered=True)
+    add("orderby_limit", {"t": O},
+        "SELECT x FROM t ORDER BY x DESC LIMIT 2", [[3], [2]], ordered=True)
+    add("orderby_nulls_override", {"t": O},
+        "SELECT x FROM t ORDER BY x ASC NULLS LAST",
+        [[1], [2], [3], [None], [None]], ordered=True)
+
+    # ── arithmetic/division in query context ──
+    add("int_division_null", {"t": T([["a", "int"], ["b", "int"]],
+                                     [[7, 2], [7, 0], [None, 2]])},
+        "SELECT a / b, a % b FROM t",
+        [[3.5, 1], [None, None], [None, None]])
+    # integer avg keeps fractional part (double result)
+    add("avg_int_double", {"t": T([["x", "int"]], [[1], [2], [2]])},
+        "SELECT AVG(x) FROM t", [[5.0 / 3.0]])
+
+    # ── scalar subquery ──
+    add("scalar_subquery", {"l": L, "r": R},
+        "SELECT l.k, (SELECT MAX(r.k) FROM r) FROM l WHERE l.k = 1",
+        [[1, 3]])
+    # correlated EXISTS
+    add("exists_correlated", {"l": L, "r": R},
+        "SELECT l.k, l.a FROM l WHERE EXISTS "
+        "(SELECT 1 FROM r WHERE r.k = l.k)",
+        [[2, "l2"], [2, "l2b"]])
+    add("not_exists_correlated", {"l": L, "r": R},
+        "SELECT l.k, l.a FROM l WHERE NOT EXISTS "
+        "(SELECT 1 FROM r WHERE r.k = l.k)",
+        [[1, "l1"], [None, "ln"], [5, "l5"]])
+
+    # ── string/cast edges inside whole queries ──
+    add("groupby_case_sensitive", {"t": T([["s", "string"], ["x", "int"]],
+                                          [["A", 1], ["a", 2], ["A", 4]])},
+        "SELECT s, SUM(x) FROM t GROUP BY s", [["A", 5], ["a", 2]])
+    add("cast_in_where", {"t": T([["s", "string"]],
+                                 [["1"], ["2x"], [" 3 "], [""]])},
+        "SELECT s FROM t WHERE CAST(s AS INT) > 0", [["1"], [" 3 "]])
+    add("like_in_where", {"t": T([["s", "string"]],
+                                 [["apple"], ["apricot"], ["banana"], [None]])},
+        "SELECT s FROM t WHERE s LIKE 'ap%'", [["apple"], ["apricot"]])
+
+    # ── count bug: correlated aggregate over empty groups ──
+    # (classic decorrelation trap: COUNT over no matching rows is 0, not NULL)
+    add("scalar_subquery_count_empty", {"l": T([["k", "int"]], [[1], [9]]),
+                                        "r": R},
+        "SELECT l.k, (SELECT COUNT(*) FROM r WHERE r.k = l.k) FROM l",
+        [[1, 0], [9, 0]])
+    return q
+
+
 def build_sweeps():
     """Bulk value sweeps (deterministic) — volume for the corpus: murmur3
     over generated keys, casts over generated numeric strings, calendar
@@ -638,6 +1179,34 @@ def build_sweeps():
     return cases
 
 
+def build_string_sweeps():
+    """Volume sweep for the string kernels: deterministic random strings
+    mixing 1/2/3/4-byte code points, pushed through substring/locate/
+    length/reverse with the python-str oracle."""
+    import random
+
+    rng = random.Random(20240601)
+    alphabet = "abcXYZ 019_éüñ中文字€𝄞𝄢"
+    cases = []
+    for _ in range(120):
+        ln = rng.randint(0, 14)
+        s = "".join(rng.choice(alphabet) for _ in range(ln))
+        cases.append({"op": "length", "input": s, "expected": len(s)})
+        cases.append({"op": "reverse", "input": s, "expected": s[::-1]})
+        pos = rng.randint(-6, 8)
+        sub_len = rng.randint(0, 5)
+        cases.append({"op": "substring", "input": s, "pos": pos,
+                      "len": sub_len,
+                      "expected": spark_substring(s, pos, sub_len)})
+        if s:
+            needle = s[rng.randint(0, len(s) - 1)]
+            p0 = rng.randint(1, max(1, len(s)))
+            cases.append({"op": "locate", "sub": needle, "input": s,
+                          "pos": p0,
+                          "expected": spark_locate(needle, s, p0)})
+    return cases
+
+
 def main():
     sweeps = build_sweeps()
     files = {
@@ -649,6 +1218,9 @@ def main():
         + [c for c in sweeps if c["op"] in ("year", "dayofweek", "weekofyear")],
         "golden_decimal.json": build_decimal(),
         "golden_arith.json": build_arith(),
+        "golden_strings.json": build_strings() + build_string_sweeps(),
+        "golden_datetime_fmt.json": build_datetime_fmt(),
+        "golden_queries.json": build_queries(),
     }
     total = 0
     for name, cases in files.items():

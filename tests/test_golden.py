@@ -233,6 +233,95 @@ def test_golden_decimal_rounding(engine):
 
 
 @pytest.mark.parametrize("engine", ["cpu", "tpu"])
+def test_golden_strings(engine):
+    """UTF-8 string-kernel fixtures: code-point semantics over multi-byte
+    data, python-str oracle (tests/golden/gen_golden.py build_strings)."""
+    session = dict(_sessions())[engine]
+    data = _load("golden_strings.json")
+    groups: dict = {}
+    for c in data:
+        groups.setdefault(c["op"], []).append(c)
+
+    def batch(op, build):
+        cs = groups.pop(op, [])
+        if not cs:
+            return
+        got = _eval_col(session, "string", [c["input"] for c in cs],
+                        lambda col_, _cs=cs: build(col_, _cs))
+        # per-case rebuild when parameters differ per row
+        _check(got, [c["expected"] for c in cs], f"{op} [{engine}]")
+
+    def per_case(op, build):
+        for c in groups.pop(op, []):
+            got = _eval_col(session, "string", [c["input"]],
+                            lambda col_: build(col_, c))
+            _check(got, [c["expected"]], f"{op} [{engine}] {c}")
+
+    batch("length", lambda c, _: F.length(c))
+    batch("reverse", lambda c, _: F.reverse(c))
+    batch("ascii", lambda c, _: F.ascii(c))
+    batch("upper", lambda c, _: F.upper(c))
+    batch("lower", lambda c, _: F.lower(c))
+    batch("initcap", lambda c, _: F.initcap(c))
+    batch("trim", lambda c, _: F.trim(c))
+    batch("ltrim", lambda c, _: F.ltrim(c))
+    batch("rtrim", lambda c, _: F.rtrim(c))
+    per_case("substring", lambda c, cc: F.substring(c, cc["pos"], cc["len"]))
+    per_case("locate", lambda c, cc: F.locate(cc["sub"], c, cc["pos"]))
+    per_case("lpad", lambda c, cc: F.lpad(c, cc["n"], cc["pad"]))
+    per_case("rpad", lambda c, cc: F.rpad(c, cc["n"], cc["pad"]))
+    per_case("substring_index",
+             lambda c, cc: F.substring_index(c, cc["delim"], cc["count"]))
+    per_case("translate", lambda c, cc: F.translate(c, cc["frm"], cc["to"]))
+    per_case("replace", lambda c, cc: F.replace(c, cc["search"], cc["repl"]))
+    per_case("repeat", lambda c, cc: F.repeat(c, cc["n"]))
+    per_case("startswith", lambda c, cc: c.startswith(cc["pre"]))
+    per_case("endswith", lambda c, cc: c.endswith(cc["pre"]))
+    per_case("contains", lambda c, cc: c.contains(cc["pre"]))
+    per_case("like", lambda c, cc: c.like(cc["pat"]))
+    per_case("split_at",
+             lambda c, cc: F.element_at(F.split(c, cc["delim"]), cc["idx"]))
+    # concat_ws builds its own multi-column frame (NULL parts skipped)
+    for c in groups.pop("concat_ws", []):
+        t = pa.table({
+            f"c{i}": pa.array([v], type=pa.string())
+            for i, v in enumerate(c["parts"])
+        })
+        rows = session.create_dataframe(t).select(
+            F.concat_ws(c["sep"], *[col(f"c{i}") for i in range(len(c["parts"]))]
+                        ).alias("r")
+        ).collect()
+        assert rows[0][0] == c["expected"], f"concat_ws [{engine}] {c}"
+    assert not groups, f"unexercised golden string ops: {sorted(groups)}"
+
+
+@pytest.mark.parametrize("engine", ["cpu", "tpu"])
+def test_golden_datetime_fmt(engine):
+    """Datetime format-token round trips (gen_golden build_datetime_fmt)."""
+    session = dict(_sessions())[engine]
+    data = _load("golden_datetime_fmt.json")
+    for c in (c for c in data if c["op"] == "date_format"):
+        got = _eval_col(session, "timestamp", [c["input"]],
+                        lambda cc: F.date_format(cc, c["fmt"]))
+        assert got[0] == c["expected"], (
+            f"date_format {c['fmt']} [{engine}]: {got[0]!r} want "
+            f"{c['expected']!r}"
+        )
+    for c in (c for c in data if c["op"] == "to_unix_timestamp"):
+        got = _eval_col(session, "string", [c["input"]],
+                        lambda cc: F.unix_timestamp(cc, c["fmt"]))
+        assert got[0] == c["expected"], f"to_unix_timestamp [{engine}] {c}"
+    for c in (c for c in data if c["op"] == "from_unixtime"):
+        got = _eval_col(session, "long", [c["input"]],
+                        lambda cc: F.from_unixtime(cc, c["fmt"]))
+        assert got[0] == c["expected"], f"from_unixtime [{engine}] {c}"
+    for c in (c for c in data if c["op"] == "to_date_fmt"):
+        got = _eval_col(session, "string", [c["input"]],
+                        lambda cc: F.to_date(cc, c["fmt"]))
+        assert _days(got[0]) == c["expected"], f"to_date [{engine}] {c}"
+
+
+@pytest.mark.parametrize("engine", ["cpu", "tpu"])
 def test_golden_arith(engine):
     session = dict(_sessions())[engine]
     data = _load("golden_arith.json")
