@@ -206,10 +206,24 @@ class HostToDeviceExec(Exec):
                     "rows": [0] * child.num_partitions,
                     "lock": threading.Lock(),
                 }
-                # bounded LRU: device HBM holds the cached uploads, so a
-                # session scanning many distinct tables must not pin them all
-                while len(cache) >= 4:
-                    cache.pop(next(iter(cache)))
+                # BYTES-bounded LRU: cached uploads are plain references
+                # (never registered with the spill catalog), so this bound
+                # is the ONLY thing standing between many-table sessions
+                # and pinned-HBM OOM. The old 4-ENTRY bound thrashed on
+                # TPC-H's 8-table star schema, re-uploading every table
+                # each run (~3.5s/query over a tunneled link at sf=0.5); a
+                # byte budget keeps whole star schemas resident while still
+                # evicting when the cached set actually grows large.
+                # Arrow nbytes underestimates the padded device footprint —
+                # ~2x covers pow2 row padding; string byte-planes can
+                # exceed it, which only makes eviction earlier (safe side).
+                new_bytes = 2 * child.table.nbytes
+                budget = 4 << 30
+                held = sum(c.get("est_bytes", 0) for c in cache.values())
+                while cache and held + new_bytes > budget:
+                    old = cache.pop(next(iter(cache)))  # LRU head
+                    held -= old.get("est_bytes", 0)
+                entry["est_bytes"] = new_bytes
                 cache[key] = entry
             else:
                 cache[key] = cache.pop(key)  # refresh LRU order
