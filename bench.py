@@ -154,7 +154,11 @@ def plan_diagnostics(session, wall_s: float) -> dict:
     plan = getattr(session, "_last_plan", None)
     if plan is None:
         return {}
-    from spark_rapids_tpu.profiling import device_host_breakdown, walk
+    from spark_rapids_tpu.profiling import (
+        device_host_breakdown,
+        pipeline_report,
+        walk,
+    )
 
     bd = device_host_breakdown(plan)
     input_rows = 0
@@ -185,14 +189,23 @@ def plan_diagnostics(session, wall_s: float) -> dict:
         else 0,
         "top_ops_ms": dict(list(bd["per_node_ms"].items())[:6]),
     }
+    # dispatch-ahead pipeline health: dispatch_depth / overlap_frac /
+    # per-stage stalls (exec/pipeline.py via profiling.pipeline_report)
+    out.update(pipeline_report(plan))
+    pc = getattr(session, "_last_precompile", None)
+    if pc and pc.get("kernels"):
+        out["precompiled_kernels"] = pc.get("warmed", 0)
     return out
 
 
-def rows_equal(rows_t, rows_c, abs_tol: float = 0.0) -> str:
+def rows_equal(rows_t, rows_c, abs_tol: float = 0.0, tol_cols=None) -> str:
     """'' if equal else a short mismatch description (sorted, approx float).
     ``abs_tol`` adds absolute slack for round()-bearing queries: device
     round under incompatibleOps may land a decimal-boundary tie one
-    last-digit step from the oracle's exact BigDecimal result."""
+    last-digit step from the oracle's exact BigDecimal result. ``tol_cols``
+    scopes that slack to the output columns whose select expression
+    actually contains round() (None = every column) — a device bug up to
+    abs_tol in an unrounded column must NOT pass silently."""
     if len(rows_t) != len(rows_c):
         return f"row count {len(rows_t)} vs {len(rows_c)}"
 
@@ -215,16 +228,17 @@ def rows_equal(rows_t, rows_c, abs_tol: float = 0.0) -> str:
         return tuple(k(v) for v in row)
 
     for rt, rc in zip(sorted(rows_t, key=key), sorted(rows_c, key=key)):
-        for vt, vc in zip(rt, rc):
+        for j, (vt, vc) in enumerate(zip(rt, rc)):
+            col_tol = abs_tol if (tol_cols is None or j in tol_cols) else 0.0
             if isinstance(vt, float) and isinstance(vc, float):
                 if not (
                     vt == vc
                     or (math.isnan(vt) and math.isnan(vc))
                     or abs(vt - vc)
                     <= 1e-6 * max(abs(vt), abs(vc), 1.0)
-                    or abs(vt - vc) <= abs_tol
+                    or abs(vt - vc) <= col_tol
                 ):
-                    return f"float {vt} vs {vc}"
+                    return f"float {vt} vs {vc} (col {j})"
             elif vt != vc:
                 return f"{vt!r} vs {vc!r}"
     return ""
@@ -251,8 +265,18 @@ def _suite_args():
 def run_query_pair(name, build_t, build_c, tpu, n_run, speedups, detail,
                    abs_tol: float = 0.0):
     """Time one query on both engines, attach per-plan diagnostics, and
-    differentially verify results."""
+    differentially verify results. ``abs_tol`` (round() slack) is scoped to
+    only the output columns whose select expression contains round —
+    plan/logical.py output_round_columns."""
     entry: dict = {}
+    tol_cols = None
+    if abs_tol:
+        try:
+            from spark_rapids_tpu.plan.logical import output_round_columns
+
+            tol_cols = output_round_columns(build_t()._plan)
+        except Exception:
+            tol_cols = None  # unknown shape: slack stays plan-wide
     try:
         first, best = time_query_split(build_t, n_run=n_run)
         ov = getattr(tpu, "_last_overrides", None)
@@ -272,7 +296,10 @@ def run_query_pair(name, build_t, build_c, tpu, n_run, speedups, detail,
             speedup=round(sp, 3),
         )
         mismatch = rows_equal(
-            _collect_retry(build_t), _collect_retry(build_c), abs_tol=abs_tol
+            _collect_retry(build_t),
+            _collect_retry(build_c),
+            abs_tol=abs_tol,
+            tol_cols=tol_cols,
         )
         if mismatch:
             entry["mismatch"] = mismatch

@@ -383,6 +383,53 @@ def host_to_device(
     return DeviceBatch(schema, list(cols), num_rows)
 
 
+def abstract_batch(
+    schema: Schema, capacity: int, str_widths: Optional[dict] = None
+) -> Optional[DeviceBatch]:
+    """DeviceBatch pytree with ``jax.ShapeDtypeStruct`` leaves — the
+    abstract input the kernel pre-compilation pass (plan/planner.py
+    precompile_plan) lowers kernels against via ``GuardedJit.warm``. The
+    treedef and leaf shapes match what ``host_to_device`` produces for the
+    same geometry, so the warmed binary is the one the real batch hits.
+
+    Returns None when the schema cannot be shaped statically: nested types
+    (their element-plane widths are data-dependent) or a string column
+    without a width hint in ``str_widths`` (column index → padded width).
+    """
+    from ..types import ArrayType, MapType, StructType
+
+    S = jax.ShapeDtypeStruct
+    cols = []
+    for i, f in enumerate(schema):
+        dt = f.data_type
+        if isinstance(dt, (ArrayType, MapType, StructType)):
+            return None
+        if isinstance(dt, StringType):
+            w = (str_widths or {}).get(i)
+            if not w:
+                return None
+            cols.append(
+                DeviceColumn(
+                    dt,
+                    S((capacity, int(w)), np.uint8),
+                    S((capacity,), np.bool_),
+                    S((capacity,), np.int32),
+                )
+            )
+            continue
+        if isinstance(dt, NullType):
+            cols.append(
+                DeviceColumn(dt, S((capacity,), np.int8), S((capacity,), np.bool_))
+            )
+            continue
+        cols.append(
+            DeviceColumn(
+                dt, S((capacity,), dt.np_dtype), S((capacity,), np.bool_)
+            )
+        )
+    return DeviceBatch(schema, cols, S((), np.int32))
+
+
 def _pad8(nbytes: int) -> int:
     return (nbytes + 7) & ~7
 

@@ -410,6 +410,53 @@ AUTO_BROADCAST_THRESHOLD = conf("spark.sql.autoBroadcastJoinThreshold").doc(
     "broadcast hash join (Spark's key, honored here; -1 disables)."
 ).bytes_conf(10 << 20)
 
+PIPELINE_ENABLED = conf("spark.rapids.tpu.pipeline.enabled").doc(
+    "Dispatch-ahead partition pipelining: blocking plan sinks (the D2H "
+    "pull at collect(), LIMIT's per-batch row-count sync) consume their "
+    "upstream batch stream through a bounded prefetch window driven by a "
+    "producer thread, so device work for batches i+1..k dispatches while "
+    "the sink blocks on batch i (kills the per-batch host-stall tax the "
+    "round-5 bench measured as host_overhead_frac 0.89-0.997). Kill "
+    "switch for the pipelined path; see docs/pipelined-execution.md."
+).boolean_conf(True)
+
+PIPELINE_MAX_BATCHES = conf("spark.rapids.tpu.pipeline.maxBatches").doc(
+    "Maximum batches in flight per pipelined partition stream (the "
+    "dispatch-ahead window depth). Bounds device-buffer growth together "
+    "with spark.rapids.tpu.pipeline.maxInflightBytes."
+).int_conf(4)
+
+PIPELINE_MAX_INFLIGHT_BYTES = conf(
+    "spark.rapids.tpu.pipeline.maxInflightBytes"
+).doc(
+    "Byte bound on the batches buffered ahead by a pipelined partition "
+    "stream; the producer also requests spill-catalog headroom before "
+    "each prefetch. 0 (default) sizes automatically: a quarter of the "
+    "spillable device budget when known, else 1 GiB."
+).bytes_conf(0)
+
+PRECOMPILE_ENABLED = conf("spark.rapids.tpu.precompile.enabled").doc(
+    "Kernel pre-compilation pass: after planning, walk the exec tree, "
+    "derive the batch geometry of shape-predictable scan-side chains, and "
+    "compile their kernels ahead of execution on a small compile pool "
+    "(concurrent on TPU, serialized on XLA:CPU), warm-starting the "
+    "persistent XLA cache — compile latency overlaps across plan nodes "
+    "instead of serializing at first touch of each operator."
+).boolean_conf(True)
+
+PRECOMPILE_PARALLELISM = conf("spark.rapids.tpu.precompile.parallelism").doc(
+    "Compile-pool width for the kernel pre-compilation pass; 0 picks "
+    "automatically (1 on the CPU backend, up to 4 elsewhere)."
+).int_conf(0)
+
+UPLOAD_CACHE_MAX_BYTES = conf("spark.rapids.tpu.uploadCache.maxBytes").doc(
+    "Byte budget for the session's device-upload (H2D) cache of in-memory "
+    "relations — the LRU bound standing between many-table sessions and "
+    "pinned-HBM OOM. 0 (default) sizes automatically from device memory "
+    "stats (a quarter of the device's byte limit) with a 4 GiB fallback "
+    "when no stats are available."
+).bytes_conf(0)
+
 OUT_OF_CORE_SORT_THRESHOLD = conf("spark.rapids.tpu.sort.outOfCoreThresholdBytes").doc(
     "Partition size above which TpuSortExec switches from single-batch sort "
     "to spillable sorted-run merge (reference: GpuSortExec.scala:212 "

@@ -166,10 +166,35 @@ def zero_vals(xp) -> TaskVals:
     )
 
 
+def abstract_zero_vals() -> TaskVals:
+    """ShapeDtypeStruct pytree matching ``zero_vals`` — the TaskVals input
+    the kernel pre-compilation pass lowers non-task-dependent project /
+    filter kernels against (plan/planner.py precompile_plan)."""
+    import jax
+    import numpy as _np
+
+    S = jax.ShapeDtypeStruct
+    return TaskVals(
+        S((), _np.int32),
+        S((), _np.int64),
+        S((DEFAULT_WIDTH,), _np.uint8),
+        S((), _np.int32),
+        S((), _np.int64),
+        S((), _np.int64),
+    )
+
+
 def run_device(fn, it, needs_task):
     """Drive a jitted kernel ``fn(batch, TaskVals)`` over device batches,
-    sampling/advancing the thread-local task state only when the expression
-    tree needs it (shared by TpuProjectExec/TpuFilterExec)."""
+    sampling the thread-local task state only when the expression tree
+    needs it (shared by TpuProjectExec/TpuFilterExec).
+
+    The running row base (monotonically_increasing_id's per-partition
+    offset) accumulates as a DEVICE scalar: ``row_base + num_rows`` is one
+    async device add, where the old ``info.advance_rows(db.row_count())``
+    paid a blocking host sync per batch — exactly the per-op stall the
+    pipelined executor exists to remove. The host TaskInfo still provides
+    the partition id and the initial base."""
     import jax.numpy as jnp
 
     if not needs_task:
@@ -177,9 +202,10 @@ def run_device(fn, it, needs_task):
         for db in it:
             yield fn(db, zeros)
         return
+    base = None  # device-resident running row count (no per-batch sync)
     for db in it:
-        info = get_or_create()
-        tv = task_vals(jnp)
+        get_or_create()
+        tv = task_vals(jnp, row_base=base)
         out = fn(db, tv)
-        info.advance_rows(db.row_count())
+        base = tv.row_base + db.num_rows.astype(jnp.int64)
         yield out

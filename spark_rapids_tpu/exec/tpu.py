@@ -101,6 +101,25 @@ def _expr_has_error_site(e) -> bool:
     return any(_expr_has_error_site(c) for c in e.children())
 
 
+def _upload_cache_budget(conf) -> int:
+    """H2D upload-cache byte budget (spark.rapids.tpu.uploadCache.maxBytes):
+    explicit when set; else a quarter of the device's reported byte limit;
+    else the historical 4 GiB fallback."""
+    from .. import config as cfg
+
+    b = cfg.UPLOAD_CACHE_MAX_BYTES.get(conf)
+    if b > 0:
+        return b
+    try:
+        stats = jax.local_devices()[0].memory_stats() or {}
+        total = stats.get("bytes_limit", 0)
+        if total:
+            return int(total) // 4
+    except Exception:
+        pass
+    return 4 << 30
+
+
 def _placed_partitions(ctx: "ExecContext", pset: PartitionSet) -> PartitionSet:
     """Mesh mode: commit partition p's batches to device p%n so per-partition
     kernels run data-parallel across chips from the scan onward (single-
@@ -218,7 +237,7 @@ class HostToDeviceExec(Exec):
                 # ~2x covers pow2 row padding; string byte-planes can
                 # exceed it, which only makes eviction earlier (safe side).
                 new_bytes = 2 * child.table.nbytes
-                budget = 4 << 30
+                budget = _upload_cache_budget(ctx.conf)
                 held = sum(c.get("est_bytes", 0) for c in cache.values())
                 while cache and held + new_bytes > budget:
                     old = cache.pop(next(iter(cache)))  # LRU head
@@ -354,7 +373,21 @@ class DeviceToHostExec(Exec):
                         bytes_m.add(rb.nbytes)
                         yield rb
 
-        return self.children[0].execute(ctx).map_partitions(fn)
+        # Dispatch-ahead pipelining (exec/pipeline.py): the D2H pull above
+        # blocks a full host round trip per window; driving the upstream
+        # chain from a producer thread keeps batches i+1..k dispatching on
+        # device while this sink blocks on batch i. Conf and metrics
+        # resolve HERE, on the single-threaded plan walk — partition
+        # thunks race on a thread pool.
+        from .pipeline import pipe_metrics, pipeline_conf, pipelined_partition
+
+        pconf = pipeline_conf(ctx)
+        metrics = pipe_metrics(self) if pconf is not None else None
+
+        def run(it):
+            return pipelined_partition(pconf, ctx, it, fn, metrics)
+
+        return self.children[0].execute(ctx).map_partitions(run)
 
 
 # ── compute execs ───────────────────────────────────────────────────────────
@@ -450,6 +483,10 @@ class _ErrorCheckingKernel:
     def _cache_size(self):
         cs = getattr(self._fn, "_cache_size", None)
         return cs() if callable(cs) else 0
+
+    def warm(self, *args) -> bool:
+        """Pre-compilation passthrough (plan/planner.py precompile_plan)."""
+        return self._fn.warm(*args)
 
 
 def _error_flags(ctx: Ctx, live, sites: list):
@@ -782,23 +819,27 @@ class TpuHashAggregateExec(Exec):
         key = ("agg_width", grouping, child_schema, pre_filter, has_nans)
         return K.jit_kernel(key, make)
 
-    def execute(self, ctx: ExecContext) -> PartitionSet:
+    def _fused_child(self) -> tuple:
+        """(effective child, fused pre_filter) — the filter-fusion decision,
+        shared by execute() and the kernel pre-compilation pass so both see
+        the SAME kernel. Fusing folds the filter predicate into the
+        aggregate as a liveness mask: a filter's schema equals its child's,
+        so bindings hold, and the compaction gather of every column is
+        skipped entirely. Filters with error sites (ANSI casts, split
+        overflow) stay standalone — fusion would bypass their kernel error
+        channel."""
         child = self.children[0]
-        pre_filter = None
-
         if (
             self.mode in ("partial", "complete")
             and isinstance(child, TpuFilterExec)
             and not child._needs_task
-            # fusing would bypass the filter kernel's error channel (ANSI
-            # casts, split overflow) — keep such filters standalone
             and not _expr_has_error_site(child.condition)
         ):
-            # fuse the filter predicate into the aggregate as a liveness
-            # mask: a filter's schema equals its child's, so bindings hold,
-            # and the compaction gather of every column is skipped entirely
-            pre_filter = child.condition
-            child = child.children[0]
+            return child.children[0], child.condition
+        return child, None
+
+    def execute(self, ctx: ExecContext) -> PartitionSet:
+        child, pre_filter = self._fused_child()
         from .. import config as cfg
 
         child_schema = child.output
@@ -2425,11 +2466,21 @@ class TpuLimitExec(Exec):
     def execute(self, ctx: ExecContext) -> PartitionSet:
         limit = self.n
         child_parts = self.children[0].execute(ctx)
+        # LIMIT syncs a row count per batch (it must know when to stop);
+        # prefetching the upstream stream hides the dispatch gap behind
+        # those syncs, and the bounded window caps how far past the limit
+        # the producer can run before the early-exit close() stops it.
+        from .pipeline import pipe_metrics, pipeline_conf, pipelined_partition
+
+        pconf = pipeline_conf(ctx)
+        metrics = pipe_metrics(self) if pconf is not None else None
 
         def it():
             remaining = limit
-            for t in child_parts.parts:
-                for db in t():
+
+            def consume(src):
+                nonlocal remaining
+                for db in src:
                     if remaining <= 0:
                         return
                     out = slice_head(db, jnp.asarray(remaining, jnp.int32))
@@ -2437,6 +2488,11 @@ class TpuLimitExec(Exec):
                     remaining -= n
                     if n:
                         yield out
+
+            for t in child_parts.parts:
+                yield from pipelined_partition(pconf, ctx, t(), consume, metrics)
+                if remaining <= 0:
+                    return
 
         return PartitionSet([it])
 

@@ -307,6 +307,11 @@ class _RoundBase(Expression):
                     out = xp.round(x * p) / p
                 else:
                     out = xp.sign(x) * xp.floor(xp.abs(x) * p + 0.5) / p
+                # x * p can overflow to ±inf for finite x (round(1e306, 3)):
+                # the scaled space cannot represent the value, where
+                # Spark's BigDecimal path returns x unchanged — such a
+                # magnitude has no digits at scale d to round
+                out = xp.where(xp.isfinite(x * p), out, x)
             else:
                 q = float(10 ** (-d))
                 if self.half_even:
@@ -322,15 +327,20 @@ class _RoundBase(Expression):
         data = np.asarray(ctx.broadcast(c.data), dtype=np.float64)
         mode = _dec.ROUND_HALF_EVEN if self.half_even else _dec.ROUND_HALF_UP
         out = np.empty(len(data), dtype=np.float64)
-        for i, x in enumerate(data.tolist()):
-            if x != x or x in (float("inf"), float("-inf")):
-                out[i] = x
-                continue
-            out[i] = float(
-                _dec.Decimal(repr(x)).quantize(
-                    _dec.Decimal(1).scaleb(-d), rounding=mode
+        # java BigDecimal is arbitrary-precision; python's default 28-digit
+        # context raises InvalidOperation quantizing huge doubles (1e306 at
+        # scale 3 needs ~310 digits) — widen to cover the full f64 range
+        with _dec.localcontext() as dctx:
+            dctx.prec = 400
+            for i, x in enumerate(data.tolist()):
+                if x != x or x in (float("inf"), float("-inf")):
+                    out[i] = x
+                    continue
+                out[i] = float(
+                    _dec.Decimal(repr(x)).quantize(
+                        _dec.Decimal(1).scaleb(-d), rounding=mode
+                    )
                 )
-            )
         return Val(out.astype(dt.np_dtype), c.valid)
 
 

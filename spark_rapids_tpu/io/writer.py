@@ -350,6 +350,47 @@ class DataFrameWriter:
 
         return walk(self._df._plan)
 
+    def _check_append_bucket_spec(self, path: str) -> None:
+        """Appending must agree with the existing bucket layout: a
+        mismatched spec (or bucketBy over data written without one) would
+        silently overwrite ``_bucket_spec.json`` and make bucket pruning
+        skip files that DO hold matching rows — wrong results, not an
+        error. Spark refuses the same way ('mismatched bucketing')."""
+        from .bucketing import read_spec
+
+        existing = read_spec(path)
+        if self._bucket_spec:
+            if existing is None:
+                has_data = any(
+                    not f.startswith("_") for f in os.listdir(path)
+                )
+                if has_data:
+                    raise ValueError(
+                        f"Cannot append bucketed data (bucketBy) to {path}: "
+                        "existing data was written without a bucket spec — "
+                        "bucket pruning over the mixed layout would return "
+                        "wrong results"
+                    )
+                return
+            if existing["num_buckets"] != self._bucket_spec["num_buckets"] or [
+                c.lower() for c in existing["cols"]
+            ] != [c.lower() for c in self._bucket_spec["cols"]]:
+                raise ValueError(
+                    f"Cannot append to {path}: bucket spec mismatch — "
+                    f"existing num_buckets={existing['num_buckets']} "
+                    f"cols={existing['cols']}, requested "
+                    f"num_buckets={self._bucket_spec['num_buckets']} "
+                    f"cols={self._bucket_spec['cols']}"
+                )
+        elif existing is not None:
+            raise ValueError(
+                f"Cannot append unbucketed data to bucketed table {path} "
+                f"(num_buckets={existing['num_buckets']} "
+                f"cols={existing['cols']}); use "
+                f"bucketBy({existing['num_buckets']}, "
+                f"{', '.join(map(repr, existing['cols']))})"
+            )
+
     def _write(self, path: str, fmt: str):
         if os.path.exists(path):
             if self._mode in ("error", "errorifexists"):
@@ -365,6 +406,8 @@ class DataFrameWriter:
                 shutil.rmtree(path)
             elif self._mode == "ignore":
                 return
+            elif self._mode == "append":
+                self._check_append_bucket_spec(path)
         os.makedirs(path, exist_ok=True)
         session = self._df._session
         from ..plan import logical as L

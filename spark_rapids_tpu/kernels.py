@@ -33,6 +33,7 @@ import jax
 _LOCK = threading.Lock()
 _KERNELS: dict = {}
 _BUILDS = 0  # number of distinct kernels built (cache misses)
+_WARMS = 0  # number of pre-compilations performed (GuardedJit.warm)
 
 
 def kernel(key: tuple, builder: Callable):
@@ -58,6 +59,17 @@ def kernel(key: tuple, builder: Callable):
 _COMPILE_LOCK = threading.RLock()
 
 
+def _args_sig(args) -> tuple:
+    leaves, treedef = jax.tree_util.tree_flatten(args)
+    return (
+        treedef,
+        tuple(
+            (tuple(x.shape), str(x.dtype)) if hasattr(x, "shape") else repr(x)
+            for x in leaves
+        ),
+    )
+
+
 class GuardedJit:
     """``jax.jit`` wrapper that serializes first-time compilations.
 
@@ -68,24 +80,42 @@ class GuardedJit:
     signature takes a global compile lock; the compiled fast path stays
     lock-free."""
 
-    __slots__ = ("_fn", "_seen", "_orig")
+    __slots__ = ("_fn", "_seen", "_orig", "_warmed")
 
     def __init__(self, fn):
         self._orig = fn
         self._fn = jax.jit(fn)
         self._seen = set()
+        self._warmed = set()
+
+    def warm(self, *args) -> bool:
+        """Pre-compilation (the tentpole's compile-warm pass): lower +
+        compile against ``args`` — usually jax.ShapeDtypeStruct pytrees —
+        WITHOUT executing. The compiled binary lands in the persistent
+        on-disk cache (enable_persistent_cache), so the first real call
+        pays a cache deserialization instead of a full XLA compile — the
+        closest TPU analogue of cuDF shipping pre-built kernels.
+
+        Serialized through the global compile lock on XLA:CPU (the known
+        concurrent-compile SIGSEGV); on other backends warms run
+        concurrently, bounded by the precompile pool. Returns False when
+        the signature was already compiled or warmed."""
+        global _WARMS
+        sig = _args_sig(args)
+        if sig in self._seen or sig in self._warmed:
+            return False
+        if jax.default_backend() == "cpu":
+            with _COMPILE_LOCK:
+                self._fn.lower(*args).compile()
+        else:
+            self._fn.lower(*args).compile()
+        self._warmed.add(sig)
+        with _LOCK:
+            _WARMS += 1
+        return True
 
     def __call__(self, *args):
-        leaves, treedef = jax.tree_util.tree_flatten(args)
-        sig = (
-            treedef,
-            tuple(
-                (tuple(x.shape), str(x.dtype))
-                if hasattr(x, "shape")
-                else repr(x)
-                for x in leaves
-            ),
-        )
+        sig = _args_sig(args)
         # capture _fn BEFORE the membership check: if another thread swaps
         # in a fresh (empty-cache) jit and clears _seen concurrently, a
         # passing check here implies our capture predates the clear, so we
@@ -136,6 +166,7 @@ class GuardedJit:
                     # passes the (cleared) membership check must have
                     # captured the old fn (see __call__)
                     self._seen.clear()
+                    self._warmed.clear()
                     self._fn = jax.jit(self._orig)
                     continue  # retrace; does not consume a retry attempt
                 transient = any(
@@ -183,6 +214,68 @@ def build_count() -> int:
     return _BUILDS
 
 
+def warm_count() -> int:
+    """Pre-compilations performed so far (monotonic; GuardedJit.warm)."""
+    return _WARMS
+
+
+def precompile_worthwhile() -> bool:
+    """Whether warming ahead of execution can pay: compiles overlap on
+    non-CPU backends, and the persistent cache carries warmed binaries to
+    later processes. On XLA:CPU with the cache disabled, a warm is the
+    SAME serial compile the first touch would do — pure waste — so the
+    default-on precompile pass skips itself there (an explicitly set
+    spark.rapids.tpu.precompile.enabled=true overrides)."""
+    try:
+        if jax.default_backend() != "cpu":
+            return True
+    except Exception:
+        return False
+    return _PERSISTENT_ENABLED
+
+
+def precompile(specs: list, parallelism: int = 0) -> dict:
+    """Warm a batch of kernels concurrently on a small compile pool.
+
+    ``specs`` is ``[(kernel, abstract_args_tuple)]`` where each kernel
+    exposes ``warm`` (GuardedJit or a wrapper forwarding to one). On the
+    CPU backend the pool collapses to one worker — GuardedJit.warm takes
+    the global compile lock there anyway (the concurrent-compile SIGSEGV),
+    so extra workers would only contend. Failures never propagate:
+    pre-compilation is an optimization, first touch retains its own
+    error handling (mosaic fallback, transient-compile retries)."""
+    stats = {"warmed": 0, "skipped": 0, "failed": 0}
+    if not specs:
+        return stats
+    try:
+        backend = jax.default_backend()
+    except Exception:
+        return stats
+    import logging
+
+    log = logging.getLogger(__name__)
+
+    def one(spec):
+        kernel, args = spec
+        try:
+            return "warmed" if kernel.warm(*args) else "skipped"
+        except Exception as e:  # noqa: BLE001 - warm is best-effort
+            log.debug("kernel precompile failed (ignored): %s", str(e)[:200])
+            return "failed"
+
+    workers = 1 if backend == "cpu" else (parallelism or min(4, len(specs)))
+    if workers <= 1:
+        for s in specs:
+            stats[one(s)] += 1
+        return stats
+    from concurrent.futures import ThreadPoolExecutor
+
+    with ThreadPoolExecutor(max_workers=workers) as pool:
+        for outcome in pool.map(one, specs):
+            stats[outcome] += 1
+    return stats
+
+
 def trace_count() -> int:
     """Total jit specializations across cached kernels — grows only when a
     kernel is traced/compiled for a new shape signature. Flat between two
@@ -227,6 +320,13 @@ def enable_persistent_cache(path: str | None = None) -> None:
         os.makedirs(cache_dir, exist_ok=True)
         jax.config.update("jax_compilation_cache_dir", cache_dir)
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+        # The cache singleton binds its directory at the FIRST compile —
+        # which has already happened by now (backend probing above, import-
+        # time jnp work), so the config update alone is silently ignored
+        # and every process recompiles cold. Re-point the singleton.
+        from jax._src import compilation_cache as _cc
+
+        _cc.reset_cache()
         _PERSISTENT_ENABLED = True
     except Exception:  # cache is an optimization; never fail a query over it
         pass

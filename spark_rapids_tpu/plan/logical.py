@@ -530,6 +530,76 @@ class WriteFiles(LogicalPlan):
         return f"WriteFiles {self.file_format} {self.path}"
 
 
+def output_round_columns(plan: LogicalPlan):
+    """Indices of output columns tainted by a float ``round()``/``bround()``
+    — the column either computes one or references a child column that
+    does. Scopes the bench/differential float slack to only the columns
+    the incompat device round can actually perturb (a device bug in an
+    UNROUNDED column must not ride the tolerance). Returns None when the
+    taint cannot be tracked (round hidden under a plan shape this walk
+    does not model) — callers fall back to applying slack everywhere."""
+    flags = _round_flags(plan)
+    return None if flags is None else frozenset(
+        i for i, f in enumerate(flags) if f
+    )
+
+
+def _round_flags(plan: LogicalPlan):
+    from ..expr.base import UnresolvedAttribute
+    from ..expr.math import _RoundBase
+
+    def contains_round(e) -> bool:
+        if isinstance(e, _RoundBase):
+            return True
+        return any(contains_round(c) for c in e.children())
+
+    def refs(e, out: set) -> None:
+        if isinstance(e, UnresolvedAttribute):
+            out.add(e.name.lower())
+        for c in e.children():
+            refs(c, out)
+
+    if isinstance(plan, (Limit, Sort, Filter)):
+        return _round_flags(plan.child)
+    if isinstance(plan, (Project, Aggregate)):
+        exprs = plan.exprs if isinstance(plan, Project) else plan.aggregates
+        child_flags = _round_flags(plan.child)
+        if child_flags is None:
+            return None
+        tainted = {
+            n.lower()
+            for n, f in zip(plan.child.schema.names, child_flags)
+            if f
+        }
+        out = []
+        for e in exprs:
+            if contains_round(e):
+                out.append(True)
+                continue
+            names: set = set()
+            refs(e, names)
+            out.append(bool(names & tainted))
+        return out
+    # any other node: clean only if NO round appears anywhere below —
+    # otherwise the taint path is unmodeled and the caller must stay
+    # conservative
+    seen = [False]
+
+    def probe(e):
+        if contains_round(e):
+            seen[0] = True
+        return e
+
+    transform_expressions(plan, probe)
+    if seen[0]:
+        return None
+    try:
+        width = len(plan.schema.names)
+    except Exception:
+        return None
+    return [False] * width
+
+
 def transform_expressions(lp: LogicalPlan, f) -> LogicalPlan:
     """Rebuild the plan tree with ``f`` applied bottom-up to every expression
     (the analogue of Catalyst's ``transformAllExpressions``); used by the

@@ -39,6 +39,12 @@ class Metric:
         with self._lock:
             self.value += v
 
+    def set_max(self, v: int):
+        """High-water-mark semantics (e.g. pipeline dispatch depth)."""
+        with self._lock:
+            if v > self.value:
+                self.value = v
+
     class _Timer:
         __slots__ = ("m", "t0")
 

@@ -875,3 +875,227 @@ def _plan_join(lp: L.Join, conf: TpuConf) -> Exec:
         CpuCoalescePartitionsExec(left),
         CpuCoalescePartitionsExec(right),
     )
+
+
+# ── kernel pre-compilation pass ─────────────────────────────────────────────
+#
+# The reference never compiles at query time: cuDF ships pre-built kernels.
+# The TPU engine's first touch of each operator pays an XLA compile instead,
+# and those compiles SERIALIZE down the pull-based operator chain (the
+# round-5 bench measured 18-64s of first-run compile per query). This pass
+# walks the final (device) exec tree right after planning, derives the exact
+# batch geometry of the shape-predictable scan-side chains, and warms every
+# distinct kernel through kernels.precompile — concurrently where the
+# backend allows, serialized on XLA:CPU (the known concurrent-compile
+# SIGSEGV), always warm-starting the persistent on-disk XLA cache so later
+# processes skip the compile entirely.
+
+# (id(table), lo, rows) -> (table ref, {col index -> padded width}).
+# The entry PINS the table so the id() key stays valid — the same reason
+# the H2D upload cache pins its source (exec/tpu.py); without the pin a
+# freed table's recycled id could serve stale widths.
+_STR_WIDTH_CACHE: dict = {}
+
+
+def _slice_str_widths(table, schema, max_str: int, lo: int, rows: int):
+    """{col index → padded width} for rows [lo, lo+rows) of an in-memory
+    scan — the widths ``host_to_device`` will bucket for THAT chunk (it
+    buckets per chunk, not per table, so a partition-local max is the one
+    the real batch gets). None when a column cannot be shaped (over the
+    width ceiling — the real upload raises anyway)."""
+    import pyarrow as pa
+    import pyarrow.compute as pc
+
+    from ..columnar.device import bucket_width
+    from ..types import StringType
+
+    key = (id(table), lo, rows)
+    cached = _STR_WIDTH_CACHE.get(key)
+    if cached is not None and cached[0] is table:
+        return cached[1]
+    widths: dict = {}
+    for i, f in enumerate(schema):
+        if not isinstance(f.data_type, StringType):
+            continue
+        try:
+            col = table.column(f.name).slice(lo, rows)
+            ml = pc.max(pc.binary_length(col.cast(pa.binary()))).as_py() or 0
+        except Exception:
+            return None
+        if ml > max_str:
+            return None
+        widths[i] = bucket_width(max(int(ml), 1))
+    if len(_STR_WIDTH_CACHE) > 512:
+        _STR_WIDTH_CACHE.clear()
+    _STR_WIDTH_CACHE[key] = (table, widths)
+    return widths
+
+
+def _h2d_hints(node, conf: TpuConf) -> Optional[list]:
+    """[(capacity, {col index → string width})] geometry variants a
+    HostToDeviceExec over an in-memory scan will produce — mirrors the
+    exec's re-chunking and host_to_device's per-chunk capacity/width
+    bucketing exactly, so a warmed binary is the one the real batches hit."""
+    from ..columnar.device import bucket_capacity
+    from ..exec.cpu import CpuScanExec
+    from ..exec.tpu import _row_bytes
+    from ..types import StringType
+
+    child = node.children[0]
+    if not isinstance(child, CpuScanExec):
+        return None  # file scans: batch geometry depends on file contents
+    n = child.table.num_rows
+    if n == 0:
+        return None
+    schema = node.output
+    max_rows = max(1, cfg.BATCH_SIZE_BYTES.get(conf) // _row_bytes(schema))
+    max_str = cfg.STRING_MAX_BYTES.get(conf)
+    has_strings = any(isinstance(f.data_type, StringType) for f in schema)
+    per = max(1, -(-n // child.num_partitions))
+    hints: dict = {}  # (cap, width tuple) -> (cap, widths)
+    for p in range(child.num_partitions):
+        lo = min(p * per, n)
+        rows = min(lo + per, n) - lo
+        if rows <= 0:
+            continue
+        if rows > max_rows and has_strings:
+            # the exec re-chunks this partition; sub-chunk string widths
+            # bucket per chunk and are not worth mirroring — skip it
+            continue
+        widths = _slice_str_widths(child.table, schema, max_str, lo, rows)
+        if widths is None:
+            continue
+        for cap_rows in (
+            [rows]
+            if rows <= max_rows
+            else [max_rows] + ([rows % max_rows] if rows % max_rows else [])
+        ):
+            cap = bucket_capacity(cap_rows)
+            hints.setdefault(
+                (cap, tuple(sorted(widths.items()))), (cap, widths)
+            )
+    return list(hints.values()) or None
+
+
+def _project_out_hints(node, hints) -> Optional[list]:
+    """Propagate geometry through a projection: capacity is preserved;
+    string widths survive only for passthrough (BoundReference) columns —
+    a computed string's width is data-dependent and stays unknown, which
+    makes any consumer needing it skip its warm (abstract_batch → None)."""
+    if not hints:
+        return None
+    from ..expr.base import Alias, BoundReference
+    from ..types import StringType
+
+    out = []
+    for cap, widths in hints:
+        ow: dict = {}
+        for j, (e, f) in enumerate(zip(node.exprs, node.output)):
+            if not isinstance(f.data_type, StringType):
+                continue
+            t = e.child if isinstance(e, Alias) else e
+            if isinstance(t, BoundReference) and t.ordinal in widths:
+                ow[j] = widths[t.ordinal]
+        out.append((cap, ow))
+    return out
+
+
+def precompile_plan(plan: Exec, conf: TpuConf) -> dict:
+    """Walk the planned exec tree, collect every distinct kernel whose input
+    geometry is statically derivable (H2D over in-memory scans → coalesce →
+    filter/project chains, plus the fused update-aggregate above them), and
+    compile them ahead of execution on the kernels.precompile pool. Returns
+    the pool's stats plus the number of kernel specs collected; never
+    raises — pre-compilation is an optimization, first touch keeps its own
+    error handling."""
+    from .. import kernels as K
+    from ..columnar.device import abstract_batch
+    from ..exec import task as task_mod
+    from ..exec import tpu as T
+
+    specs: list = []
+    seen: set = set()
+
+    def add(kernel, args) -> None:
+        if kernel is None or not hasattr(kernel, "warm"):
+            return
+        key = (id(kernel), K._args_sig(args))
+        if key in seen:
+            return
+        seen.add(key)
+        specs.append((kernel, args))
+
+    def warm_batch_kernel(node, hints) -> None:
+        if not hints or node._needs_task:
+            return
+        for cap, widths in hints:
+            ab = abstract_batch(node.children[0].output, cap, widths)
+            if ab is not None:
+                add(node._fn, (ab, task_mod.abstract_zero_vals()))
+
+    def derive(node) -> Optional[list]:
+        if isinstance(node, T.HostToDeviceExec):
+            return _h2d_hints(node, conf)
+        if isinstance(node, T.TpuCoalesceBatchesExec):
+            # pass-through: single-batch partitions (the common in-memory
+            # scan shape) cross coalesce untouched; multi-batch concats
+            # land on a different capacity and simply miss the warm
+            return derive(node.children[0])
+        if isinstance(node, T.TpuFilterExec):
+            hints = derive(node.children[0])
+            warm_batch_kernel(node, hints)
+            return hints  # compact() preserves capacity and schema
+        if isinstance(node, T.TpuProjectExec):
+            hints = derive(node.children[0])
+            warm_batch_kernel(node, hints)
+            return _project_out_hints(node, hints)
+        if isinstance(node, T.TpuHashAggregateExec):
+            child, pre_filter = node._fused_child()
+            hints = derive(child)
+            if hints and node.mode in ("partial", "complete"):
+                try:
+                    kernel = node._make_kernel(
+                        child.output, pre_filter, cfg.HAS_NANS.get(conf)
+                    )
+                except Exception:
+                    kernel = None
+                for cap, widths in hints:
+                    ab = abstract_batch(child.output, cap, widths)
+                    if ab is not None:
+                        add(kernel, (ab,))
+            return None  # output group count is data-dependent
+        if isinstance(node, T.TpuShuffleExchangeExec):
+            # mirror the exchange's filter fusion so a filter kernel that
+            # will never run standalone is not warmed
+            child = node.children[0]
+            if (
+                isinstance(child, T.TpuFilterExec)
+                and not child._needs_task
+                and not T._expr_has_error_site(child.condition)
+            ):
+                try:
+                    kind = node._scatter_fns(node.num_partitions)[0]
+                except Exception:
+                    kind = None
+                if kind in ("hash", "range"):
+                    derive(child.children[0])
+                    return None
+            derive(child)
+            return None
+        for c in node.children:
+            derive(c)
+        return None
+
+    empty = {"warmed": 0, "skipped": 0, "failed": 0, "kernels": 0}
+    try:
+        derive(plan)
+    except Exception:
+        return empty
+    if not specs:
+        return empty
+    try:
+        stats = K.precompile(specs, cfg.PRECOMPILE_PARALLELISM.get(conf))
+    except Exception:
+        return empty
+    stats["kernels"] = len(specs)
+    return stats

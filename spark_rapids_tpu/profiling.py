@@ -118,6 +118,46 @@ def metrics_report(plan: Exec) -> str:
     return "\n".join(lines)
 
 
+def pipeline_report(plan: Exec) -> dict:
+    """Dispatch-ahead pipeline health for the bench ``diag`` block
+    (exec/pipeline.py feeds the ``pipe*`` metrics):
+
+    * ``dispatch_depth`` — deepest in-flight window observed at any
+      pipelined sink (0 = pipeline never engaged);
+    * ``overlap_frac``   — fraction of upstream production time hidden
+      behind consumer-side work, ``1 - stall/producer`` (1.0 = the sink
+      never waited on the producer; 0.0 = fully serialized);
+    * ``pipe_stall_ms``  — total consumer time blocked on an empty window;
+    * ``pipe_stalls``    — the per-stage breakdown of those stalls.
+    """
+    depth = 0
+    stall_ns = 0
+    producer_ns = 0
+    stages: dict = {}
+    for node in walk(plan):
+        ms = node.metrics
+        d = ms.get("pipeDispatchDepth")
+        if d is not None:
+            depth = max(depth, d.value)
+        st = ms.get("pipeStallTime")
+        if st is not None and st.value:
+            stall_ns += st.value
+            key = type(node).__name__
+            stages[key] = round(stages.get(key, 0.0) + st.value / 1e6, 1)
+        pr = ms.get("pipeProducerTime")
+        if pr is not None:
+            producer_ns += pr.value
+    overlap = 0.0
+    if producer_ns > 0:
+        overlap = max(0.0, min(1.0, 1.0 - stall_ns / producer_ns))
+    return {
+        "dispatch_depth": depth,
+        "overlap_frac": round(overlap, 3),
+        "pipe_stall_ms": round(stall_ns / 1e6, 1),
+        "pipe_stalls": stages,
+    }
+
+
 def device_host_breakdown(plan: Exec) -> dict:
     """Aggregate totals for the bench JSON ``detail``: device-attributed
     op time vs host transfer time vs rows moved."""

@@ -391,6 +391,23 @@ class TpuSession:
             from .profiling import instrument_plan
 
             instrument_plan(final_plan)
+        self._last_precompile = {}
+        from . import kernels as K
+
+        if cfg.PRECOMPILE_ENABLED.get(self.conf) and (
+            self.conf.get_raw(cfg.PRECOMPILE_ENABLED.key) is not None
+            or K.precompile_worthwhile()
+        ):
+            # kernel pre-compilation pass (plan/planner.py): warm the
+            # shape-predictable kernels before execution so XLA compiles
+            # overlap across plan nodes instead of serializing at first
+            # touch of each operator; best-effort by design
+            from .plan.planner import precompile_plan
+
+            try:
+                self._last_precompile = precompile_plan(final_plan, self.conf)
+            except Exception:
+                pass
         return final_plan, ctx
 
     def _run_task(self, thunk, attempts: int) -> List[pa.RecordBatch]:

@@ -436,6 +436,48 @@ def test_bucketed_write_read_prunes(tmp_path):
     assert scan.pruned_buckets > 0, "no bucket files pruned"
 
 
+def test_append_bucket_spec_mismatch_rejected(tmp_path):
+    """Appends must agree with the existing bucket layout: a mismatched
+    bucketBy (or bucketBy over unbucketed data, or unbucketed append over
+    a bucketed table) would silently corrupt the sidecar spec and make
+    bucket pruning return wrong results — the writer raises instead."""
+    import pytest as _pytest
+
+    t = pa.table({
+        "k": pa.array(list(range(50)), type=pa.int64()),
+        "x": pa.array([float(i) for i in range(50)]),
+    })
+    s = cpu_session()
+    path = str(tmp_path / "bk")
+    s.create_dataframe(t).write.mode("overwrite").bucket_by(4, "k").parquet(path)
+
+    # different bucket count
+    with _pytest.raises(ValueError, match="bucket spec mismatch"):
+        s.create_dataframe(t).write.mode("append").bucket_by(8, "k").parquet(path)
+    # different bucket columns
+    with _pytest.raises(ValueError, match="bucket spec mismatch"):
+        s.create_dataframe(t).write.mode("append").bucket_by(4, "x").parquet(path)
+    # unbucketed append over a bucketed table
+    with _pytest.raises(ValueError, match="unbucketed data to bucketed"):
+        s.create_dataframe(t).write.mode("append").parquet(path)
+    # bucketBy append over unbucketed data
+    flat = str(tmp_path / "flat")
+    s.create_dataframe(t).write.mode("overwrite").parquet(flat)
+    with _pytest.raises(ValueError, match="without a bucket spec"):
+        s.create_dataframe(t).write.mode("append").bucket_by(4, "k").parquet(flat)
+
+    # the spec survived every rejected attempt
+    from spark_rapids_tpu.io.bucketing import read_spec
+
+    assert read_spec(path) == {"num_buckets": 4, "cols": ["k"]}
+
+    # a MATCHING bucketed append is accepted and stays readable
+    s.create_dataframe(t).write.mode("append").bucket_by(4, "k").parquet(path)
+    s2 = tpu_session()
+    rows = s2.read.parquet(path).filter(col("k") == 7).collect()
+    assert len(rows) == 2  # one row per write
+
+
 def test_bucketed_matches_hash_exchange_placement(tmp_path):
     """The writer's bucket id is the exchange's hash: repartition(n, k) and
     bucketBy(n, k) must agree on row placement (io/bucketing.py contract)."""

@@ -101,6 +101,39 @@ def test_round_double_cpu_fallback():
     )
 
 
+def test_round_double_overflow_guard():
+    """round(1e306, 3): scaling by 10^d overflows float64 to inf — the
+    device kernel must return x unchanged (a magnitude that large has no
+    digits at scale d, matching Spark's BigDecimal path), never Infinity.
+    Values chosen so device f64 round and the CPU BigDecimal oracle agree
+    exactly; NaN/±inf pass through on both engines."""
+    t = pa.table(
+        {
+            "a": pa.array(
+                [
+                    1e306,
+                    -1e306,
+                    1.7976931348623157e308,
+                    -1.7976931348623157e308,
+                    4.5,
+                    0.0,
+                    None,
+                    float("inf"),
+                    float("-inf"),
+                    float("nan"),
+                ]
+            )
+        }
+    )
+    assert_cpu_and_tpu_equal(
+        lambda s: _df(s, t).select(
+            F.round(col("a"), 3).alias("r"),
+            F.bround(col("a"), 3).alias("br"),
+        ),
+        conf={"spark.rapids.sql.incompatibleOps.enabled": True},
+    )
+
+
 def test_round_ground_truth():
     """HALF_UP/HALF_EVEN vs java BigDecimal expectations."""
     t = pa.table({"a": pa.array([25, -25, 35, -35, 26, -26], type=pa.int32())})

@@ -1,0 +1,295 @@
+"""Dispatch-ahead pipeline layer (exec/pipeline.py) — deterministic unit
+tests of the in-flight window contract, plus end-to-end equivalence of the
+pipelined and direct paths (ISSUE 1 tentpole test coverage):
+
+* the window never exceeds its batch/byte bounds (no unbounded
+  device-buffer growth — the spill-catalog memory contract);
+* LIMIT-style early exit stops the producer and closes the upstream
+  generator (no runaway production);
+* an upstream operator failure surfaces on the CONSUMING thread after the
+  batches produced before it (no lost or duplicated batches);
+* spill pressure: the producer requests catalog headroom between pulls.
+"""
+from __future__ import annotations
+
+import threading
+import time
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu.exec.pipeline import PipelinedIterator
+from tests.harness import assert_cpu_and_tpu_equal, cpu_session, tpu_session
+
+
+class _Item:
+    """Stand-in batch with a static size_bytes (like DeviceBatch)."""
+
+    def __init__(self, i: int, size: int = 100):
+        self.i = i
+        self._size = size
+
+    def size_bytes(self) -> int:
+        return self._size
+
+
+class _Source:
+    """Instrumented upstream: tracks produced count, max in-flight
+    (produced - consumed), and whether the generator was closed."""
+
+    def __init__(self, n: int, size: int = 100, fail_at: int = -1):
+        self.n = n
+        self.size = size
+        self.fail_at = fail_at
+        self.produced = 0
+        self.consumed = 0
+        self.max_inflight = 0
+        self.closed = False
+        self._lock = threading.Lock()
+
+    def note_consumed(self):
+        with self._lock:
+            self.consumed += 1
+
+    def gen(self):
+        try:
+            for i in range(self.n):
+                if i == self.fail_at:
+                    raise RuntimeError(f"operator failure at batch {i}")
+                with self._lock:
+                    self.produced += 1
+                    self.max_inflight = max(
+                        self.max_inflight, self.produced - self.consumed
+                    )
+                yield _Item(i, self.size)
+        finally:
+            self.closed = True
+
+
+def test_window_batch_bound_respected():
+    src = _Source(50)
+    pipe = PipelinedIterator(src.gen(), depth=3, max_bytes=0)
+    out = []
+    for item in pipe:
+        time.sleep(0.001)  # slow consumer: the producer must wait, not run
+        src.note_consumed()
+        out.append(item.i)
+    pipe.close()
+    assert out == list(range(50)), "batches lost, duplicated, or reordered"
+    # contract: at most `depth` buffered, plus the one batch already in the
+    # consumer's hands (popped but not yet marked consumed)
+    assert src.max_inflight <= 3 + 1, (
+        f"in-flight window exceeded depth: {src.max_inflight}"
+    )
+    assert src.closed
+
+
+def test_window_byte_bound_respected():
+    src = _Source(40, size=100)
+    # 250-byte budget at 100 bytes/batch: at most 2 buffered + 1 being
+    # produced may be outstanding at once
+    pipe = PipelinedIterator(src.gen(), depth=100, max_bytes=250)
+    out = []
+    for item in pipe:
+        time.sleep(0.001)
+        src.note_consumed()
+        out.append(item.i)
+    pipe.close()
+    assert out == list(range(40))
+    # ≤ 2 batches fit under the budget before the producer blocks, +1 the
+    # producer already pulled past the check, +1 in the consumer's hands
+    assert src.max_inflight <= 4, (
+        f"byte bound did not hold the window: {src.max_inflight}"
+    )
+
+
+def test_oversized_batch_still_flows():
+    """A batch larger than the whole byte budget must pass through (the
+    bytes bound never blocks an empty window) — otherwise deadlock."""
+    src = _Source(5, size=10_000)
+    pipe = PipelinedIterator(src.gen(), depth=4, max_bytes=100)
+    out = [item.i for item in pipe]
+    pipe.close()
+    assert out == list(range(5))
+
+
+def test_early_exit_stops_producer_and_closes_upstream():
+    src = _Source(10_000)
+    depth = 4
+    pipe = PipelinedIterator(src.gen(), depth=depth, max_bytes=0)
+    taken = [next(pipe).i for _ in range(2)]
+    pipe.close()
+    assert taken == [0, 1]
+    # producer may have filled the window plus the batch in its hands, but
+    # a LIMIT-style early exit must not let it run the whole stream
+    assert src.produced <= 2 + depth + 1, (
+        f"producer ran past the window after close: {src.produced}"
+    )
+    deadline = time.time() + 5
+    while not src.closed and time.time() < deadline:
+        time.sleep(0.01)
+    assert src.closed, "upstream generator was not closed on early exit"
+
+
+def test_error_surfaces_on_consumer_after_prior_batches():
+    src = _Source(10, fail_at=3)
+    pipe = PipelinedIterator(src.gen(), depth=2, max_bytes=0)
+    got = []
+    with pytest.raises(RuntimeError, match="operator failure at batch 3"):
+        for item in pipe:
+            src.note_consumed()
+            got.append(item.i)
+    pipe.close()
+    assert got == [0, 1, 2], "batches before the failure must all arrive"
+    assert src.closed
+
+
+def test_release_callback_runs_once_production_ends():
+    released = threading.Event()
+    src = _Source(3)
+    pipe = PipelinedIterator(
+        src.gen(), depth=2, max_bytes=0, release=released.set
+    )
+    assert [i.i for i in pipe] == [0, 1, 2]
+    assert released.wait(5), "semaphore release callback never ran"
+    pipe.close()
+
+
+def test_spill_pressure_requests_headroom():
+    """The producer asks the catalog for headroom between pulls (sized by
+    the last batch) — prefetch pressure spills parked buffers instead of
+    growing the device working set unboundedly."""
+
+    class _Catalog:
+        def __init__(self):
+            self.calls = []
+
+        def ensure_headroom(self, want, dev=None):
+            self.calls.append(want)
+
+    cat = _Catalog()
+    src = _Source(10, size=64)
+    pipe = PipelinedIterator(src.gen(), depth=2, max_bytes=0, catalog=cat)
+    out = [i.i for i in pipe]
+    pipe.close()
+    assert out == list(range(10))
+    assert cat.calls, "catalog headroom was never requested"
+    assert all(w == 64 for w in cat.calls)
+
+
+def test_metrics_feed_depth_and_counts():
+    from spark_rapids_tpu.plan.physical import Metric
+
+    metrics = {
+        "depth": Metric("pipeDispatchDepth"),
+        "stall": Metric("pipeStallTime"),
+        "producer": Metric("pipeProducerTime"),
+        "batches": Metric("pipeBatches"),
+    }
+    src = _Source(20)
+    pipe = PipelinedIterator(src.gen(), depth=3, max_bytes=0, metrics=metrics)
+    list(pipe)
+    pipe.close()
+    assert metrics["batches"].value == 20
+    assert 1 <= metrics["depth"].value <= 3
+
+
+# ── end-to-end: pipelined vs direct paths agree ─────────────────────────────
+
+
+def _table(n: int = 4000) -> pa.Table:
+    rng = np.random.default_rng(11)
+    return pa.table(
+        {
+            "k": pa.array([f"g{i%17}" for i in range(n)]),
+            "v": rng.random(n) * 100,
+            "w": rng.integers(0, 1000, n).astype(np.int64),
+        }
+    )
+
+
+def _query(session, t):
+    from spark_rapids_tpu.functions import col, sum as sum_
+
+    return (
+        session.create_dataframe(t, num_partitions=3)
+        .filter(col("w") > 100)
+        .group_by("k")
+        .agg(sum_(col("v")).alias("sv"))
+        .sort("k")
+    )
+
+
+def test_pipeline_on_off_results_identical():
+    t = _table()
+    on = tpu_session({"spark.rapids.tpu.pipeline.enabled": True})
+    off = tpu_session({"spark.rapids.tpu.pipeline.enabled": False})
+    assert _query(on, t).collect() == _query(off, t).collect()
+
+
+def test_pipeline_differential_vs_cpu():
+    t = _table()
+    assert_cpu_and_tpu_equal(
+        lambda s: _query(s, t),
+        conf={"spark.rapids.tpu.pipeline.enabled": True},
+        approx_float=True,
+    )
+
+
+def test_limit_early_exit_through_pipeline():
+    t = _table(10_000)
+    tpu = tpu_session(
+        {
+            "spark.rapids.tpu.pipeline.enabled": True,
+            "spark.rapids.tpu.pipeline.maxBatches": 2,
+            # many small batches so the limit stops mid-stream
+            "spark.rapids.sql.batchSizeBytes": "40kb",
+        }
+    )
+    from spark_rapids_tpu.functions import col
+
+    rows = (
+        tpu.create_dataframe(_table(10_000), num_partitions=2)
+        .filter(col("w") >= 0)
+        .limit(7)
+        .collect()
+    )
+    assert len(rows) == 7
+
+
+def test_pipeline_metrics_reach_diag_report():
+    from spark_rapids_tpu.profiling import pipeline_report
+
+    t = _table()
+    tpu = tpu_session({"spark.rapids.tpu.pipeline.enabled": True})
+    _query(tpu, t).collect()
+    rep = pipeline_report(tpu._last_plan)
+    assert set(rep) == {
+        "dispatch_depth",
+        "overlap_frac",
+        "pipe_stall_ms",
+        "pipe_stalls",
+    }
+    assert rep["dispatch_depth"] >= 1, "pipeline never engaged at the sink"
+    assert 0.0 <= rep["overlap_frac"] <= 1.0
+
+
+def test_operator_failure_propagates_through_pipeline():
+    """A kernel-level failure inside the pipelined stream must fail the
+    query (on the consuming side), not hang or vanish."""
+    tpu = tpu_session(
+        {
+            "spark.rapids.tpu.pipeline.enabled": True,
+            "spark.sql.ansi.enabled": True,
+        }
+    )
+    from spark_rapids_tpu.functions import col
+    from spark_rapids_tpu.types import INT
+
+    t = pa.table({"a": pa.array([1, 2, 3], pa.int64())})
+    df = tpu.create_dataframe(t).select(
+        (col("a") * 10_000_000_000).cast(INT).alias("x")
+    )
+    with pytest.raises(Exception):
+        df.collect()

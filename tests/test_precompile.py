@@ -1,0 +1,193 @@
+"""Kernel pre-compilation pass (plan/planner.py precompile_plan +
+kernels.GuardedJit.warm) — ISSUE 1 tentpole #2.
+
+The contract under test:
+
+* the pass derives the EXACT batch geometry of scan-side chains, so every
+  warmed signature is hit by a real call at execution (a wrong-shape warm
+  would waste a compile and win nothing);
+* warming populates the persistent XLA cache, so a later compile of the
+  same program is a cache-dir HIT (no new cache entries) — the mechanism
+  by which a second process's ``compile_s`` drops vs. cold;
+* the kernels-module ``_BUILDS`` counter stays flat across re-preparation:
+  the pass never duplicates kernel objects.
+"""
+from __future__ import annotations
+
+import os
+import tempfile
+
+import jax
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu import TpuSession
+from spark_rapids_tpu import kernels as K
+from tests.harness import tpu_session
+
+
+def _table(n: int = 3000) -> pa.Table:
+    rng = np.random.default_rng(3)
+    return pa.table(
+        {
+            "k": pa.array([f"key{i % 11}" for i in range(n)]),
+            "q": rng.integers(1, 50, n).astype(np.int64),
+            "p": rng.random(n) * 1e4,
+        }
+    )
+
+
+def _query(session, t):
+    from spark_rapids_tpu.functions import col, sum as sum_
+
+    return (
+        session.create_dataframe(t, num_partitions=2)
+        .filter(col("q") > 5)
+        .group_by("k")
+        .agg(sum_(col("p")).alias("sp"))
+    )
+
+
+def _warmed_guarded_jits():
+    out = []
+    for fn in K._KERNELS.values():
+        gj = fn if hasattr(fn, "_warmed") else getattr(fn, "_fn", None)
+        if gj is not None and getattr(gj, "_warmed", None):
+            out.append(gj)
+    return out
+
+
+def test_precompile_warms_and_execution_hits_every_signature():
+    t = _table()
+    tpu = tpu_session({"spark.rapids.tpu.precompile.enabled": True})
+    df = _query(tpu, t)
+    warm0 = K.warm_count()
+    tpu._prepare_plan(df._plan)  # planning runs the pass
+    stats = tpu._last_precompile
+    assert stats["kernels"] >= 1, "pass collected no kernels for a scan chain"
+    assert K.warm_count() > warm0 or stats["warmed"] == 0
+    warmed = _warmed_guarded_jits()
+    assert warmed, "no GuardedJit holds a warmed signature"
+    df.collect()
+    for gj in warmed:
+        missed = gj._warmed - gj._seen
+        assert not missed, (
+            "precompiled signature never hit by a real call (wrong shape "
+            f"derivation): {missed}"
+        )
+
+
+def test_precompile_per_partition_string_widths_hit():
+    """String widths bucket PER CHUNK in host_to_device: a table whose
+    long strings live only in partition 0 gives each partition a different
+    padded width, and every warmed signature must match its partition's
+    real batch — a table-global max would warm a wide kernel partition 1
+    never runs."""
+    from spark_rapids_tpu.functions import col
+
+    n = 1000
+    vals = ["x" * 100 if i < 10 else "s" for i in range(n)]  # long in p0 only
+    t = pa.table(
+        {"k": pa.array(vals), "v": np.arange(n, dtype=np.int64)}
+    )
+    tpu = tpu_session({"spark.rapids.tpu.precompile.enabled": True})
+    df = (
+        tpu.create_dataframe(t, num_partitions=2)
+        .filter(col("v") >= 0)
+        .select(col("k"), (col("v") + 1).alias("v1"))
+    )
+    tpu._prepare_plan(df._plan)
+    assert tpu._last_precompile["kernels"] >= 2  # one per width variant
+    df.collect()
+    for gj in _warmed_guarded_jits():
+        assert not (gj._warmed - gj._seen), "warmed width variant never hit"
+
+
+def test_precompile_builds_no_duplicate_kernels():
+    """Re-preparing the same query warms nothing new and builds nothing
+    new — the pass rides the module kernel cache (_BUILDS flat)."""
+    t = _table()
+    tpu = tpu_session({"spark.rapids.tpu.precompile.enabled": True})
+    df = _query(tpu, t)
+    tpu._prepare_plan(df._plan)
+    builds0, warms0 = K.build_count(), K.warm_count()
+    tpu._prepare_plan(df._plan)
+    assert K.build_count() == builds0, "re-preparation built new kernels"
+    assert K.warm_count() == warms0, "re-preparation re-warmed a kernel"
+
+
+def test_precompile_kill_switch():
+    t = _table()
+    tpu = tpu_session({"spark.rapids.tpu.precompile.enabled": False})
+    warm0 = K.warm_count()
+    df = _query(tpu, t)
+    tpu._prepare_plan(df._plan)
+    assert tpu._last_precompile == {}
+    assert K.warm_count() == warm0
+
+
+def test_results_identical_with_and_without_precompile():
+    t = _table()
+    on = tpu_session({"spark.rapids.tpu.precompile.enabled": True})
+    off = tpu_session({"spark.rapids.tpu.precompile.enabled": False})
+    assert sorted(_query(on, t).collect()) == sorted(
+        _query(off, t).collect()
+    )
+
+
+def test_warm_populates_persistent_cache_and_second_compile_hits():
+    """GuardedJit.warm writes the persistent XLA cache; a FRESH GuardedJit
+    over the same program then compiles without adding cache entries (a
+    cache-dir hit) — how a second process's compile_s drops vs. cold."""
+    try:
+        from jax._src import compilation_cache as _cc
+    except ImportError:  # pragma: no cover - private API moved
+        pytest.skip("jax compilation_cache internals unavailable")
+    prev_dir = jax.config.jax_compilation_cache_dir
+    prev_min = jax.config.jax_persistent_cache_min_compile_time_secs
+    with tempfile.TemporaryDirectory(prefix="srt_xla_cache_") as d:
+        try:
+            jax.config.update("jax_compilation_cache_dir", d)
+            jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
+            # the cache singleton binds its directory at first backend use;
+            # re-point it at the temp dir for this test
+            _cc.reset_cache()
+
+            # unique constant so no earlier in-process compile can alias it
+            salt = float(np.random.default_rng().integers(1 << 30))
+
+            def fn(x):
+                return x * 2.0 + salt
+
+            spec = jax.ShapeDtypeStruct((128,), np.float64)
+            g1 = K.GuardedJit(fn)
+            assert g1.warm(spec)
+            entries = set(os.listdir(d))
+            assert entries, "warm wrote nothing to the persistent cache"
+
+            g2 = K.GuardedJit(fn)  # fresh jit, cold in-memory cache
+            assert g2.warm(spec)
+            assert set(os.listdir(d)) == entries, (
+                "second compile missed the persistent cache"
+            )
+        finally:
+            jax.config.update("jax_compilation_cache_dir", prev_dir)
+            jax.config.update(
+                "jax_persistent_cache_min_compile_time_secs", prev_min
+            )
+            _cc.reset_cache()
+
+
+def test_warm_skips_already_seen_signatures():
+    def fn(x):
+        return x + 1
+
+    g = K.GuardedJit(fn)
+    spec = jax.ShapeDtypeStruct((8,), np.int64)
+    assert g.warm(spec) is True
+    assert g.warm(spec) is False  # already warmed
+    out = g(np.arange(8, dtype=np.int64))
+    assert list(np.asarray(out)) == list(range(1, 9))
+    # real call recorded the signature: warm stays a no-op
+    assert g.warm(spec) is False
