@@ -195,6 +195,12 @@ def plan_diagnostics(session, wall_s: float) -> dict:
     pc = getattr(session, "_last_precompile", None)
     if pc and pc.get("kernels"):
         out["precompiled_kernels"] = pc.get("warmed", 0)
+    # fault-tolerance counters (resilience layer): oom_retries / splits /
+    # fetch_retries / peers_evicted / circuit_breaker_trips — zero on a
+    # healthy run, and the first thing to read when a run degraded
+    from spark_rapids_tpu.profiling import resilience_report
+
+    out["resilience"] = resilience_report(session)
     return out
 
 

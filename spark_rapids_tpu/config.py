@@ -513,6 +513,139 @@ MULTIPROC_SIZE = conf("spark.rapids.shuffle.multiproc.size").doc(
     "Total executors cooperating on the multi-process query."
 ).int_conf(1)
 
+SHUFFLE_HANDSHAKE_TIMEOUT_S = conf("spark.rapids.tpu.shuffle.handshakeTimeout").doc(
+    "Seconds the TCP transport waits for a dialing peer's HELLO frame "
+    "before dropping the connection (the WorkerAddress-exchange deadline)."
+).double_conf(10.0)
+
+HEARTBEAT_MAX_AGE_S = conf("spark.rapids.tpu.shuffle.heartbeatMaxAgeSeconds").doc(
+    "An executor whose last heartbeat is older than this is considered "
+    "dead and evicted from the peer registry (ShuffleHeartbeatManager."
+    "evict_stale); 0 disables age-based eviction."
+).double_conf(0.0)
+
+
+# ── resilience: OOM split-and-retry, fetch retry, circuit breaker ──────────
+
+RETRY_OOM_MAX_RETRIES = conf("spark.rapids.tpu.retry.oom.maxRetries").doc(
+    "Spill-and-retry attempts per kernel launch on a device OOM "
+    "(RESOURCE_EXHAUSTED) before the retry state machine starts splitting "
+    "the input batch (reference: DeviceMemoryEventHandler.scala:42-69 "
+    "spill-retry loop)."
+).int_conf(2)
+
+RETRY_OOM_SPLIT_ENABLED = conf("spark.rapids.tpu.retry.oom.splitEnabled").doc(
+    "After the spill-retry budget is exhausted, recursively halve the "
+    "input batch of splittable operators (project/filter, partial "
+    "aggregate update, join probe) and retry each half — the "
+    "split-and-retry escalation for work that genuinely does not fit."
+).boolean_conf(True)
+
+RETRY_OOM_MIN_SPLIT_ROWS = conf("spark.rapids.tpu.retry.oom.minSplitRows").doc(
+    "Floor on the batch capacity the OOM retry state machine will split "
+    "down to; a batch at or below this capacity that still OOMs fails "
+    "the task."
+).int_conf(1024)
+
+RETRY_FETCH_MAX_RETRIES = conf("spark.rapids.tpu.retry.fetch.maxRetries").doc(
+    "Per-peer shuffle fetch retries (metadata request or transfer wave) "
+    "before the fetch surfaces as a ShuffleFetchError; each retry "
+    "re-requests only the blocks not yet received."
+).int_conf(3)
+
+RETRY_FETCH_BACKOFF_MS = conf("spark.rapids.tpu.retry.fetch.backoffMs").doc(
+    "Base backoff between shuffle fetch retries; attempt k sleeps "
+    "backoffMs * 2^(k-1) with deterministic seeded jitter, capped by "
+    "spark.rapids.tpu.retry.fetch.maxBackoffMs."
+).double_conf(50.0)
+
+RETRY_FETCH_MAX_BACKOFF_MS = conf("spark.rapids.tpu.retry.fetch.maxBackoffMs").doc(
+    "Upper bound on the exponential shuffle-fetch backoff."
+).double_conf(2000.0)
+
+RETRY_FETCH_BLACKLIST_AFTER = conf("spark.rapids.tpu.retry.fetch.blacklistAfter").doc(
+    "Consecutive exhausted fetch-retry budgets against one peer before "
+    "that peer is blacklisted (evicted from the executor's peer table; "
+    "later fetches to it fail fast). 0 disables blacklisting."
+).int_conf(3)
+
+CIRCUIT_BREAKER_ENABLED = conf("spark.rapids.tpu.retry.circuitBreaker.enabled").doc(
+    "When a device kernel for an op signature fails repeatedly with "
+    "non-OOM XLA errors, mark that op CPU-fallback for the session and "
+    "log the reason in the explain output (the per-node fallback contract "
+    "extended to runtime failures)."
+).boolean_conf(True)
+
+CIRCUIT_BREAKER_THRESHOLD = conf("spark.rapids.tpu.retry.circuitBreaker.threshold").doc(
+    "Device-kernel failures for one op signature that trip its circuit "
+    "breaker."
+).int_conf(3)
+
+
+# ── deterministic fault injection (resilience/faults.py) ───────────────────
+
+FAULTS_ENABLED = conf("spark.rapids.tpu.faults.enabled").doc(
+    "Master switch for the deterministic fault-injection harness; all "
+    "spark.rapids.tpu.faults.* points are inert unless enabled. Drives "
+    "the chaos test suite — never enable in production."
+).boolean_conf(False)
+
+FAULTS_SEED = conf("spark.rapids.tpu.faults.seed").doc(
+    "Seed for the injection jitter RNG, so a chaos run replays "
+    "identically."
+).int_conf(0)
+
+FAULTS_DEVICE_OOM_EVERY_N = conf("spark.rapids.tpu.faults.deviceOomEveryN").doc(
+    "Raise a synthetic RESOURCE_EXHAUSTED on every Nth compiled-kernel "
+    "launch under an OOM-recovery scope (kernels.GuardedJit inside "
+    "with_oom_retry / the retry state machine) — each injection "
+    "deterministically exercises the spill/split recovery; 0 disables."
+).int_conf(0)
+
+FAULTS_OOM_ABOVE_BYTES = conf("spark.rapids.tpu.faults.oomAboveBytes").doc(
+    "Raise a synthetic RESOURCE_EXHAUSTED whenever a splittable operator "
+    "launches a batch larger than this many bytes — the deterministic "
+    "driver for demonstrating recursive split-and-retry; 0 disables."
+).bytes_conf(0)
+
+FAULTS_KERNEL_ERROR_EVERY_N = conf("spark.rapids.tpu.faults.kernelErrorEveryN").doc(
+    "Raise a synthetic non-OOM XLA error on every Nth splittable-operator "
+    "launch (drives the circuit breaker); 0 disables."
+).int_conf(0)
+
+FAULTS_COMPILE_FAIL_EVERY_N = conf("spark.rapids.tpu.faults.compileFailEveryN").doc(
+    "Fail every Nth first-touch kernel compile with a transient error "
+    "(exercises the compile retry path); 0 disables."
+).int_conf(0)
+
+FAULTS_SPILL_WRITE_ERROR_EVERY_N = conf(
+    "spark.rapids.tpu.faults.spill.writeErrorEveryN"
+).doc(
+    "Fail every Nth disk-tier spill write with an IO error (the buffer "
+    "stays at the host tier); 0 disables."
+).int_conf(0)
+
+FAULTS_SPILL_READ_ERROR_EVERY_N = conf(
+    "spark.rapids.tpu.faults.spill.readErrorEveryN"
+).doc(
+    "Fail every Nth disk-tier re-materialization read with an IO error "
+    "(surfaces as a catalog SpillError naming the buffer); 0 disables."
+).int_conf(0)
+
+FAULTS_TCP_DROP_EVERY_N = conf("spark.rapids.tpu.faults.transport.dropEveryN").doc(
+    "Silently drop every Nth outgoing shuffle DATA frame on the TCP "
+    "transport (the fetch times out and retries); 0 disables."
+).int_conf(0)
+
+FAULTS_TCP_DELAY_EVERY_N = conf("spark.rapids.tpu.faults.transport.delayEveryN").doc(
+    "Delay every Nth outgoing shuffle DATA frame by "
+    "spark.rapids.tpu.faults.transport.delayMs; 0 disables."
+).int_conf(0)
+
+FAULTS_TCP_DELAY_MS = conf("spark.rapids.tpu.faults.transport.delayMs").doc(
+    "Injected per-frame delay for the transport delay point."
+).double_conf(50.0)
+
 
 class TpuConf:
     """An immutable-ish view over a key→string dict, with typed access.

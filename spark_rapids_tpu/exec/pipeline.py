@@ -79,7 +79,18 @@ class PipelinedIterator:
 
     # ── producer side ───────────────────────────────────────────────────
     def _window_full(self) -> bool:
-        if len(self._buf) >= self._depth:
+        depth = self._depth
+        if depth > 1:
+            # resilience opt-in: while the OOM retry machinery has fired
+            # recently anywhere in the process, prefetching ahead only adds
+            # allocation pressure to a device that just ran out — clamp the
+            # dispatch window to one batch until the pressure signal ages
+            # out (resilience/retry.py oom_pressure)
+            from ..resilience import retry as _R
+
+            if _R.oom_pressure():
+                depth = 1
+        if len(self._buf) >= depth:
             return True
         # the bytes bound never blocks an EMPTY window: one batch must
         # always be able to flow or an oversized batch would deadlock

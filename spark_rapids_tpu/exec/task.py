@@ -184,7 +184,8 @@ def abstract_zero_vals() -> TaskVals:
     )
 
 
-def run_device(fn, it, needs_task):
+def run_device(fn, it, needs_task, catalog=None, policy=None, op=None,
+               breaker=None):
     """Drive a jitted kernel ``fn(batch, TaskVals)`` over device batches,
     sampling the thread-local task state only when the expression tree
     needs it (shared by TpuProjectExec/TpuFilterExec).
@@ -194,18 +195,40 @@ def run_device(fn, it, needs_task):
     async device add, where the old ``info.advance_rows(db.row_count())``
     paid a blocking host sync per batch — exactly the per-op stall the
     pipelined executor exists to remove. The host TaskInfo still provides
-    the partition id and the initial base."""
+    the partition id and the initial base.
+
+    With a ``catalog``/``policy``, each launch routes through the OOM retry
+    state machine (resilience/retry.py): spill-retry, then split-in-half —
+    project/filter are row-wise, so halves yield independently. Task-
+    dependent kernels keep spill-retry only: splitting would need per-half
+    row_base threading, and the task-dependent set (monotonically
+    increasing ids, input-file metadata) is never the memory hog."""
     import jax.numpy as jnp
+
+    from ..resilience import retry as R
 
     if not needs_task:
         zeros = zero_vals(jnp)
+        if policy is None:
+            for db in it:
+                yield fn(db, zeros)
+            return
         for db in it:
-            yield fn(db, zeros)
+            yield from R.run_with_retry(
+                catalog, lambda b: fn(b, zeros), db, policy, op=op,
+                breaker=breaker,
+            )
         return
     base = None  # device-resident running row count (no per-batch sync)
     for db in it:
         get_or_create()
         tv = task_vals(jnp, row_base=base)
-        out = fn(db, tv)
+        if policy is None:
+            out = fn(db, tv)
+        else:
+            out = R.run_once(
+                catalog, lambda b: fn(b, tv), db, policy, op=op,
+                breaker=breaker,
+            )
         base = tv.row_base + db.num_rows.astype(jnp.int64)
         yield out

@@ -362,11 +362,14 @@ class DeviceToHostExec(Exec):
                 ):
                     shrunk = [concat_device(shrunk)]
                 for db in shrunk:
+                    from ..mem.spill import with_oom_retry
+
+                    pull = lambda b: device_to_host(b, shrink=False)  # noqa: E731
                     if timing:
                         with time_m.timed():
-                            rb = device_to_host(db, shrink=False)
+                            rb = with_oom_retry(ctx.catalog, pull, db)
                     else:
-                        rb = device_to_host(db, shrink=False)
+                        rb = with_oom_retry(ctx.catalog, pull, db)
                     ctx.semaphore.release_if_necessary()
                     if rb.num_rows:
                         rows_m.add(rb.num_rows)
@@ -578,7 +581,13 @@ class TpuProjectExec(Exec):
         needs_task = self._needs_task
 
         def run(it):
-            return task.run_device(fn, it, needs_task)
+            # splittable-operator opt-in: OOM at a launch spills, retries,
+            # then recursively halves the batch (resilience/retry.py)
+            return task.run_device(
+                fn, it, needs_task, catalog=ctx.catalog,
+                policy=ctx.retry_policy, op="ProjectExec",
+                breaker=ctx.breaker,
+            )
 
         return self.children[0].execute(ctx).map_partitions(run)
 
@@ -607,7 +616,13 @@ class TpuFilterExec(Exec):
         needs_task = self._needs_task
 
         def run(it):
-            return task.run_device(fn, it, needs_task)
+            # splittable: a filter over concat(a, b) is concat(filter(a),
+            # filter(b)) — halves yield independently under OOM pressure
+            return task.run_device(
+                fn, it, needs_task, catalog=ctx.catalog,
+                policy=ctx.retry_policy, op="FilterExec",
+                breaker=ctx.breaker,
+            )
 
         return self.children[0].execute(ctx).map_partitions(run)
 
@@ -841,11 +856,13 @@ class TpuHashAggregateExec(Exec):
     def execute(self, ctx: ExecContext) -> PartitionSet:
         child, pre_filter = self._fused_child()
         from .. import config as cfg
+        from ..resilience import retry as R
 
         child_schema = child.output
         has_nans = cfg.HAS_NANS.get(ctx.conf)
         kernel = self._make_kernel(child_schema, pre_filter, has_nans)
         merge_jit = self._merge_jit(has_nans)
+        catalog, policy, breaker = ctx.catalog, ctx.retry_policy, ctx.breaker
 
         def run(it):
             if self.mode == "partial":
@@ -854,7 +871,17 @@ class TpuHashAggregateExec(Exec):
                 # partitions shrink outputs to the live-group bucket before
                 # the merge concat; single-batch outputs are shrunk by the
                 # consumer (exchange) in one cross-partition bulk sync.
-                partials = [kernel(db) for db in it]
+                # The update kernel is splittable (partials from the two
+                # halves merge downstream exactly like two input batches),
+                # so OOM escalates through the split state machine.
+                partials = []
+                for db in it:
+                    partials.extend(
+                        R.run_with_retry(
+                            catalog, kernel, db, policy,
+                            op="HashAggregateExec", breaker=breaker,
+                        )
+                    )
                 if not partials:
                     if self.grouping:
                         return
@@ -863,9 +890,14 @@ class TpuHashAggregateExec(Exec):
                     yield partials[0]
                 else:
                     partials = bulk_shrink(partials)
-                    yield merge_jit(concat_device(partials))
+                    yield R.run_once(
+                        catalog, merge_jit, concat_device(partials), policy,
+                        op="HashAggregateExec", breaker=breaker,
+                    )
                 return
             # final/complete: single merge+evaluate over the whole partition
+            # (NOT splittable: merging halves separately would emit two
+            # partial groups per key — spill-retry only)
             batches = list(it)
             if not batches:
                 if self.grouping:
@@ -896,9 +928,15 @@ class TpuHashAggregateExec(Exec):
                     has_nans,
                     collect_width=width,
                 )
-                yield ck(merged)
+                yield R.run_once(
+                    catalog, ck, merged, policy,
+                    op="HashAggregateExec", breaker=breaker,
+                )
                 return
-            yield kernel(merged)
+            yield R.run_once(
+                catalog, kernel, merged, policy,
+                op="HashAggregateExec", breaker=breaker,
+            )
 
         return child.execute(ctx).map_partitions(run)
 

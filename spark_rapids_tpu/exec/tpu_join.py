@@ -93,14 +93,20 @@ _PROBE_WINDOW = 8
 
 
 def _stream_probe_join(node, get_build, probe_thunk, phase1, phase2, jt,
-                       matched_acc=None):
+                       matched_acc=None, ctx=None):
     """One probe stream joined against one build batch — the shared loop
     under the shuffled, runtime-broadcast-switched, and broadcast joins.
     ``get_build(first_probe)`` supplies the build batch lazily (broadcast
     materializes it on the probe's device); ``matched_acc['m']`` (when
     given) accumulates build-row match bits for right/full null-extension.
-    """
+
+    The PROBE side is splittable (each probe row matches against the whole
+    build batch independently, and the match-bit accumulator ORs across
+    halves like across batches), so with an ``ctx`` the phase1 launch rides
+    the OOM retry/split state machine (resilience/retry.py)."""
     from itertools import islice
+
+    from ..resilience import retry as R
 
     build = None
     it = iter(probe_thunk())
@@ -119,7 +125,19 @@ def _stream_probe_join(node, get_build, probe_thunk, phase1, phase2, jt,
             # only one side's exchange took the mesh path — one jit needs
             # one device
             probe = _colocate_with(probe, build)
-            window.append((probe, phase1(build, probe)))
+            if ctx is not None:
+                window.extend(
+                    R.run_with_retry(
+                        ctx.catalog,
+                        lambda b: (b, phase1(build, b)),
+                        probe,
+                        ctx.retry_policy,
+                        op=node._breaker_op,
+                        breaker=ctx.breaker,
+                    )
+                )
+            else:
+                window.append((probe, phase1(build, probe)))
         if not window:
             return
         totals = jax.device_get([c.sum() for (_p, (_b, _l, c)) in window])
@@ -148,6 +166,10 @@ def _stream_probe_join(node, get_build, probe_thunk, phase1, phase2, jt,
 
 
 class TpuShuffledHashJoinExec(Exec):
+    #: planner rule name the circuit breaker counts runtime failures under
+    #: (plan/overrides.py consults breaker.check(rule.name))
+    _breaker_op = "ShuffledHashJoinExec"
+
     def __init__(
         self,
         join_type: str,
@@ -299,7 +321,8 @@ class TpuShuffledHashJoinExec(Exec):
         def make(lt):
             def it():
                 yield from _stream_probe_join(
-                    self, lambda _p: build_once(), lt, phase1, phase2, jt
+                    self, lambda _p: build_once(), lt, phase1, phase2, jt,
+                    ctx=ctx,
                 )
 
             return it
@@ -334,7 +357,8 @@ class TpuShuffledHashJoinExec(Exec):
                 )
                 acc = {"m": jnp.zeros(build.capacity, dtype=bool)}
                 yield from _stream_probe_join(
-                    self, lambda _p: build, lt, phase1, phase2, jt, acc
+                    self, lambda _p: build, lt, phase1, phase2, jt, acc,
+                    ctx=ctx,
                 )
                 if jt in ("right", "full"):
                     unmatched = (~acc["m"]) & build.row_mask()
@@ -430,6 +454,8 @@ class TpuBroadcastHashJoinExec(TpuShuffledHashJoinExec):
     limit) skips the tail via GeneratorExit, which is sound: every consumer
     had stopped wanting rows."""
 
+    _breaker_op = "BroadcastHashJoinExec"
+
     def execute(self, ctx: ExecContext) -> PartitionSet:
         left, right = self.children
         assert isinstance(right, TpuBroadcastExchangeExec)
@@ -451,6 +477,7 @@ class TpuBroadcastHashJoinExec(TpuShuffledHashJoinExec):
                         phase1,
                         phase2,
                         jt,
+                        ctx=ctx,
                     )
 
                 return it
@@ -478,7 +505,8 @@ class TpuBroadcastHashJoinExec(TpuShuffledHashJoinExec):
                 abandoned = False
                 try:
                     yield from _stream_probe_join(
-                        self, get_build, lt, phase1, phase2, jt, acc
+                        self, get_build, lt, phase1, phase2, jt, acc,
+                        ctx=ctx,
                     )
                     done = True
                 except GeneratorExit:

@@ -115,6 +115,14 @@ class GuardedJit:
         return True
 
     def __call__(self, *args):
+        from .resilience import faults as _faults
+
+        if _faults._ACTIVE is not None:
+            # chaos harness: synthetic RESOURCE_EXHAUSTED on the Nth launch
+            # (spark.rapids.tpu.faults.deviceOomEveryN) — surfaces exactly
+            # where a real allocation failure would, so the retry/spill/
+            # split machinery above this call is what recovers it
+            _faults.on_kernel_launch()
         sig = _args_sig(args)
         # capture _fn BEFORE the membership check: if another thread swaps
         # in a fresh (empty-cache) jit and clears _seen concurrently, a
@@ -144,6 +152,12 @@ class GuardedJit:
         mosaic_fallback_used = False
         while True:
             try:
+                from .resilience import faults as _faults
+
+                if _faults._ACTIVE is not None:
+                    # chaos harness: transient compile failure on the Nth
+                    # first-touch compile — recovered by the retry loop below
+                    _faults.on_kernel_compile()
                 return self._fn(*args)
             except Exception as e:  # noqa: BLE001 - classify, then re-raise
                 msg = str(e)
@@ -187,7 +201,9 @@ class GuardedJit:
                     attempts,
                     msg[:160],
                 )
-                time.sleep(2.0 * i)
+                # injected faults back off nominally — chaos runs assert on
+                # recovery, not on real remote-compile pacing
+                time.sleep(0.02 if "fault injection" in msg else 2.0 * i)
 
     def _cache_size(self):
         cs = getattr(self._fn, "_cache_size", None)

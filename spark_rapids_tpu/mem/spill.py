@@ -60,9 +60,21 @@ class SpillPriorities:
     OUTPUT_FOR_SHUFFLE = 200
 
 
+class SpillError(RuntimeError):
+    """A spill-tier operation failed in a way that loses or blocks access to
+    a registered buffer; the message always names the buffer id and tier so
+    the task-level failure is diagnosable (vs. a bare FileNotFoundError
+    from deep inside numpy)."""
+
+
 def _is_oom(err: BaseException) -> bool:
-    s = str(err)
-    return "RESOURCE_EXHAUSTED" in s or "Out of memory" in s or "out of memory" in s
+    """Robust OOM classification: walks the __cause__/__context__ chain
+    (resilience/retry.py), so a JaxRuntimeError wrapping an XlaRuntimeError
+    RESOURCE_EXHAUSTED classifies — the old top-level substring match
+    missed every wrapped error."""
+    from ..resilience.retry import is_oom_error
+
+    return is_oom_error(err)
 
 
 class SpillableBatch:
@@ -292,19 +304,54 @@ class BufferCatalog:
         self.host_bytes += buf.size
         self.spill_count += 1
 
-    def _host_to_disk(self, buf: _Buffer):
+    def _host_to_disk(self, buf: _Buffer) -> bool:
+        """Returns False when the disk write failed — the buffer stays at
+        the HOST tier (degraded but correct: host memory overshoots its
+        budget rather than losing data; the reference's disk store surfaces
+        the same IO errors to its spill loop)."""
         if self.debug:
             logging.getLogger(__name__).debug(
                 "spill buffer %d HOST->DISK (%d B, origin %s)",
                 buf.id, buf.size, buf.origin,
             )
+        try:
+            self._write_disk(buf)
+        except Exception as e:  # noqa: BLE001 - IO errors degrade, not crash
+            from ..resilience import retry as _R
+
+            _R.record("spill_write_errors")
+            if buf.path and os.path.exists(buf.path):
+                try:
+                    os.unlink(buf.path)  # never leave a partial frame behind
+                except OSError:
+                    pass
+            buf.path = None
+            logging.getLogger(__name__).warning(
+                "disk spill of buffer %d (%d B) failed, keeping it at the "
+                "HOST tier: %s", buf.id, buf.size, e,
+            )
+            return False
+        buf.host = None
+        buf.tier = StorageTier.DISK
+        self.host_bytes -= buf.size
+        self.disk_bytes += buf.size
+        self.spill_count += 1
+        return True
+
+    def _write_disk(self, buf: _Buffer):
+        from ..resilience import faults
+
+        faults.on_spill_write()
         from .. import native
 
         if native.available():
+            # buf.path is assigned BEFORE the write in both branches so the
+            # failure cleanup in _host_to_disk can unlink a partial file
             # Contiguous-frame spill (the reference's one-device-buffer
             # spill currency, GpuColumnVectorFromBuffer.java): one header +
             # all leaves packed into a single buffer, one write() syscall.
             path = os.path.join(self._dir(), f"buf{buf.id}.srtf")
+            buf.path = path
             leaves = [None if a is None else np.asarray(a) for a in buf.host]
             header = json.dumps(
                 {
@@ -326,18 +373,33 @@ class BufferCatalog:
                 )
         else:
             path = os.path.join(self._dir(), f"buf{buf.id}.npz")
+            buf.path = path
             arrays = {f"a{i}": (np.zeros(0) if a is None else np.asarray(a))
                       for i, a in enumerate(buf.host)}
             nones = [i for i, a in enumerate(buf.host) if a is None]
             np.savez(path, __none_idx=np.asarray(nones, dtype=np.int64), **arrays)
-        buf.path = path
-        buf.host = None
-        buf.tier = StorageTier.DISK
-        self.host_bytes -= buf.size
-        self.disk_bytes += buf.size
-        self.spill_count += 1
 
     def _disk_to_host(self, buf: _Buffer):
+        try:
+            from ..resilience import faults
+
+            faults.on_spill_read()
+            self._read_disk(buf)
+        except SpillError:
+            raise
+        except Exception as e:  # noqa: BLE001 - name the buffer and tier
+            raise SpillError(
+                f"buffer {buf.id} ({buf.size} B): failed to re-materialize "
+                f"from the DISK tier at {buf.path!r}: "
+                f"{type(e).__name__}: {e}"
+            ) from e
+        os.unlink(buf.path)
+        buf.path = None
+        buf.tier = StorageTier.HOST
+        self.disk_bytes -= buf.size
+        self.host_bytes += buf.size
+
+    def _read_disk(self, buf: _Buffer):
         if buf.path.endswith(".srtf"):
             from .. import native
 
@@ -362,11 +424,6 @@ class BufferCatalog:
                 nones = set(z["__none_idx"].tolist())
                 n = len([k for k in z.files if k.startswith("a")])
                 buf.host = [None if i in nones else z[f"a{i}"] for i in range(n)]
-        os.unlink(buf.path)
-        buf.path = None
-        buf.tier = StorageTier.HOST
-        self.disk_bytes -= buf.size
-        self.host_bytes += buf.size
 
     def _spill_order(self, tier: int, dev=None) -> list[_Buffer]:
         """Lowest priority first, then largest (frees most per spill).
@@ -438,15 +495,25 @@ class BufferCatalog:
 
 
 def with_oom_retry(catalog: Optional[BufferCatalog], fn: Callable, *args, retries: int = 2):
-    """Run device work; on XLA RESOURCE_EXHAUSTED spill everything spillable
-    and retry (DeviceMemoryEventHandler.scala:42-69 RMM-callback analogue,
-    relocated to the launch site because PJRT has no alloc callback)."""
+    """Run device work; on a device OOM (classified through the full cause
+    chain) spill everything spillable and retry
+    (DeviceMemoryEventHandler.scala:42-69 RMM-callback analogue, relocated
+    to the launch site because PJRT has no alloc callback). The splitting
+    escalation for operators that can shrink their input lives in
+    resilience/retry.py::run_with_retry; this is the non-splitting form."""
+    from ..resilience import faults, retry as R
+
     attempt = 0
     while True:
         try:
+            if faults._ACTIVE is not None:
+                with faults.recoverable():
+                    return fn(*args)
             return fn(*args)
-        except Exception as e:  # XlaRuntimeError lives in jaxlib; match by text
+        except Exception as e:  # noqa: BLE001 - classified below
             if catalog is None or not _is_oom(e) or attempt >= retries:
                 raise
             attempt += 1
+            R.record("oom_retries")
+            R._note_oom()
             catalog.synchronous_spill(catalog.device_bytes)

@@ -943,10 +943,17 @@ class ExplainEntry:
 
 
 class TpuOverrides:
-    """GpuOverrides + GpuTransitionOverrides, applied to a CPU physical plan."""
+    """GpuOverrides + GpuTransitionOverrides, applied to a CPU physical plan.
 
-    def __init__(self, conf: TpuConf):
+    ``breaker`` (resilience/breaker.py) is the session's CPU-fallback
+    circuit breaker: op signatures whose device kernels failed repeatedly
+    at RUNTIME are marked CPU-fallback here at the next planning pass,
+    with the reason in the explain output — the same surface a plan-time
+    fallback uses."""
+
+    def __init__(self, conf: TpuConf, breaker=None):
         self.conf = conf
+        self.breaker = breaker
         self.explain: List[ExplainEntry] = []
 
     def apply(self, plan: Exec) -> Exec:
@@ -1035,8 +1042,13 @@ class TpuOverrides:
                 ExplainEntry(plan.node_string(), False, reasons)
             )
             return plan.with_new_children(children)
+        breaker_reason = (
+            self.breaker.check(rule.name) if self.breaker is not None else None
+        )
         if not self.conf.rule_enabled(rule.conf_key):
             reasons.append(f"disabled by {rule.conf_key}")
+        elif breaker_reason:
+            reasons.append(breaker_reason)
         else:
             _check_schema(plan.output, self.conf, reasons, rule.name)
             if rule.check is not None:

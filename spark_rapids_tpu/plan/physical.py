@@ -74,6 +74,13 @@ class ExecContext:
 
         self.semaphore = DeviceSemaphore(cfg.CONCURRENT_TPU_TASKS.get(conf))
         self.catalog = BufferCatalog.from_conf(conf)
+        # resilience: the OOM retry/split policy splittable operators use,
+        # and the session's CPU-fallback circuit breaker (failures recorded
+        # here are consulted by the NEXT planning pass)
+        from ..resilience.retry import RetryPolicy
+
+        self.retry_policy = RetryPolicy.from_conf(conf)
+        self.breaker = getattr(session, "_breaker", None)
         self.metrics_level = METRIC_LEVELS.get(
             (cfg.METRICS_LEVEL.get(conf) or "MODERATE").upper(), 1
         )
@@ -174,7 +181,12 @@ class ExecContext:
                 heartbeats, registry = ds.connect((host, int(port)))
                 rank = cfg.MULTIPROC_RANK.get(self.conf)
                 executor_id = f"executor-{rank}"
-                transport = TcpTransport(executor_id)
+                transport = TcpTransport(
+                    executor_id,
+                    handshake_timeout_s=cfg.SHUFFLE_HANDSHAKE_TIMEOUT_S.get(
+                        self.conf
+                    ),
+                )
                 from ..mem.spill import BufferCatalog
 
                 # executor-lifetime store, NOT a query's catalog: shuffle
@@ -195,6 +207,13 @@ class ExecContext:
                     bounce_buffer_size=cfg.SHUFFLE_BOUNCE_BUFFER_SIZE.get(self.conf),
                     bounce_buffer_count=cfg.SHUFFLE_BOUNCE_BUFFER_COUNT.get(self.conf),
                     address=tuple(transport.address),
+                    fetch_max_retries=cfg.RETRY_FETCH_MAX_RETRIES.get(self.conf),
+                    fetch_backoff_ms=cfg.RETRY_FETCH_BACKOFF_MS.get(self.conf),
+                    fetch_max_backoff_ms=cfg.RETRY_FETCH_MAX_BACKOFF_MS.get(
+                        self.conf
+                    ),
+                    blacklist_after=cfg.RETRY_FETCH_BLACKLIST_AFTER.get(self.conf),
+                    heartbeat_max_age_s=cfg.HEARTBEAT_MAX_AGE_S.get(self.conf),
                 )
                 self._shuffle_manager = TpuShuffleManager(env, registry)
                 if self.session is not None:
@@ -211,6 +230,11 @@ class ExecContext:
                 fetch_timeout_s=cfg.SHUFFLE_FETCH_TIMEOUT_S.get(self.conf),
                 bounce_buffer_size=cfg.SHUFFLE_BOUNCE_BUFFER_SIZE.get(self.conf),
                 bounce_buffer_count=cfg.SHUFFLE_BOUNCE_BUFFER_COUNT.get(self.conf),
+                fetch_max_retries=cfg.RETRY_FETCH_MAX_RETRIES.get(self.conf),
+                fetch_backoff_ms=cfg.RETRY_FETCH_BACKOFF_MS.get(self.conf),
+                fetch_max_backoff_ms=cfg.RETRY_FETCH_MAX_BACKOFF_MS.get(self.conf),
+                blacklist_after=cfg.RETRY_FETCH_BLACKLIST_AFTER.get(self.conf),
+                heartbeat_max_age_s=cfg.HEARTBEAT_MAX_AGE_S.get(self.conf),
             )
             self._shuffle_manager = TpuShuffleManager(env, MapOutputRegistry())
         return self._shuffle_manager

@@ -158,6 +158,23 @@ def pipeline_report(plan: Exec) -> dict:
     }
 
 
+def resilience_report(session=None) -> dict:
+    """Fault-tolerance counters for the bench ``diag`` block (cumulative,
+    process-wide — resilience/retry.py): ``oom_retries`` (spill-and-retry
+    launches), ``splits`` (batch halvings), ``fetch_retries`` (shuffle
+    retry waves), ``peers_evicted`` (stale + blacklisted executors),
+    ``circuit_breaker_trips``, ``transport_reconnects``,
+    ``spill_write_errors`` and ``faults_injected`` (chaos harness). With a
+    ``session``, the circuit breaker's open set rides along."""
+    from .resilience import retry as R
+
+    out = R.report()
+    breaker = getattr(session, "_breaker", None)
+    if breaker is not None:
+        out["circuit_breaker_open"] = breaker.state()["open"]
+    return out
+
+
 def device_host_breakdown(plan: Exec) -> dict:
     """Aggregate totals for the bench JSON ``detail``: device-attributed
     op time vs host transfer time vs rows moved."""

@@ -1,0 +1,93 @@
+"""CPU-fallback circuit breaker — the per-node fallback contract extended to
+runtime failures.
+
+The reference decides CPU-vs-GPU per node at PLAN time (tagging rules,
+``willNotWorkOnGpu``); a kernel that compiles-and-plans fine but fails at
+RUNTIME (a Mosaic miscompile, an XLA backend bug on one op shape) would
+fail every retry of every query forever. The breaker closes that gap: the
+retry layer records non-OOM device failures per op signature (the planner
+rule name — ``ProjectExec``, ``HashAggregateExec`` …); at the threshold the
+breaker opens and the NEXT planning pass marks that op CPU-fallback for the
+rest of the session, with the reason in the explain output — exactly where
+a plan-time fallback would have shown up.
+
+OOM never trips the breaker (it has its own spill/split recovery), and
+deterministic semantic errors (ANSI, assertions) never reach it — the
+retry layer only records what ``is_device_error`` classifies."""
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Optional
+
+log = logging.getLogger(__name__)
+
+
+class CircuitBreaker:
+    """Per-session failure counts keyed by planner rule name."""
+
+    def __init__(self, threshold: int = 3, enabled: bool = True):
+        self.threshold = max(1, threshold)
+        self.enabled = enabled
+        self._lock = threading.Lock()
+        self._failures: dict[str, int] = {}
+        self._last_error: dict[str, str] = {}
+        self._open: set[str] = set()
+
+    @classmethod
+    def from_conf(cls, conf) -> "CircuitBreaker":
+        from .. import config as cfg
+
+        return cls(
+            threshold=cfg.CIRCUIT_BREAKER_THRESHOLD.get(conf),
+            enabled=cfg.CIRCUIT_BREAKER_ENABLED.get(conf),
+        )
+
+    def record_failure(self, op: str, err: BaseException) -> None:
+        if not self.enabled:
+            return
+        from . import retry as R
+
+        with self._lock:
+            n = self._failures.get(op, 0) + 1
+            self._failures[op] = n
+            self._last_error[op] = f"{type(err).__name__}: {str(err)[:160]}"
+            tripped = n >= self.threshold and op not in self._open
+            if tripped:
+                self._open.add(op)
+        if tripped:
+            R.record("circuit_breaker_trips")
+            log.warning(
+                "circuit breaker OPEN for %s after %d device-kernel failures; "
+                "the op runs on CPU for the rest of the session (last: %s)",
+                op, n, self._last_error.get(op),
+            )
+
+    def is_open(self, op: str) -> bool:
+        with self._lock:
+            return op in self._open
+
+    def check(self, op: str) -> Optional[str]:
+        """Explain-output reason when open, else None — the planner appends
+        this to the node's fallback reasons."""
+        with self._lock:
+            if op not in self._open:
+                return None
+            return (
+                f"circuit breaker open: {self._failures.get(op, 0)} device-"
+                f"kernel failures this session "
+                f"(last: {self._last_error.get(op, 'unknown')})"
+            )
+
+    def state(self) -> dict:
+        with self._lock:
+            return {
+                "open": sorted(self._open),
+                "failures": dict(self._failures),
+            }
+
+    def reset(self) -> None:
+        with self._lock:
+            self._failures.clear()
+            self._last_error.clear()
+            self._open.clear()

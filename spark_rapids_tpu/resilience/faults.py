@@ -1,0 +1,256 @@
+"""Deterministic fault injection — the chaos harness behind the resilience
+layer's tests.
+
+The reference validates its failure paths against mocked transports and
+forced RMM allocation failures (RapidsShuffleClientSuite.scala,
+DeviceMemoryEventHandlerSuite); PJRT offers no alloc hook to force, so the
+TPU engine injects faults at its own seams instead: compiled-kernel launches
+(kernels.GuardedJit), first-touch compiles, disk-tier spill IO
+(mem/spill.py), and outgoing shuffle DATA frames (shuffle/tcp.py). Every
+point is counter-driven ("every Nth event") from one seeded config, so a
+chaos run replays bit-identically — assertions can demand that results under
+injected faults equal the fault-free run.
+
+All points are inert (one ``is None`` check) unless a ``FaultConfig`` is
+installed, either by ``scoped()`` (tests) or by the session when
+``spark.rapids.tpu.faults.enabled`` is set.
+"""
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from contextlib import contextmanager
+from typing import Optional
+
+
+class InjectedFault(RuntimeError):
+    """A synthetic failure raised by an injection point. The message mimics
+    the real error class (RESOURCE_EXHAUSTED for OOM, UNAVAILABLE for
+    transient compiles) so classification paths treat it like the real
+    thing; ``kind`` lets tests assert on the injection itself."""
+
+    def __init__(self, kind: str, msg: str):
+        super().__init__(msg)
+        self.kind = kind
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultConfig:
+    """One chaos scenario (all counters per-process, deterministic)."""
+
+    seed: int = 0
+    device_oom_every_n: int = 0  # GuardedJit launches
+    oom_above_bytes: int = 0  # splittable-operator launches over this size
+    kernel_error_every_n: int = 0  # splittable-operator launches (non-OOM)
+    compile_fail_every_n: int = 0  # first-touch compiles
+    spill_write_error_every_n: int = 0  # host→disk spill writes
+    spill_read_error_every_n: int = 0  # disk→host re-materializations
+    tcp_drop_every_n: int = 0  # outgoing shuffle DATA frames
+    tcp_delay_every_n: int = 0
+    tcp_delay_ms: float = 0.0
+
+
+class FaultInjector:
+    """Counters + the decision logic for one installed FaultConfig."""
+
+    def __init__(self, config: FaultConfig):
+        self.config = config
+        self._lock = threading.Lock()
+        self._counters: dict[str, int] = {}
+        self.injected: dict[str, int] = {}
+
+    def _tick(self, point: str, every_n: int) -> bool:
+        if every_n <= 0:
+            return False
+        with self._lock:
+            n = self._counters.get(point, 0) + 1
+            self._counters[point] = n
+            if n % every_n:
+                return False
+            self.injected[point] = self.injected.get(point, 0) + 1
+            return True
+
+    def _record(self, point: str) -> None:
+        from . import retry as R
+
+        R.record("faults_injected")
+
+    # ── injection points ────────────────────────────────────────────────
+    def on_kernel_launch(self) -> None:
+        """Every compiled-kernel call (kernels.GuardedJit.__call__)."""
+        if self._tick("kernel_launch", self.config.device_oom_every_n):
+            self._record("kernel_launch")
+            raise InjectedFault(
+                "oom", "RESOURCE_EXHAUSTED: injected device OOM (fault injection)"
+            )
+
+    def on_batch_launch(self, size_bytes: int) -> None:
+        """Every splittable-operator launch, with the batch size known
+        (resilience/retry.py — the seam the split state machine watches)."""
+        c = self.config
+        if c.oom_above_bytes and size_bytes > c.oom_above_bytes:
+            with self._lock:
+                self.injected["oom_above_bytes"] = (
+                    self.injected.get("oom_above_bytes", 0) + 1
+                )
+            self._record("oom_above_bytes")
+            raise InjectedFault(
+                "oom",
+                f"RESOURCE_EXHAUSTED: injected OOM — batch of {size_bytes} B "
+                f"exceeds the injected device budget of {c.oom_above_bytes} B",
+            )
+        if self._tick("batch_launch", c.kernel_error_every_n):
+            self._record("batch_launch")
+            raise InjectedFault(
+                "kernel",
+                "INTERNAL: injected XlaRuntimeError — device kernel failed "
+                "(fault injection)",
+            )
+
+    def on_kernel_compile(self) -> None:
+        """First-touch compiles (kernels.GuardedJit._first_call)."""
+        if self._tick("kernel_compile", self.config.compile_fail_every_n):
+            self._record("kernel_compile")
+            raise InjectedFault(
+                "compile",
+                "UNAVAILABLE: injected remote_compile failure (fault injection)",
+            )
+
+    def on_spill_write(self) -> None:
+        if self._tick("spill_write", self.config.spill_write_error_every_n):
+            self._record("spill_write")
+            raise InjectedFault("io", "injected spill-disk write IO error")
+
+    def on_spill_read(self) -> None:
+        if self._tick("spill_read", self.config.spill_read_error_every_n):
+            self._record("spill_read")
+            raise InjectedFault("io", "injected spill-disk read IO error")
+
+    def on_tcp_data_frame(self) -> bool:
+        """Returns True when the frame should be DROPPED; may also sleep
+        (injected delay). Called only for DATA frames — control frames
+        stay reliable, like a lossy link under a reliable RPC layer."""
+        c = self.config
+        if self._tick("tcp_delay", c.tcp_delay_every_n) and c.tcp_delay_ms > 0:
+            time.sleep(c.tcp_delay_ms / 1e3)
+        if self._tick("tcp_drop", c.tcp_drop_every_n):
+            self._record("tcp_drop")
+            return True
+        return False
+
+
+_ACTIVE: Optional[FaultInjector] = None
+_INSTALL_LOCK = threading.Lock()
+_TLS = threading.local()
+
+
+def active() -> Optional[FaultInjector]:
+    return _ACTIVE
+
+
+@contextmanager
+def recoverable():
+    """Marks the dynamic extent of a launch that has inline OOM recovery
+    above it (resilience/retry.py run_once/run_with_retry, spill.py
+    with_oom_retry). ``deviceOomEveryN`` fires ONLY inside this scope:
+    injecting a synthetic OOM at a launch nothing recovers would only
+    assert that unrecoverable failures fail — every covered launch instead
+    exercises the spill/split machinery deterministically."""
+    depth = getattr(_TLS, "depth", 0)
+    _TLS.depth = depth + 1
+    try:
+        yield
+    finally:
+        _TLS.depth = depth
+
+
+def in_recoverable_scope() -> bool:
+    return getattr(_TLS, "depth", 0) > 0
+
+
+# Module-level fast paths: one attribute read when no injector is installed.
+def on_kernel_launch() -> None:
+    inj = _ACTIVE
+    if inj is not None and in_recoverable_scope():
+        inj.on_kernel_launch()
+
+
+def on_batch_launch(size_bytes: int) -> None:
+    inj = _ACTIVE
+    if inj is not None:
+        inj.on_batch_launch(size_bytes)
+
+
+def on_kernel_compile() -> None:
+    inj = _ACTIVE
+    if inj is not None:
+        inj.on_kernel_compile()
+
+
+def on_spill_write() -> None:
+    inj = _ACTIVE
+    if inj is not None:
+        inj.on_spill_write()
+
+
+def on_spill_read() -> None:
+    inj = _ACTIVE
+    if inj is not None:
+        inj.on_spill_read()
+
+
+def drop_tcp_data_frame() -> bool:
+    inj = _ACTIVE
+    if inj is not None:
+        return inj.on_tcp_data_frame()
+    return False
+
+
+@contextmanager
+def scoped(config_or_injector):
+    """Install a fault scenario process-wide for the duration of the block
+    (no-op when None). Accepts a ``FaultConfig`` (fresh counters) or a
+    ``FaultInjector`` (counters persist across scopes — the session reuses
+    ONE injector for its lifetime so every-Nth counters accumulate across
+    queries). The injector is global on purpose: partition tasks run on
+    thread pools and the injection points must see it from any thread.
+    Scopes do not nest — an inner scope temporarily shadows the outer one."""
+    global _ACTIVE
+    if config_or_injector is None:
+        yield None
+        return
+    inj = (
+        config_or_injector
+        if isinstance(config_or_injector, FaultInjector)
+        else FaultInjector(config_or_injector)
+    )
+    with _INSTALL_LOCK:
+        prev = _ACTIVE
+        _ACTIVE = inj
+    try:
+        yield inj
+    finally:
+        with _INSTALL_LOCK:
+            _ACTIVE = prev
+
+
+def config_from_conf(conf) -> Optional[FaultConfig]:
+    """FaultConfig from the spark.rapids.tpu.faults.* keys; None unless
+    spark.rapids.tpu.faults.enabled."""
+    from .. import config as cfg
+
+    if not cfg.FAULTS_ENABLED.get(conf):
+        return None
+    return FaultConfig(
+        seed=cfg.FAULTS_SEED.get(conf),
+        device_oom_every_n=cfg.FAULTS_DEVICE_OOM_EVERY_N.get(conf),
+        oom_above_bytes=cfg.FAULTS_OOM_ABOVE_BYTES.get(conf),
+        kernel_error_every_n=cfg.FAULTS_KERNEL_ERROR_EVERY_N.get(conf),
+        compile_fail_every_n=cfg.FAULTS_COMPILE_FAIL_EVERY_N.get(conf),
+        spill_write_error_every_n=cfg.FAULTS_SPILL_WRITE_ERROR_EVERY_N.get(conf),
+        spill_read_error_every_n=cfg.FAULTS_SPILL_READ_ERROR_EVERY_N.get(conf),
+        tcp_drop_every_n=cfg.FAULTS_TCP_DROP_EVERY_N.get(conf),
+        tcp_delay_every_n=cfg.FAULTS_TCP_DELAY_EVERY_N.get(conf),
+        tcp_delay_ms=cfg.FAULTS_TCP_DELAY_MS.get(conf),
+    )

@@ -83,6 +83,14 @@ class TpuSession:
         import threading as _threading
 
         self._retry_lock = _threading.Lock()
+        # resilience: session-lifetime CPU-fallback circuit breaker (runtime
+        # kernel failures flip ops to CPU at the next planning pass) and the
+        # deterministic fault-injection scenario (None unless
+        # spark.rapids.tpu.faults.enabled — chaos testing only)
+        from .resilience import CircuitBreaker
+
+        self._breaker = CircuitBreaker.from_conf(self.conf)
+        self._fault_injector = self._build_fault_injector()
         if cfg.MULTIPROC_DRIVER.get(self.conf):
             # fail fast on inconsistent multi-process settings — a missing
             # piece silently double-counts (every rank runs the full query)
@@ -97,6 +105,14 @@ class TpuSession:
                 raise ValueError(
                     f"multiproc rank/size invalid: rank={rank} size={size}"
                 )
+
+    def _build_fault_injector(self):
+        """One injector for the session's lifetime, so every-Nth fault
+        counters accumulate across queries (None unless faults enabled)."""
+        from .resilience import faults as _faults
+
+        config = _faults.config_from_conf(self.conf)
+        return None if config is None else _faults.FaultInjector(config)
 
     def sql(self, text: str) -> "DataFrame":
         """Run a SELECT statement over registered temp views (sql/ package —
@@ -161,6 +177,8 @@ class TpuSession:
 
     def set_conf(self, key: str, value: Any):
         self.conf = self.conf.set(key, value)
+        if key.startswith("spark.rapids.tpu.faults."):
+            self._fault_injector = self._build_fault_injector()
 
     # ── execution ───────────────────────────────────────────────────────
     def _resolve_subqueries(self, lp: L.LogicalPlan) -> L.LogicalPlan:
@@ -316,14 +334,19 @@ class TpuSession:
                 h2d.pop(k, None)
 
     def _execute(self, lp: L.LogicalPlan) -> pa.Table:
-        final_plan, ctx = self._prepare_plan(lp)
-        from .profiling import query_trace
+        from .resilience import faults as _faults
 
-        try:
-            with query_trace(cfg.PROFILE_PATH.get(self.conf)):
-                return self._run_plan(final_plan, ctx)
-        finally:
-            self._leak_check(ctx)
+        # chaos harness scope: injection points fire only while THIS
+        # session's queries execute (no-op when faults are not enabled)
+        with _faults.scoped(self._fault_injector):
+            final_plan, ctx = self._prepare_plan(lp)
+            from .profiling import query_trace
+
+            try:
+                with query_trace(cfg.PROFILE_PATH.get(self.conf)):
+                    return self._run_plan(final_plan, ctx)
+            finally:
+                self._leak_check(ctx)
 
     def _leak_check(self, ctx) -> None:
         if ctx.catalog.debug:
@@ -375,7 +398,7 @@ class TpuSession:
             )
         lp = prune_columns(lp)
         cpu_plan = plan_physical(lp, self.conf)
-        overrides = TpuOverrides(self.conf)
+        overrides = TpuOverrides(self.conf, breaker=self._breaker)
         final_plan = overrides.apply(cpu_plan)
         if cfg.EXCHANGE_REUSE_ENABLED.get(self.conf):
             from .plan.reuse import reuse_exchanges

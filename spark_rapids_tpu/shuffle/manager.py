@@ -78,6 +78,12 @@ class ShuffleEnv:
         fetch_timeout_s: float = 120.0,
         bounce_buffer_size: int = 4 << 20,
         bounce_buffer_count: int = 8,
+        fetch_max_retries: int = 3,
+        fetch_backoff_ms: float = 50.0,
+        fetch_max_backoff_ms: float = 2000.0,
+        blacklist_after: int = 3,
+        retry_seed: int = 0,
+        heartbeat_max_age_s: float = 0.0,
     ):
         from .bounce import BounceBufferManager
 
@@ -88,6 +94,11 @@ class ShuffleEnv:
         self.codec: CompressionCodec = get_codec(codec)
         self.throttle = InflightThrottle(max_inflight_bytes)
         self.fetch_timeout_s = fetch_timeout_s
+        self.fetch_max_retries = fetch_max_retries
+        self.fetch_backoff_ms = fetch_backoff_ms
+        self.fetch_max_backoff_ms = fetch_max_backoff_ms
+        self.blacklist_after = blacklist_after
+        self.retry_seed = retry_seed
         self.server = ShuffleServer(
             executor_id,
             transport.server,
@@ -95,14 +106,62 @@ class ShuffleEnv:
             self.codec,
             BounceBufferManager(bounce_buffer_size, bounce_buffer_count),
         )
-        self.heartbeat = HeartbeatEndpoint(executor_id, heartbeat, address)
+        self.heartbeat = HeartbeatEndpoint(
+            executor_id, heartbeat, address, max_age_s=heartbeat_max_age_s
+        )
         self._clients: Dict[str, "ShuffleClient"] = {}
         self._lock = threading.Lock()
+        # consecutive exhausted-retry-budget counts per peer; at
+        # ``blacklist_after`` the peer is evicted from the local table and
+        # later fetches to it fail fast (FetchFailedException semantics —
+        # the stage retry can reschedule around the dead executor)
+        self._peer_failures: Dict[str, int] = {}
+        self._blacklist: set = set()
+
+    def _on_fetch_result(self, peer_executor_id: str, ok: bool) -> None:
+        """ShuffleClient outcome callback: success resets the consecutive-
+        failure count; an exhausted retry budget advances it toward the
+        blacklist threshold."""
+        with self._lock:
+            if ok:
+                self._peer_failures.pop(peer_executor_id, None)
+                return
+            n = self._peer_failures.get(peer_executor_id, 0) + 1
+            self._peer_failures[peer_executor_id] = n
+            trip = (
+                self.blacklist_after > 0
+                and n >= self.blacklist_after
+                and peer_executor_id not in self._blacklist
+            )
+            if trip:
+                self._blacklist.add(peer_executor_id)
+        if trip:
+            import logging
+
+            from ..resilience import retry as R
+
+            R.record("peers_evicted")
+            self.heartbeat.drop_peer(peer_executor_id)
+            logging.getLogger(__name__).warning(
+                "peer %s blacklisted after %d consecutive fetch failures",
+                peer_executor_id, n,
+            )
+
+    def blacklisted(self, peer_executor_id: str) -> bool:
+        with self._lock:
+            return peer_executor_id in self._blacklist
 
     def client_to(self, peer_executor_id: str) -> "ShuffleClient":
         """One ShuffleClient per peer connection — it owns the connection's
         frame handler, and concurrent fetches multiplex by tag."""
+        from .client import ShuffleFetchError
+
         with self._lock:
+            if peer_executor_id in self._blacklist:
+                raise ShuffleFetchError(
+                    f"peer {peer_executor_id} is blacklisted after repeated "
+                    "fetch failures"
+                )
             client = self._clients.get(peer_executor_id)
             if client is None:
                 self.heartbeat.heartbeat()  # refresh peer table
@@ -110,7 +169,15 @@ class ShuffleEnv:
                 addr = peer.address if peer is not None else None
                 conn = self.transport.connect(peer_executor_id, addr)
                 client = ShuffleClient(
-                    conn, self.received, self.throttle, self.fetch_timeout_s
+                    conn,
+                    self.received,
+                    self.throttle,
+                    self.fetch_timeout_s,
+                    max_retries=self.fetch_max_retries,
+                    backoff_ms=self.fetch_backoff_ms,
+                    max_backoff_ms=self.fetch_max_backoff_ms,
+                    retry_seed=self.retry_seed,
+                    on_fetch_result=self._on_fetch_result,
                 )
                 self._clients[peer_executor_id] = client
         return client

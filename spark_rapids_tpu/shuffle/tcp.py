@@ -65,6 +65,15 @@ def set_injection(latency_ms: float = 0.0, bandwidth_mbps: float = 0.0) -> None:
 
 
 def _send_frame(sock: socket.socket, lock: threading.Lock, kind: int, a: int, b: int, payload: bytes):
+    if kind == _DATA:
+        # deterministic fault injection (resilience/faults.py): DATA frames
+        # may be dropped or delayed — the fetch layer's timeout + retry is
+        # what recovers. Control frames stay reliable (a lossy link under a
+        # reliable RPC layer).
+        from ..resilience import faults as _faults
+
+        if _faults._ACTIVE is not None and _faults.drop_tcp_data_frame():
+            return
     with lock:
         if _INJECT["latency_s"] > 0:
             _time.sleep(_INJECT["latency_s"])
@@ -108,6 +117,7 @@ class _TcpChannel:
         self.pending: Dict[int, Transaction] = {}
         self.pending_lock = threading.Lock()
         self.client_conn: Optional["_TcpClientConnection"] = None
+        self.dead = False  # set when the read loop exits (socket dropped)
         self.reader = threading.Thread(target=self._read_loop, daemon=True)
         self.reader.start()
 
@@ -131,6 +141,7 @@ class _TcpChannel:
                     if self.client_conn is not None:
                         self.client_conn.deliver_frame(a, 0, payload)
         except (ConnectionError, OSError):
+            self.dead = True
             with self.pending_lock:
                 for tx in self.pending.values():
                     tx.complete(TransactionStatus.ERROR, error="connection lost")
@@ -138,24 +149,61 @@ class _TcpChannel:
 
 
 class _TcpClientConnection(ClientConnection):
-    def __init__(self, channel: _TcpChannel):
+    """Client role over one channel, with reconnect-on-drop: when the
+    channel's socket died (peer restart, dropped TCP session), the next
+    ``request`` redials the peer and retries the send once — a transient
+    transport fault costs one reconnect, not a poisoned connection object
+    that fails every later fetch (the resilience-layer transport
+    contract)."""
+
+    def __init__(self, channel: _TcpChannel, transport: "TcpTransport",
+                 address: Optional[tuple]):
         super().__init__(channel.peer_id)
         self._channel = channel
+        self._transport = transport
+        self._address = address
+        self._redial_lock = threading.Lock()
         self._req_ids = itertools.count(1)
+
+    def _live_channel(self) -> _TcpChannel:
+        ch = self._channel
+        if not ch.dead:
+            return ch
+        with self._redial_lock:
+            if self._channel.dead:
+                if self._address is None:
+                    raise ConnectionError(
+                        f"channel to {self.peer_executor_id} is dead and no "
+                        "dial address is known"
+                    )
+                from ..resilience import retry as R
+
+                self._channel = self._transport._dial(
+                    self.peer_executor_id, self._address, self
+                )
+                R.record("transport_reconnects")
+            return self._channel
 
     def request(self, req_type: int, payload: bytes) -> Transaction:
         tx = new_transaction()
         rid = next(self._req_ids)  # pending table is per-channel, so a plain counter is unique
-        with self._channel.pending_lock:
-            self._channel.pending[rid] = tx
-        try:
-            _send_frame(
-                self._channel.sock, self._channel.wlock, _REQUEST, rid, req_type, payload
-            )
-        except OSError as e:
-            with self._channel.pending_lock:
-                self._channel.pending.pop(rid, None)
-            tx.complete(TransactionStatus.ERROR, error=str(e))
+        for attempt in (0, 1):  # second attempt after a reconnect
+            try:
+                ch = self._live_channel()
+            except (ConnectionError, OSError) as e:
+                tx.complete(TransactionStatus.ERROR, error=str(e))
+                return tx
+            with ch.pending_lock:
+                ch.pending[rid] = tx
+            try:
+                _send_frame(ch.sock, ch.wlock, _REQUEST, rid, req_type, payload)
+                return tx
+            except OSError as e:
+                ch.dead = True
+                with ch.pending_lock:
+                    ch.pending.pop(rid, None)
+                if attempt == 1:
+                    tx.complete(TransactionStatus.ERROR, error=str(e))
         return tx
 
     def close(self):
@@ -188,8 +236,12 @@ class TcpTransport(Transport):
     """One listener per executor; ``address`` is the (host, port) peers dial
     — the BlockManagerId topology-info analogue carried by heartbeats."""
 
-    def __init__(self, executor_id: str, host: str = "127.0.0.1", port: int = 0, workers: int = 4):
+    def __init__(self, executor_id: str, host: str = "127.0.0.1", port: int = 0,
+                 workers: int = 4, handshake_timeout_s: float = 10.0):
         super().__init__(executor_id)
+        #: HELLO-frame deadline for dialing peers
+        #: (spark.rapids.tpu.shuffle.handshakeTimeout)
+        self.handshake_timeout_s = handshake_timeout_s
         self._listener = socket.create_server((host, port))
         self.address = self._listener.getsockname()
         self._server = _TcpServerConnection(self)
@@ -217,7 +269,7 @@ class TcpTransport(Transport):
 
     def _handshake(self, sock: socket.socket):
         try:
-            sock.settimeout(10.0)
+            sock.settimeout(self.handshake_timeout_s)
             sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             kind, _a, _b, payload = _recv_frame(sock)
             if kind != _HELLO:
@@ -238,14 +290,24 @@ class TcpTransport(Transport):
         table; omitted → the peer was registered locally (tests)."""
         if address is None:
             address = _ADDRESSES[peer_executor_id]
+        ch = self._dial(peer_executor_id, tuple(address), None)
+        conn = _TcpClientConnection(ch, self, tuple(address))
+        ch.client_conn = conn
+        return conn
+
+    def _dial(self, peer_executor_id: str, address: tuple,
+              conn: Optional[_TcpClientConnection]) -> _TcpChannel:
+        """Open a socket + HELLO handshake + channel; shared by first
+        connect and reconnect-on-drop (``conn`` rebinds to the new
+        channel's frame delivery)."""
         sock = socket.create_connection(tuple(address))
         sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         lock = threading.Lock()
         _send_frame(sock, lock, _HELLO, 0, 0, self.executor_id.encode())
         ch = _TcpChannel(self, sock, peer_executor_id, wlock=lock)
-        conn = _TcpClientConnection(ch)
-        ch.client_conn = conn
-        return conn
+        if conn is not None:
+            ch.client_conn = conn
+        return ch
 
     def _dispatch_request(self, ch: _TcpChannel, req_id: int, req_type: int, payload: bytes):
         def run():

@@ -30,6 +30,11 @@ class TransactionStatus:
     CANCELLED = 3
 
 
+class FetchCancelled(Exception):
+    """Raised out of a blocking transport wait when the owning fetch was
+    abandoned — the issuer-thread shutdown signal, never user-visible."""
+
+
 class Transaction:
     """One async send/receive/request with completion callback + wait
     (RapidsShuffleTransport.scala Transaction)."""
@@ -60,6 +65,26 @@ class Transaction:
         if not self._done.wait(timeout):
             raise TimeoutError(f"transaction {self.tx_id} timed out")
         return self
+
+    def wait_cancellable(
+        self,
+        timeout: Optional[float],
+        cancel: Optional[threading.Event],
+        poll_s: float = 0.05,
+    ) -> "Transaction":
+        """``wait`` that also aborts (FetchCancelled) when ``cancel`` fires
+        — so a fetch-issuer thread blocked on a peer response can be shut
+        down promptly instead of leaking until the full timeout."""
+        import time
+
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            if self._done.wait(poll_s):
+                return self
+            if cancel is not None and cancel.is_set():
+                raise FetchCancelled(f"transaction {self.tx_id} cancelled")
+            if deadline is not None and time.monotonic() > deadline:
+                raise TimeoutError(f"transaction {self.tx_id} timed out")
 
 
 _tx_counter = itertools.count(1)
@@ -151,23 +176,39 @@ class InflightThrottle:
         self._waiters: List[tuple] = []  # heap of (size, seq)
         self._seq = itertools.count()
 
-    def acquire(self, nbytes: int, timeout: Optional[float] = None):
+    def acquire(self, nbytes: int, timeout: Optional[float] = None,
+                cancel: Optional["threading.Event"] = None):
         """Block until nbytes may go inflight. Requests larger than the
-        window are admitted alone (never deadlock)."""
+        window are admitted alone (never deadlock). A ``cancel`` event
+        interrupts the wait with ``FetchCancelled`` — the fetch-abandonment
+        path uses it so an issuer thread parked here can be shut down
+        instead of leaked (``kick`` wakes the waiters to re-check)."""
         with self._lock:
             me = (nbytes, next(self._seq))
             heapq.heappush(self._waiters, me)
             deadline_ok = self._lock.wait_for(
-                lambda: self._waiters[0] == me
-                and (self._inflight == 0 or self._inflight + nbytes <= self.max_bytes),
+                lambda: (cancel is not None and cancel.is_set())
+                or (
+                    self._waiters[0] == me
+                    and (self._inflight == 0 or self._inflight + nbytes <= self.max_bytes)
+                ),
                 timeout,
             )
+            if cancel is not None and cancel.is_set():
+                self._waiters.remove(me)
+                heapq.heapify(self._waiters)
+                raise FetchCancelled("shuffle fetch cancelled")
             if not deadline_ok:
                 self._waiters.remove(me)
                 heapq.heapify(self._waiters)
                 raise TimeoutError("shuffle fetch throttle timeout")
             heapq.heappop(self._waiters)
             self._inflight += nbytes
+            self._lock.notify_all()
+
+    def kick(self):
+        """Wake every waiter to re-check its predicate (cancellation)."""
+        with self._lock:
             self._lock.notify_all()
 
     def release(self, nbytes: int):
