@@ -71,3 +71,14 @@ golden:
 bench-dry:
 	BENCH_PLATFORM=cpu BENCH_SF=0.02 BENCH_PARTITIONS=2 \
 	  BENCH_SHUFFLE_PARTITIONS=2 BENCH_RUNS=1 $(PY) bench.py
+
+# Trace one TPC-H query through the bench rig: `make trace Q=6` writes
+# traces/query-<n>.trace.json (open at ui.perfetto.dev), the per-query
+# metrics artifact, and a Prometheus dump (docs/observability.md).
+TRACE_DIR ?= traces
+Q ?= 6
+.PHONY: trace
+trace:
+	BENCH_PLATFORM=$(or $(BENCH_PLATFORM),cpu) BENCH_SF=0.05 \
+	  BENCH_PARTITIONS=2 BENCH_SHUFFLE_PARTITIONS=2 BENCH_RUNS=1 \
+	  $(PY) bench.py --trace-dir $(TRACE_DIR) --queries $(Q)

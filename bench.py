@@ -154,7 +154,7 @@ def plan_diagnostics(session, wall_s: float) -> dict:
     plan = getattr(session, "_last_plan", None)
     if plan is None:
         return {}
-    from spark_rapids_tpu.profiling import (
+    from spark_rapids_tpu.obs.export import (
         device_host_breakdown,
         pipeline_report,
         walk,
@@ -190,17 +190,23 @@ def plan_diagnostics(session, wall_s: float) -> dict:
         "top_ops_ms": dict(list(bd["per_node_ms"].items())[:6]),
     }
     # dispatch-ahead pipeline health: dispatch_depth / overlap_frac /
-    # per-stage stalls (exec/pipeline.py via profiling.pipeline_report)
+    # per-stage stalls (exec/pipeline.py via obs.export.pipeline_report)
     out.update(pipeline_report(plan))
     pc = getattr(session, "_last_precompile", None)
     if pc and pc.get("kernels"):
         out["precompiled_kernels"] = pc.get("warmed", 0)
     # fault-tolerance counters (resilience layer): oom_retries / splits /
     # fetch_retries / peers_evicted / circuit_breaker_trips — zero on a
-    # healthy run, and the first thing to read when a run degraded
-    from spark_rapids_tpu.profiling import resilience_report
+    # healthy run, and the first thing to read when a run degraded.
+    # (pipeline_report + resilience_report are the obs/export views now;
+    # with --trace-dir the same run also writes per-query trace + metrics
+    # artifacts from the session's tracer.)
+    from spark_rapids_tpu.obs.export import resilience_report
 
     out["resilience"] = resilience_report(session)
+    tracer = getattr(session, "_last_tracer", None)
+    if tracer is not None:
+        out["trace_spans"] = tracer.span_count
     return out
 
 
@@ -260,12 +266,21 @@ def geomean(xs) -> float:
 def _suite_args():
     suite = os.environ.get("BENCH_SUITE", "tpch")
     smoke = os.environ.get("BENCH_SMOKE", "") == "1"
+    trace_dir = os.environ.get("BENCH_TRACE_DIR", "")
+    queries = os.environ.get("BENCH_QUERIES", "")
     argv = sys.argv[1:]
     if "--smoke" in argv:
         smoke = True
     if "--suite" in argv:
         suite = argv[argv.index("--suite") + 1]
-    return suite, smoke
+    if "--trace-dir" in argv:
+        trace_dir = argv[argv.index("--trace-dir") + 1]
+    if "--queries" in argv:
+        queries = argv[argv.index("--queries") + 1]
+    qids = tuple(
+        int(q.strip().lstrip("q")) for q in queries.split(",") if q.strip()
+    )
+    return suite, smoke, trace_dir, qids
 
 
 def run_query_pair(name, build_t, build_c, tpu, n_run, speedups, detail,
@@ -380,7 +395,7 @@ TPCDS_DEFAULT_SLICE = (3, 7, 12, 19, 27, 34, 42, 52, 55, 68, 96, 98)
 
 def main() -> None:
     t_start = time.monotonic()
-    suite, smoke = _suite_args()
+    suite, smoke, trace_dir, only_qids = _suite_args()
     if BENCH_PLATFORM:
         import jax
 
@@ -428,12 +443,19 @@ def main() -> None:
         partitions = 2
 
     shuffle_conf = {"spark.sql.shuffle.partitions": SHUFFLE_PARTITIONS if not smoke else 2}
+    trace_conf = {}
+    if trace_dir:
+        # per-query Perfetto trace + metrics artifact (obs/ subsystem);
+        # the diag block stays in the JSON either way
+        os.makedirs(trace_dir, exist_ok=True)
+        trace_conf["spark.rapids.tpu.trace.dir"] = trace_dir
     tpu = TpuSession({
         "spark.rapids.sql.enabled": True,
         # float round() on device (TPC-DS uses it heavily); the reference's
         # published benchmarks run with incompatibleOps enabled the same way
         "spark.rapids.sql.incompatibleOps.enabled": True,
         **shuffle_conf,
+        **trace_conf,
     })
     cpu = TpuSession({"spark.rapids.sql.enabled": False, **shuffle_conf})
 
@@ -443,6 +465,8 @@ def main() -> None:
     tpch_tables = None
     if suite in ("tpch", "both"):
         qids = (1, 6, 3) if smoke else tuple(range(1, 23))
+        if only_qids:
+            qids = only_qids  # --queries / make trace Q=<n> selection
         sp, qdetail, tpch_tables = run_tpch(tpu, cpu, sf, partitions, qids, n_run)
         speedups.extend(sp)
         detail["sf"] = sf
@@ -454,6 +478,8 @@ def main() -> None:
             ds_qids = (3, 42, 52) if smoke else tuple(range(1, 100))
         else:
             ds_qids = (3, 42, 52) if smoke else TPCDS_DEFAULT_SLICE
+        if only_qids:
+            ds_qids = only_qids  # --queries filters every active suite
         ds_sp, ds_detail = run_tpcds(tpu, cpu, tpcds_sf, partitions, ds_qids, n_run)
         detail["tpcds"] = {
             "sf": tpcds_sf,
@@ -498,6 +524,18 @@ def main() -> None:
         except Exception as e:  # noqa: BLE001
             scan_detail["error"] = str(e)[-300:]
         detail["scan"] = scan_detail
+
+    if trace_dir:
+        # one Prometheus text dump for the whole run (kernel-compile, spill,
+        # shuffle, resilience series + the last plan's per-op metrics)
+        from spark_rapids_tpu.obs.export import prometheus_text
+
+        prom_path = os.path.join(trace_dir, "metrics.prom")
+        with open(prom_path, "w") as f:
+            f.write(prometheus_text(plan=getattr(tpu, "_last_plan", None),
+                                    session=tpu))
+        detail["trace_dir"] = trace_dir
+        log({"trace_dir": trace_dir, "prometheus": prom_path})
 
     geo = geomean(speedups)
     detail["wall_s"] = round(time.monotonic() - t_start, 1)

@@ -349,6 +349,40 @@ METRICS_LEVEL = conf("spark.rapids.sql.metrics.level").doc(
     "(reference: RapidsConf.scala:456)."
 ).string_conf("MODERATE")
 
+METRICS_LEVEL_TPU = conf("spark.rapids.tpu.metrics.level").doc(
+    "TPU-engine override of spark.rapids.sql.metrics.level for the obs/ "
+    "subsystem: ESSENTIAL (counters only — no per-batch timer reads), "
+    "MODERATE (plus transfer/pipeline timings) or DEBUG (plus opTime "
+    "device-time attribution). Unset inherits the sql key."
+).string_conf(None)
+
+TRACE_ENABLED = conf("spark.rapids.tpu.trace.enabled").doc(
+    "Hierarchical query tracing (obs/trace.py): each sampled query records "
+    "query → operator → batch spans — including work executed on pipeline "
+    "producer threads via span-context propagation — into a ring buffer "
+    "exportable as Chrome-trace/Perfetto JSON. Implied by "
+    "spark.rapids.tpu.trace.dir; see docs/observability.md."
+).boolean_conf(False)
+
+TRACE_SAMPLE = conf("spark.rapids.tpu.trace.sample").doc(
+    "Fraction of queries traced when tracing is enabled (Dapper-style "
+    "sampling): 1.0 traces every query, 0.01 one in a hundred. The "
+    "per-query decision is deterministic in the session's query sequence "
+    "number, so a rerun traces the same queries."
+).double_conf(1.0)
+
+TRACE_DIR = conf("spark.rapids.tpu.trace.dir").doc(
+    "When set, every traced query writes query-<n>.trace.json (Chrome-"
+    "trace/Perfetto: load at ui.perfetto.dev) and query-<n>.metrics.json "
+    "(the per-query metrics artifact) into this directory. Setting it "
+    "implies spark.rapids.tpu.trace.enabled."
+).string_conf(None)
+
+TRACE_BUFFER_SPANS = conf("spark.rapids.tpu.trace.bufferSpans").doc(
+    "Span ring-buffer capacity per traced query; the oldest spans are "
+    "overwritten beyond it (the exporter reports the drop count)."
+).int_conf(65536)
+
 CPU_ONLY = conf("spark.rapids.tpu.cpuOnly").doc(
     "Force the JAX CPU backend (testing; the virtual-device mesh path)."
 ).internal().boolean_conf(False)

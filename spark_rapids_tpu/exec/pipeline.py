@@ -38,6 +38,9 @@ import threading
 import time
 from typing import Callable, Iterator, Optional
 
+from ..obs import trace as obs_trace
+from ..obs.metrics import MetricKind
+
 
 class PipelinedIterator:
     """Bounded dispatch-ahead prefetcher over an iterator of batches.
@@ -72,6 +75,11 @@ class PipelinedIterator:
         self._done = False
         self._error: Optional[BaseException] = None
         self._last_size = 0
+        # span-context propagation (obs/trace.py): capture the consuming
+        # thread's current span so upstream work pulled on the producer
+        # thread attributes under the operator that spawned the pipeline —
+        # not outside the query trace (the pre-obs attribution hole)
+        self._trace_ctx = obs_trace.capture_context()
         self._thread = threading.Thread(
             target=self._produce, name="srt-pipeline", daemon=True
         )
@@ -101,6 +109,7 @@ class PipelinedIterator:
         )
 
     def _produce(self) -> None:
+        obs_trace.attach_context(self._trace_ctx)
         m_prod = self._metrics.get("producer")
         m_full = self._metrics.get("wait_full")
         m_depth = self._metrics.get("depth")
@@ -234,17 +243,21 @@ def pipeline_conf(ctx) -> Optional[dict]:
     }
 
 
-def pipe_metrics(node) -> dict:
-    """The five ``pipe*`` metrics of a pipelined sink. Call ONCE per
-    execute() — on the single-threaded plan-walk — and pass the dict into
-    ``pipelined_partition``: partition thunks run on a thread pool, and
-    Exec.metric's check-then-insert is not safe to race."""
+def pipe_metrics(node, ctx=None) -> dict:
+    """The five ``pipe*`` metrics of a pipelined sink (typed: the window
+    depth is a high-watermark, the three waits are nanos timers). Call once
+    per execute() — on the single-threaded plan-walk — and pass the dict
+    into ``pipelined_partition`` so partition thunks share one metric set.
+    With a ``ctx`` the MODERATE level gates collection: at ESSENTIAL the
+    sink publishes nothing (the hot loop's no-obs-work contract)."""
+    if ctx is not None and not node.metrics_on(ctx, "MODERATE"):
+        return {}
     return {
-        "depth": node.metric("pipeDispatchDepth", "MODERATE"),
-        "stall": node.metric("pipeStallTime", "MODERATE"),
-        "producer": node.metric("pipeProducerTime", "MODERATE"),
-        "wait_full": node.metric("pipeWaitFullTime", "MODERATE"),
-        "batches": node.metric("pipeBatches", "MODERATE"),
+        "depth": node.metric("pipeDispatchDepth", "MODERATE", MetricKind.WATERMARK),
+        "stall": node.metric("pipeStallTime", "MODERATE", MetricKind.NANOS),
+        "producer": node.metric("pipeProducerTime", "MODERATE", MetricKind.NANOS),
+        "wait_full": node.metric("pipeWaitFullTime", "MODERATE", MetricKind.NANOS),
+        "batches": node.metric("pipeBatches", "MODERATE", MetricKind.COUNTER),
     }
 
 

@@ -385,7 +385,7 @@ class DeviceToHostExec(Exec):
         from .pipeline import pipe_metrics, pipeline_conf, pipelined_partition
 
         pconf = pipeline_conf(ctx)
-        metrics = pipe_metrics(self) if pconf is not None else None
+        metrics = pipe_metrics(self, ctx) if pconf is not None else None
 
         def run(it):
             return pipelined_partition(pconf, ctx, it, fn, metrics)
@@ -2511,7 +2511,7 @@ class TpuLimitExec(Exec):
         from .pipeline import pipe_metrics, pipeline_conf, pipelined_partition
 
         pconf = pipeline_conf(ctx)
-        metrics = pipe_metrics(self) if pconf is not None else None
+        metrics = pipe_metrics(self, ctx) if pconf is not None else None
 
         def it():
             remaining = limit

@@ -30,10 +30,20 @@ from typing import Callable
 
 import jax
 
+from .obs import metrics as obs_metrics
+from .obs import trace as obs_trace
+
 _LOCK = threading.Lock()
 _KERNELS: dict = {}
-_BUILDS = 0  # number of distinct kernels built (cache misses)
-_WARMS = 0  # number of pre-compilations performed (GuardedJit.warm)
+
+# typed process metrics (obs/metrics.py catalog) replacing the old module
+# counters: compile-vs-execute attribution, cache behavior, precompiles
+_M_BUILDS = obs_metrics.GLOBAL.counter("kernel.builds")
+_M_CACHE_HITS = obs_metrics.GLOBAL.counter("kernel.cacheHits")
+_M_WARMS = obs_metrics.GLOBAL.counter("kernel.warms")
+_M_WARM_NS = obs_metrics.GLOBAL.timer("kernel.warmTimeNs")
+_M_FIRST_CALLS = obs_metrics.GLOBAL.counter("kernel.firstCalls")
+_M_COMPILE_NS = obs_metrics.GLOBAL.timer("kernel.compileTimeNs")
 
 
 def kernel(key: tuple, builder: Callable):
@@ -42,7 +52,6 @@ def kernel(key: tuple, builder: Callable):
     ``builder`` returns the (usually jitted) callable; it must close over
     nothing whose lifetime matters — everything semantic belongs in the key.
     """
-    global _BUILDS
     fn = _KERNELS.get(key)
     if fn is None:
         with _LOCK:
@@ -50,7 +59,9 @@ def kernel(key: tuple, builder: Callable):
             if fn is None:
                 fn = builder()
                 _KERNELS[key] = fn
-                _BUILDS += 1
+                _M_BUILDS.add(1)
+                return fn
+    _M_CACHE_HITS.add(1)
     return fn
 
 
@@ -100,18 +111,17 @@ class GuardedJit:
         concurrent-compile SIGSEGV); on other backends warms run
         concurrently, bounded by the precompile pool. Returns False when
         the signature was already compiled or warmed."""
-        global _WARMS
         sig = _args_sig(args)
         if sig in self._seen or sig in self._warmed:
             return False
-        if jax.default_backend() == "cpu":
-            with _COMPILE_LOCK:
+        with _M_WARM_NS.timed():
+            if jax.default_backend() == "cpu":
+                with _COMPILE_LOCK:
+                    self._fn.lower(*args).compile()
+            else:
                 self._fn.lower(*args).compile()
-        else:
-            self._fn.lower(*args).compile()
         self._warmed.add(sig)
-        with _LOCK:
-            _WARMS += 1
+        _M_WARMS.add(1)
         return True
 
     def __call__(self, *args):
@@ -150,6 +160,9 @@ class GuardedJit:
         attempts = 4
         i = 0
         mosaic_fallback_used = False
+        # once per first execution — retry attempts and the Mosaic-fallback
+        # retrace accumulate compile TIME but are not more first calls
+        _M_FIRST_CALLS.add(1)
         while True:
             try:
                 from .resilience import faults as _faults
@@ -158,7 +171,9 @@ class GuardedJit:
                     # chaos harness: transient compile failure on the Nth
                     # first-touch compile — recovered by the retry loop below
                     _faults.on_kernel_compile()
-                return self._fn(*args)
+                with obs_trace.span("xla-compile", "kernel"):
+                    with _M_COMPILE_NS.timed():
+                        return self._fn(*args)
             except Exception as e:  # noqa: BLE001 - classify, then re-raise
                 msg = str(e)
                 from .ops import pallas_strings as _ps
@@ -227,12 +242,12 @@ def schema_key(schema) -> tuple:
 
 def build_count() -> int:
     """Distinct kernels built so far (monotonic; cache misses)."""
-    return _BUILDS
+    return _M_BUILDS.value
 
 
 def warm_count() -> int:
     """Pre-compilations performed so far (monotonic; GuardedJit.warm)."""
-    return _WARMS
+    return _M_WARMS.value
 
 
 def precompile_worthwhile() -> bool:

@@ -9,6 +9,14 @@ device-memory working set across concurrent tasks — the same role here, where
 from __future__ import annotations
 
 import threading
+import time
+
+from ..obs import metrics as obs_metrics
+
+# admission-control telemetry: how often tasks take the device, and how
+# long they block waiting for a permit (the reference's semaphoreWaitTime)
+_M_ACQUIRES = obs_metrics.GLOBAL.counter("semaphore.acquires")
+_M_WAIT_NS = obs_metrics.GLOBAL.timer("semaphore.waitNs")
 
 
 class DeviceSemaphore:
@@ -19,8 +27,14 @@ class DeviceSemaphore:
     def acquire_if_necessary(self):
         """Idempotent per-thread acquire (GpuSemaphore.acquireIfNecessary)."""
         if getattr(self._held, "count", 0) == 0:
-            self._sem.acquire()
+            if not self._sem.acquire(blocking=False):
+                # contended path only pays the timer (the common uncontended
+                # acquire stays two branch instructions)
+                t0 = time.perf_counter_ns()
+                self._sem.acquire()
+                _M_WAIT_NS.add(time.perf_counter_ns() - t0)
             self._held.count = 1
+            _M_ACQUIRES.add(1)
 
     def release_if_necessary(self):
         if getattr(self._held, "count", 0) > 0:

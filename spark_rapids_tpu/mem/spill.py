@@ -39,6 +39,16 @@ import numpy as np
 
 from .. import config as cfg
 from ..columnar.device import DeviceBatch
+from ..obs import metrics as obs_metrics
+
+# process-wide spill telemetry (obs/metrics.py catalog): bytes by tier
+# transition plus the HBM high-watermark, sampled at batch boundaries
+# (register / re-materialize — the points device_bytes changes)
+_M_D2H = obs_metrics.GLOBAL.counter("spill.bytesDeviceToHost")
+_M_H2D_DISK = obs_metrics.GLOBAL.counter("spill.bytesHostToDisk")
+_M_DISK2H = obs_metrics.GLOBAL.counter("spill.bytesDiskToHost")
+_M_SPILLS = obs_metrics.GLOBAL.counter("spill.count")
+_M_HBM_PEAK = obs_metrics.GLOBAL.watermark("mem.deviceBytesHighWatermark")
 
 
 class StorageTier:
@@ -223,6 +233,7 @@ class BufferCatalog:
             self._buffers[buf.id] = buf
             self.device_bytes += size
             self._dev_add(dev, size)
+            _M_HBM_PEAK.set_max(self.device_bytes)
         return SpillableBatch(self, buf.id, batch.schema, size)
 
     def leak_report(self) -> list:
@@ -262,6 +273,7 @@ class BufferCatalog:
             self.host_bytes -= buf.size
             self.device_bytes += buf.size
             self._dev_add(buf.dev, buf.size)
+            _M_HBM_PEAK.set_max(self.device_bytes)
             return batch
 
     def _unpin(self, buf_id: int):
@@ -303,6 +315,8 @@ class BufferCatalog:
         buf.dev = None
         self.host_bytes += buf.size
         self.spill_count += 1
+        _M_D2H.add(buf.size)
+        _M_SPILLS.add(1)
 
     def _host_to_disk(self, buf: _Buffer) -> bool:
         """Returns False when the disk write failed — the buffer stays at
@@ -336,6 +350,8 @@ class BufferCatalog:
         self.host_bytes -= buf.size
         self.disk_bytes += buf.size
         self.spill_count += 1
+        _M_H2D_DISK.add(buf.size)
+        _M_SPILLS.add(1)
         return True
 
     def _write_disk(self, buf: _Buffer):
@@ -398,6 +414,7 @@ class BufferCatalog:
         buf.tier = StorageTier.HOST
         self.disk_bytes -= buf.size
         self.host_bytes += buf.size
+        _M_DISK2H.add(buf.size)
 
     def _read_disk(self, buf: _Buffer):
         if buf.path.endswith(".srtf"):

@@ -1,0 +1,28 @@
+"""Unified observability subsystem — typed metrics, hierarchical tracing,
+and exporters (PR 4 tentpole).
+
+The reference accelerator diagnoses regressions and fallbacks through a rich
+per-operator metric set (``GpuMetric``: opTime, concatTime, spillTime,
+peakDevMemory, numOutputBatches/Rows — GpuExec.scala:40-157) surfaced in the
+Spark SQL UI, plus a dedicated profiling tool. This package is that layer
+for the TPU engine, in three planes:
+
+- :mod:`spark_rapids_tpu.obs.metrics` — typed metric registry (counter /
+  nanos-timer / gauge / high-watermark, each ESSENTIAL/MODERATE/DEBUG) used
+  per-operator-instance (``Exec.metrics``) and process-wide (``GLOBAL``:
+  kernel compiles, spill bytes by tier, shuffle bytes, semaphore waits,
+  resilience counters).
+- :mod:`spark_rapids_tpu.obs.trace` — hierarchical spans
+  (query → operator → batch / kernel-compile) in a lock-cheap ring buffer
+  with explicit span-context propagation into pipeline producer threads,
+  opt-in sampling, and a Chrome-trace/Perfetto JSON exporter (the Dapper
+  model: cheap sampled spans with propagated context).
+- :mod:`spark_rapids_tpu.obs.export` — Prometheus text-format dump,
+  per-query JSON artifact, and the ``df.explain("metrics")`` renderer
+  (reference-style per-op metrics inline on the physical plan).
+
+``profiling.py`` remains the stable public surface; its report entry points
+are thin shims over this package.
+"""
+from . import metrics, trace  # noqa: F401
+from .metrics import GLOBAL, Metric, MetricKind, MetricRegistry  # noqa: F401

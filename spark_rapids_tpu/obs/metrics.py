@@ -1,0 +1,268 @@
+"""Typed metric registry — the ``GpuMetric`` analogue, generalized.
+
+Reference: GpuExec.scala:40-157 — one metric class with ESSENTIAL /
+MODERATE / DEBUG levels gated by ``spark.rapids.sql.metrics.level``, plus
+the Spark ``SQLMetrics`` accumulator taxonomy (sum / timing / size /
+average). Here a :class:`Metric` is one thread-safe value with a *kind*
+that tells exporters how to render it:
+
+- ``COUNTER``   — monotonic sum (rows, bytes, retries, cache hits);
+- ``NANOS``     — accumulated ``perf_counter_ns`` durations (rendered ms);
+- ``GAUGE``     — last-set value (dispatch window, pool size);
+- ``WATERMARK`` — high-watermark via ``set_max`` (peak HBM bytes, max
+  in-flight depth — the reference's ``peakDevMemory``).
+
+A :class:`MetricRegistry` is a dict of metrics with a *locked*
+get-or-create (``Exec.metric``'s old check-then-insert raced under the
+pipeline's producer threads). Two scopes exist:
+
+- per-operator-instance: ``Exec.metrics`` (plan/physical.py) — rebuilt per
+  query with the plan;
+- process-wide: :data:`GLOBAL` — kernel compile/warm counts, spill bytes by
+  tier, shuffle bytes, semaphore waits, resilience counters. Module-level
+  code (kernels.py, mem/, shuffle/, resilience/) publishes here; sessions
+  read it through :mod:`spark_rapids_tpu.obs.export` views.
+
+This module is dependency-free (stdlib threading only) so every layer of
+the engine can import it without cycles.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, Iterable, Optional
+
+METRIC_LEVELS = {"ESSENTIAL": 0, "MODERATE": 1, "DEBUG": 2}
+
+
+class MetricKind:
+    COUNTER = "counter"
+    NANOS = "nanos"
+    GAUGE = "gauge"
+    WATERMARK = "watermark"
+
+
+def infer_kind(name: str) -> str:
+    """Kind from naming convention when a call site doesn't say: ``*Time`` /
+    ``*Ns`` are timers, ``peak*`` / ``*HighWatermark`` are watermarks."""
+    if name.endswith("Time") or name.endswith("Ns") or name.endswith("TimeNs"):
+        return MetricKind.NANOS
+    low = name.lower()
+    if low.startswith("peak") or low.endswith("highwatermark"):
+        return MetricKind.WATERMARK
+    return MetricKind.COUNTER
+
+
+class Metric:
+    """One thread-safe metric value (the GpuMetric analogue)."""
+
+    __slots__ = ("name", "value", "level", "kind", "_lock")
+
+    def __init__(
+        self,
+        name: str,
+        level: str = "ESSENTIAL",
+        kind: Optional[str] = None,
+    ):
+        self.name = name
+        self.value = 0
+        self.level = level
+        self.kind = kind or infer_kind(name)
+        self._lock = threading.Lock()
+
+    def add(self, v: int):
+        with self._lock:
+            self.value += v
+
+    def set(self, v: int):
+        """Gauge semantics: last write wins."""
+        with self._lock:
+            self.value = v
+
+    def set_max(self, v: int):
+        """High-water-mark semantics (e.g. pipeline dispatch depth)."""
+        with self._lock:
+            if v > self.value:
+                self.value = v
+
+    class _Timer:
+        __slots__ = ("m", "t0")
+
+        def __init__(self, m):
+            self.m = m
+
+        def __enter__(self):
+            self.t0 = time.perf_counter_ns()
+            return self
+
+        def __exit__(self, *a):
+            self.m.add(time.perf_counter_ns() - self.t0)
+
+    def timed(self) -> "_Timer":
+        return Metric._Timer(self)
+
+    def __repr__(self):
+        return f"Metric({self.name}={self.value}, {self.kind}/{self.level})"
+
+
+class _NullMetric:
+    """Shared no-op sink for metrics gated off by the level conf: call
+    sites keep one unconditional code path with zero per-batch allocation
+    or bookkeeping (the <2% instrumentation-cost contract)."""
+
+    __slots__ = ()
+    name = "__null__"
+    value = 0
+    level = "DEBUG"
+    kind = MetricKind.COUNTER
+
+    def add(self, v: int):
+        pass
+
+    def set(self, v: int):
+        pass
+
+    def set_max(self, v: int):
+        pass
+
+    class _NoopTimer:
+        __slots__ = ()
+
+        def __enter__(self):
+            return self
+
+        def __exit__(self, *a):
+            pass
+
+    _TIMER = _NoopTimer()
+
+    def timed(self):
+        return _NullMetric._TIMER
+
+
+NULL_METRIC = _NullMetric()
+
+
+class MetricRegistry(dict):
+    """name → :class:`Metric` with a locked get-or-create.
+
+    Subclasses ``dict`` so existing consumers (``node.metrics.values()``,
+    ``.get(name)``, iteration) keep working unchanged.
+    """
+
+    def __init__(self, scope: str = ""):
+        super().__init__()
+        self.scope = scope
+        self._lock = threading.Lock()
+
+    def get_or_create(
+        self, name: str, level: str = "ESSENTIAL", kind: Optional[str] = None
+    ) -> Metric:
+        m = self.get(name)
+        if m is None:
+            with self._lock:
+                m = self.get(name)
+                if m is None:
+                    m = Metric(name, level, kind)
+                    self[name] = m
+        return m
+
+    # kind shorthands (the typed-registry surface)
+    def counter(self, name: str, level: str = "ESSENTIAL") -> Metric:
+        return self.get_or_create(name, level, MetricKind.COUNTER)
+
+    def timer(self, name: str, level: str = "ESSENTIAL") -> Metric:
+        return self.get_or_create(name, level, MetricKind.NANOS)
+
+    def gauge(self, name: str, level: str = "ESSENTIAL") -> Metric:
+        return self.get_or_create(name, level, MetricKind.GAUGE)
+
+    def watermark(self, name: str, level: str = "ESSENTIAL") -> Metric:
+        return self.get_or_create(name, level, MetricKind.WATERMARK)
+
+    def snapshot(self) -> Dict[str, int]:
+        """Point-in-time name → value (stable iteration copy)."""
+        with self._lock:
+            return {name: m.value for name, m in self.items()}
+
+    def view(self, prefix: str, strip: bool = True) -> Dict[str, int]:
+        """Snapshot of the metrics under ``prefix`` (``resilience.``,
+        ``spill.`` …), optionally with the prefix stripped — the registry
+        view the old bespoke report functions became."""
+        with self._lock:
+            return {
+                (name[len(prefix):] if strip else name): m.value
+                for name, m in self.items()
+                if name.startswith(prefix)
+            }
+
+    def reset(self, prefix: str = "") -> None:
+        """Zero the metrics under ``prefix`` ('' = all). Values are zeroed
+        in place — published references stay live. Each metric's own lock
+        is taken so a racing ``add`` cannot resurrect the pre-reset total
+        (the unlocked write could land inside add's read-modify-write)."""
+        with self._lock:
+            for name, m in self.items():
+                if name.startswith(prefix):
+                    with m._lock:
+                        m.value = 0
+
+
+#: Process-wide registry (kernel compiles, spill tiers, shuffle bytes,
+#: semaphore waits, resilience counters). Sessions read it via export views.
+GLOBAL = MetricRegistry(scope="process")
+
+
+# ── well-known process metrics (the metric catalog) ─────────────────────────
+# Registered eagerly so exporters always emit the full series set (a
+# Prometheus scrape sees `spark_rapids_tpu_spill_bytes_device_to_host 0`
+# on a healthy run instead of a missing series), and so docs/observability.md
+# can list the catalog. Per-operator metrics (numInputRows, opTime, pipe*)
+# live on Exec instances and are documented there.
+
+CATALOG: Iterable[tuple] = (
+    # kernels.py — compile vs execute attribution, cache behavior
+    ("kernel.builds", MetricKind.COUNTER, "distinct kernels built (cache misses)"),
+    ("kernel.cacheHits", MetricKind.COUNTER, "kernel-cache hits (kernels.kernel)"),
+    ("kernel.warms", MetricKind.COUNTER, "pre-compilations performed (GuardedJit.warm)"),
+    ("kernel.warmTimeNs", MetricKind.NANOS, "time spent in pre-compilation lower+compile"),
+    ("kernel.firstCalls", MetricKind.COUNTER, "first executions per signature (trace+compile)"),
+    ("kernel.compileTimeNs", MetricKind.NANOS, "time spent in first-call trace+compile"),
+    # mem/spill.py — spill bytes by tier transition + HBM watermark
+    ("spill.bytesDeviceToHost", MetricKind.COUNTER, "bytes spilled HBM → host RAM"),
+    ("spill.bytesHostToDisk", MetricKind.COUNTER, "bytes spilled host RAM → disk"),
+    ("spill.bytesDiskToHost", MetricKind.COUNTER, "bytes re-materialized disk → host RAM"),
+    ("spill.count", MetricKind.COUNTER, "tier-transition spill operations"),
+    ("mem.deviceBytesHighWatermark", MetricKind.WATERMARK,
+     "peak registered spillable bytes on device, sampled at batch boundaries"),
+    # mem/semaphore.py — admission control
+    ("semaphore.acquires", MetricKind.COUNTER, "device-semaphore acquisitions"),
+    ("semaphore.waitNs", MetricKind.NANOS, "time blocked acquiring the device semaphore"),
+    # shuffle/* — data-plane volume + codec efficiency
+    ("shuffle.bytesWritten", MetricKind.COUNTER, "map-output bytes parked in the shuffle catalog"),
+    ("shuffle.bytesFetched", MetricKind.COUNTER, "payload bytes received from peer executors"),
+    ("shuffle.bytesCompressedOut", MetricKind.COUNTER, "serialized shuffle payload bytes after compression"),
+    ("shuffle.bytesUncompressed", MetricKind.COUNTER, "serialized shuffle payload bytes before compression"),
+    # resilience/* — the old retry.report() counters (registry view now)
+    ("resilience.oom_retries", MetricKind.COUNTER, "spill-and-retry launches after device OOM"),
+    ("resilience.splits", MetricKind.COUNTER, "OOM batch halvings"),
+    ("resilience.fetch_retries", MetricKind.COUNTER, "shuffle fetch retry waves"),
+    ("resilience.peers_evicted", MetricKind.COUNTER, "stale + blacklisted executors evicted"),
+    ("resilience.circuit_breaker_trips", MetricKind.COUNTER, "ops flipped to CPU by the breaker"),
+    ("resilience.transport_reconnects", MetricKind.COUNTER, "TCP transport reconnects"),
+    ("resilience.spill_write_errors", MetricKind.COUNTER, "disk-spill write failures (degraded to HOST)"),
+    ("resilience.faults_injected", MetricKind.COUNTER, "chaos-harness injections fired"),
+)
+
+for _name, _kind, _doc in CATALOG:
+    GLOBAL.get_or_create(_name, "ESSENTIAL", _kind)
+
+
+def shuffle_compression_ratio() -> float:
+    """Uncompressed / compressed across all serialized shuffle payloads
+    (1.0 = incompressible or codec 'none'; 0.0 = nothing shuffled yet)."""
+    u = GLOBAL.counter("shuffle.bytesUncompressed").value
+    c = GLOBAL.counter("shuffle.bytesCompressedOut").value
+    if not u or not c:
+        return 0.0
+    return u / c

@@ -81,6 +81,16 @@ _PROBE_TTL_S = 3600.0
 _probe_result: "bool | None" = None
 
 
+def _boot_id() -> str:
+    """This boot's identity (monotonic stamps are only comparable within
+    it); empty string where the kernel doesn't expose one."""
+    try:
+        with open("/proc/sys/kernel/random/boot_id") as f:
+            return f.read().strip()
+    except Exception:
+        return ""
+
+
 def _mosaic_probe_ok() -> bool:
     """Can this environment actually compile Mosaic kernels? Probed ONCE in
     a SUBPROCESS: the tunneled remote-compile fleet is of mixed health, and
@@ -100,7 +110,13 @@ def _mosaic_probe_ok() -> bool:
     try:
         with open(cache_path) as f:
             cached = json.load(f)
-        if time.time() - cached["ts"] < _PROBE_TTL_S:
+        # CLOCK_MONOTONIC, not wall clock: an NTP step or operator clock
+        # change must not make the TTL never expire (backwards jump) or
+        # expire instantly (forwards jump). Monotonic is only comparable
+        # within one boot, so the stamp carries the boot id — a cache from
+        # a previous boot (where uptimes could alias as fresh) re-probes.
+        age = time.monotonic() - cached["ts"]
+        if cached.get("boot") == _boot_id() and 0 <= age < _PROBE_TTL_S:
             _probe_result = bool(cached["ok"])
             return _probe_result
     except Exception:
@@ -129,7 +145,7 @@ def _mosaic_probe_ok() -> bool:
     _probe_result = ok
     try:
         with open(cache_path, "w") as f:
-            json.dump({"ts": time.time(), "ok": ok}, f)
+            json.dump({"ts": time.monotonic(), "boot": _boot_id(), "ok": ok}, f)
     except Exception:
         pass
     return ok

@@ -12,54 +12,21 @@ engine is columnar end to end).
 from __future__ import annotations
 
 import threading
-import time
 from typing import Callable, Iterator, List, Optional, Sequence
 
 from ..config import TpuConf
+from ..obs.metrics import METRIC_LEVELS, Metric, MetricKind, MetricRegistry
 from ..types import Schema
 
-
-METRIC_LEVELS = {"ESSENTIAL": 0, "MODERATE": 1, "DEBUG": 2}
-
-
-class Metric:
-    """One operator metric — the GpuMetric analogue (GpuExec.scala:40-157).
-    Levels mirror the reference's ESSENTIAL/MODERATE/DEBUG taxonomy; the
-    per-query cutoff comes from ``spark.rapids.sql.metrics.level``."""
-
-    __slots__ = ("name", "value", "level", "_lock")
-
-    def __init__(self, name: str, level: str = "ESSENTIAL"):
-        self.name = name
-        self.value = 0
-        self.level = level
-        self._lock = threading.Lock()
-
-    def add(self, v: int):
-        with self._lock:
-            self.value += v
-
-    def set_max(self, v: int):
-        """High-water-mark semantics (e.g. pipeline dispatch depth)."""
-        with self._lock:
-            if v > self.value:
-                self.value = v
-
-    class _Timer:
-        __slots__ = ("m", "t0")
-
-        def __init__(self, m):
-            self.m = m
-
-        def __enter__(self):
-            self.t0 = time.perf_counter_ns()
-            return self
-
-        def __exit__(self, *a):
-            self.m.add(time.perf_counter_ns() - self.t0)
-
-    def timed(self) -> "_Timer":
-        return Metric._Timer(self)
+__all__ = [
+    "METRIC_LEVELS",
+    "Metric",
+    "MetricKind",
+    "MetricRegistry",
+    "Exec",
+    "ExecContext",
+    "PartitionSet",
+]
 
 
 class ExecContext:
@@ -81,9 +48,14 @@ class ExecContext:
 
         self.retry_policy = RetryPolicy.from_conf(conf)
         self.breaker = getattr(session, "_breaker", None)
-        self.metrics_level = METRIC_LEVELS.get(
-            (cfg.METRICS_LEVEL.get(conf) or "MODERATE").upper(), 1
+        # spark.rapids.tpu.metrics.level wins when set; else the reference's
+        # spark.rapids.sql.metrics.level key (obs/metrics.py taxonomy)
+        level = (
+            cfg.METRICS_LEVEL_TPU.get(conf)
+            or cfg.METRICS_LEVEL.get(conf)
+            or "MODERATE"
         )
+        self.metrics_level = METRIC_LEVELS.get(level.upper(), 1)
         limit = cfg.DEVICE_POOL_LIMIT.get(conf)
         if limit > 0:
             self.catalog.device_limit = limit
@@ -306,7 +278,7 @@ class Exec:
 
     def __init__(self, children: Sequence["Exec"]):
         self._children = list(children)
-        self.metrics: dict[str, Metric] = {}
+        self.metrics: MetricRegistry = MetricRegistry()
 
     # ── tree ────────────────────────────────────────────────────────────
     @property
@@ -318,7 +290,7 @@ class Exec:
 
         new = copy.copy(self)
         new._children = list(children)
-        new.metrics = {}
+        new.metrics = MetricRegistry()
         return new
 
     # ── contract ────────────────────────────────────────────────────────
@@ -335,10 +307,13 @@ class Exec:
         raise NotImplementedError
 
     # ── metrics ─────────────────────────────────────────────────────────
-    def metric(self, name: str, level: str = "ESSENTIAL") -> Metric:
-        if name not in self.metrics:
-            self.metrics[name] = Metric(name, level)
-        return self.metrics[name]
+    def metric(
+        self, name: str, level: str = "ESSENTIAL", kind: Optional[str] = None
+    ) -> Metric:
+        """Get-or-create this node's metric (locked — partition tasks and
+        pipeline producers may race first touch). ``kind`` (MetricKind)
+        drives exporter rendering; inferred from the name when omitted."""
+        return self.metrics.get_or_create(name, level, kind)
 
     def metrics_on(self, ctx: "ExecContext", level: str) -> bool:
         """Is a metric of ``level`` collected under this query's

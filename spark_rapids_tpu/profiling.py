@@ -1,45 +1,43 @@
-"""Tracing / profiling subsystem — the NvtxWithMetrics analogue (SURVEY §5).
+"""Profiling facade — stable public entry points over the obs/ subsystem.
 
-The reference fuses NVTX ranges with GpuMetrics so one instrumentation
-point feeds both the Nsight timeline and the Spark-UI metric totals
-(sql-plugin NvtxWithMetrics.scala, GpuMetric ranges). The TPU analogues:
+Historically this module owned the whole observability story (NvtxWithMetrics
+analogue: jax.profiler traces + ad-hoc per-node metrics and three bespoke
+report functions). PR 4 moved the machinery into the unified subsystem:
 
-- **timeline**: ``jax.profiler.trace`` dumps an XPlane/TensorBoard capture
-  of the whole query (device kernels + host gaps);
-  ``jax.profiler.TraceAnnotation`` marks each operator's partition work so
-  the capture carries plan-node names — that is the NVTX range.
-- **device-time attribution**: dispatch is async (enqueue ≈ 0), so
-  per-operator device time needs a sync point. ``instrument_plan`` wraps
-  every exec's partition iterators with ``block_until_ready`` + a timer
-  feeding an ``opTime`` metric — the CUDA_LAUNCH_BLOCKING-style debug mode.
-  It serializes the inter-operator pipeline, so it is opt-in
-  (``spark.rapids.sql.profile.opTime.enabled``), exactly like the
-  reference's DEBUG metric level.
+- typed metric registries      → :mod:`spark_rapids_tpu.obs.metrics`
+- hierarchical span tracing    → :mod:`spark_rapids_tpu.obs.trace`
+- reports/exporters            → :mod:`spark_rapids_tpu.obs.export`
 
-``metrics_report`` renders the per-node metric tree (wall + device time,
-rows) — the Spark-UI stand-in the bench uses for its device-vs-host
-breakdown.
+Everything importable from here before PR 4 still is — ``walk``,
+``instrument_plan``, ``query_trace``, ``metrics_report``,
+``pipeline_report``, ``resilience_report``, ``device_host_breakdown`` —
+now as thin shims, so bench rigs and tests written against the old surface
+keep working. What stays native here is the jax.profiler integration
+(XPlane/TensorBoard capture + the block-until-ready opTime debug mode),
+which is TPU-runtime-specific rather than part of the portable obs layer.
 """
 from __future__ import annotations
 
 import time
-from typing import Iterator
 
 import jax
 
+from .obs.export import (  # noqa: F401  (public re-exports)
+    device_host_breakdown,
+    metrics_report,
+    pipeline_report,
+    resilience_report,
+    walk,
+)
 from .plan.physical import Exec, ExecContext, PartitionSet
-
-
-def walk(plan: Exec) -> Iterator[Exec]:
-    yield plan
-    for c in plan.children:
-        yield from walk(c)
 
 
 def _wrap_partitions(node: Exec, pset: PartitionSet) -> PartitionSet:
     """Per-partition: annotate the trace with the node name and attribute
     blocked device time per produced batch to the node's opTime metric."""
-    op_time = node.metric("opTime", "DEBUG")
+    from .obs.metrics import MetricKind
+
+    op_time = node.metric("opTime", "DEBUG", MetricKind.NANOS)
     batches_m = node.metric("opOutputBatches", "DEBUG")
     name = type(node).__name__
 
@@ -77,7 +75,8 @@ def instrument_plan(plan: Exec) -> None:
 
 class query_trace:
     """Context manager: wrap one query execution in a jax.profiler trace
-    dump when a path is configured (else no-op)."""
+    dump when a path is configured (else no-op). This is the XPlane/
+    TensorBoard capture; the portable span trace is obs/trace.py."""
 
     def __init__(self, path: str | None):
         self.path = path or None
@@ -93,115 +92,3 @@ class query_trace:
         if self._cm is not None:
             return self._cm.__exit__(*exc)
         return False
-
-
-def metrics_report(plan: Exec) -> str:
-    """Human-readable per-node metric tree (Spark-UI stand-in)."""
-    lines = []
-
-    def fmt(node: Exec, indent: int):
-        ms = {m.name: m.value for m in node.metrics.values()}
-        shown = []
-        for k in sorted(ms):
-            v = ms[k]
-            if k.endswith("Time") or k == "opTime":
-                shown.append(f"{k}={v / 1e6:.1f}ms")
-            else:
-                shown.append(f"{k}={v}")
-        lines.append("  " * indent + node.node_string() + (
-            ("  [" + ", ".join(shown) + "]") if shown else ""
-        ))
-        for c in node.children:
-            fmt(c, indent + 1)
-
-    fmt(plan, 0)
-    return "\n".join(lines)
-
-
-def pipeline_report(plan: Exec) -> dict:
-    """Dispatch-ahead pipeline health for the bench ``diag`` block
-    (exec/pipeline.py feeds the ``pipe*`` metrics):
-
-    * ``dispatch_depth`` — deepest in-flight window observed at any
-      pipelined sink (0 = pipeline never engaged);
-    * ``overlap_frac``   — fraction of upstream production time hidden
-      behind consumer-side work, ``1 - stall/producer`` (1.0 = the sink
-      never waited on the producer; 0.0 = fully serialized);
-    * ``pipe_stall_ms``  — total consumer time blocked on an empty window;
-    * ``pipe_stalls``    — the per-stage breakdown of those stalls.
-    """
-    depth = 0
-    stall_ns = 0
-    producer_ns = 0
-    stages: dict = {}
-    for node in walk(plan):
-        ms = node.metrics
-        d = ms.get("pipeDispatchDepth")
-        if d is not None:
-            depth = max(depth, d.value)
-        st = ms.get("pipeStallTime")
-        if st is not None and st.value:
-            stall_ns += st.value
-            key = type(node).__name__
-            stages[key] = round(stages.get(key, 0.0) + st.value / 1e6, 1)
-        pr = ms.get("pipeProducerTime")
-        if pr is not None:
-            producer_ns += pr.value
-    overlap = 0.0
-    if producer_ns > 0:
-        overlap = max(0.0, min(1.0, 1.0 - stall_ns / producer_ns))
-    return {
-        "dispatch_depth": depth,
-        "overlap_frac": round(overlap, 3),
-        "pipe_stall_ms": round(stall_ns / 1e6, 1),
-        "pipe_stalls": stages,
-    }
-
-
-def resilience_report(session=None) -> dict:
-    """Fault-tolerance counters for the bench ``diag`` block (cumulative,
-    process-wide — resilience/retry.py): ``oom_retries`` (spill-and-retry
-    launches), ``splits`` (batch halvings), ``fetch_retries`` (shuffle
-    retry waves), ``peers_evicted`` (stale + blacklisted executors),
-    ``circuit_breaker_trips``, ``transport_reconnects``,
-    ``spill_write_errors`` and ``faults_injected`` (chaos harness). With a
-    ``session``, the circuit breaker's open set rides along."""
-    from .resilience import retry as R
-
-    out = R.report()
-    breaker = getattr(session, "_breaker", None)
-    if breaker is not None:
-        out["circuit_breaker_open"] = breaker.state()["open"]
-    return out
-
-
-def device_host_breakdown(plan: Exec) -> dict:
-    """Aggregate totals for the bench JSON ``detail``: device-attributed
-    op time vs host transfer time vs rows moved."""
-    out = {
-        "op_time_ms": 0.0,
-        "h2d_time_ms": 0.0,
-        "d2h_time_ms": 0.0,
-        "h2d_bytes": 0,
-        "d2h_bytes": 0,
-        "per_node_ms": {},
-    }
-    for node in walk(plan):
-        for m in node.metrics.values():
-            if m.name == "opTime":
-                ms = m.value / 1e6
-                out["op_time_ms"] += ms
-                key = type(node).__name__
-                out["per_node_ms"][key] = out["per_node_ms"].get(key, 0.0) + ms
-            elif m.name == "hostToDeviceTime":
-                out["h2d_time_ms"] += m.value / 1e6
-            elif m.name == "deviceToHostTime":
-                out["d2h_time_ms"] += m.value / 1e6
-            elif m.name == "hostToDeviceBytes":
-                out["h2d_bytes"] += m.value
-            elif m.name == "deviceToHostBytes":
-                out["d2h_bytes"] += m.value
-    out["per_node_ms"] = dict(
-        sorted(out["per_node_ms"].items(), key=lambda kv: -kv[1])
-    )
-    return out

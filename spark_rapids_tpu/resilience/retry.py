@@ -80,38 +80,31 @@ def is_device_error(err: BaseException) -> bool:
 
 
 # ── retry counters (the bench / profiling diag block) ──────────────────────
+# Counters live in the process-wide typed registry (obs/metrics.py) under
+# the ``resilience.`` prefix; ``report()`` is a registry view. The catalog
+# pre-registers the well-known names so a healthy run still exports the
+# full series set at zero.
+
+from ..obs.metrics import GLOBAL as _REGISTRY  # noqa: E402
 
 _METRICS_LOCK = threading.Lock()
-_METRICS: dict[str, int] = {}
 _LAST_OOM: Optional[float] = None  # time.monotonic of the last observed OOM
 
 
 def record(name: str, n: int = 1) -> None:
-    with _METRICS_LOCK:
-        _METRICS[name] = _METRICS.get(name, 0) + n
+    _REGISTRY.counter("resilience." + name).add(n)
 
 
 def report() -> dict:
-    """Cumulative process-wide resilience counters (profiling / bench)."""
-    with _METRICS_LOCK:
-        out = {
-            "oom_retries": 0,
-            "splits": 0,
-            "fetch_retries": 0,
-            "peers_evicted": 0,
-            "circuit_breaker_trips": 0,
-            "transport_reconnects": 0,
-            "spill_write_errors": 0,
-            "faults_injected": 0,
-        }
-        out.update(_METRICS)
-        return out
+    """Cumulative process-wide resilience counters (profiling / bench) —
+    a view over the registry's ``resilience.`` slice."""
+    return _REGISTRY.view("resilience.")
 
 
 def reset() -> None:
     global _LAST_OOM
+    _REGISTRY.reset("resilience.")
     with _METRICS_LOCK:
-        _METRICS.clear()
         _LAST_OOM = None
 
 

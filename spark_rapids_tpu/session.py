@@ -340,13 +340,73 @@ class TpuSession:
         # session's queries execute (no-op when faults are not enabled)
         with _faults.scoped(self._fault_injector):
             final_plan, ctx = self._prepare_plan(lp)
+            from .obs import trace as obs_trace
             from .profiling import query_trace
 
+            tracer, seq = self._maybe_tracer()
+            if tracer is not None:
+                # tracer pinned into the wrappers: a straggling producer
+                # thread keeps recording into ITS query's buffer, never
+                # into a later query's active tracer
+                obs_trace.instrument_plan(final_plan, tracer)
             try:
-                with query_trace(cfg.PROFILE_PATH.get(self.conf)):
-                    return self._run_plan(final_plan, ctx)
+                with obs_trace.query_scope(
+                    tracer, f"query-{seq}", {"seq": seq}
+                ):
+                    with query_trace(cfg.PROFILE_PATH.get(self.conf)):
+                        return self._run_plan(final_plan, ctx)
             finally:
+                if tracer is not None:
+                    self._export_trace(tracer, final_plan, seq)
                 self._leak_check(ctx)
+
+    def _maybe_tracer(self):
+        """(tracer, query_seq): the span tracer for this query when tracing
+        is on AND this query is sampled, else (None, seq). Sampling is
+        deterministic in the session's query sequence (Dapper-style cheap
+        sampled spans; spark.rapids.tpu.trace.sample)."""
+        seq = self._query_seq  # minted by _prepare_plan's ExecContext
+        trace_dir = cfg.TRACE_DIR.get(self.conf)
+        if not (cfg.TRACE_ENABLED.get(self.conf) or trace_dir):
+            return None, seq
+        sample = cfg.TRACE_SAMPLE.get(self.conf)
+        # Weyl-sequence hash of the seq → [0, 1): deterministic, well
+        # spread even for consecutive seqs
+        u = ((seq * 2654435761) & 0xFFFFFFFF) / 2**32
+        if u >= sample:
+            return None, seq
+        from .obs.trace import Tracer
+
+        return Tracer(capacity=cfg.TRACE_BUFFER_SPANS.get(self.conf)), seq
+
+    def _export_trace(self, tracer, plan, seq: int) -> None:
+        """Per-query artifacts (spark.rapids.tpu.trace.dir): the Chrome-
+        trace/Perfetto span dump plus the metrics JSON. Export failures
+        never fail the query."""
+        self._last_tracer = tracer
+        trace_dir = cfg.TRACE_DIR.get(self.conf)
+        if not trace_dir:
+            return
+        import os
+
+        from .obs import export as obs_export
+
+        try:
+            tracer.export_chrome(
+                os.path.join(trace_dir, f"query-{seq}.trace.json")
+            )
+            obs_export.write_query_artifact(
+                os.path.join(trace_dir, f"query-{seq}.metrics.json"),
+                plan=plan,
+                session=self,
+                tracer=tracer,
+            )
+        except Exception:
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "trace export to %s failed", trace_dir, exc_info=True
+            )
 
     def _leak_check(self, ctx) -> None:
         if ctx.catalog.debug:
@@ -1219,6 +1279,22 @@ class DataFrame:
         return t.column(0)[0].as_py()
 
     def explain(self, mode: str = "plans") -> str:
+        if mode == "metrics":
+            # reference-style: per-op metrics inline on the physical plan
+            # (the Spark-UI node annotations). Metrics live on the EXECUTED
+            # plan instance, so this renders the session's last run —
+            # collect() first (matching the UI, which is also post-run).
+            from .obs.export import render_plan_metrics
+
+            plan = self._session._last_plan
+            if plan is None:
+                s = "<no query executed yet — collect() first>"
+            else:
+                # every collected metric (ESSENTIAL always; MODERATE/DEBUG
+                # when the level conf collected them)
+                s = render_plan_metrics(plan)
+            print(s)
+            return s
         cpu_plan = plan_physical(self._plan, self._session.conf)
         overrides = TpuOverrides(self._session.conf)
         final_plan = overrides.apply(cpu_plan)

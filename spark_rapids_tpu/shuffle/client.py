@@ -102,6 +102,9 @@ class ShuffleClient:
         if payload is not None:
             rid = self._received.add(payload, meta)
             self._throttle.release(meta.buffer.size)
+            from ..obs.metrics import GLOBAL as _obs
+
+            _obs.counter("shuffle.bytesFetched").add(len(payload))
             completions.put((rid, meta))
 
     # ── retry pacing ────────────────────────────────────────────────────

@@ -199,6 +199,9 @@ class CachingWriter:
             self.shuffle_id, self.map_id, partition_id, batch
         )
         self._sizes[partition_id] += size
+        from ..obs.metrics import GLOBAL as _obs
+
+        _obs.counter("shuffle.bytesWritten").add(size)
 
     def commit(self) -> MapStatus:
         status = MapStatus(

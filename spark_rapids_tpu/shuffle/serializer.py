@@ -16,8 +16,14 @@ from typing import Optional, Tuple
 import pyarrow as pa
 
 from ..columnar.device import DeviceBatch, device_to_host, host_to_device
+from ..obs import metrics as obs_metrics
 from . import meta as M
 from .compression import CompressionCodec, codec_for_id
+
+# codec efficiency across every serialized shuffle payload (export computes
+# the compression ratio from the pair)
+_M_UNCOMP = obs_metrics.GLOBAL.counter("shuffle.bytesUncompressed")
+_M_COMP = obs_metrics.GLOBAL.counter("shuffle.bytesCompressedOut")
 
 
 def schema_to_bytes(schema: pa.Schema) -> bytes:
@@ -35,7 +41,10 @@ def serialize_record_batch(rb: pa.RecordBatch, codec: CompressionCodec) -> Tuple
     with pa.ipc.new_stream(sink, rb.schema) as w:
         w.write_batch(rb)
     raw = sink.getvalue()
-    return codec.compress(raw), len(raw), codec.codec_id
+    payload = codec.compress(raw)
+    _M_UNCOMP.add(len(raw))
+    _M_COMP.add(len(payload))
+    return payload, len(raw), codec.codec_id
 
 
 def deserialize_record_batch(payload: bytes, buffer_meta: M.BufferMeta) -> pa.RecordBatch:

@@ -1,0 +1,414 @@
+"""Unified observability subsystem (spark_rapids_tpu/obs/) — PR 4 tests.
+
+Covers the acceptance surface:
+
+* typed registry semantics — kinds, levels, watermark/gauge behavior, and
+  thread-safety under concurrent publishers (the pipeline producer races);
+* hierarchical spans — query → operator → batch nesting, and span-context
+  propagation onto pipeline producer threads (the attribution hole the
+  subsystem exists to close);
+* exporter golden shapes — Chrome-trace/Perfetto JSON, Prometheus text
+  format, the per-query metrics artifact, ``df.explain("metrics")``;
+* ``metrics_report`` on empty/zero-batch plans;
+* the instrumentation-overhead guard: ESSENTIAL level + tracing off does
+  no span work and no per-batch allocation inside obs/ hot paths;
+* ``profiling.py`` public entry points as working shims.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu.obs import export as OE
+from spark_rapids_tpu.obs import metrics as OM
+from spark_rapids_tpu.obs import trace as OT
+from spark_rapids_tpu.functions import col, sum as sum_
+
+from harness import tpu_session
+
+
+# ── registry semantics ──────────────────────────────────────────────────────
+
+
+def test_metric_kinds_and_semantics():
+    reg = OM.MetricRegistry()
+    c = reg.counter("rows")
+    c.add(3)
+    c.add(4)
+    assert c.value == 7 and c.kind == OM.MetricKind.COUNTER
+
+    g = reg.gauge("window")
+    g.set(5)
+    g.set(2)
+    assert g.value == 2 and g.kind == OM.MetricKind.GAUGE
+
+    w = reg.watermark("peak")
+    w.set_max(10)
+    w.set_max(4)
+    assert w.value == 10 and w.kind == OM.MetricKind.WATERMARK
+
+    t = reg.timer("waitNs")
+    with t.timed():
+        time.sleep(0.002)
+    assert t.value > 0 and t.kind == OM.MetricKind.NANOS
+
+    # get_or_create returns the SAME object (no metric resets on re-touch)
+    assert reg.counter("rows") is c
+    snap = reg.snapshot()
+    assert snap["rows"] == 7 and snap["peak"] == 10
+
+
+def test_kind_inference_from_name():
+    assert OM.infer_kind("hostToDeviceTime") == OM.MetricKind.NANOS
+    assert OM.infer_kind("semaphore.waitNs") == OM.MetricKind.NANOS
+    assert OM.infer_kind("peakDevMemory") == OM.MetricKind.WATERMARK
+    assert OM.infer_kind("numOutputRows") == OM.MetricKind.COUNTER
+
+
+def test_registry_view_and_reset():
+    reg = OM.MetricRegistry()
+    reg.counter("res.a").add(2)
+    reg.counter("res.b").add(3)
+    reg.counter("other").add(9)
+    assert reg.view("res.") == {"a": 2, "b": 3}
+    reg.reset("res.")
+    assert reg.view("res.") == {"a": 0, "b": 0}
+    assert reg.counter("other").value == 9
+
+
+def test_registry_thread_safety_under_producers():
+    """Concurrent get-or-create + adds from many threads (the pipeline
+    producer pattern): exactly one Metric per name, no lost updates."""
+    reg = OM.MetricRegistry()
+    n_threads, n_adds = 8, 2000
+    barrier = threading.Barrier(n_threads)
+
+    def work():
+        barrier.wait()
+        m = reg.counter("hot")
+        for _ in range(n_adds):
+            m.add(1)
+
+    threads = [threading.Thread(target=work) for _ in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert reg.counter("hot").value == n_threads * n_adds
+    assert len(reg) == 1
+
+
+def test_null_metric_is_inert_singleton():
+    n = OM.NULL_METRIC
+    n.add(5)
+    n.set(7)
+    n.set_max(9)
+    with n.timed():
+        pass
+    assert n.value == 0
+    assert n.timed() is n.timed()  # shared no-op timer, no allocation
+
+
+def test_resilience_report_is_registry_view():
+    from spark_rapids_tpu.resilience import retry as R
+
+    R.reset()
+    R.record("oom_retries", 2)
+    rep = R.report()
+    assert rep["oom_retries"] == 2
+    # the same number is visible through the process registry
+    assert OM.GLOBAL.counter("resilience.oom_retries").value == 2
+    R.reset()
+    assert R.report()["oom_retries"] == 0
+
+
+# ── spans: nesting + cross-thread propagation ──────────────────────────────
+
+
+def _parent_map(tracer):
+    return {s.sid: s for s in tracer.spans()}
+
+
+def test_span_nesting_same_thread():
+    tr = OT.Tracer(capacity=256)
+    with OT.query_scope(tr, "query-t"):
+        with OT.span("opA", "operator") as a:
+            with OT.span("batch", "batch") as b:
+                pass
+            a_sid = a.sid
+            b_sid = b.sid
+    spans = _parent_map(tr)
+    assert spans[b_sid].parent == a_sid
+    root = [s for s in spans.values() if s.cat == "query"]
+    assert len(root) == 1
+    assert spans[a_sid].parent == root[0].sid
+
+
+def test_span_context_propagates_to_producer_thread():
+    """The Dapper seam: spans opened on a pipeline producer thread nest
+    under the operator that created the pipeline, not under nothing."""
+    from spark_rapids_tpu.exec.pipeline import PipelinedIterator
+
+    tr = OT.Tracer(capacity=256)
+    producer_tids = set()
+
+    def upstream():
+        for i in range(4):
+            with OT.span("upstream-batch", "batch", {"i": i}):
+                producer_tids.add(threading.get_ident())
+            yield i
+
+    with OT.query_scope(tr, "query-p"):
+        with OT.span("sink", "operator") as op:
+            pipe = PipelinedIterator(upstream(), depth=2)
+            try:
+                assert list(pipe) == [0, 1, 2, 3]
+            finally:
+                pipe.close()
+            op_sid = op.sid
+    spans = _parent_map(tr)
+    ups = [s for s in spans.values() if s.name == "upstream-batch"]
+    assert len(ups) == 4
+    assert producer_tids and threading.get_ident() not in producer_tids
+    for s in ups:
+        assert s.tid in producer_tids  # really ran on the producer thread
+        assert s.parent == op_sid  # ...and still attributed under the sink
+
+
+def test_ring_buffer_bounds_and_drop_count():
+    tr = OT.Tracer(capacity=16)
+    with OT.query_scope(tr, "q"):
+        for i in range(40):
+            with OT.span(f"s{i}"):
+                pass
+    assert tr.span_count == 41  # 40 + the query root
+    assert tr.dropped == 41 - 16
+    assert len(list(tr.spans())) == 16
+
+
+def test_trace_hooks_are_noops_when_inactive():
+    assert OT.active() is None
+    assert OT.span("x") is OT.span("y")  # shared singleton, no allocation
+    assert OT.capture_context() is None
+    OT.attach_context(None)  # must not raise
+
+
+# ── end-to-end: session wiring ─────────────────────────────────────────────
+
+
+def _run_query(s, rows=400, partitions=2):
+    t = pa.table(
+        {"a": list(range(rows)), "b": [float(i) for i in range(rows)]}
+    )
+    df = (
+        s.create_dataframe(t, num_partitions=partitions)
+        .filter(col("a") > 10)
+        .group_by()
+        .agg(sum_(col("b")).alias("s"))
+    )
+    assert df.collect()
+    return df
+
+
+def test_query_trace_export_nests_query_operator_batch(tmp_path):
+    td = str(tmp_path / "traces")
+    s = tpu_session({"spark.rapids.tpu.trace.dir": td})
+    _run_query(s)
+    files = sorted(os.listdir(td))
+    trace_files = [f for f in files if f.endswith(".trace.json")]
+    art_files = [f for f in files if f.endswith(".metrics.json")]
+    assert trace_files and art_files
+    doc = json.load(open(os.path.join(td, trace_files[0])))
+    events = [e for e in doc["traceEvents"] if e.get("ph") == "X"]
+    assert events
+    by_sid = {e["args"]["span_id"]: e for e in events}
+    roots = [e for e in events if e["cat"] == "query"]
+    assert len(roots) == 1
+    assert roots[0]["args"]["parent_id"] is None  # no self-parented root
+    root_sid = roots[0]["args"]["span_id"]
+
+    def chain_reaches_root(e):
+        seen = set()
+        while True:
+            p = e["args"]["parent_id"]
+            if p == root_sid:
+                return True
+            if p is None or p in seen or p not in by_sid:
+                return False
+            seen.add(p)
+            e = by_sid[p]
+
+    ops = [e for e in events if e["cat"] == "operator"]
+    batches = [e for e in events if e["cat"] == "batch"]
+    assert ops and batches
+    op_sids = {e["args"]["span_id"] for e in ops}
+    assert all(chain_reaches_root(e) for e in ops)
+    # every batch span hangs DIRECTLY under an operator span
+    assert all(e["args"]["parent_id"] in op_sids for e in batches)
+    # pipeline producer-thread work is inside the tree, not orphaned:
+    # some span ran on a thread other than the query root's and still
+    # chains to the root
+    off_thread = [e for e in events if e["tid"] != roots[0]["tid"]]
+    assert off_thread
+    assert all(chain_reaches_root(e) for e in off_thread)
+    # golden shape: required Chrome-trace keys on every complete event
+    for e in events:
+        assert {"ph", "name", "cat", "ts", "dur", "pid", "tid"} <= set(e)
+
+    art = json.load(open(os.path.join(td, art_files[0])))
+    assert {"operators", "pipeline", "resilience", "process", "trace"} <= set(art)
+    assert art["trace"]["spans"] > 0
+
+
+def test_trace_sampling_zero_disables(tmp_path):
+    td = str(tmp_path / "traces")
+    s = tpu_session(
+        {
+            "spark.rapids.tpu.trace.dir": td,
+            "spark.rapids.tpu.trace.sample": 0.0,
+        }
+    )
+    _run_query(s)
+    assert getattr(s, "_last_tracer", None) is None
+    assert not os.path.exists(td) or not os.listdir(td)
+
+
+def test_explain_metrics_renders_per_op(capsys):
+    s = tpu_session()
+    df = _run_query(s)
+    out = df.explain("metrics")
+    assert "numInputRows" in out and "HostToDeviceExec" in out
+    assert "numOutputRows" in out
+    # nanos metrics render as milliseconds
+    assert "ms" in out
+
+
+def test_prometheus_dump_contains_required_series():
+    s = tpu_session()
+    _run_query(s)
+    text = OE.prometheus_text(plan=s._last_plan, session=s)
+    for series in (
+        "spark_rapids_tpu_kernel_builds",
+        "spark_rapids_tpu_kernel_compile_time_ns",
+        "spark_rapids_tpu_spill_bytes_device_to_host",
+        "spark_rapids_tpu_shuffle_bytes_written",
+        "spark_rapids_tpu_resilience_oom_retries",
+        "spark_rapids_tpu_resilience_circuit_breaker_trips",
+        "spark_rapids_tpu_mem_device_bytes_high_watermark",
+    ):
+        assert f"\n{series} " in "\n" + text or text.startswith(f"{series} "), series
+        assert f"# TYPE {series} " in text, series
+    # per-operator family with labels
+    assert 'spark_rapids_tpu_operator_metric{op="HostToDeviceExec"' in text
+    # kernel compiles actually happened on this process
+    assert OM.GLOBAL.counter("kernel.builds").value > 0
+
+
+def test_metrics_report_on_empty_and_zero_batch_plans():
+    from spark_rapids_tpu.profiling import metrics_report
+
+    s = tpu_session()
+    t = pa.table({"a": list(range(50))})
+    df = s.create_dataframe(t, num_partitions=2).filter(col("a") > 999)
+    assert df.collect() == []
+    rep = metrics_report(s._last_plan)
+    assert "HostToDeviceExec" in rep
+    # zero-row relation
+    e = s.create_dataframe(pa.table({"a": pa.array([], type=pa.int64())}))
+    assert e.filter(col("a") > 0).collect() == []
+    rep2 = metrics_report(s._last_plan)
+    assert rep2  # renders without blowing up on empty metrics
+    art = OE.query_artifact(plan=s._last_plan, session=s)
+    assert "operators" in art and "pipeline" in art
+
+
+def test_profiling_shims_keep_working():
+    import spark_rapids_tpu.profiling as P
+
+    s = tpu_session()
+    _run_query(s)
+    plan = s._last_plan
+    assert list(P.walk(plan))
+    assert isinstance(P.metrics_report(plan), str)
+    pr = P.pipeline_report(plan)
+    assert {"dispatch_depth", "overlap_frac", "pipe_stall_ms"} <= set(pr)
+    rr = P.resilience_report(s)
+    assert "oom_retries" in rr and "circuit_breaker_open" in rr
+    bd = P.device_host_breakdown(plan)
+    assert "op_time_ms" in bd and "h2d_bytes" in bd
+    with P.query_trace(None):
+        pass  # no-op path
+
+
+# ── overhead guard ─────────────────────────────────────────────────────────
+
+
+def test_essential_level_hot_loop_does_no_obs_work():
+    """With metrics.level=ESSENTIAL and tracing off, the per-batch hot loop
+    must not touch the tracer, allocate inside obs/ hot paths, or time
+    transfers — the <2% instrumentation-cost contract, pinned via counter
+    deltas plus an allocation probe on the obs modules."""
+    import tracemalloc
+
+    from spark_rapids_tpu.tpch import gen_table, tpch_query
+    from spark_rapids_tpu.tpch.datagen import TABLES
+
+    tables = {name: gen_table(name, 0.003) for name in TABLES}
+    s = tpu_session({"spark.rapids.tpu.metrics.level": "ESSENTIAL"})
+
+    def accessor(session):
+        def t(name):
+            n = 2 if tables[name].num_rows > 1000 else 1
+            return session.create_dataframe(tables[name], num_partitions=n)
+
+        return t
+
+    # warm run: pays kernel compiles and registry creation
+    assert tpch_query(6, accessor(s)).collect()
+    builds_before = OM.GLOBAL.counter("kernel.builds").value
+
+    import spark_rapids_tpu.obs.trace as trace_mod
+    import spark_rapids_tpu.obs.export as export_mod
+
+    tracemalloc.start()
+    try:
+        t0 = tracemalloc.take_snapshot()
+        assert tpch_query(6, accessor(s)).collect()
+        t1 = tracemalloc.take_snapshot()
+    finally:
+        tracemalloc.stop()
+
+    # tracing off: the hot run touched no tracer and exported nothing
+    assert OT.active() is None
+    assert getattr(s, "_last_tracer", None) is None
+    # allocation probe: zero allocations attributed to the trace/export
+    # modules during the hot run
+    filt = [
+        tracemalloc.Filter(True, trace_mod.__file__),
+        tracemalloc.Filter(True, export_mod.__file__),
+    ]
+    obs_allocs = [
+        st
+        for st in t1.filter_traces(filt).compare_to(t0.filter_traces(filt), "lineno")
+        if st.size_diff > 0 or st.count_diff > 0
+    ]
+    assert not obs_allocs, obs_allocs
+    # counter deltas: the warm cache served every kernel (no new builds)
+    assert OM.GLOBAL.counter("kernel.builds").value == builds_before
+    # ESSENTIAL gating: no timing metric collected anything
+    for node in OE.walk(s._last_plan):
+        for m in node.metrics.values():
+            if m.kind == OM.MetricKind.NANOS:
+                assert m.value == 0, (type(node).__name__, m.name)
+    # ...while essential row counters did
+    flat = {
+        k: v
+        for d in s._last_plan.collect_metrics().values()
+        for k, v in d.items()
+    }
+    assert flat.get("numInputRows", 0) > 0
