@@ -268,6 +268,7 @@ def _suite_args():
     smoke = os.environ.get("BENCH_SMOKE", "") == "1"
     trace_dir = os.environ.get("BENCH_TRACE_DIR", "")
     queries = os.environ.get("BENCH_QUERIES", "")
+    concurrency = int(os.environ.get("BENCH_CONCURRENCY", "0") or 0)
     argv = sys.argv[1:]
     if "--smoke" in argv:
         smoke = True
@@ -277,10 +278,88 @@ def _suite_args():
         trace_dir = argv[argv.index("--trace-dir") + 1]
     if "--queries" in argv:
         queries = argv[argv.index("--queries") + 1]
+    if "--concurrency" in argv:
+        concurrency = int(argv[argv.index("--concurrency") + 1])
     qids = tuple(
         int(q.strip().lstrip("q")) for q in queries.split(",") if q.strip()
     )
-    return suite, smoke, trace_dir, qids
+    return suite, smoke, trace_dir, qids, concurrency
+
+
+def run_concurrent(tpu, tables, qids, n_threads, sf, partitions, rounds=2):
+    """Multi-tenant throughput mode (--concurrency N): N client threads
+    drive the SAME session with a round-robin mix of TPC-H queries — the
+    sched/ subsystem's admission control, fair-share queueing, and permit
+    accounting all on the hot path. Reports aggregate queries/s plus the
+    scheduler slice of the obs registry (queue-wait, admitted/rejected,
+    per-pool admissions) into the diag JSON."""
+    import threading
+    from spark_rapids_tpu.obs.metrics import GLOBAL
+    from spark_rapids_tpu.tpch import tpch_query
+
+    def accessor(session):
+        def t(name):
+            n = partitions if tables[name].num_rows > 100_000 else 1
+            return session.create_dataframe(tables[name], num_partitions=n)
+
+        return t
+
+    # serial warm pass: compile every query's kernels once so the timed
+    # window measures scheduling + execution, not first-touch XLA compiles
+    for q in qids:
+        _collect_retry(lambda: tpch_query(q, accessor(tpu), sf=sf))
+
+    sched_before = GLOBAL.view("scheduler.", strip=False)
+    work = [qids[i % len(qids)] for i in range(len(qids) * rounds * n_threads)]
+    work_lock = threading.Lock()
+    errors: list = []
+    done = [0]
+
+    def client(tid: int) -> None:
+        while True:
+            with work_lock:
+                if not work:
+                    return
+                q = work.pop()
+            try:
+                _collect_retry(lambda: tpch_query(q, accessor(tpu), sf=sf))
+                with work_lock:
+                    done[0] += 1
+            except Exception as e:  # noqa: BLE001 - keep the rig alive
+                with work_lock:
+                    errors.append(f"q{q}: {str(e)[-200:]}")
+
+    total = len(work)
+    t0 = time.perf_counter()
+    threads = [
+        threading.Thread(target=client, args=(i,), name=f"bench-client-{i}")
+        for i in range(n_threads)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t0
+    sched_after = GLOBAL.view("scheduler.", strip=False)
+    delta = {
+        k: sched_after.get(k, 0) - sched_before.get(k, 0)
+        for k in sched_after
+        if sched_after.get(k, 0) != sched_before.get(k, 0)
+        or k.endswith(("Depth", "InUse", "Permits"))
+    }
+    out = {
+        "threads": n_threads,
+        "queries_total": total,
+        "queries_ok": done[0],
+        "wall_s": round(wall, 3),
+        "qps": round(done[0] / wall, 3) if wall > 0 else 0.0,
+        "scheduler": delta,
+        "scheduler_state": tpu.scheduler.state(),
+    }
+    if errors:
+        out["errors"] = errors[:10]
+    log({"concurrent": out})
+    return out
 
 
 def run_query_pair(name, build_t, build_c, tpu, n_run, speedups, detail,
@@ -395,7 +474,7 @@ TPCDS_DEFAULT_SLICE = (3, 7, 12, 19, 27, 34, 42, 52, 55, 68, 96, 98)
 
 def main() -> None:
     t_start = time.monotonic()
-    suite, smoke, trace_dir, only_qids = _suite_args()
+    suite, smoke, trace_dir, only_qids, concurrency = _suite_args()
     if BENCH_PLATFORM:
         import jax
 
@@ -461,6 +540,53 @@ def main() -> None:
 
     detail: dict = {"backend": backend, "suite": suite, "smoke": smoke}
     speedups = []
+
+    if concurrency > 1:
+        # multi-tenant throughput mode: N client threads, one session,
+        # scheduler metrics in the diag — replaces the serial comparison.
+        # TPC-H only: fail loudly instead of silently benchmarking the
+        # wrong suite under a tpcds label.
+        if suite not in ("tpch", "both"):
+            print(
+                json.dumps(
+                    {
+                        "metric": "tpch_concurrent_qps",
+                        "value": 0.0,
+                        "unit": "queries/s",
+                        "vs_baseline": 0.0,
+                        "detail": {
+                            "error": f"--concurrency supports only the tpch "
+                                     f"suite (got --suite {suite})",
+                        },
+                    }
+                ),
+                flush=True,
+            )
+            return
+        from spark_rapids_tpu.tpch.datagen import TABLES, gen_table
+
+        csf = min(sf, 0.05) if not smoke else min(sf, 0.01)
+        tables = {name: gen_table(name, csf) for name in TABLES}
+        qids = only_qids or ((1, 6, 3) if smoke else (1, 3, 5, 6, 12, 14))
+        conc = run_concurrent(
+            tpu, tables, qids, concurrency, csf, partitions,
+            rounds=1 if smoke else 2,
+        )
+        detail["concurrency"] = conc
+        detail["wall_s"] = round(time.monotonic() - t_start, 1)
+        print(
+            json.dumps(
+                {
+                    "metric": "tpch_concurrent_qps",
+                    "value": conc["qps"],
+                    "unit": "queries/s",
+                    "vs_baseline": 0.0,
+                    "detail": detail,
+                }
+            ),
+            flush=True,
+        )
+        return
 
     tpch_tables = None
     if suite in ("tpch", "both"):

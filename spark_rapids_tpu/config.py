@@ -116,7 +116,7 @@ PALLAS_ENABLED = conf("spark.rapids.sql.pallas.enabled").doc(
     "Use hand-written Pallas TPU kernels for hot string ops (substring "
     "search over the padded byte planes) instead of the pure-XLA lowering. "
     "Results are bit-identical; this only changes the kernel strategy."
-).boolean_conf(True)
+).startup_only().boolean_conf(True)
 
 TASK_MAX_FAILURES = conf("spark.task.maxFailures").doc(
     "Task-retry budget (Spark's key): a failed partition task re-runs from "
@@ -131,7 +131,7 @@ NATIVE_ENABLED = conf("spark.rapids.native.enabled").doc(
     "(built from native/srt_host.cc; auto-compiled with g++ on first use). "
     "Pure-python/numpy fallbacks run when disabled or when no toolchain is "
     "available."
-).boolean_conf(True)
+).startup_only().boolean_conf(True)
 
 EXPLAIN = conf("spark.rapids.sql.explain").doc(
     "Explain why parts of a query were or were not placed on the TPU: "
@@ -165,7 +165,10 @@ MAX_READER_BATCH_SIZE_BYTES = conf("spark.rapids.sql.reader.batchSizeBytes").doc
 CONCURRENT_TPU_TASKS = conf("spark.rapids.sql.concurrentGpuTasks").doc(
     "Number of concurrent tasks that may hold the device at once — admission "
     "control via the device semaphore (reference: GpuSemaphore.scala), and "
-    "the size of the session's partition-task thread pool."
+    "the size of the session's partition-task thread pool. Re-read at every "
+    "query, so a long-lived service can retune it live; query-level "
+    "admission across tenants is the scheduler's permit pool "
+    "(spark.rapids.tpu.scheduler.*)."
 ).int_conf(4)
 
 HAS_NANS = conf("spark.rapids.sql.hasNans").doc(
@@ -252,7 +255,7 @@ SPARK_VERSION = conf("spark.rapids.tpu.sparkVersion").doc(
     "Spark version whose semantics to emulate; selects the shim provider "
     "(reference: ShimLoader + per-version shims/ modules). Shim-dependent "
     "defaults (ANSI, adaptive execution) apply when their keys are unset."
-).string_conf("3.1")
+).startup_only().string_conf("3.1")
 
 CBO_ENABLED = conf("spark.rapids.sql.optimizer.enabled").doc(
     "Cost-based un-conversion: device islands whose estimated compute is "
@@ -303,11 +306,11 @@ MESH_ENABLED = conf("spark.rapids.sql.mesh.enabled").doc(
     "RapidsShuffleInternalManagerBase.scala) and each partition's kernels "
     "run on its own chip. Requires shuffle partitions == mesh size (the "
     "session aligns the default automatically)."
-).boolean_conf(False)
+).startup_only().boolean_conf(False)
 
 MESH_SIZE = conf("spark.rapids.sql.mesh.size").doc(
     "Number of devices in the execution mesh; 0 uses every visible device."
-).int_conf(0)
+).startup_only().int_conf(0)
 
 SPLIT_MAX_TOKENS = conf("spark.rapids.sql.split.maxTokens").doc(
     "Static token-plane width for device split(): a row splitting into "
@@ -537,15 +540,15 @@ MULTIPROC_DRIVER = conf("spark.rapids.shuffle.multiproc.driver").doc(
     "the map/reduce partitions this rank owns and fetch peer map output "
     "over the TCP transport (the DCN path; reference: "
     "RapidsShuffleHeartbeatManager + UCX executor-to-executor traffic)."
-).string_conf("")
+).startup_only().string_conf("")
 
 MULTIPROC_RANK = conf("spark.rapids.shuffle.multiproc.rank").doc(
     "This executor's rank in the multi-process query (0-based)."
-).int_conf(0)
+).startup_only().int_conf(0)
 
 MULTIPROC_SIZE = conf("spark.rapids.shuffle.multiproc.size").doc(
     "Total executors cooperating on the multi-process query."
-).int_conf(1)
+).startup_only().int_conf(1)
 
 SHUFFLE_HANDSHAKE_TIMEOUT_S = conf("spark.rapids.tpu.shuffle.handshakeTimeout").doc(
     "Seconds the TCP transport waits for a dialing peer's HELLO frame "
@@ -614,6 +617,64 @@ CIRCUIT_BREAKER_THRESHOLD = conf("spark.rapids.tpu.retry.circuitBreaker.threshol
     "Device-kernel failures for one op signature that trip its circuit "
     "breaker."
 ).int_conf(3)
+
+
+# ── multi-tenant query scheduler (sched/) ──────────────────────────────────
+
+SCHEDULER_ENABLED = conf("spark.rapids.tpu.scheduler.enabled").doc(
+    "Gate every query action (collect/toPandas/to_jax) through the "
+    "session's multi-tenant scheduler: HBM-aware admission control over a "
+    "weighted permit pool, fair-share pools, bounded queueing with typed "
+    "QueryQueueFull backpressure. Disabling skips permit gating; "
+    "cancellation and deadlines keep working. See docs/scheduler.md."
+).boolean_conf(True)
+
+SCHEDULER_PERMITS = conf("spark.rapids.tpu.scheduler.permits").doc(
+    "Device capacity units of the admission pool. Each query takes "
+    "ceil(estimatedPeakBytes / bytesPerPermit) permits (clamped to the "
+    "pool size), so several small queries or one scan-heavy join hold the "
+    "device at a time — the query-granular generalization of "
+    "spark.rapids.sql.concurrentGpuTasks. Re-read per query."
+).int_conf(8)
+
+SCHEDULER_MAX_QUEUED = conf("spark.rapids.tpu.scheduler.maxQueued").doc(
+    "Maximum queries waiting for admission across all pools; an admission "
+    "past this bound is rejected with the typed QueryQueueFull error — the "
+    "backpressure signal a service in front of the engine sheds load on. "
+    "Re-read per query."
+).int_conf(32)
+
+SCHEDULER_POOL = conf("spark.rapids.tpu.scheduler.pool").doc(
+    "Fair-share pool this session's queries are admitted under (Spark FAIR "
+    "scheduler pools analogue). Set per-session or flip between queries "
+    "with set_conf — the value is read at each query's admission."
+).string_conf("default")
+
+SCHEDULER_POOLS = conf("spark.rapids.tpu.scheduler.pools").doc(
+    "Pool weight spec 'name:weight,name:weight' (e.g. 'etl:1,interactive:"
+    "3'). Under saturation a pool is admitted permit-capacity proportional "
+    "to its weight (stride scheduling); FIFO within each pool. Unlisted "
+    "pools get weight 1. Re-read per query."
+).string_conf(None)
+
+SCHEDULER_QUERY_TIMEOUT_S = conf("spark.rapids.tpu.scheduler.queryTimeout").doc(
+    "Per-query deadline in seconds, measured from admission request "
+    "(queue wait included). Expiry raises the typed QueryTimeoutError at "
+    "the next batch boundary — queued or mid-execution. 0 disables."
+).double_conf(0.0)
+
+SCHEDULER_BYTES_PER_PERMIT = conf("spark.rapids.tpu.scheduler.bytesPerPermit").doc(
+    "Estimated-footprint bytes one admission permit stands for; a query "
+    "needs ceil(estimate / this) permits. Tune so permits × bytesPerPermit "
+    "≈ the HBM budget you want admission to protect."
+).bytes_conf(256 << 20)
+
+SCHEDULER_DEFAULT_QUERY_BYTES = conf(
+    "spark.rapids.tpu.scheduler.defaultQueryBytes"
+).doc(
+    "Footprint assumed for a query whose plan yields no measurable "
+    "estimate (no scans with stats — sched/estimate.py returns 0)."
+).bytes_conf(256 << 20)
 
 
 # ── deterministic fault injection (resilience/faults.py) ───────────────────

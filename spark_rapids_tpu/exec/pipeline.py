@@ -41,6 +41,12 @@ from typing import Callable, Iterator, Optional
 from ..obs import trace as obs_trace
 from ..obs.metrics import MetricKind
 
+# Producer threads can run first-touch XLA compiles (upstream kernel
+# pulls) whose deep LLVM recursion overflows the default thread stack —
+# spawn producers with the engine's shared big-stack helper (ONE process-
+# wide lock for every stack_size window; utils/threads.py).
+from ..utils.threads import start_big_stack_thread
+
 
 class PipelinedIterator:
     """Bounded dispatch-ahead prefetcher over an iterator of batches.
@@ -61,8 +67,15 @@ class PipelinedIterator:
         catalog=None,
         release: Optional[Callable[[], None]] = None,
         metrics: Optional[dict] = None,
+        cancel_token=None,
     ):
         self._source = source
+        # sched/ cancellation: checked before each upstream pull so a
+        # cancelled query's producer stops at its next batch boundary; the
+        # raised error surfaces on the consuming thread like any upstream
+        # failure, and the finally-block release still runs (semaphore/
+        # permit holds cannot leak on a cancel)
+        self._cancel_token = cancel_token
         self._depth = max(1, int(depth))
         self._max_bytes = max(0, int(max_bytes))
         self._catalog = catalog
@@ -80,10 +93,7 @@ class PipelinedIterator:
         # thread attributes under the operator that spawned the pipeline —
         # not outside the query trace (the pre-obs attribution hole)
         self._trace_ctx = obs_trace.capture_context()
-        self._thread = threading.Thread(
-            target=self._produce, name="srt-pipeline", daemon=True
-        )
-        self._thread.start()
+        self._thread = start_big_stack_thread(self._produce, "srt-pipeline")
 
     # ── producer side ───────────────────────────────────────────────────
     def _window_full(self) -> bool:
@@ -132,6 +142,8 @@ class PipelinedIterator:
                         self._catalog.ensure_headroom(self._last_size)
                     except Exception:
                         pass  # headroom is advisory; the pull may still fit
+                if self._cancel_token is not None:
+                    self._cancel_token.check()
                 t0 = time.perf_counter_ns()
                 try:
                     item = next(it)
@@ -277,6 +289,7 @@ def pipelined_partition(conf, ctx, it, fn, metrics=None):
         catalog=ctx.catalog,
         release=ctx.semaphore.release_if_necessary,
         metrics=metrics,
+        cancel_token=getattr(ctx, "cancel_token", None),
     )
     try:
         yield from fn(pipe)

@@ -185,7 +185,7 @@ def abstract_zero_vals() -> TaskVals:
 
 
 def run_device(fn, it, needs_task, catalog=None, policy=None, op=None,
-               breaker=None):
+               breaker=None, token=None):
     """Drive a jitted kernel ``fn(batch, TaskVals)`` over device batches,
     sampling the thread-local task state only when the expression tree
     needs it (shared by TpuProjectExec/TpuFilterExec).
@@ -202,7 +202,12 @@ def run_device(fn, it, needs_task, catalog=None, policy=None, op=None,
     project/filter are row-wise, so halves yield independently. Task-
     dependent kernels keep spill-retry only: splitting would need per-half
     row_base threading, and the task-dependent set (monotonically
-    increasing ids, input-file metadata) is never the memory hog."""
+    increasing ids, input-file metadata) is never the memory hog.
+
+    ``token`` (sched/cancel.py CancelToken) is checked before every batch —
+    the scheduler's cancellation/deadline contract: a cancelled query stops
+    dispatching within one batch boundary and unwinds through the normal
+    error path (permits, semaphore, spill holds all release)."""
     import jax.numpy as jnp
 
     from ..resilience import retry as R
@@ -211,9 +216,13 @@ def run_device(fn, it, needs_task, catalog=None, policy=None, op=None,
         zeros = zero_vals(jnp)
         if policy is None:
             for db in it:
+                if token is not None:
+                    token.check()
                 yield fn(db, zeros)
             return
         for db in it:
+            if token is not None:
+                token.check()
             yield from R.run_with_retry(
                 catalog, lambda b: fn(b, zeros), db, policy, op=op,
                 breaker=breaker,
@@ -221,6 +230,8 @@ def run_device(fn, it, needs_task, catalog=None, policy=None, op=None,
         return
     base = None  # device-resident running row count (no per-batch sync)
     for db in it:
+        if token is not None:
+            token.check()
         get_or_create()
         tv = task_vals(jnp, row_base=base)
         if policy is None:

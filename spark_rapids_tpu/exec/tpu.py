@@ -173,7 +173,10 @@ class HostToDeviceExec(Exec):
         timing = self.metrics_on(ctx, "MODERATE")
 
         def fn(it):
+            tok = ctx.cancel_token
             for rb in it:
+                if tok is not None:
+                    tok.check()  # sched/: stop uploads at batch boundaries
                 if rb.num_rows == 0:
                     continue
                 rows_m.add(rb.num_rows)
@@ -211,59 +214,93 @@ class HostToDeviceExec(Exec):
                 max_rows,
                 max_str,
             )
-            cache = ctx.session.__dict__.setdefault("_h2d_cache", {})
-            entry = cache.get(key)
-            if entry is None:
-                import threading
+            import threading
 
-                entry = {
-                    # pin BOTH: the source anchors the cache key's id()
-                    # across pruning passes, the pruned table backs the
-                    # uploaded batches
-                    "table": (child.source, child.table),
-                    "parts": [None] * child.num_partitions,
-                    "rows": [0] * child.num_partitions,
-                    "lock": threading.Lock(),
-                }
-                # BYTES-bounded LRU: cached uploads are plain references
-                # (never registered with the spill catalog), so this bound
-                # is the ONLY thing standing between many-table sessions
-                # and pinned-HBM OOM. The old 4-ENTRY bound thrashed on
-                # TPC-H's 8-table star schema, re-uploading every table
-                # each run (~3.5s/query over a tunneled link at sf=0.5); a
-                # byte budget keeps whole star schemas resident while still
-                # evicting when the cached set actually grows large.
-                # Arrow nbytes underestimates the padded device footprint —
-                # ~2x covers pow2 row padding; string byte-planes can
-                # exceed it, which only makes eviction earlier (safe side).
-                new_bytes = 2 * child.table.nbytes
-                budget = _upload_cache_budget(ctx.conf)
-                held = sum(c.get("est_bytes", 0) for c in cache.values())
-                while cache and held + new_bytes > budget:
-                    old = cache.pop(next(iter(cache)))  # LRU head
-                    held -= old.get("est_bytes", 0)
-                entry["est_bytes"] = new_bytes
-                cache[key] = entry
-            else:
-                cache[key] = cache.pop(key)  # refresh LRU order
+            # concurrent queries race this LRU (get/insert vs evict-pop →
+            # KeyError, double-insert): all cache BOOKKEEPING serializes
+            # under one session lock; the uploads themselves stay outside it
+            with ctx.session._h2d_lock:
+                cache = ctx.session.__dict__.setdefault("_h2d_cache", {})
+                entry = cache.get(key)
+                if entry is None:
+                    entry = {
+                        # pin BOTH: the source anchors the cache key's id()
+                        # across pruning passes, the pruned table backs the
+                        # uploaded batches
+                        "table": (child.source, child.table),
+                        "parts": [None] * child.num_partitions,
+                        "rows": [0] * child.num_partitions,
+                        # per-partition in-flight build event (single-
+                        # flight: concurrent cold queries must not each
+                        # upload the partition — N transient HBM copies
+                        # would defeat the scheduler's admission budget)
+                        "building": [None] * child.num_partitions,
+                        "lock": threading.Lock(),
+                    }
+                    # BYTES-bounded LRU: cached uploads are plain references
+                    # (never registered with the spill catalog), so this bound
+                    # is the ONLY thing standing between many-table sessions
+                    # and pinned-HBM OOM. The old 4-ENTRY bound thrashed on
+                    # TPC-H's 8-table star schema, re-uploading every table
+                    # each run (~3.5s/query over a tunneled link at sf=0.5); a
+                    # byte budget keeps whole star schemas resident while still
+                    # evicting when the cached set actually grows large.
+                    # Arrow nbytes underestimates the padded device footprint —
+                    # ~2x covers pow2 row padding; string byte-planes can
+                    # exceed it, which only makes eviction earlier (safe side).
+                    new_bytes = 2 * child.table.nbytes
+                    budget = _upload_cache_budget(ctx.conf)
+                    held = sum(c.get("est_bytes", 0) for c in cache.values())
+                    while cache and held + new_bytes > budget:
+                        old = cache.pop(next(iter(cache)))  # LRU head
+                        held -= old.get("est_bytes", 0)
+                    entry["est_bytes"] = new_bytes
+                    cache[key] = entry
+                else:
+                    cache[key] = cache.pop(key)  # refresh LRU order
             child_parts = child.execute(ctx)
 
             def make_cached(p, thunk):
                 def it():
-                    if entry["parts"][p] is None:
-                        n_before = rows_m.value
-                        built = list(fn(thunk()))
+                    # single-flight per partition: one builder uploads, the
+                    # rest wait on its event and replay; a failed builder
+                    # clears its event so a waiter takes over (same
+                    # contract as the session's df.cache() store)
+                    tok = ctx.cancel_token
+                    while True:
                         with entry["lock"]:
-                            entry["parts"][p] = built
-                            entry["rows"][p] = rows_m.value - n_before
-                        for db in built:
-                            yield db
-                        return
-                    # replay: keep the metric honest without device syncs
-                    rows_m.add(entry["rows"][p])
-                    for db in entry["parts"][p]:
-                        ctx.semaphore.acquire_if_necessary()
-                        yield db
+                            built = entry["parts"][p]
+                            ev = entry["building"][p]
+                            builder = built is None and ev is None
+                            if builder:
+                                ev = entry["building"][p] = threading.Event()
+                        if built is not None:
+                            # replay: keep the metric honest, no device sync
+                            rows_m.add(entry["rows"][p])
+                            for db in built:
+                                ctx.semaphore.acquire_if_necessary()
+                                yield db
+                            return
+                        if builder:
+                            n_before = rows_m.value
+                            try:
+                                out = list(fn(thunk()))
+                                with entry["lock"]:
+                                    entry["parts"][p] = out
+                                    entry["rows"][p] = rows_m.value - n_before
+                            finally:
+                                with entry["lock"]:
+                                    entry["building"][p] = None
+                                ev.set()
+                            for db in out:
+                                yield db
+                            return
+                        # another query is uploading this partition: wait
+                        # for it (cancellable — this thread's own token
+                        # still fires at its admission deadline/cancel)
+                        while not ev.wait(0.05):
+                            if tok is not None:
+                                tok.check()
 
                 return it
 
@@ -586,7 +623,7 @@ class TpuProjectExec(Exec):
             return task.run_device(
                 fn, it, needs_task, catalog=ctx.catalog,
                 policy=ctx.retry_policy, op="ProjectExec",
-                breaker=ctx.breaker,
+                breaker=ctx.breaker, token=ctx.cancel_token,
             )
 
         return self.children[0].execute(ctx).map_partitions(run)
@@ -621,7 +658,7 @@ class TpuFilterExec(Exec):
             return task.run_device(
                 fn, it, needs_task, catalog=ctx.catalog,
                 policy=ctx.retry_policy, op="FilterExec",
-                breaker=ctx.breaker,
+                breaker=ctx.breaker, token=ctx.cancel_token,
             )
 
         return self.children[0].execute(ctx).map_partitions(run)

@@ -243,6 +243,17 @@ CATALOG: Iterable[tuple] = (
     ("shuffle.bytesFetched", MetricKind.COUNTER, "payload bytes received from peer executors"),
     ("shuffle.bytesCompressedOut", MetricKind.COUNTER, "serialized shuffle payload bytes after compression"),
     ("shuffle.bytesUncompressed", MetricKind.COUNTER, "serialized shuffle payload bytes before compression"),
+    # sched/* — multi-tenant admission control (per-pool admitted counters
+    # under scheduler.pool.<name>.admitted register dynamically on first use)
+    ("scheduler.admitted", MetricKind.COUNTER, "queries granted device permits"),
+    ("scheduler.rejected", MetricKind.COUNTER, "admissions rejected (QueryQueueFull)"),
+    ("scheduler.cancelled", MetricKind.COUNTER, "queries cancelled (queued or running)"),
+    ("scheduler.timeouts", MetricKind.COUNTER, "queries past their deadline (QueryTimeoutError)"),
+    ("scheduler.queueWaitNs", MetricKind.NANOS, "time queries spent waiting for admission"),
+    ("scheduler.queueDepth", MetricKind.GAUGE, "queries currently waiting for admission"),
+    ("scheduler.permitsInUse", MetricKind.GAUGE, "admission permits currently held"),
+    ("scheduler.effectivePermits", MetricKind.GAUGE,
+     "live permit limit (configured permits, halved under OOM pressure)"),
     # resilience/* — the old retry.report() counters (registry view now)
     ("resilience.oom_retries", MetricKind.COUNTER, "spill-and-retry launches after device OOM"),
     ("resilience.splits", MetricKind.COUNTER, "OOM batch halvings"),

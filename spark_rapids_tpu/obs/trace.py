@@ -221,6 +221,18 @@ def activate(tracer: Optional[Tracer]) -> None:
         _ACTIVE = tracer
 
 
+def deactivate(tracer: Tracer) -> None:
+    """Clear the active tracer ONLY if it is still ``tracer`` — with the
+    scheduler admitting concurrent queries, query A ending must not strip
+    query B's freshly-activated tracer (module-level span hooks would go
+    dark mid-query). Plan spans are unaffected either way: instrument_plan
+    pins each query's tracer into its wrappers."""
+    global _ACTIVE
+    with _ACTIVE_LOCK:
+        if _ACTIVE is tracer:
+            _ACTIVE = None
+
+
 def span(name: str, cat: str = "op", args=None):
     """Module-level hook for engine code: a real span when a tracer is
     active, a shared no-op singleton otherwise (zero allocation)."""
@@ -269,7 +281,7 @@ class query_scope:
     def __exit__(self, *exc):
         if self._span is not None:
             self._span.__exit__(*exc)
-            activate(None)
+            deactivate(self.tracer)
         return False
 
 

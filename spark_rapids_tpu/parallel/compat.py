@@ -1,0 +1,41 @@
+"""JAX API compatibility shims for the parallel/ package.
+
+``shard_map`` moved across jax releases: ``jax.experimental.shard_map``
+(0.4.x) graduated to top-level ``jax.shard_map`` (0.6+). The engine's mesh
+modules resolve it through here so either vintage works; when NEITHER
+exists the placeholder raises a clear error at call time (module import
+stays safe, and tests skip with the same message via ``HAS_SHARD_MAP``).
+"""
+from __future__ import annotations
+
+SHARD_MAP_UNAVAILABLE_MSG = (
+    "shard_map is unavailable in this jax installation (neither "
+    "jax.shard_map nor jax.experimental.shard_map.shard_map exists) — "
+    "mesh/ICI execution requires one of them"
+)
+
+
+def _resolve():
+    import jax
+
+    fn = getattr(jax, "shard_map", None)
+    if fn is not None:
+        return fn
+    try:
+        from jax.experimental.shard_map import shard_map as fn  # type: ignore
+
+        return fn
+    except ImportError:
+        return None
+
+
+_SHARD_MAP = _resolve()
+HAS_SHARD_MAP = _SHARD_MAP is not None
+
+
+def shard_map(*args, **kwargs):
+    """Dispatch to whichever shard_map this jax provides; loud, typed
+    failure (NotImplementedError) when none does."""
+    if _SHARD_MAP is None:
+        raise NotImplementedError(SHARD_MAP_UNAVAILABLE_MSG)
+    return _SHARD_MAP(*args, **kwargs)

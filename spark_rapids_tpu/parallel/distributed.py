@@ -20,7 +20,7 @@ from typing import Callable
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax import shard_map
+from .compat import shard_map  # jax.shard_map / experimental, shimmed
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..columnar.device import DeviceBatch, DeviceColumn

@@ -85,7 +85,12 @@ class ExecContext:
         # driver program, so the (query_seq, per-query counter) pair is
         # deterministic across processes).
         seq = session._next_query_seq() if session is not None else 0
+        self.query_seq = seq
         self._shuffle_ids = itertools.count(seq * 1_000_000 + 1)
+        # multi-tenant scheduler (sched/): the per-query cancellation token,
+        # installed by the session at admission; operators check it at batch
+        # boundaries. None = unscheduled execution (no checks).
+        self.cancel_token = None
         # depth counter: >0 while building a broadcast batch — exchanges
         # below a broadcast must run WHOLE in every process (no rank split,
         # no shared-registry map statuses). Thread-LOCAL: broadcast builds

@@ -1,0 +1,42 @@
+"""Multi-tenant query scheduling — admission control, pools, cancellation.
+
+The reference delegates this whole layer to the cluster manager: Spark's
+FAIR scheduler pools order jobs, ``spark.cancelJobGroup`` kills them, and
+the plugin only guards the device *within* a job (GpuSemaphore.scala's
+``concurrentGpuTasks`` permits). Standalone, this repo owns the service
+layer itself, so the machinery lives here:
+
+- :mod:`.cancel` — per-query :class:`CancelToken` (cancellation + deadline),
+  checked at batch boundaries throughout the engine;
+- :mod:`.estimate` — peak-HBM working-set estimation from the physical plan
+  (scan footprints × widest operator, plus join/agg build sides);
+- :mod:`.admission` — :class:`WeightedPermitPool`: the weighted, multi-query
+  generalization of ``mem/semaphore.py``'s DeviceSemaphore, with fair-share
+  pools and a bounded admission queue;
+- :mod:`.scheduler` — :class:`QueryScheduler`: ties the three together and
+  owns the active-query registry (``session.cancel`` / ``cancel_all``).
+"""
+from .cancel import (
+    CancelToken,
+    QueryCancelledError,
+    QueryQueueFull,
+    QueryTimeoutError,
+    SchedulerError,
+)
+from .admission import PoolSpec, WeightedPermitPool, parse_pool_spec
+from .estimate import estimate_plan_bytes
+from .scheduler import Admission, QueryScheduler
+
+__all__ = [
+    "Admission",
+    "CancelToken",
+    "PoolSpec",
+    "QueryCancelledError",
+    "QueryQueueFull",
+    "QueryScheduler",
+    "QueryTimeoutError",
+    "SchedulerError",
+    "WeightedPermitPool",
+    "estimate_plan_bytes",
+    "parse_pool_spec",
+]

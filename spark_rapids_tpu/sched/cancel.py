@@ -1,0 +1,104 @@
+"""Cancellation tokens and the scheduler's typed error family.
+
+The ``cancelJobGroup`` analogue: a :class:`CancelToken` is minted per query
+by the scheduler and threaded into execution (``ExecContext.cancel_token``).
+Operators check it at *batch boundaries* — ``exec/task.py``'s device loop,
+the pipeline producer thread, the H2D/D2H pull loops, and the session's
+result loop — so a cancelled query stops within one batch and unwinds
+through normal exception propagation, releasing its device permits,
+semaphore holds, and spill registrations on the way out.
+
+Deadlines ride the same token: ``spark.rapids.tpu.scheduler.queryTimeout``
+becomes an absolute ``time.monotonic`` deadline at admission; ``check()``
+raises the *typed* :class:`QueryTimeoutError` once it passes, whether the
+query is still queued or already running.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Optional
+
+
+class SchedulerError(RuntimeError):
+    """Base of the scheduler's typed error family — never retried by the
+    task-retry machinery (retrying a cancelled/rejected query can only
+    waste the device)."""
+
+
+class QueryCancelledError(SchedulerError):
+    """The query was cancelled (``session.cancel`` / ``cancel_all``)."""
+
+
+class QueryTimeoutError(QueryCancelledError):
+    """The query's deadline (``spark.rapids.tpu.scheduler.queryTimeout``)
+    expired — in the admission queue or mid-execution."""
+
+
+class QueryQueueFull(SchedulerError):
+    """Admission rejected: the scheduler queue is at
+    ``spark.rapids.tpu.scheduler.maxQueued`` — the backpressure signal a
+    service in front of this engine sheds load on."""
+
+
+class CancelToken:
+    """Thread-safe per-query cancellation flag + optional deadline.
+
+    ``check()`` is the hot-path call (one attribute read when healthy, plus
+    a clock read only when a deadline exists); ``cancel()`` may be called
+    from any thread, any number of times — first reason wins.
+    """
+
+    __slots__ = ("query_id", "deadline", "_cancelled", "_reason", "_lock")
+
+    def __init__(self, query_id: str = "", timeout_s: Optional[float] = None):
+        self.query_id = query_id
+        self.deadline = (
+            time.monotonic() + timeout_s
+            if timeout_s is not None and timeout_s > 0
+            else None
+        )
+        self._cancelled = False
+        self._reason = ""
+        self._lock = threading.Lock()
+
+    def cancel(self, reason: str = "cancelled") -> bool:
+        """Flag the query cancelled; True if this call flipped the flag."""
+        with self._lock:
+            if self._cancelled:
+                return False
+            self._cancelled = True
+            self._reason = reason
+            return True
+
+    @property
+    def cancelled(self) -> bool:
+        return self._cancelled or self.expired
+
+    @property
+    def expired(self) -> bool:
+        return self.deadline is not None and time.monotonic() > self.deadline
+
+    @property
+    def reason(self) -> str:
+        return self._reason
+
+    def remaining_s(self) -> Optional[float]:
+        """Seconds until the deadline (None = no deadline; 0.0 = expired)."""
+        if self.deadline is None:
+            return None
+        return max(0.0, self.deadline - time.monotonic())
+
+    def check(self) -> None:
+        """Raise the typed error if cancelled or past deadline; the one
+        call engine loops make at each batch boundary."""
+        if self._cancelled:
+            raise QueryCancelledError(
+                f"query {self.query_id or '<anonymous>'} cancelled"
+                + (f": {self._reason}" if self._reason else "")
+            )
+        if self.expired:
+            raise QueryTimeoutError(
+                f"query {self.query_id or '<anonymous>'} exceeded its "
+                "deadline (spark.rapids.tpu.scheduler.queryTimeout)"
+            )

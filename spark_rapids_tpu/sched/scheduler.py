@@ -1,0 +1,224 @@
+"""QueryScheduler — the session's multi-tenant service layer.
+
+One scheduler per :class:`TpuSession` gates every ``collect()`` /
+``to_pandas()`` / ``to_jax()`` through admission control
+(:class:`~spark_rapids_tpu.sched.admission.WeightedPermitPool`), tracks
+every in-flight query in a registry keyed by query id (the
+``cancelJobGroup`` analogue: ``session.cancel(query_id)`` /
+``session.cancel_all()``), and enforces per-query deadlines.
+
+Every conf this module reads is re-read *per admission* — permit count,
+queue bound, pool weights, pool assignment, timeout — so a long-lived
+service can be retuned live via ``session.set_conf`` without restarting
+(docs/configs.md marks the few genuinely session-frozen keys).
+
+Observability: admitted/rejected/cancelled/timeout counters, the
+queue-wait timer, queue-depth and permits-in-use gauges all live in the
+process registry (``obs/metrics.py``) so the Prometheus export carries
+them; a ``queued`` span (category ``sched``) is recorded on the query's
+tracer whenever admission had to wait, so Perfetto shows admission stalls
+inside the query timeline.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, Optional
+
+from ..obs import metrics as obs_metrics
+from .admission import WeightedPermitPool, parse_pool_spec
+from .cancel import (
+    CancelToken,
+    QueryCancelledError,
+    QueryQueueFull,
+    QueryTimeoutError,
+)
+
+_M = obs_metrics.GLOBAL
+
+
+class Admission:
+    """One query's passage through the scheduler: a context manager that
+    blocks in ``__enter__`` until admitted (or raises the typed rejection)
+    and releases permits + unregisters in ``__exit__`` — on success, error,
+    and cancellation alike."""
+
+    def __init__(
+        self,
+        scheduler: "QueryScheduler",
+        query_id: str,
+        permits: int,
+        pool: str,
+        token: CancelToken,
+        enabled: bool,
+        tracer=None,
+    ):
+        self.scheduler = scheduler
+        self.query_id = query_id
+        self.permits = permits
+        self.pool = pool
+        self.token = token
+        self.enabled = enabled
+        self.tracer = tracer
+        self.queue_wait_ns = 0
+        self._granted = 0
+
+    def __enter__(self) -> "Admission":
+        self.scheduler._register(self)
+        try:
+            self.token.check()  # cancelled/expired while still client-side
+            if self.enabled:
+                t0 = time.perf_counter_ns()
+                span = (
+                    self.tracer.span(
+                        "queued",
+                        "sched",
+                        {"pool": self.pool, "permits": self.permits},
+                    )
+                    if self.tracer is not None
+                    else None
+                )
+                try:
+                    if span is not None:
+                        span.__enter__()
+                    self._granted = self.scheduler.pool.acquire(
+                        self.permits, self.pool, self.token
+                    )
+                finally:
+                    if span is not None:
+                        span.__exit__(None, None, None)
+                self.queue_wait_ns = time.perf_counter_ns() - t0
+                # counted only when admission actually gated: a disabled
+                # scheduler must not report admissions it never performed
+                _M.counter("scheduler.admitted").add(1)
+        except QueryTimeoutError:
+            _M.counter("scheduler.timeouts").add(1)
+            self.scheduler._unregister(self)
+            raise
+        except QueryCancelledError:
+            _M.counter("scheduler.cancelled").add(1)
+            self.scheduler._unregister(self)
+            raise
+        except QueryQueueFull:
+            _M.counter("scheduler.rejected").add(1)
+            self.scheduler._unregister(self)
+            raise
+        except BaseException:
+            # anything else (KeyboardInterrupt while queued, tracer bugs)
+            # is NOT backpressure — unregister without touching rejected
+            self.scheduler._unregister(self)
+            raise
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        if self._granted:
+            self.scheduler.pool.release(self._granted, self.pool)
+            self._granted = 0
+        self.scheduler._unregister(self)
+        if exc_type is not None and issubclass(
+            exc_type, QueryTimeoutError
+        ):
+            _M.counter("scheduler.timeouts").add(1)
+        elif exc_type is not None and issubclass(
+            exc_type, QueryCancelledError
+        ):
+            _M.counter("scheduler.cancelled").add(1)
+        return False
+
+
+class QueryScheduler:
+    """Session-scoped admission + cancellation authority."""
+
+    def __init__(self):
+        self.pool = WeightedPermitPool()
+        self._active: Dict[str, Admission] = {}
+        self._lock = threading.Lock()
+        # bumped by cancel_all: preparation-phase waits that predate a
+        # query's admission (no token yet — e.g. blocking on another
+        # query's cache materialization) poll this so session shutdown
+        # reaches them too
+        self._cancel_epoch = 0
+
+    @property
+    def cancel_epoch(self) -> int:
+        return self._cancel_epoch
+
+    # ── admission ───────────────────────────────────────────────────────
+    def admit(self, query_id: str, plan, conf, tracer=None) -> Admission:
+        """Build the admission for one query from the CURRENT conf (all
+        scheduler keys are per-query, never frozen at session init)."""
+        from .. import config as cfg
+        from .estimate import permits_for_plan
+
+        enabled = cfg.SCHEDULER_ENABLED.get(conf)
+        permits = cfg.SCHEDULER_PERMITS.get(conf)
+        self.pool.configure(
+            permits=permits,
+            max_queued=cfg.SCHEDULER_MAX_QUEUED.get(conf),
+            pools=parse_pool_spec(cfg.SCHEDULER_POOLS.get(conf)),
+        )
+        need = permits_for_plan(plan, conf, permits) if enabled else 1
+        timeout = cfg.SCHEDULER_QUERY_TIMEOUT_S.get(conf)
+        token = CancelToken(
+            query_id, timeout_s=timeout if timeout > 0 else None
+        )
+        pool_name = cfg.SCHEDULER_POOL.get(conf) or "default"
+        return Admission(
+            self, query_id, need, pool_name, token, enabled, tracer
+        )
+
+    # ── registry / cancellation ─────────────────────────────────────────
+    def _register(self, adm: Admission) -> None:
+        with self._lock:
+            self._active[adm.query_id] = adm
+
+    def _unregister(self, adm: Admission) -> None:
+        with self._lock:
+            cur = self._active.get(adm.query_id)
+            if cur is adm:
+                del self._active[adm.query_id]
+
+    def active_queries(self) -> Dict[str, dict]:
+        """query_id → {pool, permits, granted} for every registered query
+        (queued or running)."""
+        with self._lock:
+            return {
+                qid: {
+                    "pool": a.pool,
+                    "permits": a.permits,
+                    "granted": a._granted,
+                }
+                for qid, a in self._active.items()
+            }
+
+    def cancel(self, query_id: str, reason: str = "cancelled by user") -> bool:
+        """Flag one query cancelled (queued or mid-execution); True when a
+        matching active query existed — including one already flagged
+        (double-cancel is idempotent, not a miss)."""
+        with self._lock:
+            adm = self._active.get(query_id)
+        if adm is None:
+            return False
+        adm.token.cancel(reason)
+        return True
+
+    def cancel_all(self, reason: str = "cancel_all") -> int:
+        """The ``cancelJobGroup`` analogue across the whole session:
+        returns the number of queries flagged."""
+        with self._lock:
+            admissions = list(self._active.values())
+            self._cancel_epoch += 1
+        return sum(1 for a in admissions if a.token.cancel(reason))
+
+    def state(self) -> dict:
+        """One snapshot for bench/diagnostics: pool occupancy + the
+        scheduler slice of the process metric registry."""
+        out = {
+            "permits": self.pool.permits,
+            "effective_permits": self.pool.effective_permits(),
+            "in_use": self.pool.in_use,
+            "queued": self.pool.queued,
+            "active": len(self._active),
+        }
+        out.update(_M.view("scheduler.", strip=False))
+        return out

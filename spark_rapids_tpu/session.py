@@ -25,6 +25,12 @@ from .plan.planner import plan_physical
 from .types import Schema
 from .columnar.host import concat_batches
 
+# threading.stack_size is process-global: EVERY set→spawn→restore window in
+# the engine (partition workers here, pipeline producers) shares this one
+# lock — two independent locks could interleave and spawn a thread after
+# the other window's restore (utils/threads.py)
+from .utils.threads import BIG_STACK_BYTES, STACK_SIZE_LOCK as _STACK_SIZE_LOCK
+
 
 class TpuSession:
     def __init__(self, conf: Optional[dict] = None):
@@ -83,6 +89,16 @@ class TpuSession:
         import threading as _threading
 
         self._retry_lock = _threading.Lock()
+        # multi-tenant scheduler (sched/): admission control + cancellation
+        # registry for concurrent collect()/toPandas() callers. Scheduler
+        # CONFS are re-read at every admission (nothing frozen here).
+        from .sched import QueryScheduler
+
+        self._scheduler = QueryScheduler()
+        # concurrency guards for the session-lifetime caches: the df.cache()
+        # store (single-flight per cache key) and the device-upload LRU
+        self._cache_lock = _threading.Lock()
+        self._h2d_lock = _threading.Lock()
         # resilience: session-lifetime CPU-fallback circuit breaker (runtime
         # kernel failures flip ops to CPU at the next planning pass) and the
         # deterministic fault-injection scenario (None unless
@@ -135,6 +151,30 @@ class TpuSession:
         with self._retry_lock:
             self._query_seq += 1
             return self._query_seq
+
+    # ── multi-tenant scheduling (sched/) ────────────────────────────────
+    @property
+    def scheduler(self):
+        """The session's QueryScheduler (admission pool + active-query
+        registry) — read-only introspection for services and tests."""
+        return self._scheduler
+
+    def active_queries(self) -> dict:
+        """query_id → {pool, permits, granted} of every query currently
+        queued or executing in this session."""
+        return self._scheduler.active_queries()
+
+    def cancel(self, query_id: str, reason: str = "cancelled by user") -> bool:
+        """Cancel one in-flight query (the ``cancelJobGroup`` analogue for
+        a single query): it stops at its next batch boundary, releases its
+        admission permits, and raises QueryCancelledError to its caller.
+        True when a matching active query existed."""
+        return self._scheduler.cancel(query_id, reason)
+
+    def cancel_all(self, reason: str = "cancel_all") -> int:
+        """Cancel every queued and running query; returns how many were
+        flagged. The session stays fully usable afterwards."""
+        return self._scheduler.cancel_all(reason)
 
     def mesh_context(self):
         """Lazily build the session's MeshContext (mesh mode only)."""
@@ -288,23 +328,7 @@ class TpuSession:
         if not isinstance(lp, L.LogicalPlan):
             return lp
         if isinstance(lp, L.InMemoryRelation):
-            import io
-
-            import pyarrow.parquet as papq
-
-            store = self.__dict__.setdefault("_cache_store", {})
-            entry = store.get(lp.cache_key)
-            if entry is None:
-                table = self._execute(lp.child)
-                buf = io.BytesIO()
-                papq.write_table(table, buf, compression="zstd")
-                entry = {"bytes": buf.getvalue(), "table": None}
-                store[lp.cache_key] = entry
-            if entry["table"] is None:
-                entry["table"] = papq.read_table(io.BytesIO(entry["bytes"]))
-                # the decoded table serves all later reads (and anchors the
-                # device-upload cache); the compressed bytes are done
-                entry["bytes"] = None
+            entry = self._cache_entry(lp)
             return L.LocalRelation(
                 entry["table"], lp.schema, lp.num_partitions
             )
@@ -323,15 +347,89 @@ class TpuSession:
                 changed = True
         return _dc.replace(lp, **kw) if changed else lp
 
+    def _cache_entry(self, lp: "L.InMemoryRelation") -> dict:
+        """Materialize (or await) one InMemoryRelation's cache entry with
+        SINGLE-FLIGHT semantics: the first toucher of a cold key executes
+        the subtree; concurrent touchers of the same key block on its done
+        event instead of re-executing the subtree or racing the dict (two
+        threads double-executing an expensive cached aggregate is precisely
+        what cache() exists to prevent). A failed materialization clears
+        the key and raises only to the OWNER; waiters retry ownership
+        themselves — the owner's failure may be its own cancellation or
+        deadline, which must not poison an innocent tenant's query. The
+        retry loop terminates: each pass either waits for a different
+        owner or becomes the owner, and an owner always returns or
+        raises."""
+        import io
+        import threading
+
+        import pyarrow.parquet as papq
+
+        while True:
+            with self._cache_lock:
+                store = self.__dict__.setdefault("_cache_store", {})
+                entry = store.get(lp.cache_key)
+                owner = entry is None
+                if owner:
+                    entry = {
+                        "bytes": None,
+                        "table": None,
+                        "error": None,
+                        "done": threading.Event(),
+                        "lock": threading.Lock(),
+                    }
+                    store[lp.cache_key] = entry
+            if owner:
+                try:
+                    table = self._execute(lp.child)
+                    buf = io.BytesIO()
+                    papq.write_table(table, buf, compression="zstd")
+                    entry["bytes"] = buf.getvalue()
+                except BaseException as e:
+                    entry["error"] = e
+                    with self._cache_lock:
+                        if store.get(lp.cache_key) is entry:
+                            del store[lp.cache_key]
+                    raise
+                finally:
+                    entry["done"].set()
+                break
+            # this wait predates the waiter's own admission (no CancelToken
+            # yet), so session.cancel_all() reaches it through the
+            # scheduler's cancel epoch instead — shutdown must not leave a
+            # thread parked on another query's materialization
+            from .sched import QueryCancelledError
+
+            epoch = self._scheduler.cancel_epoch
+            while not entry["done"].wait(0.05):
+                if self._scheduler.cancel_epoch != epoch:
+                    raise QueryCancelledError(
+                        "cancel_all while waiting on cache "
+                        f"({lp.cache_key}) materialization"
+                    )
+            if entry["error"] is None:
+                break  # materialized: decode below
+        with entry["lock"]:
+            if entry["table"] is None:
+                entry["table"] = papq.read_table(io.BytesIO(entry["bytes"]))
+                # the decoded table serves all later reads (and anchors the
+                # device-upload cache); the compressed bytes are done
+                entry["bytes"] = None
+        return entry
+
     def uncache(self, key: int) -> None:
-        entry = self.__dict__.setdefault("_cache_store", {}).pop(key, None)
+        with self._cache_lock:
+            entry = self.__dict__.setdefault("_cache_store", {}).pop(key, None)
         if entry and entry.get("table") is not None:
             # also evict the device uploads anchored on the decoded table —
-            # unpersist() must actually free HBM
+            # unpersist() must actually free HBM. Same lock as the H2D
+            # LRU's insert/evict path: a concurrent query's upload must not
+            # race this iteration.
             tid = id(entry["table"])
-            h2d = self.__dict__.get("_h2d_cache", {})
-            for k in [k for k in h2d if len(k) > 1 and k[1] == tid]:
-                h2d.pop(k, None)
+            with self._h2d_lock:
+                h2d = self.__dict__.get("_h2d_cache", {})
+                for k in [k for k in h2d if len(k) > 1 and k[1] == tid]:
+                    h2d.pop(k, None)
 
     def _execute(self, lp: L.LogicalPlan) -> pa.Table:
         from .resilience import faults as _faults
@@ -343,7 +441,8 @@ class TpuSession:
             from .obs import trace as obs_trace
             from .profiling import query_trace
 
-            tracer, seq = self._maybe_tracer()
+            seq = ctx.query_seq
+            tracer = self._maybe_tracer(seq)
             if tracer is not None:
                 # tracer pinned into the wrappers: a straggling producer
                 # thread keeps recording into ITS query's buffer, never
@@ -353,31 +452,39 @@ class TpuSession:
                 with obs_trace.query_scope(
                     tracer, f"query-{seq}", {"seq": seq}
                 ):
-                    with query_trace(cfg.PROFILE_PATH.get(self.conf)):
-                        return self._run_plan(final_plan, ctx)
+                    # multi-tenant admission (sched/): estimate the HBM
+                    # footprint, take a weighted permit share (queueing
+                    # under the fair-share policy — the wait shows as a
+                    # 'queued' span), install the cancel token, run. The
+                    # context manager releases permits on every exit path.
+                    with self._scheduler.admit(
+                        f"q{seq}", final_plan, self.conf, tracer
+                    ) as admission:
+                        ctx.cancel_token = admission.token
+                        with query_trace(cfg.PROFILE_PATH.get(self.conf)):
+                            return self._run_plan(final_plan, ctx)
             finally:
                 if tracer is not None:
                     self._export_trace(tracer, final_plan, seq)
                 self._leak_check(ctx)
 
-    def _maybe_tracer(self):
-        """(tracer, query_seq): the span tracer for this query when tracing
-        is on AND this query is sampled, else (None, seq). Sampling is
-        deterministic in the session's query sequence (Dapper-style cheap
-        sampled spans; spark.rapids.tpu.trace.sample)."""
-        seq = self._query_seq  # minted by _prepare_plan's ExecContext
+    def _maybe_tracer(self, seq: int):
+        """The span tracer for this query when tracing is on AND this query
+        is sampled, else None. Sampling is deterministic in the session's
+        query sequence (Dapper-style cheap sampled spans;
+        spark.rapids.tpu.trace.sample)."""
         trace_dir = cfg.TRACE_DIR.get(self.conf)
         if not (cfg.TRACE_ENABLED.get(self.conf) or trace_dir):
-            return None, seq
+            return None
         sample = cfg.TRACE_SAMPLE.get(self.conf)
         # Weyl-sequence hash of the seq → [0, 1): deterministic, well
         # spread even for consecutive seqs
         u = ((seq * 2654435761) & 0xFFFFFFFF) / 2**32
         if u >= sample:
-            return None, seq
+            return None
         from .obs.trace import Tracer
 
-        return Tracer(capacity=cfg.TRACE_BUFFER_SPANS.get(self.conf)), seq
+        return Tracer(capacity=cfg.TRACE_BUFFER_SPANS.get(self.conf))
 
     def _export_trace(self, tracer, plan, seq: int) -> None:
         """Per-query artifacts (spark.rapids.tpu.trace.dir): the Chrome-
@@ -493,26 +600,31 @@ class TpuSession:
                 pass
         return final_plan, ctx
 
-    def _run_task(self, thunk, attempts: int) -> List[pa.RecordBatch]:
+    def _run_task(self, thunk, attempts: int, on_retry=None) -> List[pa.RecordBatch]:
         """One partition task with Spark's retry model (spark.task.maxFailures;
         SURVEY §5 failure detection): the lineage IS the recovery mechanism —
         a partition thunk is a pure closure over its upstream pipeline, so a
         failed attempt simply re-runs it. Results commit only on success (a
         partial stream from a failed attempt is discarded). Deterministic
         semantic errors surface immediately: retrying an ANSI overflow or an
-        assertion can only fail again."""
+        assertion can only fail again — and so can a cancelled or
+        deadline-expired query (sched/ errors never retry)."""
         from .expr.base import AnsiError
+        from .sched import SchedulerError
 
         last: Optional[Exception] = None
         for attempt in range(max(1, attempts)):
             try:
                 return list(thunk())
-            except (AssertionError, AnsiError):
+            except (AssertionError, AnsiError, SchedulerError):
                 raise
             except Exception as e:  # noqa: BLE001 - Spark retries any task failure
                 last = e
-                with self._retry_lock:
-                    self._task_retries += 1
+                if on_retry is not None:
+                    on_retry()  # per-query accounting (_run_plan)
+                else:
+                    with self._retry_lock:
+                        self._task_retries += 1
                 if attempt + 1 < attempts:
                     import logging
 
@@ -529,7 +641,33 @@ class TpuSession:
         parts = final_plan.execute(ctx)
         batches: List[pa.RecordBatch] = []
         attempts = cfg.TASK_MAX_FAILURES.get(self.conf)
-        self._task_retries = 0
+        # per-QUERY retry count (concurrent queries must not clobber each
+        # other mid-flight); the session attribute becomes the last
+        # finished query's total, assigned once in the finally below
+        query_retries = [0]
+
+        def on_retry():
+            with self._retry_lock:
+                query_retries[0] += 1
+
+        token = getattr(ctx, "cancel_token", None)
+
+        def checked(thunk):
+            # scheduler cancellation/deadline: one check per result batch —
+            # with CPU-only plans (no device loop to check) this is the
+            # batch-boundary guarantee
+            if token is None:
+                return thunk
+
+            def it():
+                for rb in thunk():
+                    token.check()
+                    yield rb
+
+            return it
+
+        # concurrentGpuTasks is re-read HERE, per query — a long-lived
+        # service retunes it live with set_conf (docs/configs.md scope)
         n_threads = min(len(parts.parts), cfg.CONCURRENT_TPU_TASKS.get(self.conf))
         if n_threads > 1:
             # Run partition tasks concurrently (the reference's executor task
@@ -541,21 +679,39 @@ class TpuSession:
 
             # XLA compilation can run inside these workers (first touch of a
             # kernel); LLVM passes recurse deeply on large fused programs and
-            # overflow the default worker stack — give executors a big one
-            prev_stack = threading.stack_size(512 * 1024 * 1024)
+            # overflow the default worker stack — give executors a big one.
+            # stack_size() is PROCESS-global: the set→spawn→restore window
+            # serializes under a lock so a concurrently-admitted query
+            # cannot restore the small stack while this one's workers are
+            # still being spawned (workers all exist once every submit
+            # returns — ThreadPoolExecutor spawns up to max_workers threads
+            # on submission, and len(parts) >= n_threads here).
+            with _STACK_SIZE_LOCK:
+                prev_stack = threading.stack_size(BIG_STACK_BYTES)
+                try:
+                    pool = ThreadPoolExecutor(max_workers=n_threads)
+                    futures = [
+                        pool.submit(
+                            self._run_task, checked(t), attempts, on_retry
+                        )
+                        for t in parts.parts
+                    ]
+                finally:
+                    threading.stack_size(prev_stack)
             try:
-                with ThreadPoolExecutor(max_workers=n_threads) as pool:
-                    results = list(
-                        pool.map(lambda t: self._run_task(t, attempts), parts.parts)
-                    )
+                results = [f.result() for f in futures]
             finally:
-                threading.stack_size(prev_stack)
+                pool.shutdown(wait=True)
+                self._task_retries = query_retries[0]
             batches = [rb for rbs in results for rb in rbs if rb.num_rows]
         else:
-            for thunk in parts.parts:
-                for rb in self._run_task(thunk, attempts):
-                    if rb.num_rows:
-                        batches.append(rb)
+            try:
+                for thunk in parts.parts:
+                    for rb in self._run_task(checked(thunk), attempts, on_retry):
+                        if rb.num_rows:
+                            batches.append(rb)
+            finally:
+                self._task_retries = query_retries[0]
         schema = final_plan.output
         if not batches:
             return pa.table(
@@ -1238,15 +1394,33 @@ class DataFrame:
                 "CPU?) — use to_arrow() instead"
             )
         try:
-            parts = plan.execute(ctx)
-            # same retry model as collect(): partition thunks re-run from
-            # lineage on transient failures (spark.task.maxFailures)
-            attempts = cfg.TASK_MAX_FAILURES.get(self._session.conf)
-            batches = [
-                db
-                for t in parts.parts
-                for db in self._session._run_task(t, attempts)
-            ]
+            # device export rides the same admission control as collect():
+            # its result stays resident in HBM, exactly what the permit
+            # pool is budgeting
+            with self._session._scheduler.admit(
+                f"q{ctx.query_seq}", final_plan, self._session.conf
+            ) as admission:
+                ctx.cancel_token = admission.token
+                parts = plan.execute(ctx)
+                # same retry model as collect(): partition thunks re-run
+                # from lineage on transient failures (spark.task.maxFailures)
+                # — with the same per-QUERY retry accounting (a concurrent
+                # collect's counter must not be clobbered mid-flight)
+                attempts = cfg.TASK_MAX_FAILURES.get(self._session.conf)
+                query_retries = [0]
+
+                def on_retry():
+                    with self._session._retry_lock:
+                        query_retries[0] += 1
+
+                try:
+                    batches = [
+                        db
+                        for t in parts.parts
+                        for db in self._session._run_task(t, attempts, on_retry)
+                    ]
+                finally:
+                    self._session._task_retries = query_retries[0]
             batches = [b for b in bulk_shrink(batches) if b.capacity]
             if not batches:
                 from .columnar.device import empty_batch

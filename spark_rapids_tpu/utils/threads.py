@@ -1,0 +1,35 @@
+"""Shared big-stack thread spawning.
+
+``threading.stack_size`` is PROCESS-global: every set→spawn→restore window
+in the engine must serialize on ONE lock, or two windows interleave and a
+thread meant to get the big stack is created after the other window's
+restore (first-touch XLA compiles recurse deeply in LLVM and overflow the
+default stack — the crash the big stack exists to prevent). Both the
+session's partition-worker pool and the pipeline's producer threads spawn
+through here.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Callable
+
+#: XLA:CPU compiles inside engine threads need this much headroom
+BIG_STACK_BYTES = 512 * 1024 * 1024
+
+#: the ONE lock every stack_size set→spawn→restore window takes
+STACK_SIZE_LOCK = threading.Lock()
+
+
+def start_big_stack_thread(
+    target: Callable[[], None], name: str, daemon: bool = True
+) -> threading.Thread:
+    """Spawn one thread with the big stack (Thread.start() reads the
+    process-global size, so the whole window holds the lock)."""
+    with STACK_SIZE_LOCK:
+        prev = threading.stack_size(BIG_STACK_BYTES)
+        try:
+            t = threading.Thread(target=target, name=name, daemon=daemon)
+            t.start()
+        finally:
+            threading.stack_size(prev)
+    return t
