@@ -72,6 +72,23 @@ bench-dry:
 	BENCH_PLATFORM=cpu BENCH_SF=0.02 BENCH_PARTITIONS=2 \
 	  BENCH_SHUFFLE_PARTITIONS=2 BENCH_RUNS=1 $(PY) bench.py
 
+# Start the Arrow-IPC SQL endpoint with the TPC-H demo catalog registered
+# as temp views (docs/serving.md). Connect with:
+#   python -c "from spark_rapids_tpu.serve import connect; \
+#     print(connect(port=8045).sql('select count(*) c from lineitem').to_table())"
+SERVE_PORT ?= 8045
+SERVE_SF ?= 0.01
+.PHONY: serve
+serve:
+	$(PY) -m spark_rapids_tpu.serve --port $(SERVE_PORT) --tpch-sf $(SERVE_SF)
+
+# Closed-loop serving SLO benchmark (N clients x target qps over the wire;
+# emits SLO_r06.json with p50/p95/p99 wait+run latency and per-tenant qps).
+.PHONY: bench-serve
+bench-serve:
+	BENCH_PLATFORM=$(or $(BENCH_PLATFORM),cpu) BENCH_SF=0.05 \
+	  BENCH_RUNS=1 $(PY) bench.py --serve 4
+
 # Trace one TPC-H query through the bench rig: `make trace Q=6` writes
 # traces/query-<n>.trace.json (open at ui.perfetto.dev), the per-query
 # metrics artifact, and a Prometheus dump (docs/observability.md).

@@ -269,6 +269,7 @@ def _suite_args():
     trace_dir = os.environ.get("BENCH_TRACE_DIR", "")
     queries = os.environ.get("BENCH_QUERIES", "")
     concurrency = int(os.environ.get("BENCH_CONCURRENCY", "0") or 0)
+    serve_clients = int(os.environ.get("BENCH_SERVE_CLIENTS", "0") or 0)
     argv = sys.argv[1:]
     if "--smoke" in argv:
         smoke = True
@@ -280,10 +281,15 @@ def _suite_args():
         queries = argv[argv.index("--queries") + 1]
     if "--concurrency" in argv:
         concurrency = int(argv[argv.index("--concurrency") + 1])
+    if "--serve" in argv:
+        # `--serve` alone = default client count; `--serve N` pins it
+        i = argv.index("--serve")
+        nxt = argv[i + 1] if i + 1 < len(argv) else ""
+        serve_clients = int(nxt) if nxt.isdigit() else (serve_clients or 4)
     qids = tuple(
         int(q.strip().lstrip("q")) for q in queries.split(",") if q.strip()
     )
-    return suite, smoke, trace_dir, qids, concurrency
+    return suite, smoke, trace_dir, qids, concurrency, serve_clients
 
 
 def run_concurrent(tpu, tables, qids, n_threads, sf, partitions, rounds=2):
@@ -359,6 +365,140 @@ def run_concurrent(tpu, tables, qids, n_threads, sf, partitions, rounds=2):
     if errors:
         out["errors"] = errors[:10]
     log({"concurrent": out})
+    return out
+
+
+def _pctl(xs, p: float) -> float:
+    """Nearest-rank percentile over a sample list (0.0 when empty)."""
+    if not xs:
+        return 0.0
+    xs = sorted(xs)
+    k = min(len(xs) - 1, max(0, int(round((p / 100.0) * (len(xs) - 1)))))
+    return xs[k]
+
+
+def run_serve_slo(tpu, qids, n_clients, target_qps, duration_s, sf, smoke):
+    """Closed-loop SLO mode (--serve N): a TpuServer over the session, N
+    wire clients split across two tenants (dashboards in a weight-3
+    interactive pool, etl in a weight-1 pool), each client pacing
+    PREPARED TPC-H queries at target_qps/N. Latency percentiles come from
+    the server's per-query (wait, run) samples — wait is the scheduler
+    admission queue, run is execute+stream — and per-tenant qps from the
+    serve.tenant.* slice of the obs registry. Result: SLO_r06.json."""
+    import threading
+    from spark_rapids_tpu.obs.metrics import GLOBAL
+    from spark_rapids_tpu.serve import TpuServer, connect
+    from spark_rapids_tpu.tpch.datagen import TABLES, gen_table
+    from spark_rapids_tpu.tpch.sql_queries import tpch_sql
+
+    tenants = (("tok-dash", "dash"), ("tok-etl", "etl"))
+    tpu.set_conf(
+        "spark.rapids.tpu.serve.tenants",
+        "tok-dash:dash:interactive,tok-etl:etl:etl",
+    )
+    tpu.set_conf("spark.rapids.tpu.scheduler.pools", "interactive:3,etl:1")
+    for name in TABLES:
+        tpu.create_dataframe(gen_table(name, sf)).create_or_replace_temp_view(
+            name
+        )
+    server = TpuServer(tpu, port=0)
+    host, port = server.start()
+    log({"serve": {"host": host, "port": port, "sf": sf, "qids": list(qids)}})
+
+    texts = {q: tpch_sql(q, sf=1.0) for q in qids}
+    # warm pass: compile every query shape once so the timed window
+    # measures serving + scheduling, not first-touch XLA compiles
+    with connect(host, port, token="tok-dash") as warm:
+        for q in qids:
+            warm.sql(texts[q]).drain()
+
+    tenant_q_before = {
+        t: GLOBAL.counter(f"serve.tenant.{t}.queries").value
+        for _, t in tenants
+    }
+    server.latency_samples.clear()
+    per_client_qps = max(0.01, target_qps / max(1, n_clients))
+    errors: list = []
+    done = [0]
+    lock = threading.Lock()
+    t_start = time.perf_counter()
+
+    def client(cid: int) -> None:
+        token, _tenant = tenants[cid % len(tenants)]
+        try:
+            conn = connect(host, port, token=token)
+        except Exception as e:  # noqa: BLE001
+            with lock:
+                errors.append(f"connect: {str(e)[-200:]}")
+            return
+        try:
+            stmts = {q: conn.prepare(texts[q]) for q in qids}
+            k = 0
+            while True:
+                next_t = t_start + k / per_client_qps
+                now = time.perf_counter()
+                if now >= t_start + duration_s:
+                    return
+                if next_t > now:
+                    time.sleep(min(next_t - now, 0.25))
+                    continue
+                q = qids[k % len(qids)]
+                k += 1
+                try:
+                    conn.execute(stmts[q]).drain()
+                    with lock:
+                        done[0] += 1
+                except Exception as e:  # noqa: BLE001 - keep the loop alive
+                    with lock:
+                        errors.append(f"q{q}: {str(e)[-200:]}")
+                    return
+        finally:
+            conn.close()
+
+    threads = [
+        threading.Thread(target=client, args=(i,), name=f"slo-client-{i}")
+        for i in range(n_clients)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t_start
+    samples = list(server.latency_samples)
+    server.stop()
+
+    wait_ms = [w * 1e3 for (_t, w, _r) in samples]
+    run_ms = [r * 1e3 for (_t, _w, r) in samples]
+    total_ms = [(w + r) * 1e3 for (_t, w, r) in samples]
+    tenant_qps = {
+        t: round(
+            (GLOBAL.counter(f"serve.tenant.{t}.queries").value
+             - tenant_q_before[t]) / wall, 3)
+        for _, t in tenants
+    }
+    out = {
+        "clients": n_clients,
+        "target_qps": target_qps,
+        "achieved_qps": round(done[0] / wall, 3) if wall > 0 else 0.0,
+        "queries_ok": done[0],
+        "wall_s": round(wall, 3),
+        "latency_ms": {
+            "wait": {p: round(_pctl(wait_ms, v), 3)
+                     for p, v in (("p50", 50), ("p95", 95), ("p99", 99))},
+            "run": {p: round(_pctl(run_ms, v), 3)
+                    for p, v in (("p50", 50), ("p95", 95), ("p99", 99))},
+            "total": {p: round(_pctl(total_ms, v), 3)
+                      for p, v in (("p50", 50), ("p95", 95), ("p99", 99))},
+        },
+        "per_tenant_qps": tenant_qps,
+        "serve_metrics": GLOBAL.view("serve.", strip=False),
+        "scheduler": tpu.scheduler.state(),
+        "prepared_cache": server.prepared.stats(),
+        "smoke": smoke,
+    }
+    if errors:
+        out["errors"] = errors[:10]
+    log({"serve_slo": out})
     return out
 
 
@@ -474,7 +614,8 @@ TPCDS_DEFAULT_SLICE = (3, 7, 12, 19, 27, 34, 42, 52, 55, 68, 96, 98)
 
 def main() -> None:
     t_start = time.monotonic()
-    suite, smoke, trace_dir, only_qids, concurrency = _suite_args()
+    (suite, smoke, trace_dir, only_qids, concurrency,
+     serve_clients) = _suite_args()
     if BENCH_PLATFORM:
         import jax
 
@@ -540,6 +681,33 @@ def main() -> None:
 
     detail: dict = {"backend": backend, "suite": suite, "smoke": smoke}
     speedups = []
+
+    if serve_clients > 0:
+        # network serving SLO mode: the session behind a TpuServer, N wire
+        # clients at a target qps, latency percentiles + per-tenant qps
+        ssf = min(sf, 0.02) if smoke else min(sf, 0.05)
+        qids = only_qids or ((1, 6) if smoke else (1, 6, 3))
+        target_qps = float(os.environ.get("BENCH_SERVE_QPS", "8"))
+        duration_s = float(
+            os.environ.get("BENCH_SERVE_SECONDS", "6" if smoke else "20")
+        )
+        slo = run_serve_slo(
+            tpu, qids, serve_clients, target_qps, duration_s, ssf, smoke
+        )
+        detail["serve_slo"] = slo
+        detail["wall_s"] = round(time.monotonic() - t_start, 1)
+        result = {
+            "metric": "serve_slo_p99_total_ms",
+            "value": slo["latency_ms"]["total"]["p99"],
+            "unit": "ms",
+            "vs_baseline": 0.0,
+            "detail": detail,
+        }
+        with open("SLO_r06.json", "w") as f:
+            json.dump(result, f, indent=1)
+        log({"slo_json": "SLO_r06.json"})
+        print(json.dumps(result), flush=True)
+        return
 
     if concurrency > 1:
         # multi-tenant throughput mode: N client threads, one session,

@@ -1,0 +1,87 @@
+"""Arrow IPC stream framing — the ONE wire encoding for columnar batches.
+
+Shuffle frames (``shuffle/serializer.py``), broadcast payloads, and the
+network serving front-end (``serve/``) all move record batches as
+self-contained Arrow IPC streams (schema message + batch messages). The
+read/write helpers live here so the framing is written once and hardened
+once; the serializer keeps its codec/metric layering on top as thin shims.
+
+Hardening the streamed-result path needs (both hit by result tails):
+
+- **zero-row batches** — a served query's final partition is often empty;
+  pyarrow round-trips a 0-row batch fine, but a stream whose table is empty
+  yields NO combinable batch (``Table.to_batches() == []``), so the single-
+  batch readers here rebuild an empty batch from the stream schema instead
+  of indexing into a missing list;
+- **all-null columns** — an all-null typed column and a ``NullType`` column
+  both serialize with degenerate buffers; reads go through the stream
+  reader (never raw buffer peeling), so validity-only columns survive.
+"""
+from __future__ import annotations
+
+import io
+from typing import List, Optional, Tuple
+
+import pyarrow as pa
+
+
+def schema_to_bytes(schema: pa.Schema) -> bytes:
+    return schema.serialize().to_pybytes()
+
+
+def schema_from_bytes(data: bytes) -> pa.Schema:
+    return pa.ipc.read_schema(pa.py_buffer(data))
+
+
+def empty_batch(schema: pa.Schema) -> pa.RecordBatch:
+    """A 0-row batch of ``schema`` (the stream-tail currency)."""
+    return pa.RecordBatch.from_arrays(
+        [pa.array([], type=f.type) for f in schema], schema=schema
+    )
+
+
+def write_stream(
+    batches: List[pa.RecordBatch], schema: Optional[pa.Schema] = None
+) -> bytes:
+    """Batches → one complete Arrow IPC stream. ``schema`` is required when
+    ``batches`` may be empty (a schema-only stream is valid and decodes to
+    zero batches)."""
+    if schema is None:
+        if not batches:
+            raise ValueError("write_stream with no batches requires a schema")
+        schema = batches[0].schema
+    sink = io.BytesIO()
+    with pa.ipc.new_stream(sink, schema) as w:
+        for rb in batches:
+            w.write_batch(rb)
+    return sink.getvalue()
+
+
+def read_stream(data: bytes) -> Tuple[pa.Schema, List[pa.RecordBatch]]:
+    """IPC stream → (schema, batches). Zero-row batches are preserved; a
+    schema-only stream returns an empty list."""
+    with pa.ipc.open_stream(pa.py_buffer(data)) as r:
+        schema = r.schema
+        batches = [b for b in r]
+    return schema, batches
+
+
+def write_batch(rb: pa.RecordBatch) -> bytes:
+    """One batch → a self-contained IPC stream frame (schema + batch), the
+    unit both shuffle frames and served result batches travel as."""
+    return write_stream([rb])
+
+
+def read_batch(data: bytes) -> pa.RecordBatch:
+    """Self-contained IPC frame → ONE batch. Multi-batch frames combine;
+    empty frames (schema only, or only 0-row batches) rebuild a 0-row batch
+    from the stream schema rather than failing on the empty batch list."""
+    schema, batches = read_stream(data)
+    if len(batches) == 1:
+        return batches[0]
+    if not batches:
+        return empty_batch(schema)
+    table = pa.Table.from_batches(batches)
+    if table.num_rows == 0:
+        return empty_batch(schema)
+    return table.combine_chunks().to_batches()[0]

@@ -677,6 +677,50 @@ SCHEDULER_DEFAULT_QUERY_BYTES = conf(
 ).bytes_conf(256 << 20)
 
 
+# ── network serving front-end (serve/) ─────────────────────────────────────
+
+SERVE_HOST = conf("spark.rapids.tpu.serve.host").doc(
+    "Interface the Arrow-IPC SQL endpoint binds (serve/server.py). The "
+    "default stays loopback-only; bind 0.0.0.0 explicitly to expose the "
+    "service."
+).string_conf("127.0.0.1")
+
+SERVE_PORT = conf("spark.rapids.tpu.serve.port").doc(
+    "TCP port for the serving endpoint; 0 picks an ephemeral port "
+    "(reported by TpuServer.start(), the test/bench mode)."
+).int_conf(8045)
+
+SERVE_TENANTS = conf("spark.rapids.tpu.serve.tenants").doc(
+    "Auth spec 'token:tenant:pool,…' mapping each HELLO auth token to a "
+    "tenant name and the fair-share scheduler pool its queries are "
+    "admitted under (spark.rapids.tpu.scheduler.pools weights apply). "
+    "Empty = open access: every client is tenant 'anonymous' in pool "
+    "'default'. When set, a HELLO with an unknown token is rejected."
+).string_conf(None)
+
+SERVE_MAX_CONNECTIONS = conf("spark.rapids.tpu.serve.maxConnections").doc(
+    "Concurrent client connections the server accepts; further connects "
+    "are refused at HELLO with a typed error (admission-queue backpressure "
+    "for queries is the scheduler's maxQueued, this bounds sockets/threads)."
+).int_conf(64)
+
+SERVE_STREAM_BATCH_ROWS = conf("spark.rapids.tpu.serve.streamBatchRows").doc(
+    "Maximum rows per streamed result BATCH frame: engine result batches "
+    "are re-chunked to this bound so clients see incremental frames (and "
+    "mid-stream CANCEL has boundaries to act on) even when a partition "
+    "produced one huge batch."
+).int_conf(65536)
+
+SERVE_PREPARED_CACHE_ENTRIES = conf(
+    "spark.rapids.tpu.serve.preparedCacheEntries"
+).doc(
+    "Bound of the prepared-plan cache (serve/prepared.py): compiled "
+    "physical plans keyed by canonicalized statement + bound parameters + "
+    "batch geometry, LRU-evicted past this many entries. A hit skips "
+    "parse/plan/compile entirely — the repeated-dashboard fast path."
+).int_conf(128)
+
+
 # ── deterministic fault injection (resilience/faults.py) ───────────────────
 
 FAULTS_ENABLED = conf("spark.rapids.tpu.faults.enabled").doc(

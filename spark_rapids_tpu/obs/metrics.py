@@ -42,6 +42,17 @@ class MetricKind:
     WATERMARK = "watermark"
 
 
+_SLUG_RE = __import__("re").compile(r"[^a-z0-9]+")
+
+
+def metric_slug(name: str, fallback: str = "unspecified") -> str:
+    """Free-form text → a bounded metric-name segment, the ONE rule for
+    dynamically-named series (``scheduler.cancelled.reason.<slug>``,
+    ``serve.tenant.<slug>.queries``) so their naming never diverges."""
+    s = _SLUG_RE.sub("_", (name or fallback).lower()).strip("_")
+    return (s or fallback)[:48]
+
+
 def infer_kind(name: str) -> str:
     """Kind from naming convention when a call site doesn't say: ``*Time`` /
     ``*Ns`` are timers, ``peak*`` / ``*HighWatermark`` are watermarks."""
@@ -244,16 +255,42 @@ CATALOG: Iterable[tuple] = (
     ("shuffle.bytesCompressedOut", MetricKind.COUNTER, "serialized shuffle payload bytes after compression"),
     ("shuffle.bytesUncompressed", MetricKind.COUNTER, "serialized shuffle payload bytes before compression"),
     # sched/* — multi-tenant admission control (per-pool admitted counters
-    # under scheduler.pool.<name>.admitted register dynamically on first use)
+    # under scheduler.pool.<name>.admitted and per-cause cancellations
+    # under scheduler.cancelled.reason.<slug> register dynamically on
+    # first use)
     ("scheduler.admitted", MetricKind.COUNTER, "queries granted device permits"),
     ("scheduler.rejected", MetricKind.COUNTER, "admissions rejected (QueryQueueFull)"),
-    ("scheduler.cancelled", MetricKind.COUNTER, "queries cancelled (queued or running)"),
-    ("scheduler.timeouts", MetricKind.COUNTER, "queries past their deadline (QueryTimeoutError)"),
+    ("scheduler.cancelled", MetricKind.COUNTER,
+     "queries cancelled (queued or running) — the aggregate over every "
+     "scheduler.cancelled.reason.* series, deadline expiries INCLUDED "
+     "(a timeout is a cancellation with reason 'deadline')"),
+    ("scheduler.timeouts", MetricKind.COUNTER,
+     "queries past their deadline (QueryTimeoutError); each is also "
+     "counted in scheduler.cancelled under reason.deadline"),
     ("scheduler.queueWaitNs", MetricKind.NANOS, "time queries spent waiting for admission"),
     ("scheduler.queueDepth", MetricKind.GAUGE, "queries currently waiting for admission"),
     ("scheduler.permitsInUse", MetricKind.GAUGE, "admission permits currently held"),
     ("scheduler.effectivePermits", MetricKind.GAUGE,
      "live permit limit (configured permits, halved under OOM pressure)"),
+    # serve/* — the network front-end (per-tenant query counters under
+    # serve.tenant.<name>.queries register dynamically on first use)
+    ("serve.connections", MetricKind.COUNTER, "client connections accepted (HELLO ok)"),
+    ("serve.connectionsRejected", MetricKind.COUNTER,
+     "connections refused (bad token / connection limit)"),
+    ("serve.connectionsActive", MetricKind.GAUGE, "currently open client connections"),
+    ("serve.queries", MetricKind.COUNTER, "queries executed over the wire"),
+    ("serve.queryErrors", MetricKind.COUNTER, "served queries that ended in an ERROR frame"),
+    ("serve.preparedStatements", MetricKind.COUNTER, "PREPARE commands handled"),
+    ("serve.preparedHits", MetricKind.COUNTER,
+     "prepared-plan cache hits (parse/plan/compile skipped)"),
+    ("serve.preparedMisses", MetricKind.COUNTER,
+     "prepared-plan cache misses (full parse+plan performed)"),
+    ("serve.streamedBatches", MetricKind.COUNTER, "result BATCH frames sent to clients"),
+    ("serve.streamedBytes", MetricKind.COUNTER, "result payload bytes sent to clients"),
+    ("serve.cancels", MetricKind.COUNTER,
+     "server-side cancellations (CANCEL frames + client disconnects)"),
+    ("serve.queryWaitNs", MetricKind.NANOS, "served queries' admission queue wait"),
+    ("serve.queryRunNs", MetricKind.NANOS, "served queries' execution+stream time"),
     # resilience/* — the old retry.report() counters (registry view now)
     ("resilience.oom_retries", MetricKind.COUNTER, "spill-and-retry launches after device OOM"),
     ("resilience.splits", MetricKind.COUNTER, "OOM batch halvings"),

@@ -27,7 +27,14 @@ class SchedulerError(RuntimeError):
 
 
 class QueryCancelledError(SchedulerError):
-    """The query was cancelled (``session.cancel`` / ``cancel_all``)."""
+    """The query was cancelled (``session.cancel`` / ``cancel_all``).
+    ``reason`` carries the cancel call's reason string verbatim, so a
+    server distinguishes client-disconnect from deadline from operator
+    action without parsing the message."""
+
+    def __init__(self, message: str, reason: str = ""):
+        super().__init__(message)
+        self.reason = reason
 
 
 class QueryTimeoutError(QueryCancelledError):
@@ -95,10 +102,12 @@ class CancelToken:
         if self._cancelled:
             raise QueryCancelledError(
                 f"query {self.query_id or '<anonymous>'} cancelled"
-                + (f": {self._reason}" if self._reason else "")
+                + (f": {self._reason}" if self._reason else ""),
+                reason=self._reason,
             )
         if self.expired:
             raise QueryTimeoutError(
                 f"query {self.query_id or '<anonymous>'} exceeded its "
-                "deadline (spark.rapids.tpu.scheduler.queryTimeout)"
+                "deadline (spark.rapids.tpu.scheduler.queryTimeout)",
+                reason="deadline",
             )

@@ -37,6 +37,15 @@ from .cancel import (
 _M = obs_metrics.GLOBAL
 
 
+def _count_cancelled(reason: str) -> None:
+    """One Prometheus series per distinct cancel cause (user action vs
+    client disconnect vs deadline) next to the aggregate counter."""
+    _M.counter("scheduler.cancelled").add(1)
+    _M.counter(
+        f"scheduler.cancelled.reason.{obs_metrics.metric_slug(reason)}"
+    ).add(1)
+
+
 class Admission:
     """One query's passage through the scheduler: a context manager that
     blocks in ``__enter__`` until admitted (or raises the typed rejection)
@@ -62,8 +71,19 @@ class Admission:
         self.tracer = tracer
         self.queue_wait_ns = 0
         self._granted = 0
+        self.enqueued_at = None  # set when __enter__ starts queueing
+
+    def queue_wait_s(self) -> float:
+        """Seconds this query has waited for admission SO FAR: the final
+        wait once granted (or when admission is disabled — no permit gate,
+        so nothing queues), the still-growing wait while queued (the live
+        queue view ``session.active_queries()`` renders)."""
+        if self._granted or not self.enabled or self.enqueued_at is None:
+            return self.queue_wait_ns / 1e9
+        return max(0.0, time.monotonic() - self.enqueued_at)
 
     def __enter__(self) -> "Admission":
+        self.enqueued_at = time.monotonic()
         self.scheduler._register(self)
         try:
             self.token.check()  # cancelled/expired while still client-side
@@ -93,10 +113,11 @@ class Admission:
                 _M.counter("scheduler.admitted").add(1)
         except QueryTimeoutError:
             _M.counter("scheduler.timeouts").add(1)
+            _count_cancelled("deadline")
             self.scheduler._unregister(self)
             raise
-        except QueryCancelledError:
-            _M.counter("scheduler.cancelled").add(1)
+        except QueryCancelledError as e:
+            _count_cancelled(getattr(e, "reason", "") or self.token.reason)
             self.scheduler._unregister(self)
             raise
         except QueryQueueFull:
@@ -119,10 +140,11 @@ class Admission:
             exc_type, QueryTimeoutError
         ):
             _M.counter("scheduler.timeouts").add(1)
+            _count_cancelled("deadline")
         elif exc_type is not None and issubclass(
             exc_type, QueryCancelledError
         ):
-            _M.counter("scheduler.cancelled").add(1)
+            _count_cancelled(getattr(exc, "reason", "") or self.token.reason)
         return False
 
 
@@ -144,9 +166,14 @@ class QueryScheduler:
         return self._cancel_epoch
 
     # ── admission ───────────────────────────────────────────────────────
-    def admit(self, query_id: str, plan, conf, tracer=None) -> Admission:
+    def admit(
+        self, query_id: str, plan, conf, tracer=None, pool: Optional[str] = None
+    ) -> Admission:
         """Build the admission for one query from the CURRENT conf (all
-        scheduler keys are per-query, never frozen at session init)."""
+        scheduler keys are per-query, never frozen at session init).
+        ``pool`` overrides the conf's fair-share pool — the serving
+        front-end admits each tenant under ITS pool without mutating the
+        shared session conf."""
         from .. import config as cfg
         from .estimate import permits_for_plan
 
@@ -162,7 +189,7 @@ class QueryScheduler:
         token = CancelToken(
             query_id, timeout_s=timeout if timeout > 0 else None
         )
-        pool_name = cfg.SCHEDULER_POOL.get(conf) or "default"
+        pool_name = pool or cfg.SCHEDULER_POOL.get(conf) or "default"
         return Admission(
             self, query_id, need, pool_name, token, enabled, tracer
         )
@@ -179,14 +206,18 @@ class QueryScheduler:
                 del self._active[adm.query_id]
 
     def active_queries(self) -> Dict[str, dict]:
-        """query_id → {pool, permits, granted} for every registered query
-        (queued or running)."""
+        """query_id → live view of every registered query (queued or
+        running): fair-share pool, requested/granted permit counts, whether
+        it is running, and the queue wait so far — the ops/STATUS queue
+        view a server renders."""
         with self._lock:
             return {
                 qid: {
                     "pool": a.pool,
                     "permits": a.permits,
                     "granted": a._granted,
+                    "running": a._granted > 0 or not a.enabled,
+                    "queue_wait_s": round(a.queue_wait_s(), 6),
                 }
                 for qid, a in self._active.items()
             }

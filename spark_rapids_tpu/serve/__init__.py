@@ -1,0 +1,32 @@
+"""Network serving front-end — the Arrow-IPC SQL endpoint.
+
+"Millions of users" needs a wire protocol, not in-process ``collect()``:
+this package turns a :class:`TpuSession` into a service. Modeled on Arrow
+Flight SQL's design (typed SQL-over-Arrow-IPC RPC, prepared statements,
+streamed record batches), scaled to a framed socket protocol:
+
+- :mod:`.protocol` — the frame format and conversation shape;
+- :mod:`.server`   — :class:`TpuServer`: threaded endpoint mapping auth
+  tokens to tenants and tenants to scheduler fair-share pools, streaming
+  results incrementally with mid-stream cancellation;
+- :mod:`.prepared` — prepared statements + the canonical-key plan cache
+  (re-execution skips parse/plan/compile);
+- :mod:`.client`   — :func:`connect` / :class:`Connection`: the python
+  driver (``connect().sql(...)`` → iterator of record batches).
+
+``python -m spark_rapids_tpu.serve`` runs a standalone server
+(docs/serving.md; ``make serve`` for the TPC-H demo catalog).
+"""
+from .client import Connection, PreparedHandle, ResultStream, connect
+from .protocol import ProtocolError, ServeError
+from .server import TpuServer
+
+__all__ = [
+    "Connection",
+    "PreparedHandle",
+    "ProtocolError",
+    "ResultStream",
+    "ServeError",
+    "TpuServer",
+    "connect",
+]

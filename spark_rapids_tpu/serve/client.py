@@ -1,0 +1,254 @@
+"""Client for the Arrow-IPC SQL endpoint — ``connect(...).sql(...)``.
+
+A thin, dependency-light driver (socket + pyarrow): one
+:class:`Connection` per socket, one in-flight result stream at a time
+(the protocol is request/response with a streamed fetch; open a second
+connection for concurrent queries — that is also how tenants get
+per-connection fair-share admission).
+
+    from spark_rapids_tpu.serve import connect
+
+    with connect("127.0.0.1", 8045, token="t1") as conn:
+        for batch in conn.sql("select o_orderkey from orders where ..."):
+            ...                         # pa.RecordBatch, incremental
+        table = conn.sql("select 1").to_table()
+
+        stmt = conn.prepare("select * from t where a < ?")
+        conn.execute(stmt, [10]).to_table()   # prepared-plan cache path
+
+Mid-stream ``ResultStream.cancel()`` sends CANCEL on the same (full
+duplex) socket; the server stops at the next batch boundary and the
+stream raises the typed :class:`ServeError` carrying the cancel reason.
+"""
+from __future__ import annotations
+
+import base64
+import socket
+from typing import Iterator, List, Optional
+
+import pyarrow as pa
+
+from ..columnar import ipc
+from . import protocol as P
+from .protocol import ProtocolError, ServeError  # noqa: F401 - re-export
+
+
+class PreparedHandle:
+    """A server-side prepared statement (PREPARE_OK payload)."""
+
+    __slots__ = ("statement_id", "n_params", "sql")
+
+    def __init__(self, statement_id: str, n_params: int, sql: str):
+        self.statement_id = statement_id
+        self.n_params = n_params
+        self.sql = sql
+
+
+class ResultStream:
+    """Iterator over one query's streamed record batches.
+
+    Yields each BATCH frame as a :class:`pa.RecordBatch`; END closes the
+    stream (``rows``/``batches``/``wait_ms``/``run_ms`` populate from its
+    payload), ERROR raises :class:`ServeError`. ``to_table()`` drains into
+    one table — an empty result still carries the correct schema (from
+    the RESULT frame)."""
+
+    def __init__(self, conn: "Connection", query_id: str, schema: pa.Schema,
+                 cache_hit: bool = False):
+        self._conn = conn
+        self.query_id = query_id
+        self.schema = schema
+        self.cache_hit = cache_hit
+        self.rows: Optional[int] = None
+        self.batches: Optional[int] = None
+        self.wait_ms: Optional[float] = None
+        self.run_ms: Optional[float] = None
+        self._done = False
+        self._cancel_sent = False
+
+    def __iter__(self) -> Iterator[pa.RecordBatch]:
+        while not self._done:
+            try:
+                ftype, body = P.expect_frame(self._conn._sock, P.BATCH, P.END)
+            except ServeError:
+                # an ERROR frame ends the stream (cancel, deadline, query
+                # failure) — the connection itself stays usable
+                self._done = True
+                self._conn._stream = None
+                raise
+            if ftype == P.END:
+                info = P.decode_json(body)
+                self.rows = info.get("rows")
+                self.batches = info.get("batches")
+                self.wait_ms = info.get("wait_ms")
+                self.run_ms = info.get("run_ms")
+                self._done = True
+                self._conn._stream = None
+                if self._cancel_sent:
+                    # the CANCEL lost the race to the final batch: the
+                    # server will read it as a standalone command and
+                    # reply CANCEL_OK — swallow that late ack so the next
+                    # command's reply framing stays aligned
+                    self._conn._stale_cancel_oks += 1
+                return
+            yield ipc.read_batch(body)
+
+    def cancel(self) -> None:
+        """Ask the server to cancel this query mid-stream. Keep iterating
+        afterwards: the stream ends with the typed cancelled ServeError
+        (or, if the cancel raced the stream's completion, ends normally)."""
+        if not self._done and not self._cancel_sent:
+            self._cancel_sent = True
+            P.send_json(self._conn._sock, P.CANCEL, {"query_id": self.query_id})
+
+    def to_table(self) -> pa.Table:
+        batches = list(self)
+        if not batches:
+            return pa.Table.from_batches([], schema=self.schema)
+        return pa.Table.from_batches(batches)
+
+    def drain(self) -> None:
+        """Consume and discard any remaining frames (so the connection can
+        issue the next command)."""
+        for _ in self:
+            pass
+
+
+class Connection:
+    """One authenticated protocol connection. Not thread-safe; a thread
+    (or tenant task) owns its connection."""
+
+    def __init__(self, sock: socket.socket, hello: dict):
+        self._sock = sock
+        self.tenant = hello.get("tenant")
+        self.pool = hello.get("pool")
+        self.protocol = hello.get("protocol")
+        self._stream: Optional[ResultStream] = None
+        # CANCELs that lost the race to their stream's END: the server
+        # acks them as standalone commands, so that many CANCEL_OK frames
+        # precede the next real reply and must be skipped
+        self._stale_cancel_oks = 0
+
+    # ── queries ─────────────────────────────────────────────────────────
+    def _begin(self) -> None:
+        if self._stream is not None and not self._stream._done:
+            raise ProtocolError(
+                "a result stream is still open on this connection — drain "
+                "or cancel it before issuing the next command"
+            )
+
+    def _reply(self, *ftypes: int):
+        """expect_frame + stale-CANCEL_OK skipping (see _stale_cancel_oks)."""
+        while True:
+            want = ftypes + ((P.CANCEL_OK,) if self._stale_cancel_oks else ())
+            ftype, body = P.expect_frame(self._sock, *want)
+            if ftype == P.CANCEL_OK and P.CANCEL_OK not in ftypes:
+                self._stale_cancel_oks -= 1
+                continue
+            return ftype, body
+
+    def _fetch(self, result: dict) -> ResultStream:
+        schema = ipc.schema_from_bytes(
+            base64.b64decode(result["schema"])
+        )
+        stream = ResultStream(
+            self,
+            result["query_id"],
+            schema,
+            cache_hit=bool(result.get("cache_hit")),
+        )
+        P.send_json(self._sock, P.FETCH, {"query_id": result["query_id"]})
+        self._stream = stream
+        return stream
+
+    def sql(self, text: str, params: Optional[List] = None) -> ResultStream:
+        """EXECUTE + FETCH: run one statement, stream its result."""
+        self._begin()
+        req = {"sql": text}
+        if params is not None:
+            req["params"] = params
+        P.send_json(self._sock, P.EXECUTE, req)
+        _, body = self._reply(P.RESULT)
+        return self._fetch(P.decode_json(body))
+
+    def prepare(self, text: str) -> PreparedHandle:
+        self._begin()
+        P.send_json(self._sock, P.PREPARE, {"sql": text})
+        _, body = self._reply(P.PREPARE_OK)
+        info = P.decode_json(body)
+        return PreparedHandle(info["statement_id"], info["n_params"], text)
+
+    def execute(
+        self, stmt: PreparedHandle, params: Optional[List] = None
+    ) -> ResultStream:
+        """EXECUTE_PREPARED + FETCH: run a prepared statement with bound
+        parameters (the prepared-plan-cache path)."""
+        self._begin()
+        P.send_json(
+            self._sock, P.EXECUTE_PREPARED,
+            {"statement_id": stmt.statement_id, "params": params or []},
+        )
+        _, body = self._reply(P.RESULT)
+        return self._fetch(P.decode_json(body))
+
+    # ── control ─────────────────────────────────────────────────────────
+    def cancel(self, query_id: str) -> bool:
+        """Cancel a query by id (usable from a second connection for a
+        query streaming elsewhere). Returns whether the server found it."""
+        self._begin()
+        P.send_json(self._sock, P.CANCEL, {"query_id": query_id})
+        while True:
+            _, body = P.expect_frame(self._sock, P.CANCEL_OK)
+            info = P.decode_json(body)
+            # stale acks of raced stream-cancels arrive first (FIFO) —
+            # match by query_id so their found flag is never misattributed
+            if self._stale_cancel_oks and info.get("query_id") != query_id:
+                self._stale_cancel_oks -= 1
+                continue
+            return bool(info.get("found"))
+
+    def status(self) -> dict:
+        """Server-side live view: active queries (pool, permits, queue
+        wait), scheduler pool state, serve metrics, prepared-cache stats."""
+        self._begin()
+        P.send_json(self._sock, P.STATUS, {})
+        _, body = self._reply(P.STATUS_OK)
+        return P.decode_json(body)
+
+    def close(self) -> None:
+        try:
+            P.send_frame(self._sock, P.BYE)
+        except OSError:
+            pass
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+    def __enter__(self) -> "Connection":
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self.close()
+        return False
+
+
+def connect(
+    host: str = "127.0.0.1",
+    port: int = 8045,
+    token: Optional[str] = None,
+    timeout: Optional[float] = 30.0,
+) -> Connection:
+    """Open + authenticate one connection (HELLO → HELLO_OK). ``token``
+    selects the tenant/pool under ``spark.rapids.tpu.serve.tenants``;
+    omit it against an open server."""
+    sock = socket.create_connection((host, port), timeout=timeout)
+    sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+    sock.settimeout(None)
+    P.send_json(sock, P.HELLO, {"token": token or "", "client": "python"})
+    try:
+        _, body = P.expect_frame(sock, P.HELLO_OK)
+    except BaseException:
+        sock.close()
+        raise
+    return Connection(sock, P.decode_json(body))

@@ -1,0 +1,159 @@
+"""Prepared statements + the prepared-plan cache.
+
+The repeated-dashboard fast path (Arrow Flight SQL's prepared-statement
+model): PREPARE parses once and keeps the AST; each execution binds
+parameters and resolves a COMPILED physical plan from a bounded LRU cache
+keyed by
+
+    (statement text, bound parameter values, conf fingerprint, catalog version)
+
+so re-running the same query skips parse → analyze → plan → override
+entirely (the planner is not re-entered — the first composition point for
+the ROADMAP's persistent-executable-cache item: the cached ``final_plan``
+holds the very ``GuardedJit`` signatures the kernel cache warms).
+
+Cross-statement sharing rides :func:`plan/reuse.py::canonical_key`: two
+clients PREPARE-ing structurally identical SQL resolve to ONE plan object
+(the same canonicalization the exchange-reuse pass trusts); plans whose
+parameters resist canonical comparison simply skip sharing — correct but
+unshared, exactly the reuse pass's false-negative-is-safe posture.
+
+The whole explicit conf is part of the key because MANY keys shape the
+compiled plan (batch geometry, shuffle width, ANSI semantics, per-op kill
+switches): any ``set_conf`` retune must plan fresh rather than serve a
+stale shape — a spurious re-plan is the safe false negative. The catalog
+version guards temp-view replacement — ``create_or_replace_temp_view``
+bumps it, invalidating every plan compiled against the old table.
+"""
+from __future__ import annotations
+
+import itertools
+import threading
+from collections import OrderedDict
+from typing import Optional, Tuple
+
+from ..obs import metrics as obs_metrics
+
+_M = obs_metrics.GLOBAL
+
+
+class PreparedStatement:
+    """One PREPARE-d statement: the SQL text and its parsed AST (parse
+    happens once, at PREPARE time), plus the owning tenant."""
+
+    __slots__ = ("statement_id", "text", "ast", "n_params", "tenant")
+
+    def __init__(self, statement_id: str, text: str, ast, tenant: str):
+        self.statement_id = statement_id
+        self.text = text
+        self.ast = ast
+        self.n_params = getattr(ast, "n_params", 0)
+        self.tenant = tenant
+
+
+class PreparedPlanCache:
+    """Bounded LRU of compiled physical plans for prepared statements.
+
+    ``resolve`` returns ``(final_plan, ctx, cache_hit)``: on a hit the
+    plan comes straight from the cache and only a fresh ExecContext is
+    built; on a miss the statement's AST is bound and pushed through the
+    session's full planning pass, then cached (and deduplicated across
+    statements via the plan's canonical key when computable).
+    """
+
+    def __init__(self, session, max_entries: Optional[int] = None):
+        from .. import config as cfg
+
+        self.session = session
+        self.max_entries = (
+            max_entries
+            if max_entries is not None
+            else cfg.SERVE_PREPARED_CACHE_ENTRIES.get(session.conf)
+        )
+        self._lock = threading.Lock()
+        self._plans: OrderedDict = OrderedDict()  # key -> final_plan
+        self._by_canon: dict = {}  # canonical_key -> key (share index)
+        self._ids = itertools.count(1)
+
+    def next_statement_id(self) -> str:
+        return f"stmt-{next(self._ids)}"
+
+    # ── keying ──────────────────────────────────────────────────────────
+    def _geometry(self) -> tuple:
+        """The conf + catalog slice of the cache key: the session's ENTIRE
+        explicit conf fingerprint (any retune — batch geometry, shuffle
+        width, ANSI, per-op kill switches — re-plans rather than risking a
+        stale compiled plan; a spurious re-plan is the safe false
+        negative) plus the temp-view catalog version."""
+        return (
+            tuple(sorted(self.session.conf.items())),
+            getattr(self.session, "_catalog_version", 0),
+        )
+
+    @staticmethod
+    def _param_key(params) -> tuple:
+        # type+repr pairs: 1 and 1.0 and True must key differently (they
+        # bind different literal types and so different plans)
+        return tuple((type(v).__name__, repr(v)) for v in params)
+
+    # ── resolve ─────────────────────────────────────────────────────────
+    def resolve(self, stmt: PreparedStatement, params) -> Tuple[object, object, bool]:
+        from ..plan.physical import ExecContext
+        from ..sql import Compiler, bind_parameters
+
+        key = (stmt.text, self._param_key(params), self._geometry())
+        with self._lock:
+            plan = self._plans.get(key)
+            if plan is not None:
+                self._plans.move_to_end(key)
+        if plan is not None:
+            _M.counter("serve.preparedHits").add(1)
+            # fresh per-execution context; parse/plan/compile all skipped
+            return plan, ExecContext(self.session.conf, self.session), True
+
+        _M.counter("serve.preparedMisses").add(1)
+        ast = bind_parameters(stmt.ast, params)
+        df = Compiler(self.session).compile(ast)
+        final_plan, ctx = self.session._prepare_plan(df._plan)
+        final_plan = self._intern(key, final_plan)
+        return final_plan, ctx, False
+
+    def _intern(self, key, final_plan):
+        """Cache the plan under ``key``; structurally identical plans from
+        other statements collapse onto the first instance via the
+        canonical key (uncanonicalizable plans are cached unshared)."""
+        from ..plan.reuse import canonical_key
+
+        try:
+            canon = ("canon", canonical_key(final_plan))
+        except Exception:
+            canon = None
+        with self._lock:
+            if canon is not None:
+                existing = self._by_canon.get(canon)
+                if existing is not None and existing in self._plans:
+                    final_plan = self._plans[existing]
+            self._plans[key] = final_plan
+            self._plans.move_to_end(key)
+            if canon is not None:
+                self._by_canon.setdefault(canon, key)
+            while len(self._plans) > max(1, self.max_entries):
+                old_key, _ = self._plans.popitem(last=False)
+                self._by_canon = {
+                    c: k for c, k in self._by_canon.items() if k != old_key
+                }
+        return final_plan
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "entries": len(self._plans),
+                "max_entries": self.max_entries,
+                "hits": _M.counter("serve.preparedHits").value,
+                "misses": _M.counter("serve.preparedMisses").value,
+            }
+
+    def clear(self) -> None:
+        with self._lock:
+            self._plans.clear()
+            self._by_canon.clear()

@@ -1,0 +1,168 @@
+"""Wire protocol for the Arrow-IPC SQL endpoint — small, framed, typed.
+
+The shape follows Arrow Flight SQL's design (typed SQL-over-Arrow-IPC RPC
+with prepared statements and streamed record batches) scaled down to a
+length-prefixed socket protocol: every frame is
+
+    ``<u32 little-endian body length> <u8 frame type> <body>``
+
+Control frames carry UTF-8 JSON bodies; result data travels as ``BATCH``
+frames whose body is one self-contained Arrow IPC stream
+(``columnar/ipc.py`` — the same framing shuffle uses), so a client needs
+nothing beyond pyarrow to decode.
+
+Conversation shape::
+
+    client                                server
+    HELLO {token}            →
+                             ←            HELLO_OK {tenant, pool}
+    EXECUTE {sql, params}    →
+                             ←            RESULT {query_id, schema}
+    FETCH {query_id}         →
+                             ←            BATCH* … END {rows, batches}
+    PREPARE {sql}            →
+                             ←            PREPARE_OK {statement_id, n_params}
+    EXECUTE_PREPARED/BIND {statement_id, params} →
+                             ←            RESULT {query_id, schema, cache_hit}
+    CANCEL {query_id}        →            (valid mid-stream: the server polls
+                             ←            CANCEL_OK | the stream ends ERROR)
+    STATUS {}                →
+                             ←            STATUS_OK {active, scheduler, serve}
+
+Any command may answer ``ERROR {type, error, reason?, query_id?}``; the
+connection survives query errors (only protocol violations and transport
+failures close it).
+"""
+from __future__ import annotations
+
+import json
+import socket
+import struct
+from typing import Optional, Tuple
+
+PROTOCOL_VERSION = 1
+
+# frame types (u8)
+HELLO = 1
+HELLO_OK = 2
+EXECUTE = 3
+RESULT = 4
+FETCH = 5
+BATCH = 6
+END = 7
+PREPARE = 8
+PREPARE_OK = 9
+BIND = 10
+EXECUTE_PREPARED = 11
+CANCEL = 12
+CANCEL_OK = 13
+STATUS = 14
+STATUS_OK = 15
+ERROR = 16
+BYE = 17
+
+FRAME_NAMES = {
+    HELLO: "HELLO", HELLO_OK: "HELLO_OK", EXECUTE: "EXECUTE",
+    RESULT: "RESULT", FETCH: "FETCH", BATCH: "BATCH", END: "END",
+    PREPARE: "PREPARE", PREPARE_OK: "PREPARE_OK", BIND: "BIND",
+    EXECUTE_PREPARED: "EXECUTE_PREPARED", CANCEL: "CANCEL",
+    CANCEL_OK: "CANCEL_OK", STATUS: "STATUS", STATUS_OK: "STATUS_OK",
+    ERROR: "ERROR", BYE: "BYE",
+}
+
+_HEADER = struct.Struct("<IB")
+
+#: one frame may not exceed this (a corrupt length prefix must not drive a
+#: multi-GB allocation); streamed results re-chunk well below it
+MAX_FRAME_BYTES = 256 << 20
+
+
+class ProtocolError(RuntimeError):
+    """Malformed frame / unexpected type — the connection-fatal class."""
+
+
+class ConnectionClosed(ProtocolError):
+    """Peer closed the socket mid-conversation."""
+
+
+def send_frame(sock: socket.socket, ftype: int, body: bytes = b"") -> None:
+    if len(body) > MAX_FRAME_BYTES:
+        raise ProtocolError(
+            f"frame body {len(body)} bytes exceeds MAX_FRAME_BYTES"
+        )
+    sock.sendall(_HEADER.pack(len(body), ftype) + body)
+
+
+def send_json(sock: socket.socket, ftype: int, obj: dict) -> None:
+    send_frame(sock, ftype, json.dumps(obj).encode("utf-8"))
+
+
+def _recv_exactly(sock: socket.socket, n: int) -> bytes:
+    parts = []
+    got = 0
+    while got < n:
+        chunk = sock.recv(n - got)
+        if not chunk:
+            raise ConnectionClosed("peer closed the connection")
+        parts.append(chunk)
+        got += len(chunk)
+    return b"".join(parts)
+
+
+def recv_frame(sock: socket.socket) -> Tuple[int, bytes]:
+    header = _recv_exactly(sock, _HEADER.size)
+    length, ftype = _HEADER.unpack(header)
+    if length > MAX_FRAME_BYTES:
+        raise ProtocolError(
+            f"frame length {length} exceeds MAX_FRAME_BYTES (corrupt stream?)"
+        )
+    body = _recv_exactly(sock, length) if length else b""
+    return ftype, body
+
+
+def decode_json(body: bytes) -> dict:
+    try:
+        obj = json.loads(body.decode("utf-8"))
+    except (UnicodeDecodeError, json.JSONDecodeError) as e:
+        raise ProtocolError(f"malformed JSON control frame: {e}") from None
+    if not isinstance(obj, dict):
+        raise ProtocolError("control frame body must be a JSON object")
+    return obj
+
+
+def expect_frame(sock: socket.socket, *ftypes: int) -> Tuple[int, bytes]:
+    """Receive one frame that must be of the given types; an ERROR frame
+    raises the server's typed error instead."""
+    ftype, body = recv_frame(sock)
+    if ftype == ERROR and ERROR not in ftypes:
+        info = decode_json(body)
+        raise ServeError(
+            info.get("error", "server error"),
+            error_type=info.get("type", ""),
+            reason=info.get("reason", ""),
+            query_id=info.get("query_id"),
+        )
+    if ftype not in ftypes:
+        want = "/".join(FRAME_NAMES.get(t, str(t)) for t in ftypes)
+        raise ProtocolError(
+            f"expected {want}, got {FRAME_NAMES.get(ftype, ftype)}"
+        )
+    return ftype, body
+
+
+class ServeError(RuntimeError):
+    """A server-reported error (the client-side rendering of an ERROR
+    frame): ``error_type`` names the server-side exception class,
+    ``reason`` carries a cancel reason when the query was cancelled."""
+
+    def __init__(
+        self,
+        message: str,
+        error_type: str = "",
+        reason: str = "",
+        query_id: Optional[str] = None,
+    ):
+        super().__init__(message)
+        self.error_type = error_type
+        self.reason = reason
+        self.query_id = query_id

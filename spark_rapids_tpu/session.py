@@ -32,6 +32,21 @@ from .columnar.host import concat_batches
 from .utils.threads import BIG_STACK_BYTES, STACK_SIZE_LOCK as _STACK_SIZE_LOCK
 
 
+def _token_checked(thunk, token):
+    """Wrap a partition thunk so the query's cancel token is checked once
+    per result batch — with CPU-only plans (no device loop to check) this
+    IS the batch-boundary cancellation guarantee."""
+    if token is None:
+        return thunk
+
+    def it():
+        for rb in thunk():
+            token.check()
+            yield rb
+
+    return it
+
+
 class TpuSession:
     def __init__(self, conf: Optional[dict] = None):
         from . import kernels as K
@@ -130,16 +145,25 @@ class TpuSession:
         config = _faults.config_from_conf(self.conf)
         return None if config is None else _faults.FaultInjector(config)
 
-    def sql(self, text: str) -> "DataFrame":
+    def sql(self, text: str, params=None) -> "DataFrame":
         """Run a SELECT statement over registered temp views (sql/ package —
         the standalone analogue of riding Spark's parser; reference QA
-        battery: integration_tests/src/main/python/qa_nightly_sql.py)."""
-        from .sql import Compiler, parse
+        battery: integration_tests/src/main/python/qa_nightly_sql.py).
+        ``params`` binds the statement's ``?`` placeholders positionally —
+        AST-level substitution (sql/parser.py::bind_parameters), so values
+        are always literals, never spliced text."""
+        from .sql import Compiler, bind_parameters, parse
 
-        return Compiler(self).compile(parse(text))
+        q = parse(text)
+        if params is not None:
+            q = bind_parameters(q, params)
+        return Compiler(self).compile(q)
 
     def create_or_replace_temp_view(self, name: str, df: "DataFrame"):
         self._temp_views[name.lower()] = df
+        # invalidates plans compiled against the old view (the serve
+        # prepared-plan cache keys on this version)
+        self._catalog_version = getattr(self, "_catalog_version", 0) + 1
 
     def table(self, name: str) -> "DataFrame":
         try:
@@ -160,8 +184,9 @@ class TpuSession:
         return self._scheduler
 
     def active_queries(self) -> dict:
-        """query_id → {pool, permits, granted} of every query currently
-        queued or executing in this session."""
+        """query_id → {pool, permits, granted, running, queue_wait_s} of
+        every query currently queued or executing in this session — the
+        live queue view the serve STATUS command and ops tooling render."""
         return self._scheduler.active_queries()
 
     def cancel(self, query_id: str, reason: str = "cancelled by user") -> bool:
@@ -637,10 +662,36 @@ class TpuSession:
         assert last is not None
         raise last
 
+    def run_plan_stream(self, final_plan, ctx, on_retry=None):
+        """Generator over a prepared plan's result record batches,
+        partition by partition — the serving front-end's streaming
+        currency (serve/server.py), and the serial collect() path.
+
+        Retry semantics match collect(): a partition's task commits only
+        when it SUCCEEDED (``_run_task`` discards the partial stream of a
+        failed attempt before any of it is yielded), so the stream never
+        duplicates rows; cancellation/deadline raise between batches via
+        the context's cancel token. Empty batches are filtered — the wire
+        never carries zero-row frames mid-stream (the END frame closes a
+        result, not a sentinel batch)."""
+        parts = final_plan.execute(ctx)
+        attempts = cfg.TASK_MAX_FAILURES.get(self.conf)
+        token = getattr(ctx, "cancel_token", None)
+        yield from self._stream_parts(parts, attempts, token, on_retry)
+
+    def _stream_parts(self, parts, attempts, token, on_retry):
+        for thunk in parts.parts:
+            for rb in self._run_task(
+                _token_checked(thunk, token), attempts, on_retry
+            ):
+                if rb.num_rows:
+                    yield rb
+
     def _run_plan(self, final_plan, ctx) -> pa.Table:
         parts = final_plan.execute(ctx)
         batches: List[pa.RecordBatch] = []
         attempts = cfg.TASK_MAX_FAILURES.get(self.conf)
+        token = getattr(ctx, "cancel_token", None)
         # per-QUERY retry count (concurrent queries must not clobber each
         # other mid-flight); the session attribute becomes the last
         # finished query's total, assigned once in the finally below
@@ -649,22 +700,6 @@ class TpuSession:
         def on_retry():
             with self._retry_lock:
                 query_retries[0] += 1
-
-        token = getattr(ctx, "cancel_token", None)
-
-        def checked(thunk):
-            # scheduler cancellation/deadline: one check per result batch —
-            # with CPU-only plans (no device loop to check) this is the
-            # batch-boundary guarantee
-            if token is None:
-                return thunk
-
-            def it():
-                for rb in thunk():
-                    token.check()
-                    yield rb
-
-            return it
 
         # concurrentGpuTasks is re-read HERE, per query — a long-lived
         # service retunes it live with set_conf (docs/configs.md scope)
@@ -692,7 +727,10 @@ class TpuSession:
                     pool = ThreadPoolExecutor(max_workers=n_threads)
                     futures = [
                         pool.submit(
-                            self._run_task, checked(t), attempts, on_retry
+                            self._run_task,
+                            _token_checked(t, token),
+                            attempts,
+                            on_retry,
                         )
                         for t in parts.parts
                     ]
@@ -706,10 +744,9 @@ class TpuSession:
             batches = [rb for rbs in results for rb in rbs if rb.num_rows]
         else:
             try:
-                for thunk in parts.parts:
-                    for rb in self._run_task(checked(thunk), attempts, on_retry):
-                        if rb.num_rows:
-                            batches.append(rb)
+                batches.extend(
+                    self._stream_parts(parts, attempts, token, on_retry)
+                )
             finally:
                 self._task_retries = query_retries[0]
         schema = final_plan.output

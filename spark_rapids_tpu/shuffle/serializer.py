@@ -10,12 +10,13 @@ seam (`device_to_host`) exactly where the reference's D2H serializer sits.
 """
 from __future__ import annotations
 
-import io
-from typing import Optional, Tuple
+from typing import Tuple
 
 import pyarrow as pa
 
+from ..columnar import ipc
 from ..columnar.device import DeviceBatch, device_to_host, host_to_device
+from ..columnar.ipc import schema_from_bytes, schema_to_bytes  # noqa: F401 - shims
 from ..obs import metrics as obs_metrics
 from . import meta as M
 from .compression import CompressionCodec, codec_for_id
@@ -26,21 +27,11 @@ _M_UNCOMP = obs_metrics.GLOBAL.counter("shuffle.bytesUncompressed")
 _M_COMP = obs_metrics.GLOBAL.counter("shuffle.bytesCompressedOut")
 
 
-def schema_to_bytes(schema: pa.Schema) -> bytes:
-    return schema.serialize().to_pybytes()
-
-
-def schema_from_bytes(data: bytes) -> pa.Schema:
-    return pa.ipc.read_schema(pa.py_buffer(data))
-
-
 def serialize_record_batch(rb: pa.RecordBatch, codec: CompressionCodec) -> Tuple[bytes, int, int]:
     """RecordBatch → (payload, uncompressed_size, codec_id). The payload is a
-    complete Arrow IPC stream (schema + batch) so a frame is self-contained."""
-    sink = io.BytesIO()
-    with pa.ipc.new_stream(sink, rb.schema) as w:
-        w.write_batch(rb)
-    raw = sink.getvalue()
+    complete Arrow IPC stream (schema + batch, columnar/ipc.py framing) so a
+    frame is self-contained."""
+    raw = ipc.write_batch(rb)
     payload = codec.compress(raw)
     _M_UNCOMP.add(len(raw))
     _M_COMP.add(len(payload))
@@ -50,12 +41,7 @@ def serialize_record_batch(rb: pa.RecordBatch, codec: CompressionCodec) -> Tuple
 def deserialize_record_batch(payload: bytes, buffer_meta: M.BufferMeta) -> pa.RecordBatch:
     codec = codec_for_id(buffer_meta.codec)
     raw = codec.decompress(payload, buffer_meta.uncompressed_size)
-    with pa.ipc.open_stream(pa.py_buffer(raw)) as r:
-        batches = [b for b in r]
-    if len(batches) == 1:
-        return batches[0]
-    table = pa.Table.from_batches(batches)
-    return table.combine_chunks().to_batches()[0]
+    return ipc.read_batch(raw)
 
 
 def serialize_device_batch(db: DeviceBatch, codec: CompressionCodec) -> Tuple[bytes, int, int, pa.Schema]:

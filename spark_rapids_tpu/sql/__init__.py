@@ -12,10 +12,11 @@ texts against the engine's existing logical planner:
   window functions OVER (PARTITION BY .. ORDER BY .. ROWS|RANGE BETWEEN ..)
   CASE, CAST, EXTRACT, INTERVAL / DATE literals, BETWEEN / LIKE / IN / IS
 
-Entry points: ``TpuSession.sql(text)``, ``parse(text)`` (AST), and
-``Compiler`` (AST -> DataFrame).
+Entry points: ``TpuSession.sql(text)``, ``parse(text)`` (AST),
+``bind_parameters`` (substitute ``?`` placeholders — the PREPARE/BIND
+seam), and ``Compiler`` (AST -> DataFrame).
 """
-from .parser import parse
+from .parser import bind_parameters, parse
 from .compiler import Compiler
 
-__all__ = ["parse", "Compiler"]
+__all__ = ["bind_parameters", "parse", "Compiler"]

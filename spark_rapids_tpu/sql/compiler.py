@@ -1415,6 +1415,12 @@ class Compiler:
             return f["column"]
         if k == "lit":
             return lit(f["value"])
+        if k == "param":
+            raise SqlError(
+                f"unbound parameter placeholder ?{f['index'] + 1} — bind "
+                "values with sql(text, params=[...]) or PREPARE/BIND "
+                "before execution"
+            )
         if k == "datelit":
             return lit(_dt.date.fromisoformat(f["s"]))
         if k == "tslit":

@@ -112,6 +112,9 @@ class QueryExpr:
     )
     order: List[OrderItem] = field(default_factory=list)
     limit: Optional[int] = None
+    # number of '?' placeholders lexed (set on the TOP-LEVEL QueryExpr by
+    # parse()); bind_parameters substitutes them before compilation
+    n_params: int = 0
 
 
 # ── lexer ──────────────────────────────────────────────────────────────────
@@ -123,7 +126,7 @@ _TOKEN_RE = re.compile(
   | (?P<string>'(?:[^']|'')*')
   | (?P<qident>"(?:[^"]|"")*"|`(?:[^`]|``)*`)
   | (?P<ident>[A-Za-z_][A-Za-z_0-9]*)
-  | (?P<op><>|!=|>=|<=|\|\||=|<|>|\+|-|\*|/|%|\(|\)|,|\.|;)
+  | (?P<op><>|!=|>=|<=|\|\||=|<|>|\+|-|\*|/|%|\(|\)|,|\.|;|\?)
     """,
     re.VERBOSE | re.DOTALL,
 )
@@ -185,6 +188,7 @@ class _Parser:
         self.text = text
         self.toks = _lex(text)
         self.i = 0
+        self.n_params = 0  # '?' placeholders seen, numbered lexically
 
     # token helpers -------------------------------------------------------
     def peek(self, k: int = 0) -> Tok:
@@ -593,6 +597,13 @@ class _Parser:
         if t.kind == "string":
             self.next()
             return Node("lit", value=t.value)
+        if self.at_op("?"):
+            # positional parameter placeholder (PREPARE/BIND): numbered in
+            # lexical order; bind_parameters substitutes literal nodes
+            self.next()
+            idx = self.n_params
+            self.n_params += 1
+            return Node("param", index=idx)
         if self.at_kw("null"):
             self.next()
             return Node("lit", value=None)
@@ -772,4 +783,79 @@ def parse(text: str) -> QueryExpr:
     p.take_op(";")
     if p.peek().kind != "eof":
         p.error("unexpected trailing input")
+    q.n_params = p.n_params
     return q
+
+
+# ── parameter binding (PREPARE/BIND) ───────────────────────────────────────
+
+
+def _map_ast(obj, fn):
+    """Structural copy-transform over the query AST: ``fn`` maps Nodes (a
+    changed node is taken as-is, an unchanged one recurses into its
+    payload); dataclasses, lists, and tuples rebuild around the mapped
+    children. Non-mutating — a prepared statement's AST is bound many
+    times with different values."""
+    import dataclasses as _dc
+
+    if isinstance(obj, Node):
+        mapped = fn(obj)
+        if mapped is not obj:
+            return mapped
+        return Node(obj.kind, **{k: _map_ast(v, fn) for k, v in obj.f.items()})
+    if isinstance(obj, list):
+        return [_map_ast(x, fn) for x in obj]
+    if isinstance(obj, tuple):
+        return tuple(_map_ast(x, fn) for x in obj)
+    if _dc.is_dataclass(obj) and not isinstance(obj, type):
+        return _dc.replace(
+            obj,
+            **{
+                f.name: _map_ast(getattr(obj, f.name), fn)
+                for f in _dc.fields(obj)
+            },
+        )
+    return obj
+
+
+def _param_literal(v) -> Node:
+    """One bound value → its literal AST node. This is SUBSTITUTION AT THE
+    AST LEVEL, never text splicing: a string value containing quotes or
+    SQL fragments stays one literal — injection-shaped inputs cannot
+    change the query's structure. Python types coerce to their natural SQL
+    literal (bool/int/float/str/None; date/datetime to the typed
+    literals)."""
+    import datetime as _dt
+
+    if v is None or isinstance(v, (bool, int, float, str)):
+        return Node("lit", value=v)
+    # datetime first: datetime.datetime subclasses datetime.date
+    if isinstance(v, _dt.datetime):
+        return Node("tslit", s=v.isoformat(sep=" "))
+    if isinstance(v, _dt.date):
+        return Node("datelit", s=v.isoformat())
+    raise SqlError(
+        f"unsupported parameter type {type(v).__name__} "
+        "(supported: None, bool, int, float, str, date, datetime)"
+    )
+
+
+def bind_parameters(query: QueryExpr, params) -> QueryExpr:
+    """Substitute the query's ``?`` placeholders with literal values, in
+    lexical order. Exactly ``query.n_params`` values are required; the
+    result is a new, fully-bound AST (the input is untouched, so a
+    prepared statement re-binds freely)."""
+    values = list(params)
+    n = getattr(query, "n_params", 0)
+    if len(values) != n:
+        raise SqlError(
+            f"query has {n} parameter placeholder(s) but {len(values)} "
+            "value(s) were bound"
+        )
+
+    def fix(node: Node):
+        if node.kind == "param":
+            return _param_literal(values[node.f["index"]])
+        return node
+
+    return _map_ast(query, fix)

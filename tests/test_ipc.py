@@ -1,0 +1,114 @@
+"""columnar/ipc.py — the shared Arrow IPC framing (shuffle + serve).
+
+The hardening contract: zero-row batches and all-null columns round-trip
+(streamed result tails hit both), schema-only streams decode, and the
+shuffle serializer's codec layer still rides the shared helpers.
+"""
+from __future__ import annotations
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu.columnar import ipc
+
+
+def _rt_batch(rb: pa.RecordBatch) -> pa.RecordBatch:
+    return ipc.read_batch(ipc.write_batch(rb))
+
+
+def test_roundtrip_plain_batch():
+    rb = pa.record_batch({"a": [1, 2, 3], "s": ["x", None, "z"]})
+    out = _rt_batch(rb)
+    assert out.equals(rb)
+
+
+def test_roundtrip_zero_row_batch():
+    rb = pa.record_batch(
+        {"a": pa.array([], type=pa.int64()), "s": pa.array([], type=pa.string())}
+    )
+    out = _rt_batch(rb)
+    assert out.num_rows == 0
+    assert out.schema.equals(rb.schema)
+
+
+def test_roundtrip_all_null_columns():
+    rb = pa.record_batch(
+        {
+            "i": pa.array([None, None, None], type=pa.int32()),
+            "f": pa.array([None, None, None], type=pa.float64()),
+            "s": pa.array([None, None, None], type=pa.string()),
+            "n": pa.nulls(3),  # NullType column: validity only, no data
+        }
+    )
+    out = _rt_batch(rb)
+    assert out.equals(rb)
+    assert out.column(0).null_count == 3
+    assert out.column(3).type == pa.null()
+
+
+def test_schema_only_stream_decodes_to_no_batches():
+    schema = pa.schema([("a", pa.int64())])
+    data = ipc.write_stream([], schema=schema)
+    got_schema, batches = ipc.read_stream(data)
+    assert got_schema.equals(schema)
+    assert batches == []
+    # single-batch reader rebuilds the empty batch instead of IndexError
+    rb = ipc.read_batch(data)
+    assert rb.num_rows == 0 and rb.schema.equals(schema)
+
+
+def test_write_stream_empty_without_schema_raises():
+    with pytest.raises(ValueError):
+        ipc.write_stream([])
+
+
+def test_multi_batch_stream_preserves_zero_row_tail():
+    schema = pa.schema([("a", pa.int64())])
+    b1 = pa.record_batch({"a": [1, 2]}).cast(schema)
+    b0 = ipc.empty_batch(schema)
+    data = ipc.write_stream([b1, b0, b1], schema=schema)
+    got_schema, batches = ipc.read_stream(data)
+    assert [b.num_rows for b in batches] == [2, 0, 2]
+    # read_batch combines the frames into one batch
+    combined = ipc.read_batch(data)
+    assert combined.num_rows == 4
+    assert combined.column(0).to_pylist() == [1, 2, 1, 2]
+
+
+def test_read_batch_all_zero_row_frames():
+    schema = pa.schema([("a", pa.int64()), ("s", pa.string())])
+    data = ipc.write_stream(
+        [ipc.empty_batch(schema), ipc.empty_batch(schema)], schema=schema
+    )
+    rb = ipc.read_batch(data)
+    assert rb.num_rows == 0 and rb.schema.equals(schema)
+
+
+def test_schema_bytes_roundtrip():
+    schema = pa.schema([("a", pa.decimal128(12, 2)), ("t", pa.timestamp("us"))])
+    assert ipc.schema_from_bytes(ipc.schema_to_bytes(schema)).equals(schema)
+
+
+def test_serializer_shims_ride_ipc_helpers():
+    """The shuffle serializer's codec layer sits on the shared framing —
+    zero-row and all-null batches survive the codec round trip too."""
+    from spark_rapids_tpu.shuffle import meta as M
+    from spark_rapids_tpu.shuffle.compression import get_codec
+    from spark_rapids_tpu.shuffle.serializer import (
+        deserialize_record_batch,
+        serialize_record_batch,
+    )
+
+    codec = get_codec("zstd")
+    for rb in (
+        pa.record_batch({"a": np.arange(100), "s": ["v"] * 100}),
+        pa.record_batch({"a": pa.array([], type=pa.int64())}),
+        pa.record_batch({"a": pa.array([None] * 5, type=pa.int64())}),
+    ):
+        payload, usize, cid = serialize_record_batch(rb, codec)
+        bm = M.BufferMeta(
+            buffer_id=0, size=len(payload), uncompressed_size=usize, codec=cid
+        )
+        out = deserialize_record_batch(payload, bm)
+        assert out.equals(rb)

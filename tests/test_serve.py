@@ -1,0 +1,341 @@
+"""Network serving front-end (serve/) — loopback smoke + acceptance suite.
+
+The PR-6 acceptance bar: the loopback server round-trips TPC-H q1 and q6
+bit-identical to in-process ``collect()``; a mid-stream CANCEL frees the
+scheduler permits and leaves the session serving subsequent queries;
+prepared-statement re-execution skips parse+plan (hit counter increments,
+planner not re-entered); tenants map to fair-share pools; a vanished
+client cancels its query with a distinguishable reason in the Prometheus
+export.
+"""
+from __future__ import annotations
+
+import socket
+import threading
+import time
+
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu.obs.metrics import GLOBAL
+from spark_rapids_tpu.serve import ServeError, TpuServer, connect
+from spark_rapids_tpu.tpch.datagen import TABLES, gen_table
+from spark_rapids_tpu.tpch.sql_queries import tpch_sql
+
+from tests.harness import tpu_session
+
+SF = 0.002
+
+
+def _poll(pred, timeout_s: float = 30.0, what: str = "condition"):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if pred():
+            return
+        time.sleep(0.01)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+@pytest.fixture(scope="module")
+def rig():
+    """One session + one loopback server for the module: TPC-H tables as
+    temp views, a big range view for cancellation tests, small stream
+    chunks so streams have many frame boundaries."""
+    session = tpu_session(
+        {
+            "spark.sql.shuffle.partitions": 2,
+            "spark.rapids.sql.batchSizeRows": 4096,
+            "spark.rapids.tpu.serve.streamBatchRows": 512,
+        },
+        strict=False,
+    )
+    for name in TABLES:
+        session.create_dataframe(gen_table(name, SF)).create_or_replace_temp_view(name)
+    session.create_or_replace_temp_view("bigrange", session.range(0, 2_000_000))
+    session.create_or_replace_temp_view("smallrange", session.range(0, 5000))
+    session.create_or_replace_temp_view("midrange", session.range(0, 150_000))
+    server = TpuServer(session, port=0)
+    host, port = server.start()
+    yield session, server, host, port
+    server.stop()
+
+
+# ── bit-identical round trips (the tier-1 smoke) ───────────────────────────
+
+
+@pytest.mark.parametrize("q", [1, 6])
+def test_loopback_tpch_bit_identical(rig, q):
+    session, _server, host, port = rig
+    text = tpch_sql(q, sf=1.0)
+    expect = session.sql(text).to_arrow()
+    with connect(host, port) as conn:
+        got = conn.sql(text).to_table()
+    assert got.schema.names == expect.schema.names
+    # bit-identical: same arrow values, row-for-row (both paths execute
+    # the identical plan on the identical session, so no sort needed)
+    assert got.to_pydict() == expect.to_pydict()
+
+
+def test_empty_result_carries_schema(rig):
+    _session, _server, host, port = rig
+    with connect(host, port) as conn:
+        t = conn.sql(
+            "select l_orderkey, l_comment from lineitem where l_quantity < 0"
+        ).to_table()
+    assert t.num_rows == 0
+    assert t.schema.names == ["l_orderkey", "l_comment"]
+
+
+def test_params_over_the_wire(rig):
+    session, _server, host, port = rig
+    with connect(host, port) as conn:
+        got = conn.sql(
+            "select count(*) as c from lineitem where l_quantity < ?",
+            params=[10],
+        ).to_table()
+    expect = session.sql(
+        "select count(*) as c from lineitem where l_quantity < 10"
+    ).to_arrow()
+    assert got.to_pydict() == expect.to_pydict()
+
+
+def test_sql_error_keeps_connection_alive(rig):
+    _session, _server, host, port = rig
+    with connect(host, port) as conn:
+        with pytest.raises(ServeError, match="unknown table"):
+            conn.sql("select * from nope").to_table()
+        assert conn.sql("select 1 as one").to_table().to_pydict() == {"one": [1]}
+
+
+# ── mid-stream cancellation (acceptance) ───────────────────────────────────
+
+
+def test_mid_stream_cancel_frees_permits_and_session_survives(rig):
+    session, _server, host, port = rig
+    with connect(host, port) as conn:
+        stream = conn.sql("select id from bigrange where id % 7 <> 0")
+        it = iter(stream)
+        first = next(it)
+        assert first.num_rows > 0
+        stream.cancel()
+        with pytest.raises(ServeError) as ei:
+            for _ in it:
+                pass
+        assert ei.value.error_type == "QueryCancelledError"
+        assert ei.value.reason == "client cancel"
+        # permits released through the normal admission exit
+        _poll(
+            lambda: session.scheduler.pool.in_use == 0,
+            what="permits released after cancel",
+        )
+        # the same connection (and session) keeps serving
+        assert conn.sql("select 2 + 2 as x").to_table().to_pydict() == {"x": [4]}
+    # the reason slug is distinguishable in the Prometheus export
+    from spark_rapids_tpu.obs.export import prometheus_text
+
+    assert "spark_rapids_tpu_scheduler_cancelled_reason_client_cancel" in (
+        prometheus_text()
+    )
+
+
+def test_client_disconnect_cancels_query(rig):
+    session, _server, host, port = rig
+    before = GLOBAL.counter(
+        "scheduler.cancelled.reason.client_disconnect"
+    ).value
+    conn = connect(host, port)
+    it = iter(conn.sql("select id from bigrange where id % 3 = 0"))
+    next(it)
+    conn._sock.close()  # vanish mid-stream, no BYE
+    _poll(
+        lambda: session.scheduler.pool.in_use == 0
+        and GLOBAL.counter(
+            "scheduler.cancelled.reason.client_disconnect"
+        ).value
+        > before,
+        what="disconnect cancel",
+    )
+
+
+# ── prepared statements (acceptance) ───────────────────────────────────────
+
+
+def test_prepared_reexecution_skips_parse_and_plan(rig, monkeypatch):
+    session, _server, host, port = rig
+    import spark_rapids_tpu.session as session_mod
+
+    calls = [0]
+    real = session_mod.plan_physical
+
+    def counting(*a, **kw):
+        calls[0] += 1
+        return real(*a, **kw)
+
+    monkeypatch.setattr(session_mod, "plan_physical", counting)
+    hits_before = GLOBAL.counter("serve.preparedHits").value
+    text = tpch_sql(6, sf=1.0)
+    with connect(host, port) as conn:
+        stmt = conn.prepare(text)
+        assert stmt.n_params == 0
+        r1 = conn.execute(stmt)
+        t1 = r1.to_table()
+        assert not r1.cache_hit
+        planner_calls_after_first = calls[0]
+        assert planner_calls_after_first >= 1
+        r2 = conn.execute(stmt)
+        t2 = r2.to_table()
+        assert r2.cache_hit
+    # the hit counter incremented and the planner was NOT re-entered
+    assert GLOBAL.counter("serve.preparedHits").value == hits_before + 1
+    assert calls[0] == planner_calls_after_first
+    assert t1.to_pydict() == t2.to_pydict()
+    expect = session.sql(text).to_arrow()
+    assert t1.to_pydict() == expect.to_pydict()
+
+
+def test_prepared_params_key_the_plan_cache(rig):
+    _session, _server, host, port = rig
+    with connect(host, port) as conn:
+        stmt = conn.prepare(
+            "select count(*) as c from lineitem where l_quantity < ?"
+        )
+        assert stmt.n_params == 1
+        a1 = conn.execute(stmt, [10]).to_table()
+        r_same = conn.execute(stmt, [10])
+        a2 = r_same.to_table()
+        assert r_same.cache_hit
+        r_diff = conn.execute(stmt, [20])
+        b1 = r_diff.to_table()
+        assert not r_diff.cache_hit  # different binding → different plan
+        assert a1.to_pydict() == a2.to_pydict()
+        assert b1.column("c")[0].as_py() >= a1.column("c")[0].as_py()
+
+
+def test_prepared_cache_invalidated_by_view_replacement(rig):
+    session, _server, host, port = rig
+    session.create_dataframe({"v": [1, 2, 3]}).create_or_replace_temp_view("inval")
+    with connect(host, port) as conn:
+        stmt = conn.prepare("select sum(v) as s from inval")
+        assert conn.execute(stmt).to_table().to_pydict() == {"s": [6]}
+        session.create_dataframe({"v": [10, 20]}).create_or_replace_temp_view(
+            "inval"
+        )
+        r = conn.execute(stmt)
+        t = r.to_table()
+        assert not r.cache_hit  # catalog version bumped → replanned
+        assert t.to_pydict() == {"s": [30]}
+
+
+# ── auth / tenants / status ────────────────────────────────────────────────
+
+
+def test_tenant_auth_and_pool_mapping():
+    session = tpu_session(
+        {
+            "spark.rapids.tpu.serve.tenants": "tok-a:alpha:etl,tok-b:beta",
+            "spark.rapids.tpu.scheduler.pools": "etl:1,interactive:3",
+        },
+        strict=False,
+    )
+    session.create_dataframe({"x": [1, 2]}).create_or_replace_temp_view("t")
+    with TpuServer(session, port=0) as server:
+        host, port = server.host, server.port
+        with pytest.raises(ServeError, match="unknown auth token"):
+            connect(host, port, token="wrong")
+        before = GLOBAL.counter("serve.tenant.alpha.queries").value
+        with connect(host, port, token="tok-a") as conn:
+            assert conn.tenant == "alpha" and conn.pool == "etl"
+            conn.sql("select sum(x) as s from t").to_table()
+        assert GLOBAL.counter("serve.tenant.alpha.queries").value == before + 1
+        # the tenant's queries were admitted under ITS pool
+        assert (
+            GLOBAL.counter("scheduler.pool.etl.admitted").value >= 1
+        )
+        with connect(host, port, token="tok-b") as conn:
+            assert conn.tenant == "beta" and conn.pool == "default"
+
+
+def test_status_renders_live_queue_view(rig):
+    _session, _server, host, port = rig
+    with connect(host, port) as conn, connect(host, port) as c2:
+        # hold a second connection's query mid-stream (first batch read,
+        # rest unconsumed — the server thread keeps its admission while it
+        # backpressures on the socket), then sample STATUS from the first
+        stream = c2.sql("select id from bigrange where id % 5 <> 0")
+        it = iter(stream)
+        next(it)
+        seen = conn.status()
+        stream.cancel()
+        with pytest.raises(ServeError):
+            for _ in it:
+                pass
+    assert "active" in seen and "scheduler" in seen and "serve" in seen
+    # the streaming query appeared with the enriched registry fields
+    entries = list(seen["active"].values())
+    assert entries, "streaming query missing from the STATUS queue view"
+    assert {"pool", "permits", "granted", "running", "queue_wait_s"} <= set(
+        entries[0]
+    )
+    assert "prepared_cache" in seen
+
+
+def test_active_queries_shape_in_process(rig):
+    """The satellite's registry contract, checked without the wire."""
+    session, *_ = rig
+    done = threading.Event()
+    snap: dict = {}
+
+    def run():
+        try:
+            session.sql("select count(*) c from midrange").to_arrow()
+        finally:
+            done.set()
+
+    t = threading.Thread(target=run)
+    t.start()
+    _poll(lambda: bool(session.active_queries()) or done.is_set(),
+          what="query registered")
+    snap.update(session.active_queries())
+    t.join(timeout=120)
+    if snap:
+        entry = next(iter(snap.values()))
+        assert set(entry) == {
+            "pool", "permits", "granted", "running", "queue_wait_s"
+        }
+        assert entry["queue_wait_s"] >= 0.0
+
+
+# ── protocol robustness ────────────────────────────────────────────────────
+
+
+def test_non_hello_first_frame_rejected(rig):
+    _session, _server, host, port = rig
+    from spark_rapids_tpu.serve import protocol as P
+
+    sock = socket.create_connection((host, port), timeout=10)
+    try:
+        P.send_json(sock, P.STATUS, {})
+        ftype, body = P.recv_frame(sock)
+        assert ftype == P.ERROR
+        assert "HELLO" in P.decode_json(body)["error"]
+    finally:
+        sock.close()
+
+
+def test_fetch_unknown_query_id_errors_but_survives(rig):
+    _session, _server, host, port = rig
+    from spark_rapids_tpu.serve import protocol as P
+
+    with connect(host, port) as conn:
+        P.send_json(conn._sock, P.FETCH, {"query_id": "nope"})
+        with pytest.raises(ServeError, match="unknown or already-fetched"):
+            P.expect_frame(conn._sock, P.BATCH)
+        assert conn.sql("select 7 as x").to_table().to_pydict() == {"x": [7]}
+
+
+def test_streamed_batches_respect_chunk_bound(rig):
+    _session, _server, host, port = rig
+    with connect(host, port) as conn:
+        sizes = [b.num_rows for b in conn.sql("select id from smallrange")]
+    assert sizes and max(sizes) <= 512
+    assert sum(sizes) == 5000

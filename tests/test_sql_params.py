@@ -1,0 +1,183 @@
+"""sql/parser.py parameter binding — the PREPARE/BIND substrate.
+
+Placeholders substitute at the AST level (never text splicing), values
+coerce to their natural literal types, and injection-shaped strings stay
+literals — a bound value can never change the query's structure.
+"""
+from __future__ import annotations
+
+import datetime as dt
+
+import pytest
+
+from spark_rapids_tpu.sql import bind_parameters, parse
+from spark_rapids_tpu.sql.parser import Node, SqlError
+
+from tests.harness import tpu_session
+
+
+@pytest.fixture(scope="module")
+def session():
+    s = tpu_session(strict=False)
+    s.create_dataframe(
+        {
+            "n": [1, 2, 3, 4, 5],
+            "name": ["a", "b", "x' or '1'='1", "d; drop table t --", "e"],
+            "price": [1.5, 2.5, 3.5, 4.5, 5.5],
+            "day": [dt.date(2024, 1, d) for d in range(1, 6)],
+        }
+    ).create_or_replace_temp_view("t")
+    return s
+
+
+# ── parsing ────────────────────────────────────────────────────────────────
+
+
+def test_placeholders_parse_and_count():
+    q = parse("select n from t where n < ? and name = ? or price > ?")
+    assert q.n_params == 3
+
+
+def test_no_placeholders_counts_zero():
+    assert parse("select 1").n_params == 0
+
+
+def test_placeholder_indices_are_lexical():
+    q = parse("select ? as a, ? as b from t")
+    bound = bind_parameters(q, [10, 20])
+    items = bound.body.items
+    assert items[0][0] == Node("lit", value=10)
+    assert items[1][0] == Node("lit", value=20)
+
+
+def test_binding_is_non_mutating():
+    q = parse("select n from t where n = ?")
+    b1 = bind_parameters(q, [1])
+    b2 = bind_parameters(q, [2])
+    assert b1.body.where != b2.body.where
+    # the original AST still holds the placeholder (re-bindable)
+    assert any(
+        n.kind == "param" for n in _walk_nodes(q.body.where)
+    )
+
+
+def _walk_nodes(n):
+    out = [n]
+    if isinstance(n, Node):
+        for v in n.f.values():
+            if isinstance(v, Node):
+                out.extend(_walk_nodes(v))
+    return out
+
+
+# ── arity errors ───────────────────────────────────────────────────────────
+
+
+def test_too_few_params_raises():
+    with pytest.raises(SqlError, match="2 parameter"):
+        bind_parameters(parse("select ? + ?"), [1])
+
+
+def test_too_many_params_raises():
+    with pytest.raises(SqlError, match="0 parameter"):
+        bind_parameters(parse("select 1"), [1])
+
+
+def test_unbound_param_fails_at_compile(session):
+    with pytest.raises(SqlError, match="unbound parameter"):
+        session.sql("select n from t where n = ?").collect()
+
+
+def test_unsupported_param_type_raises():
+    with pytest.raises(SqlError, match="unsupported parameter type"):
+        bind_parameters(parse("select ?"), [object()])
+
+
+# ── execution + type coercion ──────────────────────────────────────────────
+
+
+def test_int_float_params(session):
+    rows = session.sql(
+        "select n, price from t where n >= ? and price < ? order by n",
+        params=[2, 4.0],
+    ).collect()
+    assert rows == [(2, 2.5), (3, 3.5)]
+
+
+def test_string_param(session):
+    rows = session.sql(
+        "select n from t where name = ?", params=["b"]
+    ).collect()
+    assert rows == [(2,)]
+
+
+def test_null_param(session):
+    # NULL = NULL is NULL → no rows (the literal went in as a real null)
+    rows = session.sql(
+        "select n from t where name = ?", params=[None]
+    ).collect()
+    assert rows == []
+
+
+def test_bool_param(session):
+    rows = session.sql(
+        "select n from t where (n < 3) = ? order by n", params=[True]
+    ).collect()
+    assert rows == [(1,), (2,)]
+
+
+def test_date_param(session):
+    rows = session.sql(
+        "select n from t where day = ?", params=[dt.date(2024, 1, 3)]
+    ).collect()
+    assert rows == [(3,)]
+
+
+def test_datetime_param(session):
+    rows = session.sql(
+        "select n from t where cast(day as timestamp) = ?",
+        params=[dt.datetime(2024, 1, 2, 0, 0, 0)],
+    ).collect()
+    assert rows == [(2,)]
+
+
+def test_param_in_select_item(session):
+    rows = session.sql(
+        "select ? as tag, count(*) as c from t", params=["all"]
+    ).collect()
+    assert rows == [("all", 5)]
+
+
+# ── injection-shaped strings stay literals ─────────────────────────────────
+
+
+def test_injection_quote_string_stays_literal(session):
+    # classic tautology payload: if it were spliced as text, the predicate
+    # would become name = 'x' or '1'='1' and return every row; bound as a
+    # literal it matches only the row whose value IS that exact string
+    rows = session.sql(
+        "select n from t where name = ?", params=["x' or '1'='1"]
+    ).collect()
+    assert rows == [(3,)]
+
+
+def test_injection_statement_payload_stays_literal(session):
+    rows = session.sql(
+        "select n from t where name = ?", params=["d; drop table t --"]
+    ).collect()
+    assert rows == [(4,)]
+    # the view is untouched
+    assert session.sql("select count(*) from t").collect() == [(5,)]
+
+
+def test_question_mark_inside_string_value_not_a_placeholder(session):
+    # a bound value containing '?' must not be re-substituted
+    rows = session.sql(
+        "select count(*) from t where name = ?", params=["why?"]
+    ).collect()
+    assert rows == [(0,)]
+
+
+def test_question_mark_inside_sql_string_literal_not_a_placeholder():
+    q = parse("select '?' as q, ? as p from t")
+    assert q.n_params == 1
