@@ -83,11 +83,40 @@ serve:
 	$(PY) -m spark_rapids_tpu.serve --port $(SERVE_PORT) --tpch-sf $(SERVE_SF)
 
 # Closed-loop serving SLO benchmark (N clients x target qps over the wire;
-# emits SLO_r06.json with p50/p95/p99 wait+run latency and per-tenant qps).
+# emits SLO_r07.json with p50/p95/p99 wait+run latency, per-tenant qps, and
+# the overload block: OVERLOADED rejections + retry-after + admitted-p99 vs
+# uncontended-p99 ratio. Drive past sustainable qps with BENCH_SERVE_QPS;
+# bound capacity with BENCH_SERVE_PERMITS / BENCH_SERVE_MAXQUEUED and set
+# per-query deadlines with BENCH_SERVE_DEADLINE — clients are closed-loop,
+# so overload needs clients > permits + maxQueued).
 .PHONY: bench-serve
 bench-serve:
 	BENCH_PLATFORM=$(or $(BENCH_PLATFORM),cpu) BENCH_SF=0.05 \
 	  BENCH_RUNS=1 $(PY) bench.py --serve 4
+
+# The recorded overload scenario behind SLO_r07.json: 6 closed-loop clients
+# at 2x the single-permit sustainable rate, queue bounded at 8, per-query
+# deadline ~1.5x the uncontended p99 — admitted-query p99 must stay within
+# 1.5x uncontended while rejections carry retry-after hints.
+.PHONY: bench-serve-overload
+bench-serve-overload:
+	BENCH_PLATFORM=cpu BENCH_SF=0.02 BENCH_RUNS=1 \
+	  BENCH_SERVE_QPS=4 BENCH_SERVE_SECONDS=12 BENCH_SERVE_DEADLINE=1.3 \
+	  BENCH_SERVE_PERMITS=1 BENCH_SERVE_MAXQUEUED=8 \
+	  $(PY) bench.py --serve 6 --smoke
+
+# Serve-path chaos suite (ISSUE 7): injected kernel stalls, compile delays,
+# slow-loris clients, mid-stream socket drops, corrupt frames — asserts
+# bit-identical results, watchdog cancellation, and zero leaked
+# permits/threads/fds. The in-process chaos suite rides the same marker.
+.PHONY: chaos-serve
+chaos-serve:
+	$(PYTEST) tests/test_chaos_serve.py -q -m chaos
+
+# The full chaos surface (in-process + serve-path).
+.PHONY: chaos
+chaos:
+	$(PYTEST) -q -m chaos
 
 # Trace one TPC-H query through the bench rig: `make trace Q=6` writes
 # traces/query-<n>.trace.json (open at ui.perfetto.dev), the per-query

@@ -677,6 +677,64 @@ SCHEDULER_DEFAULT_QUERY_BYTES = conf(
 ).bytes_conf(256 << 20)
 
 
+# ── service survivability: watchdog, shedding, compile deadlines ───────────
+
+WATCHDOG_ENABLED = conf("spark.rapids.tpu.watchdog.enabled").doc(
+    "Master switch for the progress watchdog thread (resilience/watchdog."
+    "py): scans running queries for missing progress beats and runs the "
+    "periodic stale-peer sweep. The thread only exists while stallTimeout "
+    "or evictStalePeriod is non-zero."
+).boolean_conf(True)
+
+WATCHDOG_STALL_TIMEOUT_S = conf("spark.rapids.tpu.watchdog.stallTimeout").doc(
+    "Seconds a RUNNING query may go without a progress beat (batch "
+    "boundary, H2D upload, pipeline pull, shuffle fetch, compile "
+    "start/end) before the watchdog cancels it with reason "
+    "'stall:<site>', feeds the circuit breaker, and releases its permits "
+    "through the normal admission exit. Must exceed the longest legit "
+    "beat gap — in particular first-touch XLA compiles (set "
+    "spark.rapids.tpu.compile.deadlineSeconds below this so a hung "
+    "compile is cut first). 0 disables stall detection."
+).double_conf(0.0)
+
+WATCHDOG_BEAT_INTERVAL_S = conf("spark.rapids.tpu.watchdog.beatInterval").doc(
+    "Watchdog scan period in seconds; a stalled query is cancelled within "
+    "stallTimeout + one beat interval. 0 picks stallTimeout/4 clamped to "
+    "[0.05, 5]."
+).double_conf(0.0)
+
+WATCHDOG_EVICT_STALE_PERIOD_S = conf(
+    "spark.rapids.tpu.watchdog.evictStalePeriod"
+).doc(
+    "Seconds between the watchdog's periodic shuffle-registry "
+    "evict_stale sweeps (±20% jitter so many sessions never sweep in "
+    "lockstep); dead peers older than spark.rapids.tpu.shuffle."
+    "heartbeatMaxAgeSeconds (or 3x this period when that is unset) are "
+    "evicted without waiting for an explicit heartbeat. 0 disables the "
+    "periodic sweep (eviction then happens only on heartbeat calls)."
+).double_conf(0.0)
+
+SCHEDULER_SHED_EXPIRED = conf("spark.rapids.tpu.scheduler.shedExpired").doc(
+    "Deadline-aware load shedding: reject a query at admission when its "
+    "estimated queue wait plus estimated run time (calibrated from "
+    "completed-query timings) already exceeds its deadline — the typed "
+    "QueryOverloadedError carries a retry-after hint instead of wasting "
+    "device time on a query that cannot finish. Queued queries whose "
+    "deadlines expire while waiting are shed by the deadline check "
+    "either way."
+).boolean_conf(True)
+
+COMPILE_DEADLINE_S = conf("spark.rapids.tpu.compile.deadlineSeconds").doc(
+    "Budget in seconds for one first-touch XLA kernel compile "
+    "(kernels.GuardedJit). On timeout the compile is abandoned to a "
+    "daemon thread and the typed CompileDeadlineError force-opens the "
+    "op's circuit breaker — the NEXT planning pass runs that op on CPU "
+    "instead of blocking the tenant behind a 6-90s compile wall. "
+    "Process-global (the kernel cache is process-global); the last "
+    "session to set it wins. 0 disables."
+).double_conf(0.0)
+
+
 # ── network serving front-end (serve/) ─────────────────────────────────────
 
 SERVE_HOST = conf("spark.rapids.tpu.serve.host").doc(
@@ -710,6 +768,51 @@ SERVE_STREAM_BATCH_ROWS = conf("spark.rapids.tpu.serve.streamBatchRows").doc(
     "mid-stream CANCEL has boundaries to act on) even when a partition "
     "produced one huge batch."
 ).int_conf(65536)
+
+SERVE_MAX_CONNECTIONS_PER_TENANT = conf(
+    "spark.rapids.tpu.serve.maxConnectionsPerTenant"
+).doc(
+    "Concurrent connections one tenant may hold; further connects from "
+    "that tenant are refused at HELLO with a typed error so one tenant "
+    "cannot wedge the accept loop for everyone (the global bound is "
+    "spark.rapids.tpu.serve.maxConnections). 0 = unlimited."
+).int_conf(0)
+
+SERVE_MAX_INFLIGHT_PER_TENANT = conf(
+    "spark.rapids.tpu.serve.maxInflightPerTenant"
+).doc(
+    "Concurrent in-flight (fetching) queries one tenant may run; a FETCH "
+    "past the bound answers a typed OVERLOADED error with a retry-after "
+    "hint while the connection stays alive. 0 = unlimited."
+).int_conf(0)
+
+SERVE_DRAIN_TIMEOUT_S = conf("spark.rapids.tpu.serve.drainTimeout").doc(
+    "Seconds server.drain() (and the SIGTERM handler) waits for in-flight "
+    "streams to finish before cancelling them with reason 'shutdown'. "
+    "Every stream still ends with a typed END or ERROR frame; new "
+    "commands during the drain answer a typed ServerDraining error."
+).double_conf(30.0)
+
+SERVE_SEND_TIMEOUT_S = conf("spark.rapids.tpu.serve.sendTimeout").doc(
+    "Socket send timeout per result frame: a client that stops draining "
+    "its socket (slow-loris reads) is treated as disconnected after this "
+    "many seconds — its query cancels and the worker thread frees — "
+    "instead of pinning a permit on a zero-window send forever. 0 "
+    "disables."
+).double_conf(60.0)
+
+SERVE_HELLO_TIMEOUT_S = conf("spark.rapids.tpu.serve.helloTimeout").doc(
+    "Seconds a fresh connection gets to complete its HELLO before being "
+    "dropped (slow-loris connects hold a handler thread, never the "
+    "accept loop)."
+).double_conf(10.0)
+
+SERVE_WARMUP_STATEMENTS = conf("spark.rapids.tpu.serve.warmupStatements").doc(
+    "Semicolon-separated SQL statements the server plans+precompiles in "
+    "the background after start(); STATUS reports ready=false until the "
+    "warm pool is primed, so a rolling restart can wait for readiness "
+    "before shifting traffic. Empty = ready immediately."
+).string_conf(None)
 
 SERVE_PREPARED_CACHE_ENTRIES = conf(
     "spark.rapids.tpu.serve.preparedCacheEntries"
@@ -784,6 +887,38 @@ FAULTS_TCP_DELAY_EVERY_N = conf("spark.rapids.tpu.faults.transport.delayEveryN")
 FAULTS_TCP_DELAY_MS = conf("spark.rapids.tpu.faults.transport.delayMs").doc(
     "Injected per-frame delay for the transport delay point."
 ).double_conf(50.0)
+
+FAULTS_TCP_CORRUPT_EVERY_N = conf(
+    "spark.rapids.tpu.faults.transport.corruptEveryN"
+).doc(
+    "Flip one payload byte in every Nth outgoing shuffle DATA frame "
+    "AFTER its checksum is stamped (the receiver's CRC check drops the "
+    "frame and the fetch retry recovers); 0 disables."
+).int_conf(0)
+
+FAULTS_KERNEL_STALL_EVERY_N = conf(
+    "spark.rapids.tpu.faults.kernelStallEveryN"
+).doc(
+    "Stall every Nth compiled-kernel launch for kernelStallMs before "
+    "running it (a wedged-device simulation — no error is raised; the "
+    "progress watchdog is what must notice); 0 disables."
+).int_conf(0)
+
+FAULTS_KERNEL_STALL_MS = conf("spark.rapids.tpu.faults.kernelStallMs").doc(
+    "Injected stall duration for the kernel-stall point."
+).double_conf(500.0)
+
+FAULTS_COMPILE_DELAY_EVERY_N = conf(
+    "spark.rapids.tpu.faults.compileDelayEveryN"
+).doc(
+    "Delay every Nth first-touch kernel compile by compileDelayMs "
+    "(inside the compile-deadline scope, so "
+    "spark.rapids.tpu.compile.deadlineSeconds can cut it); 0 disables."
+).int_conf(0)
+
+FAULTS_COMPILE_DELAY_MS = conf("spark.rapids.tpu.faults.compileDelayMs").doc(
+    "Injected delay for the compile-delay point."
+).double_conf(500.0)
 
 
 class TpuConf:

@@ -120,6 +120,12 @@ class PipelinedIterator:
 
     def _produce(self) -> None:
         obs_trace.attach_context(self._trace_ctx)
+        if self._cancel_token is not None:
+            # producer threads drive upstream pulls (and first-touch
+            # compiles): give the watchdog a current token here too
+            from ..resilience import watchdog as _wd
+
+            _wd.set_current(self._cancel_token)
         m_prod = self._metrics.get("producer")
         m_full = self._metrics.get("wait_full")
         m_depth = self._metrics.get("depth")

@@ -212,6 +212,12 @@ def run_device(fn, it, needs_task, catalog=None, policy=None, op=None,
 
     from ..resilience import retry as R
 
+    if token is not None:
+        # watchdog current-token install: compiles/fetches beneath this
+        # loop label their stall phase on it; each check() is a beat
+        from ..resilience import watchdog as _wd
+
+        _wd.set_current(token)
     if not needs_task:
         zeros = zero_vals(jnp)
         if policy is None:

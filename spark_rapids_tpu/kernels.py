@@ -69,6 +69,69 @@ def kernel(key: tuple, builder: Callable):
 # kernel built from cached sub-kernels); a plain lock would self-deadlock.
 _COMPILE_LOCK = threading.RLock()
 
+# ── compile deadline (spark.rapids.tpu.compile.deadlineSeconds) ─────────────
+# Process-global like the kernel cache itself: the session stamps it at init
+# and on set_conf; 0 disables. Boxed so readers never race a rebind.
+_COMPILE_DEADLINE_S = [0.0]
+_M_COMPILE_DEADLINES = obs_metrics.GLOBAL.counter("kernel.compileDeadlines")
+
+
+def set_compile_deadline(seconds: float) -> None:
+    """Install the first-touch compile budget (0 disables)."""
+    _COMPILE_DEADLINE_S[0] = max(0.0, float(seconds))
+
+
+#: set on the deadline helper thread: a NESTED first-touch compile inside
+#: the guarded region (a fused kernel tracing into a cached sub-kernel's
+#: first call) must run inline there — the outer budget already bounds the
+#: whole nest, and a second helper thread could never re-enter the RLock
+#: the helper holds
+_DEADLINE_TLS = threading.local()
+
+
+def _call_with_deadline(fn, deadline_s: float):
+    """Run ``fn()`` — the locked first-touch trace+compile region — under
+    a wall-clock budget. Without a budget this is a plain call. With one,
+    the region runs on a helper thread (big stack: LLVM recursion), which
+    acquires _COMPILE_LOCK ITSELF so nested first-touch compiles re-enter
+    the RLock on that same thread; a join past the deadline raises the
+    typed CompileDeadlineError while the orphan daemon finishes (XLA
+    exposes no compile cancellation). The orphan keeps holding
+    _COMPILE_LOCK until its compile returns, so the hazard window after a
+    blown budget is the orphan's remaining compile — acceptable for the
+    pathological case the deadline exists to cut, and exactly why the
+    deadline defaults off on single-tenant use."""
+    if deadline_s <= 0 or getattr(_DEADLINE_TLS, "active", False):
+        return fn()
+    from .resilience.watchdog import CompileDeadlineError
+    from .utils.threads import start_big_stack_thread
+
+    box: list = []
+
+    def run():
+        _DEADLINE_TLS.active = True
+        try:
+            box.append(("ok", fn()))
+        except BaseException as e:  # noqa: BLE001 - re-raised on the caller
+            box.append(("err", e))
+        finally:
+            _DEADLINE_TLS.active = False
+
+    t = start_big_stack_thread(run, "srt-compile-deadline")
+    t.join(timeout=deadline_s)
+    if not box:
+        _M_COMPILE_DEADLINES.add(1)
+        raise CompileDeadlineError(
+            f"first-touch kernel compile exceeded its budget of "
+            f"{deadline_s:g}s (spark.rapids.tpu.compile.deadlineSeconds); "
+            "abandoning the compile and flipping the op to CPU via the "
+            "circuit breaker"
+        )
+    kind, val = box[0]
+    if kind == "err":
+        raise val
+    return val
+
 
 def _args_sig(args) -> tuple:
     leaves, treedef = jax.tree_util.tree_flatten(args)
@@ -133,6 +196,11 @@ class GuardedJit:
             # where a real allocation failure would, so the retry/spill/
             # split machinery above this call is what recovers it
             _faults.on_kernel_launch()
+            # wedged-device simulation (kernelStallEveryN): the launch
+            # SLEEPS instead of failing — nothing here recovers it; the
+            # progress watchdog's stall cancel is what the chaos suite
+            # asserts on
+            _faults.on_kernel_stall()
         sig = _args_sig(args)
         # capture _fn BEFORE the membership check: if another thread swaps
         # in a fresh (empty-cache) jit and clears _seen concurrently, a
@@ -141,10 +209,26 @@ class GuardedJit:
         fn = self._fn
         if sig in self._seen:
             return fn(*args)
-        with _COMPILE_LOCK:
-            out = self._first_call(args)
-            self._seen.add(sig)
-        return out
+
+        def locked_first():
+            # lock acquisition INSIDE the deadline scope: under a budget
+            # this whole region runs on the helper thread, so nested
+            # first-touch compiles (fused kernels tracing into cached
+            # sub-kernels) re-enter the RLock on the thread that holds it
+            with _COMPILE_LOCK:
+                out = self._first_call(args)
+                self._seen.add(sig)
+                return out
+
+        deadline = _COMPILE_DEADLINE_S[0]
+        if deadline <= 0:
+            return locked_first()
+        from .resilience import watchdog as _wd
+
+        # phase-label the caller thread too: it blocks in join() for up
+        # to the budget, and a watchdog stall there is a compile stall
+        with _wd.stall_phase("compile"):
+            return _call_with_deadline(locked_first, deadline)
 
     def _first_call(self, args):
         """First execution per signature = trace + compile. Two recoveries:
@@ -163,17 +247,30 @@ class GuardedJit:
         # once per first execution — retry attempts and the Mosaic-fallback
         # retrace accumulate compile TIME but are not more first calls
         _M_FIRST_CALLS.add(1)
+        from .resilience import watchdog as _wd
+
         while True:
             try:
-                from .resilience import faults as _faults
+                def attempt():
+                    from .resilience import faults as _faults
 
-                if _faults._ACTIVE is not None:
-                    # chaos harness: transient compile failure on the Nth
-                    # first-touch compile — recovered by the retry loop below
-                    _faults.on_kernel_compile()
-                with obs_trace.span("xla-compile", "kernel"):
-                    with _M_COMPILE_NS.timed():
-                        return self._fn(*args)
+                    if _faults._ACTIVE is not None:
+                        # chaos harness: injected compile delay (inside the
+                        # deadline scope so compile.deadlineSeconds can cut
+                        # it) and transient compile failure on the Nth
+                        # first-touch compile — recovered by the retry loop
+                        _faults.on_kernel_compile()
+                    return self._fn(*args)
+
+                # the compile is a long legitimate beat gap: the stall
+                # phase stamps beats at entry/exit and labels a watchdog
+                # cancel 'stall:compile' instead of blaming the launch
+                # (the deadline join, when one is armed, lives in
+                # __call__ — this runs on the helper thread there)
+                with _wd.stall_phase("compile"), \
+                        obs_trace.span("xla-compile", "kernel"), \
+                        _M_COMPILE_NS.timed():
+                    return attempt()
             except Exception as e:  # noqa: BLE001 - classify, then re-raise
                 msg = str(e)
                 from .ops import pallas_strings as _ps

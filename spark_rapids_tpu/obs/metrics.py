@@ -239,6 +239,9 @@ CATALOG: Iterable[tuple] = (
     ("kernel.warmTimeNs", MetricKind.NANOS, "time spent in pre-compilation lower+compile"),
     ("kernel.firstCalls", MetricKind.COUNTER, "first executions per signature (trace+compile)"),
     ("kernel.compileTimeNs", MetricKind.NANOS, "time spent in first-call trace+compile"),
+    ("kernel.compileDeadlines", MetricKind.COUNTER,
+     "first-touch compiles abandoned at spark.rapids.tpu.compile."
+     "deadlineSeconds (the op force-opens its circuit breaker)"),
     # mem/spill.py — spill bytes by tier transition + HBM watermark
     ("spill.bytesDeviceToHost", MetricKind.COUNTER, "bytes spilled HBM → host RAM"),
     ("spill.bytesHostToDisk", MetricKind.COUNTER, "bytes spilled host RAM → disk"),
@@ -254,6 +257,12 @@ CATALOG: Iterable[tuple] = (
     ("shuffle.bytesFetched", MetricKind.COUNTER, "payload bytes received from peer executors"),
     ("shuffle.bytesCompressedOut", MetricKind.COUNTER, "serialized shuffle payload bytes after compression"),
     ("shuffle.bytesUncompressed", MetricKind.COUNTER, "serialized shuffle payload bytes before compression"),
+    ("shuffle.corruptFrames", MetricKind.COUNTER,
+     "TCP DATA frames dropped on checksum mismatch (recovered by the "
+     "fetch retry's missing-block re-request)"),
+    ("shuffle.evictedStale", MetricKind.COUNTER,
+     "executors evicted by age-based registry sweeps (heartbeat "
+     "evict_stale — including the watchdog's periodic sweep)"),
     # sched/* — multi-tenant admission control (per-pool admitted counters
     # under scheduler.pool.<name>.admitted and per-cause cancellations
     # under scheduler.cancelled.reason.<slug> register dynamically on
@@ -272,6 +281,15 @@ CATALOG: Iterable[tuple] = (
     ("scheduler.permitsInUse", MetricKind.GAUGE, "admission permits currently held"),
     ("scheduler.effectivePermits", MetricKind.GAUGE,
      "live permit limit (configured permits, halved under OOM pressure)"),
+    ("scheduler.shed", MetricKind.COUNTER,
+     "admissions shed by deadline-aware load shedding (per-cause series "
+     "under scheduler.shed.reason.*; each also counts in rejected)"),
+    # resilience/watchdog.py — hung-query detection (per-site series under
+    # watchdog.stalls.site.* register dynamically on first use)
+    ("watchdog.stalls", MetricKind.COUNTER,
+     "queries cancelled by the progress watchdog (no beat for "
+     "stallTimeout); classified per stall site (compile/launch/fetch/"
+     "client) under watchdog.stalls.site.*"),
     # serve/* — the network front-end (per-tenant query counters under
     # serve.tenant.<name>.queries register dynamically on first use)
     ("serve.connections", MetricKind.COUNTER, "client connections accepted (HELLO ok)"),
@@ -291,6 +309,18 @@ CATALOG: Iterable[tuple] = (
      "server-side cancellations (CANCEL frames + client disconnects)"),
     ("serve.queryWaitNs", MetricKind.NANOS, "served queries' admission queue wait"),
     ("serve.queryRunNs", MetricKind.NANOS, "served queries' execution+stream time"),
+    ("serve.overloaded", MetricKind.COUNTER,
+     "typed OVERLOADED rejections answered over the wire (queue full, "
+     "deadline-unmeetable shed, tenant in-flight cap) — each carries a "
+     "retry-after hint"),
+    ("serve.corruptFrames", MetricKind.COUNTER,
+     "protocol frames failing their CRC (FrameCorruptError; the "
+     "connection closes cleanly)"),
+    ("serve.draining", MetricKind.GAUGE,
+     "1 while the server is draining (drain()/SIGTERM)"),
+    ("serve.drainCancelled", MetricKind.COUNTER,
+     "in-flight queries cancelled at drainTimeout with reason "
+     "'shutdown'"),
     # resilience/* — the old retry.report() counters (registry view now)
     ("resilience.oom_retries", MetricKind.COUNTER, "spill-and-retry launches after device OOM"),
     ("resilience.splits", MetricKind.COUNTER, "OOM batch halvings"),

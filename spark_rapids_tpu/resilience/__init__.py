@@ -24,6 +24,7 @@ from __future__ import annotations
 
 from .breaker import CircuitBreaker
 from .faults import FaultConfig, InjectedFault
+from .watchdog import CompileDeadlineError, Watchdog, WatchdogStallError
 from .retry import (
     RetryPolicy,
     is_device_error,
@@ -40,8 +41,11 @@ from .retry import (
 
 __all__ = [
     "CircuitBreaker",
+    "CompileDeadlineError",
     "FaultConfig",
     "InjectedFault",
+    "Watchdog",
+    "WatchdogStallError",
     "RetryPolicy",
     "is_device_error",
     "is_oom_error",

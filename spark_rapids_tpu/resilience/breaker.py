@@ -63,6 +63,31 @@ class CircuitBreaker:
                 op, n, self._last_error.get(op),
             )
 
+    def force_open(self, op: str, err: BaseException) -> None:
+        """Open the breaker for ``op`` in one step — the compile-deadline
+        path: one blown compile budget already cost the tenant seconds,
+        so the op flips to CPU immediately instead of after ``threshold``
+        repeats of the same multi-second wait."""
+        if not self.enabled:
+            return
+        from . import retry as R
+
+        with self._lock:
+            self._failures[op] = max(
+                self._failures.get(op, 0) + 1, self.threshold
+            )
+            self._last_error[op] = f"{type(err).__name__}: {str(err)[:160]}"
+            tripped = op not in self._open
+            if tripped:
+                self._open.add(op)
+        if tripped:
+            R.record("circuit_breaker_trips")
+            log.warning(
+                "circuit breaker FORCED OPEN for %s; the op runs on CPU for "
+                "the rest of the session (%s)",
+                op, self._last_error.get(op),
+            )
+
     def is_open(self, op: str) -> bool:
         with self._lock:
             return op in self._open

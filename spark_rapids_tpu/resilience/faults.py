@@ -49,6 +49,11 @@ class FaultConfig:
     tcp_drop_every_n: int = 0  # outgoing shuffle DATA frames
     tcp_delay_every_n: int = 0
     tcp_delay_ms: float = 0.0
+    tcp_corrupt_every_n: int = 0  # flip a byte in outgoing DATA frames
+    kernel_stall_every_n: int = 0  # stall (not fail) compiled-kernel launches
+    kernel_stall_ms: float = 0.0
+    compile_delay_every_n: int = 0  # delay first-touch compiles
+    compile_delay_ms: float = 0.0
 
 
 class FaultInjector:
@@ -108,9 +113,23 @@ class FaultInjector:
                 "(fault injection)",
             )
 
+    def on_kernel_stall(self) -> None:
+        """Stall (not fail) a compiled-kernel launch — the wedged-device
+        simulation the progress watchdog must notice. Unlike the OOM
+        point this fires on EVERY launch (no recovery scope: nothing
+        recovers a stall; the watchdog's cancel is the recovery)."""
+        c = self.config
+        if self._tick("kernel_stall", c.kernel_stall_every_n) and c.kernel_stall_ms > 0:
+            self._record("kernel_stall")
+            time.sleep(c.kernel_stall_ms / 1e3)
+
     def on_kernel_compile(self) -> None:
         """First-touch compiles (kernels.GuardedJit._first_call)."""
-        if self._tick("kernel_compile", self.config.compile_fail_every_n):
+        c = self.config
+        if self._tick("compile_delay", c.compile_delay_every_n) and c.compile_delay_ms > 0:
+            self._record("compile_delay")
+            time.sleep(c.compile_delay_ms / 1e3)
+        if self._tick("kernel_compile", c.compile_fail_every_n):
             self._record("kernel_compile")
             raise InjectedFault(
                 "compile",
@@ -139,8 +158,19 @@ class FaultInjector:
             return True
         return False
 
+    def corrupt_tcp_data_frame(self) -> bool:
+        """Whether to flip a payload byte in this outgoing DATA frame
+        (AFTER its checksum is stamped — the receiver's CRC check is what
+        must catch it)."""
+        if self._tick("tcp_corrupt", self.config.tcp_corrupt_every_n):
+            self._record("tcp_corrupt")
+            return True
+        return False
+
 
 _ACTIVE: Optional[FaultInjector] = None
+_ACTIVE_COUNT = 0  # concurrent scoped() entries holding _ACTIVE installed
+_SHADOWED: list = []  # [(injector, count)] scopes displaced by a newer one
 _INSTALL_LOCK = threading.Lock()
 _TLS = threading.local()
 
@@ -207,6 +237,19 @@ def drop_tcp_data_frame() -> bool:
     return False
 
 
+def corrupt_tcp_data_frame() -> bool:
+    inj = _ACTIVE
+    if inj is not None:
+        return inj.corrupt_tcp_data_frame()
+    return False
+
+
+def on_kernel_stall() -> None:
+    inj = _ACTIVE
+    if inj is not None:
+        inj.on_kernel_stall()
+
+
 @contextmanager
 def scoped(config_or_injector):
     """Install a fault scenario process-wide for the duration of the block
@@ -215,8 +258,16 @@ def scoped(config_or_injector):
     ONE injector for its lifetime so every-Nth counters accumulate across
     queries). The injector is global on purpose: partition tasks run on
     thread pools and the injection points must see it from any thread.
-    Scopes do not nest — an inner scope temporarily shadows the outer one."""
-    global _ACTIVE
+
+    Concurrent scopes are refcounted by injector identity: the serve path
+    enters this from one worker thread PER query, all sharing the
+    session's injector, and a plain save/restore would let interleaved
+    exits resurrect a stale injector (thread A restores None while B
+    still runs, B then restores A's injector — installed forever). The
+    injector uninstalls only when the LAST holder exits. A scope with a
+    different injector shadows the current one (tests nesting configs)
+    and restores it when its own count drains."""
+    global _ACTIVE, _ACTIVE_COUNT
     if config_or_injector is None:
         yield None
         return
@@ -226,13 +277,35 @@ def scoped(config_or_injector):
         else FaultInjector(config_or_injector)
     )
     with _INSTALL_LOCK:
-        prev = _ACTIVE
-        _ACTIVE = inj
+        if _ACTIVE is inj:
+            _ACTIVE_COUNT += 1
+        else:
+            if _ACTIVE is not None:
+                _SHADOWED.append((_ACTIVE, _ACTIVE_COUNT))
+            _ACTIVE = inj
+            _ACTIVE_COUNT = 1
     try:
         yield inj
     finally:
         with _INSTALL_LOCK:
-            _ACTIVE = prev
+            if _ACTIVE is inj:
+                _ACTIVE_COUNT -= 1
+                if _ACTIVE_COUNT <= 0:
+                    if _SHADOWED:
+                        _ACTIVE, _ACTIVE_COUNT = _SHADOWED.pop()
+                    else:
+                        _ACTIVE, _ACTIVE_COUNT = None, 0
+            else:
+                # exiting while shadowed (out-of-order exit across threads):
+                # drain this injector's count on the shadow stack instead
+                for i in range(len(_SHADOWED) - 1, -1, -1):
+                    s, c = _SHADOWED[i]
+                    if s is inj:
+                        if c <= 1:
+                            del _SHADOWED[i]
+                        else:
+                            _SHADOWED[i] = (s, c - 1)
+                        break
 
 
 def config_from_conf(conf) -> Optional[FaultConfig]:
@@ -253,4 +326,9 @@ def config_from_conf(conf) -> Optional[FaultConfig]:
         tcp_drop_every_n=cfg.FAULTS_TCP_DROP_EVERY_N.get(conf),
         tcp_delay_every_n=cfg.FAULTS_TCP_DELAY_EVERY_N.get(conf),
         tcp_delay_ms=cfg.FAULTS_TCP_DELAY_MS.get(conf),
+        tcp_corrupt_every_n=cfg.FAULTS_TCP_CORRUPT_EVERY_N.get(conf),
+        kernel_stall_every_n=cfg.FAULTS_KERNEL_STALL_EVERY_N.get(conf),
+        kernel_stall_ms=cfg.FAULTS_KERNEL_STALL_MS.get(conf),
+        compile_delay_every_n=cfg.FAULTS_COMPILE_DELAY_EVERY_N.get(conf),
+        compile_delay_ms=cfg.FAULTS_COMPILE_DELAY_MS.get(conf),
     )

@@ -207,9 +207,32 @@ def _batch_size(batch) -> int:
 
 def _handle_non_oom(err, op, breaker) -> None:
     """Feed the circuit breaker on non-OOM device failures (the caller
-    re-raises)."""
-    if breaker is not None and op and is_device_error(err):
+    re-raises). A blown compile deadline force-opens in one step: the op
+    already cost the tenant its whole compile budget once."""
+    from .watchdog import CompileDeadlineError
+
+    if breaker is None or not op:
+        return
+    for e in walk_causes(err):
+        if isinstance(e, CompileDeadlineError):
+            breaker.force_open(op, e)
+            return
+    if is_device_error(err):
         breaker.record_failure(op, err)
+
+
+def _label_launch(op: Optional[str]) -> None:
+    """Stamp the op signature as the current token's stall-phase detail so
+    a watchdog-detected launch stall names the op it wedged in (and feeds
+    that op's circuit breaker). One attribute write; the next op
+    overwrites it."""
+    if not op:
+        return
+    from .watchdog import current as _wd_current
+
+    tok = _wd_current()
+    if tok is not None:
+        tok.phase_detail = op
 
 
 def run_once(catalog, fn: Callable, batch, policy: Optional[RetryPolicy] = None,
@@ -217,6 +240,7 @@ def run_once(catalog, fn: Callable, batch, policy: Optional[RetryPolicy] = None,
     """Spill-and-retry WITHOUT splitting (operators whose kernel is not
     distributive over row ranges: final/merge aggregates, sorts)."""
     policy = policy or DEFAULT_POLICY
+    _label_launch(op)
     attempt = 0
     while True:
         try:
@@ -250,6 +274,7 @@ def run_with_retry(catalog, fn: Callable, batch,
     output batches per input batch — that is the splittable-operator
     contract."""
     policy = policy or DEFAULT_POLICY
+    _label_launch(op)
     attempt = 0
     while True:
         try:

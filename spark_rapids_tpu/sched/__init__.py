@@ -19,6 +19,7 @@ layer itself, so the machinery lives here:
 from .cancel import (
     CancelToken,
     QueryCancelledError,
+    QueryOverloadedError,
     QueryQueueFull,
     QueryTimeoutError,
     SchedulerError,
@@ -32,6 +33,7 @@ __all__ = [
     "CancelToken",
     "PoolSpec",
     "QueryCancelledError",
+    "QueryOverloadedError",
     "QueryQueueFull",
     "QueryScheduler",
     "QueryTimeoutError",

@@ -45,7 +45,27 @@ class QueryTimeoutError(QueryCancelledError):
 class QueryQueueFull(SchedulerError):
     """Admission rejected: the scheduler queue is at
     ``spark.rapids.tpu.scheduler.maxQueued`` — the backpressure signal a
-    service in front of this engine sheds load on."""
+    service in front of this engine sheds load on. ``retry_after_s`` is
+    the scheduler's drain-time hint (0.0 when unknown); the serve layer
+    forwards it on the typed OVERLOADED error frame."""
+
+    def __init__(self, message: str, retry_after_s: float = 0.0):
+        super().__init__(message)
+        self.retry_after_s = retry_after_s
+
+
+class QueryOverloadedError(SchedulerError):
+    """Deadline-aware load shedding (``scheduler.shedExpired``): the
+    query's estimated queue wait plus estimated run time already exceeds
+    its deadline, so admission rejects it instead of wasting device time
+    on work that cannot finish. ``retry_after_s`` hints when capacity
+    should exist again."""
+
+    def __init__(self, message: str, retry_after_s: float = 0.0,
+                 reason: str = "overloaded"):
+        super().__init__(message)
+        self.retry_after_s = retry_after_s
+        self.reason = reason
 
 
 class CancelToken:
@@ -56,7 +76,8 @@ class CancelToken:
     from any thread, any number of times — first reason wins.
     """
 
-    __slots__ = ("query_id", "deadline", "_cancelled", "_reason", "_lock")
+    __slots__ = ("query_id", "deadline", "_cancelled", "_reason", "_lock",
+                 "last_beat", "phase", "phase_detail")
 
     def __init__(self, query_id: str = "", timeout_s: Optional[float] = None):
         self.query_id = query_id
@@ -68,6 +89,15 @@ class CancelToken:
         self._cancelled = False
         self._reason = ""
         self._lock = threading.Lock()
+        # progress-watchdog state (resilience/watchdog.py): every check()
+        # and beat() stamps last_beat; phase names the potentially-blocking
+        # region execution is currently inside ("launch" by default,
+        # "compile" / "fetch" / "client" around those waits) so a stall is
+        # classified by WHERE progress stopped. Plain attribute writes —
+        # racy phase labels only ever blur classification, never safety.
+        self.last_beat = time.monotonic()
+        self.phase = "launch"
+        self.phase_detail = ""
 
     def cancel(self, reason: str = "cancelled") -> bool:
         """Flag the query cancelled; True if this call flipped the flag."""
@@ -96,9 +126,20 @@ class CancelToken:
             return None
         return max(0.0, self.deadline - time.monotonic())
 
+    def beat(self) -> None:
+        """Stamp a progress beat (batch boundary, compile start/end,
+        fetch completion) without the cancellation check."""
+        self.last_beat = time.monotonic()
+
+    def stalled_s(self) -> float:
+        """Seconds since the last progress beat."""
+        return max(0.0, time.monotonic() - self.last_beat)
+
     def check(self) -> None:
         """Raise the typed error if cancelled or past deadline; the one
-        call engine loops make at each batch boundary."""
+        call engine loops make at each batch boundary. Reaching a check
+        IS progress, so it stamps the watchdog beat."""
+        self.last_beat = time.monotonic()
         if self._cancelled:
             raise QueryCancelledError(
                 f"query {self.query_id or '<anonymous>'} cancelled"

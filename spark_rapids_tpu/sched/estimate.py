@@ -141,3 +141,68 @@ def permits_for_plan(plan, conf, pool_size: int) -> int:
         est = cfg.SCHEDULER_DEFAULT_QUERY_BYTES.get(conf)
     per = max(1, cfg.SCHEDULER_BYTES_PER_PERMIT.get(conf))
     return max(1, min(pool_size, math.ceil(est / per)))
+
+
+# ── run-time calibration (deadline-aware load shedding) ─────────────────────
+# The byte estimate above answers "does it fit"; shedding needs "how LONG
+# will it take". Completed queries feed an EWMA of measured run time and
+# processing rate (the calibrated obs-timer analogue of Spark's runtime
+# statistics), so admission can refuse a query whose estimated queue wait +
+# run already blows its deadline — with a retry-after hint derived from the
+# same numbers. Process-wide on purpose: every session shares the one
+# device, so one calibration describes it.
+
+
+class RunCalibration:
+    """EWMA of completed-query (run seconds, bytes/second)."""
+
+    def __init__(self, alpha: float = 0.2):
+        self._lock = __import__("threading").Lock()
+        self._alpha = alpha
+        self._avg_run_s = 0.0
+        self._bytes_per_s = 0.0
+        self._samples = 0
+
+    def record(self, est_bytes: int, run_s: float) -> None:
+        if run_s <= 0:
+            return
+        with self._lock:
+            a = self._alpha if self._samples else 1.0
+            self._avg_run_s += a * (run_s - self._avg_run_s)
+            if est_bytes > 0:
+                rate = est_bytes / run_s
+                self._bytes_per_s += a * (rate - self._bytes_per_s)
+            self._samples += 1
+
+    @property
+    def samples(self) -> int:
+        return self._samples
+
+    def avg_run_s(self) -> float:
+        """EWMA run seconds of recent queries (0.0 = uncalibrated)."""
+        return self._avg_run_s
+
+    def estimate_run_s(self, est_bytes: int) -> float:
+        """Predicted run seconds for a query of ``est_bytes``: the
+        calibrated rate when it exists, the plain average otherwise,
+        0.0 while uncalibrated (shedding then never fires on run-time —
+        a cold scheduler must not refuse its first queries)."""
+        with self._lock:
+            if self._samples == 0:
+                return 0.0
+            if est_bytes > 0 and self._bytes_per_s > 0:
+                # never predict below the average floor: tiny queries pay
+                # fixed dispatch costs the linear model misses
+                return max(
+                    est_bytes / self._bytes_per_s, self._avg_run_s * 0.25
+                )
+            return self._avg_run_s
+
+    def reset(self) -> None:
+        with self._lock:
+            self._avg_run_s = 0.0
+            self._bytes_per_s = 0.0
+            self._samples = 0
+
+
+CALIBRATION = RunCalibration()

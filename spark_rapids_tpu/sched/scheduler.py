@@ -23,13 +23,14 @@ from __future__ import annotations
 
 import threading
 import time
-from typing import Dict, Optional
+from typing import Dict, List, Optional
 
 from ..obs import metrics as obs_metrics
 from .admission import WeightedPermitPool, parse_pool_spec
 from .cancel import (
     CancelToken,
     QueryCancelledError,
+    QueryOverloadedError,
     QueryQueueFull,
     QueryTimeoutError,
 )
@@ -39,10 +40,20 @@ _M = obs_metrics.GLOBAL
 
 def _count_cancelled(reason: str) -> None:
     """One Prometheus series per distinct cancel cause (user action vs
-    client disconnect vs deadline) next to the aggregate counter."""
+    client disconnect vs deadline vs watchdog stall) next to the
+    aggregate counter."""
     _M.counter("scheduler.cancelled").add(1)
     _M.counter(
         f"scheduler.cancelled.reason.{obs_metrics.metric_slug(reason)}"
+    ).add(1)
+
+
+def _count_shed(reason: str) -> None:
+    """Load-shedding rejections, per cause (queue_full rides the
+    rejected counter; this family covers the deadline-aware sheds)."""
+    _M.counter("scheduler.shed").add(1)
+    _M.counter(
+        f"scheduler.shed.reason.{obs_metrics.metric_slug(reason)}"
     ).add(1)
 
 
@@ -72,6 +83,8 @@ class Admission:
         self.queue_wait_ns = 0
         self._granted = 0
         self.enqueued_at = None  # set when __enter__ starts queueing
+        self.est_bytes = 0  # plan-footprint estimate (calibration input)
+        self._granted_at = None  # monotonic stamp once permits are held
 
     def queue_wait_s(self) -> float:
         """Seconds this query has waited for admission SO FAR: the final
@@ -111,6 +124,7 @@ class Admission:
                 # counted only when admission actually gated: a disabled
                 # scheduler must not report admissions it never performed
                 _M.counter("scheduler.admitted").add(1)
+            self._granted_at = time.monotonic()
         except QueryTimeoutError:
             _M.counter("scheduler.timeouts").add(1)
             _count_cancelled("deadline")
@@ -120,8 +134,11 @@ class Admission:
             _count_cancelled(getattr(e, "reason", "") or self.token.reason)
             self.scheduler._unregister(self)
             raise
-        except QueryQueueFull:
+        except QueryQueueFull as e:
             _M.counter("scheduler.rejected").add(1)
+            # attach the drain-time hint so the serve layer's OVERLOADED
+            # frame can tell the client when to come back
+            e.retry_after_s = self.scheduler.retry_after_hint()
             self.scheduler._unregister(self)
             raise
         except BaseException:
@@ -136,6 +153,14 @@ class Admission:
             self.scheduler.pool.release(self._granted, self.pool)
             self._granted = 0
         self.scheduler._unregister(self)
+        if exc_type is None and self._granted_at is not None:
+            # successful completion feeds the shed calibration: measured
+            # run time against the plan's byte estimate
+            from .estimate import CALIBRATION
+
+            CALIBRATION.record(
+                self.est_bytes, time.monotonic() - self._granted_at
+            )
         if exc_type is not None and issubclass(
             exc_type, QueryTimeoutError
         ):
@@ -152,6 +177,8 @@ class QueryScheduler:
     """Session-scoped admission + cancellation authority."""
 
     def __init__(self):
+        from ..resilience.watchdog import Watchdog
+
         self.pool = WeightedPermitPool()
         self._active: Dict[str, Admission] = {}
         self._lock = threading.Lock()
@@ -160,6 +187,12 @@ class QueryScheduler:
         # query's cache materialization) poll this so session shutdown
         # reaches them too
         self._cancel_epoch = 0
+        #: session circuit breaker (set by TpuSession) — watchdog stalls
+        #: attributed to an op signature feed it like kernel crashes do
+        self.breaker = None
+        #: progress watchdog — lazily spawns its scanner when a conf
+        #: enables it at admission (resilience/watchdog.py)
+        self.watchdog = Watchdog(self)
 
     @property
     def cancel_epoch(self) -> int:
@@ -173,9 +206,16 @@ class QueryScheduler:
         scheduler keys are per-query, never frozen at session init).
         ``pool`` overrides the conf's fair-share pool — the serving
         front-end admits each tenant under ITS pool without mutating the
-        shared session conf."""
+        shared session conf.
+
+        Deadline-aware load shedding happens HERE, before anything
+        queues: when ``scheduler.shedExpired`` holds and the query has a
+        deadline, a calibrated estimate of queue wait + run time that
+        already exceeds it raises the typed :class:`QueryOverloadedError`
+        (with a retry-after hint) instead of admitting work that cannot
+        finish."""
         from .. import config as cfg
-        from .estimate import permits_for_plan
+        from .estimate import CALIBRATION, estimate_plan_bytes, permits_for_plan
 
         enabled = cfg.SCHEDULER_ENABLED.get(conf)
         permits = cfg.SCHEDULER_PERMITS.get(conf)
@@ -184,15 +224,66 @@ class QueryScheduler:
             max_queued=cfg.SCHEDULER_MAX_QUEUED.get(conf),
             pools=parse_pool_spec(cfg.SCHEDULER_POOLS.get(conf)),
         )
+        self.watchdog.configure(conf)
         need = permits_for_plan(plan, conf, permits) if enabled else 1
+        est_bytes = estimate_plan_bytes(plan, conf) if enabled else 0
         timeout = cfg.SCHEDULER_QUERY_TIMEOUT_S.get(conf)
         token = CancelToken(
             query_id, timeout_s=timeout if timeout > 0 else None
         )
+        if (
+            enabled
+            and timeout > 0
+            and cfg.SCHEDULER_SHED_EXPIRED.get(conf)
+        ):
+            est_run = CALIBRATION.estimate_run_s(est_bytes)
+            est_wait = self.estimated_queue_wait_s()
+            # shed only under actual queue pressure: an uncontended query
+            # with a tight deadline keeps its normal timeout semantics
+            # (run estimates are rough; overload is what shedding is for)
+            if est_wait > 0 and est_run > 0 and est_wait + est_run > timeout:
+                hint = self.retry_after_hint()
+                _count_shed("deadline_unmeetable")
+                _M.counter("scheduler.rejected").add(1)
+                raise QueryOverloadedError(
+                    f"query {query_id} shed at admission: estimated queue "
+                    f"wait {est_wait:.2f}s + estimated run {est_run:.2f}s "
+                    f"exceeds its {timeout:g}s deadline "
+                    f"(spark.rapids.tpu.scheduler.shedExpired); retry after "
+                    f"~{hint:.1f}s",
+                    retry_after_s=hint,
+                    reason="deadline_unmeetable",
+                )
         pool_name = pool or cfg.SCHEDULER_POOL.get(conf) or "default"
-        return Admission(
+        adm = Admission(
             self, query_id, need, pool_name, token, enabled, tracer
         )
+        adm.est_bytes = est_bytes
+        return adm
+
+    # ── overload hints ──────────────────────────────────────────────────
+    def estimated_queue_wait_s(self) -> float:
+        """Calibrated guess at how long a NEW admission would queue:
+        queued queries ahead × average run time / effective parallelism
+        (0.0 while uncalibrated or idle)."""
+        from .estimate import CALIBRATION
+
+        depth = self.pool.queued
+        if depth <= 0:
+            return 0.0
+        avg = CALIBRATION.avg_run_s()
+        if avg <= 0:
+            return 0.0
+        return depth * avg / max(1, self.pool.effective_permits())
+
+    def retry_after_hint(self) -> float:
+        """When an overloaded scheduler should have capacity again: the
+        estimated drain time of the current queue plus one average run,
+        floored so clients never hot-spin."""
+        from .estimate import CALIBRATION
+
+        avg = CALIBRATION.avg_run_s()
+        return round(max(0.1, self.estimated_queue_wait_s() + avg), 3)
 
     # ── registry / cancellation ─────────────────────────────────────────
     def _register(self, adm: Admission) -> None:
@@ -204,6 +295,12 @@ class QueryScheduler:
             cur = self._active.get(adm.query_id)
             if cur is adm:
                 del self._active[adm.query_id]
+
+    def active_admissions(self) -> List[Admission]:
+        """Snapshot of every registered Admission object — the watchdog's
+        scan surface (tokens carry the beats/phases it classifies on)."""
+        with self._lock:
+            return list(self._active.values())
 
     def active_queries(self) -> Dict[str, dict]:
         """query_id → live view of every registered query (queued or
@@ -250,6 +347,8 @@ class QueryScheduler:
             "in_use": self.pool.in_use,
             "queued": self.pool.queued,
             "active": len(self._active),
+            "watchdog_running": self.watchdog.running,
+            "retry_after_hint_s": self.retry_after_hint(),
         }
         out.update(_M.view("scheduler.", strip=False))
         return out

@@ -18,15 +18,17 @@ streamed record batches), scaled to a framed socket protocol:
 (docs/serving.md; ``make serve`` for the TPC-H demo catalog).
 """
 from .client import Connection, PreparedHandle, ResultStream, connect
-from .protocol import ProtocolError, ServeError
-from .server import TpuServer
+from .protocol import FrameCorruptError, ProtocolError, ServeError
+from .server import ServerDrainingError, TpuServer
 
 __all__ = [
     "Connection",
+    "FrameCorruptError",
     "PreparedHandle",
     "ProtocolError",
     "ResultStream",
     "ServeError",
+    "ServerDrainingError",
     "TpuServer",
     "connect",
 ]

@@ -71,10 +71,21 @@ class ResultStream:
             try:
                 ftype, body = P.expect_frame(self._conn._sock, P.BATCH, P.END)
             except ServeError:
-                # an ERROR frame ends the stream (cancel, deadline, query
-                # failure) — the connection itself stays usable
+                # an ERROR frame ends the stream (cancel, deadline, server
+                # drain, query failure) — the connection itself stays
+                # usable; err.reason names the cause ('shutdown' when the
+                # server drained mid-stream)
                 self._done = True
                 self._conn._stream = None
+                raise
+            except BaseException as e:
+                # transport death (timeout, reset): the stream is over —
+                # clear it so the connection isn't wedged behind a
+                # misleading 'stream still open' error when it cannot (or
+                # chose not to) auto-reconnect
+                self._done = True
+                self._conn._stream = None
+                self._conn._mark_dead_on(e)
                 raise
             if ftype == P.END:
                 info = P.decode_json(body)
@@ -116,9 +127,19 @@ class ResultStream:
 
 class Connection:
     """One authenticated protocol connection. Not thread-safe; a thread
-    (or tenant task) owns its connection."""
+    (or tenant task) owns its connection.
 
-    def __init__(self, sock: socket.socket, hello: dict):
+    Robustness: ``op_timeout`` (socket timeout while waiting on replies)
+    turns a half-open socket — a silently dead server, a stalled NAT —
+    into a ``socket.timeout`` within bounds instead of a forever-hang;
+    any transport-level failure marks the connection dead, and the next
+    NEW query transparently redials (``reconnect=True``, the default) so
+    one blip costs one reconnect, not a poisoned connection object.
+    Prepared statements are connection-scoped server-side: after a
+    reconnect, re-``prepare`` (a stale handle answers a typed error)."""
+
+    def __init__(self, sock: socket.socket, hello: dict,
+                 dial: Optional[dict] = None, reconnect: bool = True):
         self._sock = sock
         self.tenant = hello.get("tenant")
         self.pool = hello.get("pool")
@@ -128,24 +149,66 @@ class Connection:
         # acks them as standalone commands, so that many CANCEL_OK frames
         # precede the next real reply and must be skipped
         self._stale_cancel_oks = 0
+        self._dial = dial or {}
+        self._auto_reconnect = reconnect and bool(dial)
+        self._dead = False
 
     # ── queries ─────────────────────────────────────────────────────────
     def _begin(self) -> None:
+        if self._dead and self._auto_reconnect:
+            self._reconnect()
         if self._stream is not None and not self._stream._done:
             raise ProtocolError(
                 "a result stream is still open on this connection — drain "
                 "or cancel it before issuing the next command"
             )
 
+    def _reconnect(self) -> None:
+        """Redial + re-HELLO on the remembered address (new queries only;
+        an in-flight stream on the dead socket is already lost)."""
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        fresh = connect(reconnect=False, **self._dial)
+        self._sock = fresh._sock
+        self.tenant, self.pool = fresh.tenant, fresh.pool
+        self.protocol = fresh.protocol
+        self._stream = None
+        self._stale_cancel_oks = 0
+        self._dead = False
+
+    def _mark_dead_on(self, e: BaseException) -> None:
+        # transport-level failures poison the socket; typed ServeErrors
+        # do NOT (the protocol keeps the connection alive across them)
+        if isinstance(e, (OSError, socket.timeout, P.ConnectionClosed)) or (
+            isinstance(e, ProtocolError) and not isinstance(e, ServeError)
+        ):
+            self._dead = True
+
     def _reply(self, *ftypes: int):
-        """expect_frame + stale-CANCEL_OK skipping (see _stale_cancel_oks)."""
-        while True:
-            want = ftypes + ((P.CANCEL_OK,) if self._stale_cancel_oks else ())
-            ftype, body = P.expect_frame(self._sock, *want)
-            if ftype == P.CANCEL_OK and P.CANCEL_OK not in ftypes:
-                self._stale_cancel_oks -= 1
-                continue
-            return ftype, body
+        """expect_frame + stale-CANCEL_OK skipping (see _stale_cancel_oks);
+        transport failures mark the connection dead for reconnect."""
+        try:
+            while True:
+                want = ftypes + (
+                    (P.CANCEL_OK,) if self._stale_cancel_oks else ()
+                )
+                ftype, body = P.expect_frame(self._sock, *want)
+                if ftype == P.CANCEL_OK and P.CANCEL_OK not in ftypes:
+                    self._stale_cancel_oks -= 1
+                    continue
+                return ftype, body
+        except BaseException as e:
+            self._mark_dead_on(e)
+            raise
+
+    def _send(self, ftype: int, obj: dict) -> None:
+        try:
+            P.send_json(self._sock, ftype, obj)
+        except OSError:
+            self._dead = True
+            raise
 
     def _fetch(self, result: dict) -> ResultStream:
         schema = ipc.schema_from_bytes(
@@ -157,7 +220,7 @@ class Connection:
             schema,
             cache_hit=bool(result.get("cache_hit")),
         )
-        P.send_json(self._sock, P.FETCH, {"query_id": result["query_id"]})
+        self._send(P.FETCH, {"query_id": result["query_id"]})
         self._stream = stream
         return stream
 
@@ -167,13 +230,13 @@ class Connection:
         req = {"sql": text}
         if params is not None:
             req["params"] = params
-        P.send_json(self._sock, P.EXECUTE, req)
+        self._send(P.EXECUTE, req)
         _, body = self._reply(P.RESULT)
         return self._fetch(P.decode_json(body))
 
     def prepare(self, text: str) -> PreparedHandle:
         self._begin()
-        P.send_json(self._sock, P.PREPARE, {"sql": text})
+        self._send(P.PREPARE, {"sql": text})
         _, body = self._reply(P.PREPARE_OK)
         info = P.decode_json(body)
         return PreparedHandle(info["statement_id"], info["n_params"], text)
@@ -184,8 +247,8 @@ class Connection:
         """EXECUTE_PREPARED + FETCH: run a prepared statement with bound
         parameters (the prepared-plan-cache path)."""
         self._begin()
-        P.send_json(
-            self._sock, P.EXECUTE_PREPARED,
+        self._send(
+            P.EXECUTE_PREPARED,
             {"statement_id": stmt.statement_id, "params": params or []},
         )
         _, body = self._reply(P.RESULT)
@@ -196,7 +259,7 @@ class Connection:
         """Cancel a query by id (usable from a second connection for a
         query streaming elsewhere). Returns whether the server found it."""
         self._begin()
-        P.send_json(self._sock, P.CANCEL, {"query_id": query_id})
+        self._send(P.CANCEL, {"query_id": query_id})
         while True:
             _, body = P.expect_frame(self._sock, P.CANCEL_OK)
             info = P.decode_json(body)
@@ -208,12 +271,30 @@ class Connection:
             return bool(info.get("found"))
 
     def status(self) -> dict:
-        """Server-side live view: active queries (pool, permits, queue
-        wait), scheduler pool state, serve metrics, prepared-cache stats."""
+        """Server-side live view: liveness/readiness/draining, active
+        queries (pool, permits, queue wait), scheduler pool state, serve
+        metrics, prepared-cache stats."""
         self._begin()
-        P.send_json(self._sock, P.STATUS, {})
+        self._send(P.STATUS, {})
         _, body = self._reply(P.STATUS_OK)
         return P.decode_json(body)
+
+    def wait_ready(self, timeout: float = 30.0, poll_s: float = 0.1) -> bool:
+        """Poll STATUS until the server reports ``ready`` (warm pool
+        primed, not draining) — the client side of the rolling-restart
+        contract. Returns False on timeout."""
+        import time as _time
+
+        deadline = _time.monotonic() + timeout
+        while True:
+            try:
+                if self.status().get("ready"):
+                    return True
+            except ServeError:
+                pass  # e.g. draining rejections racing the poll
+            if _time.monotonic() >= deadline:
+                return False
+            _time.sleep(poll_s)
 
     def close(self) -> None:
         try:
@@ -238,17 +319,31 @@ def connect(
     port: int = 8045,
     token: Optional[str] = None,
     timeout: Optional[float] = 30.0,
+    op_timeout: Optional[float] = None,
+    reconnect: bool = True,
 ) -> Connection:
     """Open + authenticate one connection (HELLO → HELLO_OK). ``token``
     selects the tenant/pool under ``spark.rapids.tpu.serve.tenants``;
-    omit it against an open server."""
+    omit it against an open server.
+
+    ``timeout`` bounds the dial+HELLO; ``op_timeout`` (None = wait
+    forever) is the per-reply socket timeout afterwards — the half-open-
+    socket bound: a silently dead server surfaces as ``socket.timeout``
+    and the connection marks itself dead, so the next new query redials
+    (``reconnect``)."""
     sock = socket.create_connection((host, port), timeout=timeout)
     sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-    sock.settimeout(None)
-    P.send_json(sock, P.HELLO, {"token": token or "", "client": "python"})
+    # the dial timeout (still armed from create_connection) bounds the
+    # HELLO exchange too — a server that accepts but never greets must
+    # not hang the client; op_timeout takes over for the session proper
     try:
+        P.send_json(sock, P.HELLO, {"token": token or "", "client": "python"})
         _, body = P.expect_frame(sock, P.HELLO_OK)
     except BaseException:
         sock.close()
         raise
-    return Connection(sock, P.decode_json(body))
+    sock.settimeout(op_timeout)
+    dial = {"host": host, "port": port, "token": token, "timeout": timeout,
+            "op_timeout": op_timeout}
+    return Connection(sock, P.decode_json(body), dial=dial,
+                      reconnect=reconnect)

@@ -4,7 +4,13 @@ The shape follows Arrow Flight SQL's design (typed SQL-over-Arrow-IPC RPC
 with prepared statements and streamed record batches) scaled down to a
 length-prefixed socket protocol: every frame is
 
-    ``<u32 little-endian body length> <u8 frame type> <body>``
+    ``<u32 little-endian body length> <u8 frame type> <u32 CRC32C> <body>``
+
+The checksum covers the body (``utils/checksum.py`` — CRC32C with a
+documented zlib fallback when no native implementation exists); a
+mismatch raises the typed :class:`FrameCorruptError`, which is
+connection-fatal on both ends — a flipped bit must close the stream
+cleanly, never reach the Arrow decoder.
 
 Control frames carry UTF-8 JSON bodies; result data travels as ``BATCH``
 frames whose body is one self-contained Arrow IPC stream
@@ -40,7 +46,14 @@ import socket
 import struct
 from typing import Optional, Tuple
 
-PROTOCOL_VERSION = 1
+from ..obs import metrics as obs_metrics
+from ..utils.checksum import frame_checksum
+
+_M_CORRUPT = obs_metrics.GLOBAL.counter("serve.corruptFrames")
+
+#: v2 added the per-frame CRC32C (ISSUE 7); both ends share this module,
+#: so the version is informational, not negotiated
+PROTOCOL_VERSION = 2
 
 # frame types (u8)
 HELLO = 1
@@ -70,7 +83,7 @@ FRAME_NAMES = {
     ERROR: "ERROR", BYE: "BYE",
 }
 
-_HEADER = struct.Struct("<IB")
+_HEADER = struct.Struct("<IBI")
 
 #: one frame may not exceed this (a corrupt length prefix must not drive a
 #: multi-GB allocation); streamed results re-chunk well below it
@@ -85,12 +98,18 @@ class ConnectionClosed(ProtocolError):
     """Peer closed the socket mid-conversation."""
 
 
+class FrameCorruptError(ProtocolError):
+    """A frame's body failed its CRC32C — wire corruption or a framing
+    bug. Connection-fatal: nothing downstream of a corrupt length/body
+    can be trusted, so both ends close the connection cleanly."""
+
+
 def send_frame(sock: socket.socket, ftype: int, body: bytes = b"") -> None:
     if len(body) > MAX_FRAME_BYTES:
         raise ProtocolError(
             f"frame body {len(body)} bytes exceeds MAX_FRAME_BYTES"
         )
-    sock.sendall(_HEADER.pack(len(body), ftype) + body)
+    sock.sendall(_HEADER.pack(len(body), ftype, frame_checksum(body)) + body)
 
 
 def send_json(sock: socket.socket, ftype: int, obj: dict) -> None:
@@ -111,12 +130,18 @@ def _recv_exactly(sock: socket.socket, n: int) -> bytes:
 
 def recv_frame(sock: socket.socket) -> Tuple[int, bytes]:
     header = _recv_exactly(sock, _HEADER.size)
-    length, ftype = _HEADER.unpack(header)
+    length, ftype, crc = _HEADER.unpack(header)
     if length > MAX_FRAME_BYTES:
         raise ProtocolError(
             f"frame length {length} exceeds MAX_FRAME_BYTES (corrupt stream?)"
         )
     body = _recv_exactly(sock, length) if length else b""
+    if frame_checksum(body) != crc:
+        _M_CORRUPT.add(1)
+        raise FrameCorruptError(
+            f"frame checksum mismatch on {FRAME_NAMES.get(ftype, ftype)} "
+            f"({length} bytes) — closing the connection"
+        )
     return ftype, body
 
 
@@ -141,6 +166,8 @@ def expect_frame(sock: socket.socket, *ftypes: int) -> Tuple[int, bytes]:
             error_type=info.get("type", ""),
             reason=info.get("reason", ""),
             query_id=info.get("query_id"),
+            code=info.get("code", ""),
+            retry_after_s=float(info.get("retry_after_s") or 0.0),
         )
     if ftype not in ftypes:
         want = "/".join(FRAME_NAMES.get(t, str(t)) for t in ftypes)
@@ -153,7 +180,10 @@ def expect_frame(sock: socket.socket, *ftypes: int) -> Tuple[int, bytes]:
 class ServeError(RuntimeError):
     """A server-reported error (the client-side rendering of an ERROR
     frame): ``error_type`` names the server-side exception class,
-    ``reason`` carries a cancel reason when the query was cancelled."""
+    ``reason`` carries a cancel reason when the query was cancelled,
+    ``code`` is the machine-readable class (``OVERLOADED`` /
+    ``DRAINING``), and ``retry_after_s`` the backoff hint attached to
+    overload rejections."""
 
     def __init__(
         self,
@@ -161,8 +191,12 @@ class ServeError(RuntimeError):
         error_type: str = "",
         reason: str = "",
         query_id: Optional[str] = None,
+        code: str = "",
+        retry_after_s: float = 0.0,
     ):
         super().__init__(message)
         self.error_type = error_type
         self.reason = reason
         self.query_id = query_id
+        self.code = code
+        self.retry_after_s = retry_after_s

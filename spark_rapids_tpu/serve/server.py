@@ -44,7 +44,12 @@ import pyarrow as pa
 from .. import config as cfg
 from ..columnar import ipc
 from ..obs import metrics as obs_metrics
-from ..sched import QueryCancelledError, SchedulerError
+from ..sched import (
+    QueryCancelledError,
+    QueryOverloadedError,
+    QueryQueueFull,
+    SchedulerError,
+)
 from ..sql.parser import SqlError
 from . import protocol as P
 from .prepared import PreparedPlanCache, PreparedStatement
@@ -55,6 +60,16 @@ _log = logging.getLogger(__name__)
 
 class _ClientGone(Exception):
     """The client socket died mid-stream (disconnect-as-cancellation)."""
+
+
+class ServerDrainingError(RuntimeError):
+    """New work refused because the server is draining (``drain()`` /
+    SIGTERM); the ERROR frame carries code=DRAINING and the drain reason
+    so clients fail over instead of retrying this endpoint."""
+
+    def __init__(self, message: str, reason: str = "shutdown"):
+        super().__init__(message)
+        self.reason = reason
 
 
 class _Tenant:
@@ -118,6 +133,7 @@ class TpuServer:
         session,
         host: Optional[str] = None,
         port: Optional[int] = None,
+        warmup: Optional[list] = None,
     ):
         self.session = session
         conf = session.conf
@@ -131,6 +147,28 @@ class TpuServer:
         self._conns: set = set()
         self._conn_lock = threading.Lock()
         self._stopping = threading.Event()
+        # ── survivability state ─────────────────────────────────────────
+        #: drain(): stop accepting, finish in-flight, then cancel
+        self._draining = threading.Event()
+        self._drain_reason = "shutdown"
+        #: readiness: set once the warm pool is primed (immediately when
+        #: no warmup statements exist) — the rolling-restart gate
+        self._ready = threading.Event()
+        #: SQL statements planned+precompiled before ready flips; the
+        #: conf (spark.rapids.tpu.serve.warmupStatements) supplies them
+        #: when the constructor doesn't
+        raw_warm = cfg.SERVE_WARMUP_STATEMENTS.get(conf) or ""
+        self._warmup = list(warmup) if warmup else [
+            s.strip() for s in raw_warm.split(";") if s.strip()
+        ]
+        self._warmup_thread: Optional[threading.Thread] = None
+        #: in-flight FETCH streams (drain waits on these)
+        self._inflight = 0
+        self._inflight_cond = threading.Condition()
+        #: per-tenant connection / in-flight-query occupancy (the caps
+        #: that stop one tenant wedging the accept loop for everyone)
+        self._tenant_conns: Dict[str, int] = {}
+        self._tenant_inflight: Dict[str, int] = {}
         #: (tenant, wait_s, run_s) per served query — the SLO bench's
         #: percentile source (bounded; aggregate totals live in serve.*)
         self.latency_samples: deque = deque(maxlen=8192)
@@ -147,16 +185,92 @@ class TpuServer:
             target=self._accept_loop, name="tpu-serve-accept", daemon=True
         )
         self._accept_thread.start()
+        if self._warmup:
+            self._warmup_thread = threading.Thread(
+                target=self._run_warmup, name="tpu-serve-warmup", daemon=True
+            )
+            self._warmup_thread.start()
+        else:
+            self._ready.set()
         _log.info("serving on %s:%d", self.host, self.port)
         return self.host, self.port
 
+    def _run_warmup(self) -> None:
+        """Prime the precompile warm pool: plan every warmup statement
+        (session._prepare_plan runs the kernel pre-compilation pass), then
+        flip readiness. A failed statement logs and is skipped — a typo
+        must not hold the server not-ready forever."""
+        for text in self._warmup:
+            if self._stopping.is_set() or self._draining.is_set():
+                return
+            try:
+                df = self.session.sql(text)
+                self.session._prepare_plan(df._plan)
+            except Exception:  # noqa: BLE001 - warmup is best-effort
+                _log.warning("warmup statement failed: %r", text[:120],
+                             exc_info=True)
+        self._ready.set()
+        _log.info("warm pool primed (%d statements); server READY",
+                  len(self._warmup))
+
+    def is_ready(self) -> bool:
+        """Readiness for traffic: warm pool primed and not draining (the
+        STATUS ``ready`` field operators roll restarts on)."""
+        return (
+            self._ready.is_set()
+            and not self._draining.is_set()
+            and not self._stopping.is_set()
+        )
+
+    def drain(self, timeout: Optional[float] = None,
+              reason: str = "shutdown") -> bool:
+        """Graceful shutdown: stop accepting connections, answer new work
+        with a typed DRAINING error, let in-flight streams finish up to
+        ``timeout`` (default ``spark.rapids.tpu.serve.drainTimeout``),
+        then cancel the stragglers with ``reason`` — every stream still
+        ends with a typed END/ERROR frame. Returns True when all
+        in-flight work finished without cancellation. Idempotent; called
+        by the SIGTERM handler."""
+        if timeout is None:
+            timeout = cfg.SERVE_DRAIN_TIMEOUT_S.get(self.session.conf)
+        first = not self._draining.is_set()
+        self._drain_reason = reason
+        self._draining.set()
+        if first:
+            _M.gauge("serve.draining").set(1)
+            _log.info("draining (timeout %.1fs, reason %r)", timeout, reason)
+        self._close_listener()  # stop accepting; handler conns live on
+        deadline = time.monotonic() + max(0.0, timeout)
+        with self._inflight_cond:
+            while self._inflight > 0:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    break
+                self._inflight_cond.wait(min(remaining, 0.1))
+            clean = self._inflight == 0
+        if not clean:
+            n = self.session.cancel_all(reason)
+            _M.counter("serve.drainCancelled").add(n)
+            _log.warning(
+                "drain timeout: cancelled %d in-flight queries (%s)",
+                n, reason,
+            )
+            # the cancelled streams unwind to their typed ERROR frames;
+            # give them one bounded window to do so
+            with self._inflight_cond:
+                end = time.monotonic() + 5.0
+                while self._inflight > 0 and time.monotonic() < end:
+                    self._inflight_cond.wait(0.1)
+        self.stop()
+        return clean
+
     def stop(self) -> None:
         self._stopping.set()
-        if self._sock is not None:
-            try:
-                self._sock.close()
-            except OSError:
-                pass
+        self._ready.clear()
+        # the draining gauge is per-server state in a process-wide
+        # registry: a stopped server must not pin it at 1
+        _M.gauge("serve.draining").set(0)
+        self._close_listener()
         with self._conn_lock:
             conns = list(self._conns)
         for c in conns:
@@ -179,13 +293,38 @@ class TpuServer:
         self.stop()
         return False
 
+    def _close_listener(self) -> None:
+        """Close the listening socket AND unblock the accept thread: a
+        plain close() leaves a thread blocked in accept() holding the
+        kernel listener alive (in-flight syscalls pin the file), so a
+        'drained' server would silently keep accepting — shutdown() makes
+        the blocked accept return immediately."""
+        sock = self._sock
+        if sock is None:
+            return
+        try:
+            sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        try:
+            sock.close()
+        except OSError:
+            pass
+
     # ── accept / connection handling ────────────────────────────────────
     def _accept_loop(self) -> None:
-        while not self._stopping.is_set():
+        while not self._stopping.is_set() and not self._draining.is_set():
             try:
                 conn, addr = self._sock.accept()
             except OSError:
-                return  # listener closed by stop()
+                return  # listener closed by stop()/drain()
+            if self._stopping.is_set() or self._draining.is_set():
+                # raced the shutdown: never serve a post-drain connection
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+                return
             threading.Thread(
                 target=self._handle_conn,
                 args=(conn, addr),
@@ -205,7 +344,8 @@ class TpuServer:
             try:
                 P.send_json(
                     sock, P.ERROR,
-                    {"type": "ConnectionLimit",
+                    {"type": "ConnectionLimit", "code": "OVERLOADED",
+                     "retry_after_s": self.session.scheduler.retry_after_hint(),
                      "error": "server connection limit reached"},
                 )
             except OSError:
@@ -215,6 +355,7 @@ class TpuServer:
         sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         _M.gauge("serve.connectionsActive").set(len(self._conns))
         tenant: Optional[_Tenant] = None
+        tenant_counted = False
         pending: Dict[str, _PendingQuery] = {}
         # prepared statements are CONNECTION-scoped (the Flight SQL session
         # model): dropped with the connection, so a churning client fleet
@@ -225,9 +366,37 @@ class TpuServer:
             tenant = self._hello(sock)
             if tenant is None:
                 return
+            # per-tenant connection cap: one tenant's connection storm is
+            # refused at HELLO time, before it can occupy handler threads
+            cap = cfg.SERVE_MAX_CONNECTIONS_PER_TENANT.get(self.session.conf)
+            with self._conn_lock:
+                held = self._tenant_conns.get(tenant.name, 0)
+                if cap > 0 and held >= cap:
+                    over_tenant = True
+                else:
+                    over_tenant = False
+                    self._tenant_conns[tenant.name] = held + 1
+                    tenant_counted = True
+            if over_tenant:
+                _M.counter("serve.connectionsRejected").add(1)
+                P.send_json(
+                    sock, P.ERROR,
+                    {"type": "ConnectionLimit", "code": "OVERLOADED",
+                     "retry_after_s":
+                         self.session.scheduler.retry_after_hint(),
+                     "error": f"tenant {tenant.name!r} is at its "
+                              f"connection limit ({cap})"},
+                )
+                return
             while not self._stopping.is_set():
                 try:
                     ftype, body = P.recv_frame(sock)
+                except P.FrameCorruptError as e:
+                    # the typed corrupt-frame close: name the cause on the
+                    # way out, then drop the connection — nothing after a
+                    # bad checksum can be trusted
+                    self._send_error(sock, e)
+                    return
                 except (P.ConnectionClosed, OSError):
                     return
                 if ftype == P.BYE:
@@ -251,6 +420,12 @@ class TpuServer:
                 pq.cancelled_reason = "client disconnect"
             with self._conn_lock:
                 self._conns.discard(sock)
+                if tenant_counted and tenant is not None:
+                    n = self._tenant_conns.get(tenant.name, 1) - 1
+                    if n <= 0:
+                        self._tenant_conns.pop(tenant.name, None)
+                    else:
+                        self._tenant_conns[tenant.name] = n
             _M.gauge("serve.connectionsActive").set(len(self._conns))
             try:
                 sock.close()
@@ -258,7 +433,11 @@ class TpuServer:
                 pass
 
     def _hello(self, sock: socket.socket) -> Optional[_Tenant]:
-        sock.settimeout(30.0)
+        # slow-loris connects: a dribbling (or silent) HELLO holds only
+        # this handler thread, and only until the deadline
+        sock.settimeout(max(0.05, cfg.SERVE_HELLO_TIMEOUT_S.get(
+            self.session.conf
+        )))
         try:
             ftype, body = P.recv_frame(sock)
         except (P.ConnectionClosed, OSError, socket.timeout):
@@ -298,6 +477,16 @@ class TpuServer:
 
     # ── command dispatch ────────────────────────────────────────────────
     def _dispatch(self, sock, tenant, pending, statements, ftype, body) -> None:
+        if self._draining.is_set() and ftype in (
+            P.EXECUTE, P.PREPARE, P.BIND, P.EXECUTE_PREPARED, P.FETCH
+        ):
+            # drain contract: no NEW work once draining; STATUS and CANCEL
+            # stay answerable so operators can watch the drain complete
+            raise ServerDrainingError(
+                f"server is draining ({self._drain_reason}); no new "
+                "queries are accepted",
+                reason=self._drain_reason,
+            )
         if ftype == P.EXECUTE:
             self._cmd_execute(sock, tenant, pending, P.decode_json(body))
         elif ftype == P.PREPARE:
@@ -393,6 +582,13 @@ class TpuServer:
             {
                 "tenant": tenant.name,
                 "pool": tenant.pool,
+                # lifecycle for operators: live is this process answering
+                # at all; ready gates traffic shifting (warm pool primed,
+                # not draining) — the rolling-restart contract
+                "live": True,
+                "ready": self.is_ready(),
+                "draining": self._draining.is_set(),
+                "inflight": self._inflight,
                 "active": self.session.active_queries(),
                 "scheduler": self.session.scheduler.state(),
                 "serve": _M.view("serve.", strip=False),
@@ -406,6 +602,34 @@ class TpuServer:
         pq = pending.pop(qid, None)
         if pq is None:
             raise SqlError(f"unknown or already-fetched query_id {qid!r}")
+        cap = cfg.SERVE_MAX_INFLIGHT_PER_TENANT.get(self.session.conf)
+        with self._inflight_cond:
+            held = self._tenant_inflight.get(tenant.name, 0)
+            if cap > 0 and held >= cap:
+                pending[qid] = pq  # still fetchable once the tenant drains
+                # counted once in _send_error when the OVERLOADED frame
+                # actually goes out — not here too
+                raise QueryOverloadedError(
+                    f"tenant {tenant.name!r} is at its in-flight query "
+                    f"limit ({cap}); retry after the hint",
+                    retry_after_s=self.session.scheduler.retry_after_hint(),
+                    reason="tenant_inflight",
+                )
+            self._tenant_inflight[tenant.name] = held + 1
+            self._inflight += 1
+        try:
+            self._fetch_stream(sock, tenant, pq, qid)
+        finally:
+            with self._inflight_cond:
+                self._inflight -= 1
+                n = self._tenant_inflight.get(tenant.name, 1) - 1
+                if n <= 0:
+                    self._tenant_inflight.pop(tenant.name, None)
+                else:
+                    self._tenant_inflight[tenant.name] = n
+                self._inflight_cond.notify_all()
+
+    def _fetch_stream(self, sock, tenant, pq: _PendingQuery, qid: str) -> None:
         _M.counter("serve.queries").add(1)
         _M.counter(f"serve.tenant.{_metric_slug(tenant.name)}.queries").add(1)
         max_rows = max(1, cfg.SERVE_STREAM_BATCH_ROWS.get(self.session.conf))
@@ -486,9 +710,24 @@ class TpuServer:
             self.session._leak_check(pq.ctx)
 
     def _send_batch(self, sock, token, rb: pa.RecordBatch) -> None:
+        from ..resilience.watchdog import stall_phase
+
         payload = ipc.write_batch(rb)
+        send_timeout = cfg.SERVE_SEND_TIMEOUT_S.get(self.session.conf)
         try:
-            P.send_frame(sock, P.BATCH, payload)
+            # phase 'client' + a bounded send: a reader that stopped
+            # draining its socket (slow loris) classifies as a CLIENT
+            # stall on the watchdog and times out here — its query
+            # cancels and the permits free, instead of a forever-blocked
+            # sendall pinning the tenant's capacity
+            with stall_phase("client", token=token):
+                if send_timeout > 0:
+                    sock.settimeout(send_timeout)
+                try:
+                    P.send_frame(sock, P.BATCH, payload)
+                finally:
+                    if send_timeout > 0:
+                        sock.settimeout(None)
         except OSError:
             # disconnect-as-cancellation: the admission context releases
             # the permits as the typed error unwinds, and the
@@ -538,8 +777,22 @@ class TpuServer:
             "type": type(e).__name__,
             "error": str(e)[:2000],
         }
-        if isinstance(e, (QueryCancelledError, SchedulerError)):
+        if isinstance(e, (QueryCancelledError, SchedulerError,
+                          ServerDrainingError)):
             info["reason"] = getattr(e, "reason", "") or ""
+        if isinstance(e, (QueryQueueFull, QueryOverloadedError)):
+            # the typed overload contract: a machine-readable code plus a
+            # computed retry-after, so clients back off instead of
+            # hammering a saturated scheduler (visible server-side as the
+            # scheduler.shed.reason.* / scheduler.rejected series)
+            info["code"] = "OVERLOADED"
+            info["retry_after_s"] = (
+                getattr(e, "retry_after_s", 0.0)
+                or self.session.scheduler.retry_after_hint()
+            )
+            _M.counter("serve.overloaded").add(1)
+        elif isinstance(e, ServerDrainingError):
+            info["code"] = "DRAINING"
         if query_id is not None:
             info["query_id"] = query_id
         try:

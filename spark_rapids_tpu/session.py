@@ -40,9 +40,18 @@ def _token_checked(thunk, token):
         return thunk
 
     def it():
-        for rb in thunk():
-            token.check()
-            yield rb
+        # install the token as this worker thread's watchdog current so
+        # blocking regions beneath the pull (kernel compile, shuffle
+        # fetch) can label their stall phase; every check() is a beat
+        from .resilience import watchdog as _wd
+
+        _wd.set_current(token)
+        try:
+            for rb in thunk():
+                token.check()
+                yield rb
+        finally:
+            _wd.set_current(None)
 
     return it
 
@@ -121,6 +130,11 @@ class TpuSession:
         from .resilience import CircuitBreaker
 
         self._breaker = CircuitBreaker.from_conf(self.conf)
+        # survivability wiring: the watchdog feeds op-attributed stalls to
+        # this session's breaker, and the first-touch compile budget is
+        # process-global like the kernel cache it guards
+        self._scheduler.breaker = self._breaker
+        K.set_compile_deadline(cfg.COMPILE_DEADLINE_S.get(self.conf))
         self._fault_injector = self._build_fault_injector()
         if cfg.MULTIPROC_DRIVER.get(self.conf):
             # fail fast on inconsistent multi-process settings — a missing
@@ -244,6 +258,10 @@ class TpuSession:
         self.conf = self.conf.set(key, value)
         if key.startswith("spark.rapids.tpu.faults."):
             self._fault_injector = self._build_fault_injector()
+        if key == cfg.COMPILE_DEADLINE_S.key:
+            from . import kernels as K
+
+            K.set_compile_deadline(cfg.COMPILE_DEADLINE_S.get(self.conf))
 
     # ── execution ───────────────────────────────────────────────────────
     def _resolve_subqueries(self, lp: L.LogicalPlan) -> L.LogicalPlan:
@@ -635,13 +653,19 @@ class TpuSession:
         assertion can only fail again — and so can a cancelled or
         deadline-expired query (sched/ errors never retry)."""
         from .expr.base import AnsiError
+        from .resilience import CompileDeadlineError
         from .sched import SchedulerError
 
         last: Optional[Exception] = None
         for attempt in range(max(1, attempts)):
             try:
                 return list(thunk())
-            except (AssertionError, AnsiError, SchedulerError):
+            except (AssertionError, AnsiError, SchedulerError,
+                    CompileDeadlineError):
+                # a blown compile budget is never task-retried: the retry
+                # would re-enter the same compile and burn the budget
+                # again; the breaker is already forced open, so the
+                # caller's NEXT run plans the op on CPU
                 raise
             except Exception as e:  # noqa: BLE001 - Spark retries any task failure
                 last = e

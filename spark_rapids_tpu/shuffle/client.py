@@ -129,9 +129,12 @@ class ShuffleClient:
 
     # ── fetch orchestration ─────────────────────────────────────────────
     def _request_metadata(self, blocks: List[M.BlockId]) -> List[M.TableMeta]:
+        from ..resilience.watchdog import stall_phase
+
         tx = self._conn.request(REQ_METADATA, M.pack_metadata_request(blocks))
         try:
-            tx.wait(self._timeout)
+            with stall_phase("fetch", f"peer:{self._peer_id}"):
+                tx.wait(self._timeout)
         except TimeoutError as e:
             # FetchFailedException semantics: timeouts are fetch failures
             # (stage retry), not task-killing runtime errors
@@ -286,12 +289,18 @@ class ShuffleClient:
                     )
                     return
 
+        from ..resilience.watchdog import stall_phase
+
         issuer = threading.Thread(target=issue, daemon=True)
         issuer.start()
         try:
             for _ in range(len(metas)):
                 try:
-                    item = completions.get(timeout=self._timeout)
+                    # the wait for remote frames is a legit long beat gap:
+                    # phase-label it so a watchdog stall here reads
+                    # 'stall:fetch' (dead peer), not a device wedge
+                    with stall_phase("fetch", f"peer:{self._peer_id}"):
+                        item = completions.get(timeout=self._timeout)
                 except queue.Empty:
                     raise ShuffleFetchError(
                         f"timed out waiting for shuffle data from "

@@ -17,7 +17,32 @@ from __future__ import annotations
 
 import threading
 import time
+import weakref
 from typing import Callable, Dict, List, Optional
+
+# Live managers in this process (weak: a dropped manager must be
+# collectable). The watchdog's periodic sweep (resilience/watchdog.py,
+# spark.rapids.tpu.watchdog.evictStalePeriod) walks this so dead peers are
+# evicted even when no executor explicitly heartbeats — before this,
+# eviction only ever happened inside heartbeat()/evict_stale() calls.
+_MANAGERS: "weakref.WeakSet[ShuffleHeartbeatManager]" = weakref.WeakSet()
+_MANAGERS_LOCK = threading.Lock()
+
+
+def evict_stale_all(max_age_s: float) -> List[str]:
+    """Sweep every live ShuffleHeartbeatManager in the process; returns
+    the evicted executor ids across all registries."""
+    if max_age_s <= 0:
+        return []
+    with _MANAGERS_LOCK:
+        managers = list(_MANAGERS)
+    dead: List[str] = []
+    for m in managers:
+        try:
+            dead.extend(m.evict_stale(max_age_s))
+        except Exception:  # noqa: BLE001 - one bad registry never stops the sweep
+            pass
+    return dead
 
 
 class ExecutorInfo:
@@ -40,6 +65,8 @@ class ShuffleHeartbeatManager:
         self._entries: List[tuple] = []  # [(version, ExecutorInfo)]
         self._last_seen: Dict[str, int] = {}  # executor -> version high-water
         self._last_beat: Dict[str, float] = {}  # executor -> last heartbeat
+        with _MANAGERS_LOCK:
+            _MANAGERS.add(self)
 
     def register_executor(self, executor_id: str, address: Optional[tuple] = None) -> List[ExecutorInfo]:
         """First contact: returns ALL currently known peers
@@ -112,9 +139,11 @@ class ShuffleHeartbeatManager:
                 self._last_beat.pop(eid, None)
                 self._last_seen.pop(eid, None)
         if dead:
+            from ..obs.metrics import GLOBAL as _obs
             from ..resilience import retry as R
 
             R.record("peers_evicted", len(dead))
+            _obs.counter("shuffle.evictedStale").add(len(dead))
         return dead
 
     def evict(self, executor_id: str) -> bool:

@@ -38,7 +38,15 @@ _RESPONSE = 2
 _DATA = 3
 _ERROR = 4
 
-_HEADER = struct.Struct("<bqqi")  # kind, a (req_id|tag), b (req_type|unused), len
+# kind, a (req_id|tag), b (req_type|unused), len, crc (CRC32C of the
+# payload, DATA frames only — control frames ride the reliable RPC layer
+# and a corrupt one already fails loudly at unpack)
+_HEADER = struct.Struct("<bqqiI")
+
+from ..obs.metrics import GLOBAL as _obs_registry
+from ..utils.checksum import frame_checksum as _crc
+
+_M_CORRUPT = _obs_registry.counter("shuffle.corruptFrames")
 
 # DCN condition injection: loopback multiproc tests exercise throttle and
 # bounce-buffer sizing under realistic latency/bandwidth (the reference
@@ -65,21 +73,31 @@ def set_injection(latency_ms: float = 0.0, bandwidth_mbps: float = 0.0) -> None:
 
 
 def _send_frame(sock: socket.socket, lock: threading.Lock, kind: int, a: int, b: int, payload: bytes):
+    crc = 0
     if kind == _DATA:
         # deterministic fault injection (resilience/faults.py): DATA frames
-        # may be dropped or delayed — the fetch layer's timeout + retry is
-        # what recovers. Control frames stay reliable (a lossy link under a
-        # reliable RPC layer).
+        # may be dropped, delayed, or bit-flipped — the fetch layer's
+        # timeout + retry (and the receiver's CRC check) is what recovers.
+        # Control frames stay reliable (a lossy link under a reliable RPC
+        # layer).
         from ..resilience import faults as _faults
 
         if _faults._ACTIVE is not None and _faults.drop_tcp_data_frame():
             return
+        crc = _crc(payload)
+        if _faults._ACTIVE is not None and payload and \
+                _faults.corrupt_tcp_data_frame():
+            # flip one byte AFTER stamping the checksum: the receiver's
+            # CRC verification is the thing under test
+            corrupted = bytearray(payload)
+            corrupted[len(corrupted) // 2] ^= 0xFF
+            payload = bytes(corrupted)
     with lock:
         if _INJECT["latency_s"] > 0:
             _time.sleep(_INJECT["latency_s"])
         if _INJECT["bw_bps"] > 0 and payload:
             _time.sleep(len(payload) / _INJECT["bw_bps"])
-        sock.sendall(_HEADER.pack(kind, a, b, len(payload)) + payload)
+        sock.sendall(_HEADER.pack(kind, a, b, len(payload), crc) + payload)
 
 
 def _recv_exact(sock: socket.socket, n: int) -> bytes:
@@ -92,11 +110,11 @@ def _recv_exact(sock: socket.socket, n: int) -> bytes:
     return bytes(buf)
 
 
-def _recv_frame(sock: socket.socket) -> Tuple[int, int, int, bytes]:
+def _recv_frame(sock: socket.socket) -> Tuple[int, int, int, bytes, int]:
     head = _recv_exact(sock, _HEADER.size)
-    kind, a, b, n = _HEADER.unpack(head)
+    kind, a, b, n, crc = _HEADER.unpack(head)
     payload = _recv_exact(sock, n) if n else b""
-    return kind, a, b, payload
+    return kind, a, b, payload, crc
 
 
 class _TcpChannel:
@@ -124,7 +142,7 @@ class _TcpChannel:
     def _read_loop(self):
         try:
             while True:
-                kind, a, b, payload = _recv_frame(self.sock)
+                kind, a, b, payload, crc = _recv_frame(self.sock)
                 if kind == _REQUEST:
                     self.transport._dispatch_request(self, a, b, payload)
                 elif kind == _RESPONSE or kind == _ERROR:
@@ -138,6 +156,12 @@ class _TcpChannel:
                                 TransactionStatus.ERROR, error=payload.decode("utf-8", "replace")
                             )
                 elif kind == _DATA:
+                    if _crc(payload) != crc:
+                        # a corrupt DATA frame is DROPPED like a lost one:
+                        # the fetch's timeout + missing-block re-request is
+                        # the recovery (never hand garbage to the decoder)
+                        _M_CORRUPT.add(1)
+                        continue
                     if self.client_conn is not None:
                         self.client_conn.deliver_frame(a, 0, payload)
         except (ConnectionError, OSError):
@@ -271,7 +295,7 @@ class TcpTransport(Transport):
         try:
             sock.settimeout(self.handshake_timeout_s)
             sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-            kind, _a, _b, payload = _recv_frame(sock)
+            kind, _a, _b, payload, _crc_v = _recv_frame(sock)
             if kind != _HELLO:
                 raise ConnectionError(f"first frame must be HELLO, got {kind}")
             sock.settimeout(None)
@@ -332,10 +356,31 @@ class TcpTransport(Transport):
         _ADDRESSES[self.executor_id] = self.address
 
     def shutdown(self):
+        # shutdown() before close(): a thread blocked in accept() pins the
+        # kernel listener alive past close (in-flight syscalls hold the
+        # file), leaking both the accept thread and the port
+        try:
+            self._listener.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
         try:
             self._listener.close()
         except OSError:
             pass
+        # close accepted channels so their reader threads unwind (the
+        # peer's dialed channel sees EOF and unwinds its own reader)
+        with self._chan_lock:
+            chans = list(self._channels.values())
+            self._channels.clear()
+        for ch in chans:
+            try:
+                ch.sock.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                ch.sock.close()
+            except OSError:
+                pass
         self._pool.shutdown(wait=False)
 
 

@@ -25,6 +25,60 @@ def session():
     return TpuSession()
 
 
+@pytest.fixture(scope="module")
+def serve_leak_guard():
+    """Thread/fd leak detector for the serve suites (ISSUE 7): snapshot
+    live threads and open fds at module start, assert both return to
+    baseline after the module's servers stop. Declared module-scoped in
+    conftest so each serve test module opts in with a tiny autouse
+    wrapper that pytest sets up BEFORE (and finalizes AFTER) the module's
+    server rig.
+
+    The comparison polls: worker threads unwind asynchronously after a
+    cancel, and CPython closes sockets on GC — a few seconds of grace is
+    part of the contract, an unbounded leak is not. Long-lived engine
+    singletons that may be LAZILY created mid-module (watchdog scanner,
+    jax runtime threads) are excluded by name."""
+    import gc
+    import threading
+    import time as _time
+
+    _IGNORE = ("srt-watchdog", "srt-compile-deadline", "pjrt", "jax")
+
+    def fd_count() -> int:
+        try:
+            return len(os.listdir("/proc/self/fd"))
+        except OSError:
+            return 0
+
+    def live_threads():
+        return {
+            t
+            for t in threading.enumerate()
+            if t.is_alive()
+            and not any(t.name.startswith(p) for p in _IGNORE)
+        }
+
+    before_threads = live_threads()
+    before_fds = fd_count()
+    yield
+    gc.collect()
+    deadline = _time.monotonic() + 15.0
+    while _time.monotonic() < deadline:
+        leaked = live_threads() - before_threads
+        fds = fd_count()
+        if not leaked and fds <= before_fds + 2:
+            return
+        _time.sleep(0.1)
+        gc.collect()
+    leaked = live_threads() - before_threads
+    fds = fd_count()
+    assert not leaked and fds <= before_fds + 2, (
+        f"serve module leaked: threads={[t.name for t in leaked]} "
+        f"fds {before_fds} -> {fds}"
+    )
+
+
 @pytest.fixture(autouse=True, scope="module")
 def _bound_jit_code_size():
     """Release compiled XLA:CPU executables between test modules.

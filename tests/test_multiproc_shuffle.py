@@ -331,8 +331,12 @@ def test_tcp_injection_adds_latency_and_caps_bandwidth():
         t0 = _t.monotonic()
         for i in range(n):
             T._send_frame(a, lock, T._DATA, i, 0, payload)
-            kind, tag, _seq, data = T._recv_frame(b)
+            kind, tag, _seq, data, crc = T._recv_frame(b)
             assert kind == T._DATA and tag == i and len(data) == len(payload)
+            # DATA frames carry the CRC32C of their payload (ISSUE 7)
+            from spark_rapids_tpu.utils.checksum import frame_checksum
+
+            assert crc == frame_checksum(data)
         elapsed = _t.monotonic() - t0
         # 5 frames x (20ms latency + 100ms serialization) = 0.6s floor
         assert elapsed >= 0.5, f"injection not applied: {elapsed:.3f}s"

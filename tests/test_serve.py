@@ -27,6 +27,14 @@ from tests.harness import tpu_session
 SF = 0.002
 
 
+@pytest.fixture(scope="module", autouse=True)
+def _no_leaks(serve_leak_guard):
+    """Every serve test module rides the shared thread/fd leak guard
+    (tests/conftest.py) — the ISSUE 7 no-leaked-threads/fds contract,
+    wired into the tier-1 serve tests too."""
+    yield
+
+
 def _poll(pred, timeout_s: float = 30.0, what: str = "condition"):
     deadline = time.monotonic() + timeout_s
     while time.monotonic() < deadline:
