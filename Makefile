@@ -5,10 +5,18 @@
 PY ?= python
 PYTEST = $(PY) -m pytest
 
+# Static metric-catalog drift check (docs_gen-style): every metric name
+# emitted in code must be pre-registered in the GLOBAL catalog (or belong
+# to a declared slug-capped dynamic family). Also runs inside the tier-1
+# suite via tests/test_metrics_lint.py so `make check`/CI cannot skip it.
+.PHONY: metrics-lint
+metrics-lint:
+	JAX_PLATFORMS=cpu $(PY) -m spark_rapids_tpu.metrics_lint .
+
 # The pre-snapshot gate: the FULL suite in one command. Red here = do not
 # ship (VERDICT r3 weak #3: a red suite must be impossible to snapshot).
 .PHONY: check
-check:
+check: metrics-lint
 	$(PYTEST) tests/ -q
 
 # The fast core: everything except the heavyweight end-to-end suites —
@@ -17,7 +25,7 @@ check:
 # weak #4: check-fast used to exclude exactly the suites most likely to
 # break).
 .PHONY: check-fast
-check-fast:
+check-fast: metrics-lint
 	$(PYTEST) tests/ -q \
 	  --ignore=tests/test_tpch.py \
 	  --ignore=tests/test_tpch_sql.py \

@@ -204,6 +204,12 @@ def plan_diagnostics(session, wall_s: float) -> dict:
     from spark_rapids_tpu.obs.export import resilience_report
 
     out["resilience"] = resilience_report(session)
+    # host-overhead ledger (obs/ledger.py): host_overhead_frac as a RANKED
+    # per-phase breakdown — compile vs dispatch vs transfers vs glue —
+    # instead of one opaque fraction
+    led = getattr(session, "_last_ledger", None)
+    if led is not None:
+        out["ledger"] = led.breakdown()
     tracer = getattr(session, "_last_tracer", None)
     if tracer is not None:
         out["trace_spans"] = tracer.span_count
@@ -368,23 +374,47 @@ def run_concurrent(tpu, tables, qids, n_threads, sf, partitions, rounds=2):
     return out
 
 
-def _pctl(xs, p: float) -> float:
-    """Nearest-rank percentile over a sample list (0.0 when empty)."""
-    if not xs:
-        return 0.0
-    xs = sorted(xs)
-    k = min(len(xs) - 1, max(0, int(round((p / 100.0) * (len(xs) - 1)))))
-    return xs[k]
+#: the serve-layer latency histograms the SLO mode reads (obs catalog)
+_SLO_HISTS = {
+    "wait": "serve.queryWaitHist",
+    "run": "serve.queryRunHist",
+    "total": "serve.queryTotalHist",
+}
+
+
+def _hist_states():
+    """Snapshot the three serve latency histograms (windowed percentiles:
+    each bench phase diffs two snapshots)."""
+    from spark_rapids_tpu.obs.metrics import GLOBAL
+
+    return {k: GLOBAL.histogram(name).state() for k, name in _SLO_HISTS.items()}
+
+
+def _hist_pcts_ms(before: dict, after: dict) -> dict:
+    """p50/p95/p99 (ms) per latency series from histogram snapshot deltas —
+    the log2-bucket interpolation replacing raw-sample percentile math."""
+    from spark_rapids_tpu.obs.metrics import histogram_delta, quantile_from_counts
+
+    out = {}
+    for k in _SLO_HISTS:
+        counts, _sum, n = histogram_delta(after[k], before[k])
+        out[k] = {
+            p: round(quantile_from_counts(counts, n, v / 100.0) / 1e6, 3)
+            for p, v in (("p50", 50), ("p95", 95), ("p99", 99))
+        }
+        out[k]["count"] = n
+    return out
 
 
 def run_serve_slo(tpu, qids, n_clients, target_qps, duration_s, sf, smoke):
     """Closed-loop SLO mode (--serve N): a TpuServer over the session, N
     wire clients split across two tenants (dashboards in a weight-3
     interactive pool, etl in a weight-1 pool), each client pacing
-    PREPARED TPC-H queries at target_qps/N. Latency percentiles come from
-    the server's per-query (wait, run) samples — wait is the scheduler
-    admission queue, run is execute+stream — and per-tenant qps from the
-    serve.tenant.* slice of the obs registry.
+    PREPARED TPC-H queries at target_qps/N. Latency percentiles are
+    HISTOGRAM-derived (serve.queryWaitHist/RunHist/TotalHist snapshot
+    deltas — wait is the scheduler admission queue, run is
+    execute+stream) and per-tenant qps comes from the serve.tenant.*
+    slice of the obs registry.
 
     Overload behavior (ISSUE 7): the scheduler queue is bounded
     (BENCH_SERVE_MAXQUEUED, default 8) and each query carries a deadline
@@ -419,18 +449,19 @@ def run_serve_slo(tpu, qids, n_clients, target_qps, duration_s, sf, smoke):
     texts = {q: tpch_sql(q, sf=1.0) for q in qids}
     # warm pass: compile every query shape once, THEN sample the
     # uncontended baseline (single client, closed loop, warm kernels) —
-    # cold compiles must not pollute the p99 the overload ratio divides by
+    # cold compiles must not pollute the p99 the overload ratio divides by.
+    # Percentiles come from the serve latency HISTOGRAMS (log2 buckets,
+    # obs/metrics.py) — each phase diffs two registry snapshots, replacing
+    # the old bounded raw-sample lists.
     with connect(host, port, token="tok-dash") as warm:
         for q in qids:
             warm.sql(texts[q]).drain()
-        server.latency_samples.clear()
+        base_h0 = _hist_states()
         for _ in range(2 if smoke else 5):
             for q in qids:
                 warm.sql(texts[q]).drain()
-    base_total_ms = [
-        (w + r) * 1e3 for (_t, w, r) in list(server.latency_samples)
-    ]
-    uncontended_p99 = round(_pctl(base_total_ms, 99), 3)
+    base_pcts = _hist_pcts_ms(base_h0, _hist_states())
+    uncontended_p99 = base_pcts["total"]["p99"]
 
     # the overload bounds apply to the STORM only (all scheduler confs are
     # re-read per admission): the cold warm pass must not trip deadlines.
@@ -456,7 +487,7 @@ def run_serve_slo(tpu, qids, n_clients, target_qps, duration_s, sf, smoke):
         "shed": GLOBAL.counter("scheduler.shed").value,
         "overloaded": GLOBAL.counter("serve.overloaded").value,
     }
-    server.latency_samples.clear()
+    storm_h0 = _hist_states()
     per_client_qps = max(0.01, target_qps / max(1, n_clients))
     errors: list = []
     done = [0]
@@ -518,13 +549,10 @@ def run_serve_slo(tpu, qids, n_clients, target_qps, duration_s, sf, smoke):
     for t in threads:
         t.join()
     wall = time.perf_counter() - t_start
-    samples = list(server.latency_samples)
+    storm_pcts = _hist_pcts_ms(storm_h0, _hist_states())
     server.stop()
 
-    wait_ms = [w * 1e3 for (_t, w, _r) in samples]
-    run_ms = [r * 1e3 for (_t, _w, r) in samples]
-    total_ms = [(w + r) * 1e3 for (_t, w, r) in samples]
-    admitted_p99 = round(_pctl(total_ms, 99), 3)
+    admitted_p99 = storm_pcts["total"]["p99"]
     tenant_qps = {
         t: round(
             (GLOBAL.counter(f"serve.tenant.{t}.queries").value
@@ -538,14 +566,8 @@ def run_serve_slo(tpu, qids, n_clients, target_qps, duration_s, sf, smoke):
         "achieved_qps": round(done[0] / wall, 3) if wall > 0 else 0.0,
         "queries_ok": done[0],
         "wall_s": round(wall, 3),
-        "latency_ms": {
-            "wait": {p: round(_pctl(wait_ms, v), 3)
-                     for p, v in (("p50", 50), ("p95", 95), ("p99", 99))},
-            "run": {p: round(_pctl(run_ms, v), 3)
-                    for p, v in (("p50", 50), ("p95", 95), ("p99", 99))},
-            "total": {p: round(_pctl(total_ms, v), 3)
-                      for p, v in (("p50", 50), ("p95", 95), ("p99", 99))},
-        },
+        "latency_ms": storm_pcts,
+        "latency_source": "histogram",  # serve.query*Hist snapshot deltas
         "overload": {
             "deadline_s": deadline_s,
             "rejected_overloaded": rejected[0],

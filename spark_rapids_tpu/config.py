@@ -383,8 +383,63 @@ TRACE_DIR = conf("spark.rapids.tpu.trace.dir").doc(
 
 TRACE_BUFFER_SPANS = conf("spark.rapids.tpu.trace.bufferSpans").doc(
     "Span ring-buffer capacity per traced query; the oldest spans are "
-    "overwritten beyond it (the exporter reports the drop count)."
+    "overwritten beyond it (exporters report the drop count, and the "
+    "process-wide trace.droppedSpans counter records every overwrite)."
 ).int_conf(65536)
+
+TRACE_PROPAGATE = conf("spark.rapids.tpu.trace.propagate").doc(
+    "Cross-process span-context propagation: serve protocol frames and "
+    "multiproc shuffle requests carry a compact (trace id, parent span id, "
+    "sampled) context so client spans, server query trees, and remote "
+    "shuffle-worker fetch spans merge into one Perfetto trace "
+    "(obs/trace.py SpanContext; the Dapper propagation model)."
+).boolean_conf(True)
+
+METRICS_HTTP_PORT = conf("spark.rapids.tpu.metrics.httpPort").doc(
+    "Live scrape endpoint (obs/scrape.py): a stdlib HTTP listener serving "
+    "/metrics (Prometheus text exposition of the process registry, "
+    "histograms included) and /healthz (liveness + serve readiness). "
+    "0 disables (default), a positive port binds there, -1 binds an "
+    "ephemeral port. Started by TpuServer.start() and by bare sessions at "
+    "construction."
+).int_conf(0)
+
+METRICS_MAX_DYNAMIC_SLUGS = conf("spark.rapids.tpu.metrics.maxDynamicSlugs").doc(
+    "Cardinality cap for dynamically-named metric series (cancel-reason, "
+    "tenant, stall-site, pool families): at most this many distinct slugs "
+    "per prefix; overflow folds into one 'other' bucket and counts in "
+    "metrics.slugOverflow. Guards the Prometheus export against unbounded "
+    "series from wire-supplied names."
+).int_conf(64)
+
+LEDGER_ENABLED = conf("spark.rapids.tpu.ledger.enabled").doc(
+    "Host-overhead ledger (obs/ledger.py): decompose each query's wall "
+    "clock into exhaustive non-overlapping phases (parse/plan, compile, "
+    "h2d, dispatch, device wait, d2h, serialize, queue wait, glue "
+    "residual), exported via df.explain('metrics'), the per-query JSON "
+    "artifact, and the bench diag ranked breakdown."
+).boolean_conf(True)
+
+CBO_CALIBRATION_ENABLED = conf("spark.rapids.tpu.cbo.calibration.enabled").doc(
+    "Harvest measured per-op device/host ns-per-row into the persisted "
+    "calibration table at every query exit (obs/calibration.py). Implies "
+    "per-batch opTime attribution (profiling.instrument_plan) while on — "
+    "a measurement mode, not a hot-path default."
+).boolean_conf(False)
+
+CBO_CALIBRATION_FILE = conf("spark.rapids.tpu.cbo.calibrationFile").doc(
+    "Path of the persisted JSON calibration table (EWMA per-op-signature "
+    "measured costs), shared across sessions and processes. Default: "
+    "~/.cache/spark_rapids_tpu/cbo_calibration.json."
+).string_conf(None)
+
+CBO_MEASURED_WEIGHTS = conf("spark.rapids.tpu.cbo.measuredWeights").doc(
+    "Drive the cost-based optimizer's island un-conversion from the "
+    "MEASURED calibration table instead of the hardcoded per-op weights "
+    "(plan/overrides.py). With this off — or the calibration file absent "
+    "or empty — planning is bit-identical to the hardcoded table; the "
+    "chosen weight source and numbers appear in the explain output."
+).boolean_conf(False)
 
 CPU_ONLY = conf("spark.rapids.tpu.cpuOnly").doc(
     "Force the JAX CPU backend (testing; the virtual-device mesh path)."

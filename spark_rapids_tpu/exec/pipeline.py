@@ -38,8 +38,14 @@ import threading
 import time
 from typing import Callable, Iterator, Optional
 
+from ..obs import ledger as obs_ledger
 from ..obs import trace as obs_trace
+from ..obs.metrics import GLOBAL as _GLOBAL_METRICS
 from ..obs.metrics import MetricKind
+
+#: per-batch upstream production time on producer threads (log2 buckets):
+#: the distribution behind the pipeProducerTime total
+_M_DISPATCH_HIST = _GLOBAL_METRICS.histogram("pipeline.dispatchHist")
 
 # Producer threads can run first-touch XLA compiles (upstream kernel
 # pulls) whose deep LLVM recursion overflows the default thread stack —
@@ -91,8 +97,11 @@ class PipelinedIterator:
         # span-context propagation (obs/trace.py): capture the consuming
         # thread's current span so upstream work pulled on the producer
         # thread attributes under the operator that spawned the pipeline —
-        # not outside the query trace (the pre-obs attribution hole)
+        # not outside the query trace (the pre-obs attribution hole). The
+        # phase ledger propagates the same way: producer-side pulls bill
+        # the query's 'dispatch' phase.
         self._trace_ctx = obs_trace.capture_context()
+        self._ledger = obs_ledger.current()
         self._thread = start_big_stack_thread(self._produce, "srt-pipeline")
 
     # ── producer side ───────────────────────────────────────────────────
@@ -120,6 +129,8 @@ class PipelinedIterator:
 
     def _produce(self) -> None:
         obs_trace.attach_context(self._trace_ctx)
+        obs_ledger.set_current(self._ledger)
+        led = self._ledger
         if self._cancel_token is not None:
             # producer threads drive upstream pulls (and first-touch
             # compiles): give the watchdog a current token here too
@@ -152,11 +163,17 @@ class PipelinedIterator:
                     self._cancel_token.check()
                 t0 = time.perf_counter_ns()
                 try:
-                    item = next(it)
+                    # the pull is the upstream chain's production: kernel
+                    # enqueue + operator host work → ledger 'dispatch'
+                    # (nested compile/h2d scopes subtract themselves out)
+                    with obs_ledger.scope_or_null(led, "dispatch"):
+                        item = next(it)
                 except StopIteration:
                     return
+                pull_ns = time.perf_counter_ns() - t0
+                _M_DISPATCH_HIST.observe(pull_ns)
                 if m_prod is not None:
-                    m_prod.add(time.perf_counter_ns() - t0)
+                    m_prod.add(pull_ns)
                 size = 0
                 sb = getattr(item, "size_bytes", None)
                 if callable(sb):
@@ -286,6 +303,12 @@ def pipelined_partition(conf, ctx, it, fn, metrics=None):
     a ``pipeline_conf(ctx)`` result and ``metrics`` a ``pipe_metrics(node)``
     dict — both resolved once per execute(), not per partition."""
     if conf is None:
+        led = getattr(ctx, "ledger", None)
+        if led is not None:
+            # no producer thread to bill 'dispatch' — time the direct
+            # upstream pulls here so the ledger decomposition holds in
+            # the pipeline-disabled (strictly serial) configuration
+            it = led.timed_iter("dispatch", it)
         yield from fn(it)
         return
     pipe = PipelinedIterator(

@@ -210,6 +210,7 @@ def run_device(fn, it, needs_task, catalog=None, policy=None, op=None,
     error path (permits, semaphore, spill holds all release)."""
     import jax.numpy as jnp
 
+    from ..obs import ledger as _ledger
     from ..resilience import retry as R
 
     if token is not None:
@@ -218,17 +219,31 @@ def run_device(fn, it, needs_task, catalog=None, policy=None, op=None,
         from ..resilience import watchdog as _wd
 
         _wd.set_current(token)
+    # host-overhead ledger: each kernel launch bills its enqueue time to
+    # the 'dispatch' phase (a first-touch compile nested inside subtracts
+    # itself out — exclusive scopes). The ledger is resolved ONCE per
+    # partition; un-ledgered paths keep a no-op scope.
+    led = _ledger.current()
+
+    def _dispatch_scope():
+        return _ledger.scope_or_null(led, "dispatch")
+
     if not needs_task:
         zeros = zero_vals(jnp)
         if policy is None:
             for db in it:
                 if token is not None:
                     token.check()
-                yield fn(db, zeros)
+                with _dispatch_scope():
+                    out = fn(db, zeros)
+                yield out
             return
         for db in it:
             if token is not None:
                 token.check()
+            # NOT scoped: run_with_retry yields split halves lazily (the
+            # OOM contract — halves must not be held concurrently), so its
+            # time lands in the caller's phase instead
             yield from R.run_with_retry(
                 catalog, lambda b: fn(b, zeros), db, policy, op=op,
                 breaker=breaker,
@@ -240,12 +255,13 @@ def run_device(fn, it, needs_task, catalog=None, policy=None, op=None,
             token.check()
         get_or_create()
         tv = task_vals(jnp, row_base=base)
-        if policy is None:
-            out = fn(db, tv)
-        else:
-            out = R.run_once(
-                catalog, lambda b: fn(b, tv), db, policy, op=op,
-                breaker=breaker,
-            )
+        with _dispatch_scope():
+            if policy is None:
+                out = fn(db, tv)
+            else:
+                out = R.run_once(
+                    catalog, lambda b: fn(b, tv), db, policy, op=op,
+                    breaker=breaker,
+                )
         base = tv.row_base + db.num_rows.astype(jnp.int64)
         yield out

@@ -45,6 +45,10 @@ from ..plan.logical import SortOrder
 from ..plan.physical import Exec, ExecContext, PartitionSet
 from ..types import Schema, StringType, StructField
 from .. import kernels as K
+from ..obs import ledger as obs_ledger
+
+
+_lscope = obs_ledger.scope_or_null
 
 
 def val_to_column(ctx: Ctx, val: Val, dtype) -> DeviceColumn:
@@ -172,6 +176,8 @@ class HostToDeviceExec(Exec):
         bytes_m = self.metric("hostToDeviceBytes", "MODERATE")
         timing = self.metrics_on(ctx, "MODERATE")
 
+        led = getattr(ctx, "ledger", None)
+
         def fn(it):
             tok = ctx.cancel_token
             for rb in it:
@@ -188,11 +194,16 @@ class HostToDeviceExec(Exec):
                         else rb.slice(off, max_rows)
                     )
                     ctx.semaphore.acquire_if_necessary()
+                    # scopes close BEFORE the yield: a ledger phase (and
+                    # the transfer timer) must measure the upload, not the
+                    # consumer's work while this generator is suspended
                     if timing:
-                        with time_m.timed():
-                            yield host_to_device(chunk, max_str_bytes=max_str)
+                        with _lscope(led, "h2d"), time_m.timed():
+                            db = host_to_device(chunk, max_str_bytes=max_str)
                     else:
-                        yield host_to_device(chunk, max_str_bytes=max_str)
+                        with _lscope(led, "h2d"):
+                            db = host_to_device(chunk, max_str_bytes=max_str)
+                    yield db
                     if rb.num_rows <= max_rows:
                         break
 
@@ -329,6 +340,7 @@ class DeviceToHostExec(Exec):
         time_m = self.metric("deviceToHostTime", "MODERATE")
         bytes_m = self.metric("deviceToHostBytes", "MODERATE")
         timing = self.metrics_on(ctx, "MODERATE")
+        led = getattr(ctx, "ledger", None)
 
         # speculate only below execs whose results are usually tiny
         # relative to capacity (a big scan/filter single batch would pay a
@@ -371,11 +383,19 @@ class DeviceToHostExec(Exec):
                     from ..columnar.device import device_to_host_speculative
                     from ..ops.gather import shrink_one
 
+                    # device-completion wait separated from the copy: the
+                    # block costs nothing extra (the transfer would wait
+                    # anyway) and splits the ledger's 'device_execute'
+                    # from 'd2h' at the only point the host truly waits
+                    if led is not None:
+                        with led.scope("device_execute"):
+                            jax.block_until_ready(chunk[0])
                     if timing:
-                        with time_m.timed():
+                        with _lscope(led, "d2h"), time_m.timed():
                             rb, n_true = device_to_host_speculative(chunk[0])
                     else:
-                        rb, n_true = device_to_host_speculative(chunk[0])
+                        with _lscope(led, "d2h"):
+                            rb, n_true = device_to_host_speculative(chunk[0])
                     if rb is not None:
                         ctx.semaphore.release_if_necessary()
                         if rb.num_rows:
@@ -402,11 +422,15 @@ class DeviceToHostExec(Exec):
                     from ..mem.spill import with_oom_retry
 
                     pull = lambda b: device_to_host(b, shrink=False)  # noqa: E731
+                    if led is not None:
+                        with led.scope("device_execute"):
+                            jax.block_until_ready(db)
                     if timing:
-                        with time_m.timed():
+                        with _lscope(led, "d2h"), time_m.timed():
                             rb = with_oom_retry(ctx.catalog, pull, db)
                     else:
-                        rb = with_oom_retry(ctx.catalog, pull, db)
+                        with _lscope(led, "d2h"):
+                            rb = with_oom_retry(ctx.catalog, pull, db)
                     ctx.semaphore.release_if_necessary()
                     if rb.num_rows:
                         rows_m.add(rb.num_rows)

@@ -30,6 +30,7 @@ from typing import Callable
 
 import jax
 
+from .obs import ledger as obs_ledger
 from .obs import metrics as obs_metrics
 from .obs import trace as obs_trace
 
@@ -44,6 +45,7 @@ _M_WARMS = obs_metrics.GLOBAL.counter("kernel.warms")
 _M_WARM_NS = obs_metrics.GLOBAL.timer("kernel.warmTimeNs")
 _M_FIRST_CALLS = obs_metrics.GLOBAL.counter("kernel.firstCalls")
 _M_COMPILE_NS = obs_metrics.GLOBAL.timer("kernel.compileTimeNs")
+_M_COMPILE_HIST = obs_metrics.GLOBAL.histogram("kernel.compileHist")
 
 
 def kernel(key: tuple, builder: Callable):
@@ -177,7 +179,7 @@ class GuardedJit:
         sig = _args_sig(args)
         if sig in self._seen or sig in self._warmed:
             return False
-        with _M_WARM_NS.timed():
+        with obs_ledger.phase("compile"), _M_WARM_NS.timed():
             if jax.default_backend() == "cpu":
                 with _COMPILE_LOCK:
                     self._fn.lower(*args).compile()
@@ -226,8 +228,13 @@ class GuardedJit:
         from .resilience import watchdog as _wd
 
         # phase-label the caller thread too: it blocks in join() for up
-        # to the budget, and a watchdog stall there is a compile stall
-        with _wd.stall_phase("compile"):
+        # to the budget, and a watchdog stall there is a compile stall.
+        # The LEDGER scope also lives here, on the caller: the helper
+        # thread has no current ledger (thread-locals don't ride along),
+        # and the caller's join-wait IS the compile's wall-clock cost —
+        # billing it here keeps 'compile' honest under a deadline and
+        # avoids double-counting against the caller's open 'dispatch'
+        with _wd.stall_phase("compile"), obs_ledger.phase("compile"):
             return _call_with_deadline(locked_first, deadline)
 
     def _first_call(self, args):
@@ -267,10 +274,17 @@ class GuardedJit:
                 # cancel 'stall:compile' instead of blaming the launch
                 # (the deadline join, when one is armed, lives in
                 # __call__ — this runs on the helper thread there)
-                with _wd.stall_phase("compile"), \
-                        obs_trace.span("xla-compile", "kernel"), \
-                        _M_COMPILE_NS.timed():
-                    return attempt()
+                t_compile = time.perf_counter_ns()
+                try:
+                    with _wd.stall_phase("compile"), \
+                            obs_trace.span("xla-compile", "kernel"), \
+                            obs_ledger.phase("compile"), \
+                            _M_COMPILE_NS.timed():
+                        return attempt()
+                finally:
+                    _M_COMPILE_HIST.observe(
+                        time.perf_counter_ns() - t_compile
+                    )
             except Exception as e:  # noqa: BLE001 - classify, then re-raise
                 msg = str(e)
                 from .ops import pallas_strings as _ps

@@ -23,6 +23,25 @@ for the TPU engine, in three planes:
 
 ``profiling.py`` remains the stable public surface; its report entry points
 are thin shims over this package.
+
+Grown by the performance-attribution layer (PR 9):
+
+- :mod:`spark_rapids_tpu.obs.ledger` — per-query host-overhead phase
+  ledger (wall clock → exhaustive non-overlapping phases);
+- :class:`spark_rapids_tpu.obs.metrics.Histogram` — log₂-bucket latency
+  distributions with Prometheus ``_bucket/_sum/_count`` rendering;
+- :mod:`spark_rapids_tpu.obs.scrape` — live ``/metrics`` + ``/healthz``
+  HTTP endpoint;
+- :mod:`spark_rapids_tpu.obs.calibration` — measured per-op cost tables
+  feeding the cost-based optimizer;
+- cross-process span-context propagation (``trace.SpanContext``) over
+  serve frames and shuffle requests.
 """
-from . import metrics, trace  # noqa: F401
-from .metrics import GLOBAL, Metric, MetricKind, MetricRegistry  # noqa: F401
+from . import ledger, metrics, trace  # noqa: F401
+from .metrics import (  # noqa: F401
+    GLOBAL,
+    Histogram,
+    Metric,
+    MetricKind,
+    MetricRegistry,
+)

@@ -70,6 +70,26 @@ def render_plan_metrics(plan, level: Optional[str] = None) -> str:
     return "\n".join(lines)
 
 
+def render_ledger(ledger) -> str:
+    """Human-readable host-overhead breakdown for ``df.explain("metrics")``:
+    the query's wall clock decomposed into ranked phases with percentages —
+    ``host_overhead_frac`` as an answer instead of a number."""
+    if ledger is None:
+        return ""
+    bd = ledger.breakdown()
+    wall = bd["wall_ms"]
+    lines = [f"host-overhead ledger: wall {wall:.1f}ms"]
+    for phase, ms in bd["phases_ms"].items():
+        pct = (100.0 * ms / wall) if wall else 0.0
+        lines.append(f"  {phase:<16} {ms:>10.1f}ms  {pct:5.1f}%")
+    if bd["parallel_overlap_ms"]:
+        lines.append(
+            f"  (parallel overlap: {bd['parallel_overlap_ms']:.1f}ms measured "
+            "on concurrent threads beyond the wall)"
+        )
+    return "\n".join(lines)
+
+
 def metrics_report(plan) -> str:
     """Human-readable per-node metric tree (Spark-UI stand-in; the
     pre-obs ``profiling.metrics_report`` contract — every level shown)."""
@@ -163,6 +183,29 @@ def resilience_report(session=None) -> dict:
 _SANITIZE = re.compile(r"[^a-zA-Z0-9_]")
 
 
+def _render_histogram(lines, pname, m) -> None:
+    """Prometheus histogram exposition: cumulative ``_bucket{le=...}`` rows
+    (log₂ upper bounds, trailing empty buckets elided), ``+Inf``, ``_sum``,
+    ``_count`` — the invariant scrapers rely on: the +Inf bucket equals
+    ``_count`` and bucket counts are monotone non-decreasing."""
+    counts, total_sum, count = m.state()
+    lines.append(f"# TYPE {pname} histogram")
+    # elide the empty head and tail: Prometheus accepts any le subset as
+    # long as cumulative counts are monotone and +Inf equals _count —
+    # 64 log2 buckets would otherwise be mostly zeros on every series
+    nonempty = [i for i, c in enumerate(counts) if c]
+    lowest = max(0, (nonempty[0] - 1)) if nonempty else 0
+    highest = nonempty[-1] if nonempty else -1
+    cum = 0
+    for i in range(lowest, highest + 1):
+        cum += counts[i]
+        le = 1 if i == 0 else (1 << i)
+        lines.append(f'{pname}_bucket{{le="{le}"}} {cum}')
+    lines.append(f'{pname}_bucket{{le="+Inf"}} {count}')
+    lines.append(f"{pname}_sum {total_sum}")
+    lines.append(f"{pname}_count {count}")
+
+
 def _prom_name(name: str) -> str:
     # kernel.compileTimeNs → kernel_compile_time_ns (prometheus snake case)
     name = name.replace(".", "_")
@@ -180,6 +223,9 @@ def prometheus_text(plan=None, session=None) -> str:
     for name in sorted(snap):
         m = snap[name]
         pname = _prom_name(name)
+        if m.kind == MetricKind.HISTOGRAM:
+            _render_histogram(lines, pname, m)
+            continue
         ptype = "counter" if m.kind in (MetricKind.COUNTER, MetricKind.NANOS) else "gauge"
         lines.append(f"# TYPE {pname} {ptype}")
         lines.append(f"{pname} {m.value}")
@@ -210,15 +256,21 @@ def prometheus_text(plan=None, session=None) -> str:
 # ── per-query JSON artifact ─────────────────────────────────────────────────
 
 
-def query_artifact(plan=None, session=None, tracer=None, extra=None) -> dict:
+def query_artifact(plan=None, session=None, tracer=None, extra=None,
+                   ledger=None) -> dict:
     """One machine-readable document per query: per-node metrics, the
     pipeline + resilience views (the old bespoke reports, folded in), the
-    process-registry snapshot, and trace stats when a tracer ran."""
+    process-registry snapshot, the host-overhead phase ledger, and trace
+    stats when a tracer ran."""
     out: dict = {"process": GLOBAL.snapshot()}
     if plan is not None:
         out["operators"] = plan.collect_metrics()
         out["pipeline"] = pipeline_report(plan)
         out["breakdown"] = device_host_breakdown(plan)
+    if ledger is None and session is not None:
+        ledger = getattr(session, "_last_ledger", None)
+    if ledger is not None:
+        out["ledger"] = ledger.breakdown()
     out["resilience"] = resilience_report(session)
     out["shuffle_compression_ratio"] = M.shuffle_compression_ratio()
     if tracer is not None:
@@ -233,10 +285,13 @@ def query_artifact(plan=None, session=None, tracer=None, extra=None) -> dict:
 
 
 def write_query_artifact(path: str, plan=None, session=None, tracer=None,
-                         extra=None) -> str:
+                         extra=None, ledger=None) -> str:
     d = os.path.dirname(path)
     if d:
         os.makedirs(d, exist_ok=True)
     with open(path, "w") as f:
-        json.dump(query_artifact(plan, session, tracer, extra), f, indent=1)
+        json.dump(
+            query_artifact(plan, session, tracer, extra, ledger=ledger),
+            f, indent=1,
+        )
     return path

@@ -40,6 +40,7 @@ class MetricKind:
     NANOS = "nanos"
     GAUGE = "gauge"
     WATERMARK = "watermark"
+    HISTOGRAM = "histogram"
 
 
 _SLUG_RE = __import__("re").compile(r"[^a-z0-9]+")
@@ -51,6 +52,56 @@ def metric_slug(name: str, fallback: str = "unspecified") -> str:
     ``serve.tenant.<slug>.queries``) so their naming never diverges."""
     s = _SLUG_RE.sub("_", (name or fallback).lower()).strip("_")
     return (s or fallback)[:48]
+
+
+# ── dynamic-series cardinality guard ────────────────────────────────────────
+# metric_slug bounds each segment's LENGTH but not how many DISTINCT slugs a
+# prefix accumulates: cancel reasons carry free-ish text and tenant names
+# arrive from the wire, so an adversarial (or merely buggy) caller could mint
+# unbounded Prometheus series. Every dynamically-named series therefore goes
+# through dynamic_name(), which admits at most the configured number of
+# distinct slugs per prefix (spark.rapids.tpu.metrics.maxDynamicSlugs) and
+# folds the overflow into one shared 'other' bucket, counted in
+# metrics.slugOverflow so the truncation is itself observable.
+
+_SLUG_CAP = [64]
+_SLUG_SEEN: Dict[str, set] = {}
+_SLUG_LOCK = threading.Lock()
+
+#: prefixes known to mint series dynamically — the metrics-lint allowlist
+#: (a GLOBAL.counter(f"...") call whose literal prefix is listed here is a
+#: catalogued dynamic family, not catalog drift)
+DYNAMIC_PREFIXES = (
+    "scheduler.cancelled.reason.",
+    "scheduler.shed.reason.",
+    "scheduler.pool.",
+    "serve.tenant.",
+    "watchdog.stalls.site.",
+)
+
+
+def set_slug_cap(n: int) -> None:
+    """Install the per-prefix distinct-slug budget (session init reads
+    spark.rapids.tpu.metrics.maxDynamicSlugs)."""
+    _SLUG_CAP[0] = max(1, int(n))
+
+
+def dynamic_name(prefix: str, raw: str, suffix: str = "",
+                 fallback: str = "unspecified") -> str:
+    """``prefix + metric_slug(raw) + suffix`` with the per-prefix
+    cardinality cap applied: the cap+1-th distinct slug (and every one
+    after it) becomes ``other``, and metrics.slugOverflow counts each
+    folded observation."""
+    s = metric_slug(raw, fallback)
+    with _SLUG_LOCK:
+        seen = _SLUG_SEEN.setdefault(prefix, set())
+        if s not in seen:
+            if len(seen) >= _SLUG_CAP[0]:
+                GLOBAL.counter("metrics.slugOverflow").add(1)
+                s = "other"
+            else:
+                seen.add(s)
+    return f"{prefix}{s}{suffix}"
 
 
 def infer_kind(name: str) -> str:
@@ -116,6 +167,90 @@ class Metric:
         return f"Metric({self.name}={self.value}, {self.kind}/{self.level})"
 
 
+class Histogram(Metric):
+    """Fixed log₂-bucket histogram — real latency distributions for every
+    series that used to keep bounded raw-sample lists (serve wait/run,
+    scheduler queue wait, kernel compile, shuffle fetch).
+
+    Bucket ``i`` holds observations ``v`` with ``2^(i-1) < v <= 2^i``
+    (``v <= 0`` lands in bucket 0), so 64 buckets cover the whole int64
+    range with no per-series configuration and ~7% worst-case relative
+    quantile error — the GWP-style always-on tradeoff: cheap enough to
+    leave running, accurate enough to rank.
+
+    ``value`` is the observation COUNT (so generic exporters render
+    something sane); ``add``/``timed()`` observe, so a Histogram drops in
+    anywhere a NANOS timer was fed durations. ``state()`` snapshots
+    ``(counts, sum, count)`` for delta-based percentile math (bench
+    phases)."""
+
+    N_BUCKETS = 64
+
+    __slots__ = ("counts", "sum")
+
+    def __init__(self, name: str, level: str = "ESSENTIAL"):
+        super().__init__(name, level, MetricKind.HISTOGRAM)
+        self.counts = [0] * self.N_BUCKETS
+        self.sum = 0
+
+    def observe(self, v) -> None:
+        v = int(v)
+        i = v.bit_length() if v > 0 else 0
+        if i >= self.N_BUCKETS:
+            i = self.N_BUCKETS - 1
+        with self._lock:
+            self.counts[i] += 1
+            self.sum += v
+            self.value += 1
+
+    # timers feed durations through add() — same call shape as Metric
+    def add(self, v) -> None:
+        self.observe(v)
+
+    def state(self) -> tuple:
+        """Point-in-time ``(counts tuple, sum, count)`` — consistent under
+        the metric lock, subtractable for windowed percentiles."""
+        with self._lock:
+            return (tuple(self.counts), self.sum, self.value)
+
+    def quantile(self, q: float, state: Optional[tuple] = None) -> float:
+        """Estimated q-quantile (0 <= q <= 1) by linear interpolation
+        inside the selected bucket; 0.0 when empty."""
+        counts, _s, total = state if state is not None else self.state()
+        return quantile_from_counts(counts, total, q)
+
+
+def histogram_delta(after: tuple, before: tuple) -> tuple:
+    """``after - before`` of two Histogram.state() snapshots — the windowed
+    view bench phases use (percentiles of only this run's observations)."""
+    ca, sa, na = after
+    cb, sb, nb = before
+    return (
+        tuple(a - b for a, b in zip(ca, cb)),
+        sa - sb,
+        na - nb,
+    )
+
+
+def quantile_from_counts(counts, total: int, q: float) -> float:
+    """Interpolated quantile over log₂ bucket counts (bucket i spans
+    (2^(i-1), 2^i]); 0.0 for an empty distribution."""
+    if total <= 0:
+        return 0.0
+    rank = max(0.0, min(1.0, q)) * total
+    seen = 0
+    for i, c in enumerate(counts):
+        if c <= 0:
+            continue
+        if seen + c >= rank:
+            lo = 0.0 if i == 0 else float(1 << (i - 1))
+            hi = 1.0 if i == 0 else float(1 << i)
+            frac = (rank - seen) / c
+            return lo + (hi - lo) * frac
+        seen += c
+    return float(1 << (len(counts) - 1))
+
+
 class _NullMetric:
     """Shared no-op sink for metrics gated off by the level conf: call
     sites keep one unconditional code path with zero per-batch allocation
@@ -174,7 +309,10 @@ class MetricRegistry(dict):
             with self._lock:
                 m = self.get(name)
                 if m is None:
-                    m = Metric(name, level, kind)
+                    if kind == MetricKind.HISTOGRAM:
+                        m = Histogram(name, level)
+                    else:
+                        m = Metric(name, level, kind)
                     self[name] = m
         return m
 
@@ -190,6 +328,9 @@ class MetricRegistry(dict):
 
     def watermark(self, name: str, level: str = "ESSENTIAL") -> Metric:
         return self.get_or_create(name, level, MetricKind.WATERMARK)
+
+    def histogram(self, name: str, level: str = "ESSENTIAL") -> "Histogram":
+        return self.get_or_create(name, level, MetricKind.HISTOGRAM)
 
     def snapshot(self) -> Dict[str, int]:
         """Point-in-time name → value (stable iteration copy)."""
@@ -217,6 +358,9 @@ class MetricRegistry(dict):
                 if name.startswith(prefix):
                     with m._lock:
                         m.value = 0
+                        if isinstance(m, Histogram):
+                            m.counts = [0] * Histogram.N_BUCKETS
+                            m.sum = 0
 
 
 #: Process-wide registry (kernel compiles, spill tiers, shuffle bytes,
@@ -321,6 +465,31 @@ CATALOG: Iterable[tuple] = (
     ("serve.drainCancelled", MetricKind.COUNTER,
      "in-flight queries cancelled at drainTimeout with reason "
      "'shutdown'"),
+    # latency distributions (HISTOGRAM kind, log2 buckets; Prometheus
+    # renders _bucket/_sum/_count) — the series that used to be bounded
+    # raw-sample lists or bare nanos totals
+    ("serve.queryWaitHist", MetricKind.HISTOGRAM,
+     "served queries' admission queue wait (ns distribution)"),
+    ("serve.queryRunHist", MetricKind.HISTOGRAM,
+     "served queries' execution+stream time (ns distribution)"),
+    ("serve.queryTotalHist", MetricKind.HISTOGRAM,
+     "served queries' wait+run total (ns distribution — the SLO series)"),
+    ("scheduler.queueWaitHist", MetricKind.HISTOGRAM,
+     "admission queue wait per query (ns distribution)"),
+    ("kernel.compileHist", MetricKind.HISTOGRAM,
+     "first-touch trace+compile time per kernel (ns distribution)"),
+    ("shuffle.fetchHist", MetricKind.HISTOGRAM,
+     "shuffle fetch wall time per fetch_blocks call (ns distribution)"),
+    ("pipeline.dispatchHist", MetricKind.HISTOGRAM,
+     "per-batch upstream production time on pipeline producers "
+     "(ns distribution)"),
+    # obs/ self-observation — the attribution layer watches itself
+    ("trace.droppedSpans", MetricKind.COUNTER,
+     "spans overwritten by ring-buffer wrap across all tracers (a "
+     "truncated Perfetto export is detectable, not silent)"),
+    ("metrics.slugOverflow", MetricKind.COUNTER,
+     "dynamic-series observations folded into an 'other' bucket because "
+     "their prefix hit spark.rapids.tpu.metrics.maxDynamicSlugs"),
     # resilience/* — the old retry.report() counters (registry view now)
     ("resilience.oom_retries", MetricKind.COUNTER, "spill-and-retry launches after device OOM"),
     ("resilience.splits", MetricKind.COUNTER, "OOM batch halvings"),

@@ -24,13 +24,59 @@ allocation probe).
 """
 from __future__ import annotations
 
+import itertools
 import json
 import os
 import threading
 import time
-from typing import Iterator, Optional
+import uuid
+from typing import Iterator, List, Optional
+
+from .metrics import GLOBAL as _GLOBAL_METRICS
 
 _EPOCH_NS = time.perf_counter_ns()  # trace timestamps are relative; ts=0 at import
+
+#: process-global span-id allocator: ids stay unique across concurrent
+#: tracers so spans from a client tracer and a server tracer merged into
+#: one Perfetto document never alias (merge_chrome relies on this)
+_SID_COUNTER = itertools.count(1)
+
+#: ring-buffer overwrites across every tracer in the process — a truncated
+#: trace must be detectable from the export alone (satellite: the old ring
+#: silently overwrote on wrap)
+_M_DROPPED = _GLOBAL_METRICS.counter("trace.droppedSpans")
+
+
+class SpanContext:
+    """The compact wire form of 'where in whose trace am I' — Dapper's
+    propagated span context: a trace id shared by every process that
+    touches the query, the parent span id on the sending side, and the
+    sampled bit that carries the trace/no-trace decision downstream."""
+
+    __slots__ = ("trace_id", "span_id", "sampled")
+
+    def __init__(self, trace_id: str, span_id: Optional[int], sampled: bool = True):
+        self.trace_id = trace_id
+        self.span_id = span_id
+        self.sampled = sampled
+
+    def to_wire(self) -> dict:
+        return {
+            "trace_id": self.trace_id,
+            "span_id": self.span_id,
+            "sampled": bool(self.sampled),
+        }
+
+    @classmethod
+    def from_wire(cls, d) -> Optional["SpanContext"]:
+        if not isinstance(d, dict) or not d.get("trace_id"):
+            return None
+        sid = d.get("span_id")
+        return cls(
+            str(d["trace_id"]),
+            int(sid) if sid is not None else None,
+            bool(d.get("sampled", True)),
+        )
 
 
 class Span:
@@ -110,25 +156,38 @@ class Tracer:
     spans. One tracer per traced query (sessions build one per sampled
     query and export it at query end)."""
 
-    def __init__(self, capacity: int = 65536):
+    def __init__(
+        self,
+        capacity: int = 65536,
+        trace_id: Optional[str] = None,
+        remote_parent: Optional[int] = None,
+    ):
         self.capacity = max(16, int(capacity))
         self._ring: list = [None] * self.capacity
         self._n = 0  # total spans ever recorded (ring index = _n % capacity)
-        self._sid = 0
         self._lock = threading.Lock()
         self._tls = threading.local()
         #: fallback parent for threads with no attached context (partition
         #: pool threads): the query root span, set by query_scope
         self.root_sid: Optional[int] = None
+        #: one id per distributed trace: adopted from an inbound
+        #: SpanContext (serve frames, shuffle requests) or minted fresh —
+        #: every export stamps it so separate processes' dumps merge into
+        #: one coherent tree
+        self.trace_id = trace_id or uuid.uuid4().hex[:16]
+        #: span id of the REMOTE caller (the client span that carried this
+        #: trace over the wire); the root span records it so a merged
+        #: export parents the server tree under the client span
+        self.remote_parent = remote_parent
 
     # ── recording ───────────────────────────────────────────────────────
     def _next_sid(self) -> int:
-        with self._lock:
-            self._sid += 1
-            return self._sid
+        return next(_SID_COUNTER)
 
     def _record(self, span: Span) -> None:
         with self._lock:
+            if self._n >= self.capacity:
+                _M_DROPPED.add(1)  # overwriting the oldest slot
             self._ring[self._n % self.capacity] = span
             self._n += 1
 
@@ -182,6 +241,15 @@ class Tracer:
             }
         ]
         for s in self.spans():
+            args = dict(s.args or {}, span_id=s.sid, parent_id=s.parent)
+            if s.parent is None:
+                # root spans carry the cross-process linkage: the shared
+                # trace id and — when this tracer was born from a wire
+                # SpanContext — the remote caller's span id, so a merged
+                # export parents this tree under the client span
+                args["trace_id"] = self.trace_id
+                if self.remote_parent is not None:
+                    args["remote_parent_id"] = self.remote_parent
             ev = {
                 "ph": "X",
                 "name": s.name,
@@ -190,11 +258,17 @@ class Tracer:
                 "dur": s.dur / 1e3,
                 "pid": pid,
                 "tid": s.tid,
-                "args": dict(s.args or {}, span_id=s.sid,
-                             parent_id=s.parent),
+                "args": args,
             }
             events.append(ev)
-        return {"traceEvents": events, "displayTimeUnit": "ms"}
+        return {
+            "traceEvents": events,
+            "displayTimeUnit": "ms",
+            "otherData": {
+                "trace_id": self.trace_id,
+                "dropped_spans": self.dropped,
+            },
+        }
 
     def export_chrome(self, path: str, process_name: str = "spark_rapids_tpu") -> str:
         d = os.path.dirname(path)
@@ -257,6 +331,90 @@ def attach_context(captured) -> None:
         return
     tracer, ctx = captured
     tracer.attach_context(ctx)
+
+
+_UNPINNED = object()
+
+
+def record_span(
+    name: str,
+    cat: str = "op",
+    t0_ns: Optional[int] = None,
+    args=None,
+    captured=_UNPINNED,
+) -> None:
+    """Record an already-measured span with an explicit start time — for
+    generator-shaped regions (shuffle fetch streams) where a ``with``
+    scope would stay open across yields and leak this thread's span
+    context into the consumer's frames. ``captured`` pins the
+    (tracer, parent ctx) pair from :func:`capture_context`; a pinned
+    ``None`` (the capture found no active tracer) is a NO-OP — falling
+    back to whatever tracer is active at record time would misattribute
+    an unsampled query's span into a concurrent sampled query's trace.
+    Omit ``captured`` entirely to use the active tracer and the calling
+    thread's context."""
+    if captured is _UNPINNED:
+        tracer = _ACTIVE
+        parent = tracer.capture_context() if tracer is not None else None
+    elif captured is None:
+        return
+    else:
+        tracer, parent = captured
+    if tracer is None:
+        return
+    if parent is None:
+        parent = tracer._thread_parent()
+    now = time.perf_counter_ns()
+    start = t0_ns if t0_ns is not None else now
+    tracer._record(
+        Span(
+            tracer._next_sid(),
+            name,
+            cat,
+            start - _EPOCH_NS,
+            max(0, now - start),
+            parent,
+            threading.get_ident(),
+            args,
+        )
+    )
+
+
+def current_context() -> Optional[SpanContext]:
+    """The calling thread's position in the active trace as a wire-ready
+    :class:`SpanContext` (None when tracing is off) — what serve frames
+    and shuffle requests attach so remote work joins this query's tree."""
+    t = _ACTIVE
+    if t is None:
+        return None
+    sid = t.capture_context()
+    return SpanContext(t.trace_id, sid if sid is not None else t.root_sid)
+
+
+def merge_chrome(*traces: dict) -> dict:
+    """Concatenate Chrome-trace documents from the processes (or tracers)
+    that served one distributed query into a single Perfetto-loadable
+    file. Span ids are process-globally unique (one allocator) and root
+    spans carry ``trace_id``/``remote_parent_id`` args, so the merged
+    document is one coherent tree: client span → server query root →
+    operators → shuffle fetches."""
+    events: List[dict] = []
+    trace_ids = []
+    dropped = 0
+    for t in traces:
+        if not t:
+            continue
+        events.extend(t.get("traceEvents", ()))
+        other = t.get("otherData", {})
+        tid = other.get("trace_id")
+        if tid and tid not in trace_ids:
+            trace_ids.append(tid)
+        dropped += int(other.get("dropped_spans", 0))
+    return {
+        "traceEvents": events,
+        "displayTimeUnit": "ms",
+        "otherData": {"trace_ids": trace_ids, "dropped_spans": dropped},
+    }
 
 
 class query_scope:

@@ -955,6 +955,21 @@ class TpuOverrides:
         self.conf = conf
         self.breaker = breaker
         self.explain: List[ExplainEntry] = []
+        # cost-model source: the hardcoded per-op weights, or — when
+        # spark.rapids.tpu.cbo.measuredWeights holds and the persisted
+        # calibration table (obs/calibration.py) has measured device
+        # costs — measured ns/row normalized into the same integer-weight
+        # currency. With the conf off or the table absent/empty this is
+        # EXACTLY the hardcoded dict: planning stays bit-identical.
+        self._cbo_weights = self._CBO_WEIGHTS
+        self._cbo_source = "default"
+        if cfg.CBO_MEASURED_WEIGHTS.get(conf):
+            from ..obs.calibration import load_weights
+
+            measured = load_weights(cfg.CBO_CALIBRATION_FILE.get(conf))
+            if measured:
+                self._cbo_weights = measured
+                self._cbo_source = "measured"
 
     def apply(self, plan: Exec) -> Exec:
         if not self.conf.is_enabled(cfg.SQL_ENABLED):
@@ -986,25 +1001,37 @@ class TpuOverrides:
 
     def _island_weight(self, plan: Exec) -> int:
         """Total weight of the contiguous device region rooted here (host
-        children are the island's boundaries)."""
-        w = self._CBO_WEIGHTS.get(type(plan).__name__, 10)
+        children are the island's boundaries). Weights come from the
+        active cost table: hardcoded, or measured (calibration) when the
+        conf selected it — unknown ops default heavy either way (a node
+        nobody measured is assumed worth keeping on device)."""
+        w = self._cbo_weights.get(type(plan).__name__, 10)
         for c in plan.children:
             if c.is_device:
                 w += self._island_weight(c)
         return w
 
-    def _unconvert_island(self, plan: Exec) -> Exec:
+    def _unconvert_island(self, plan: Exec, weight: Optional[int] = None) -> Exec:
         if not plan.is_device:
             return self._cost_optimize(plan)
         kids = [self._unconvert_island(c) for c in plan.children]
         orig = getattr(plan, "_cpu_original", None)
         if orig is None:
             return plan.with_new_children(kids)
+        detail = (
+            f" ({self._cbo_source} weights: island {weight} < "
+            f"transition cost {self._CBO_TRANSITION_COST})"
+            if weight is not None
+            else ""
+        )
         self.explain.append(
             ExplainEntry(
                 orig.node_string(),
                 False,
-                ["cost-based optimizer: island too small to pay transitions"],
+                [
+                    "cost-based optimizer: island too small to pay "
+                    f"transitions{detail}"
+                ],
             )
         )
         return orig.with_new_children(kids)
@@ -1021,8 +1048,9 @@ class TpuOverrides:
 
     def _cost_optimize(self, plan: Exec) -> Exec:
         if plan.is_device:
-            if self._island_weight(plan) < self._CBO_TRANSITION_COST:
-                return self._unconvert_island(plan)
+            w = self._island_weight(plan)
+            if w < self._CBO_TRANSITION_COST:
+                return self._unconvert_island(plan, w)
             return self._keep_island(plan)
         return plan.with_new_children(
             [self._cost_optimize(c) for c in plan.children]

@@ -230,7 +230,7 @@ class Watchdog:
                 tok._reason = reason
                 _M.counter("watchdog.stalls").add(1)
                 _M.counter(
-                    f"watchdog.stalls.site.{obs_metrics.metric_slug(phase)}"
+                    obs_metrics.dynamic_name("watchdog.stalls.site.", phase)
                 ).add(1)
                 log.warning(
                     "watchdog: query %s stalled %.1fs in phase %s%s — "

@@ -39,6 +39,7 @@ from .cancel import CancelToken, QueryQueueFull
 
 _M = obs_metrics.GLOBAL
 _M_WAIT_NS = _M.timer("scheduler.queueWaitNs")
+_M_WAIT_HIST = _M.histogram("scheduler.queueWaitHist")
 _M_DEPTH = _M.gauge("scheduler.queueDepth")
 _M_IN_USE = _M.gauge("scheduler.permitsInUse")
 _M_LIMIT = _M.gauge("scheduler.effectivePermits")
@@ -228,7 +229,9 @@ class WeightedPermitPool:
                 self._dispatch()
             raise
         finally:
-            _M_WAIT_NS.add(time.perf_counter_ns() - t0)
+            wait_ns = time.perf_counter_ns() - t0
+            _M_WAIT_NS.add(wait_ns)
+            _M_WAIT_HIST.observe(wait_ns)
         return w.granted_need
 
     def release(self, granted: int, pool: str = "default") -> None:
@@ -253,7 +256,10 @@ class WeightedPermitPool:
         _M_IN_USE.set(self._in_use)
         _M_LIMIT.set(self.effective_permits())
         self._pass[pool] += need / self._pools[pool].weight
-        _M.counter(f"scheduler.pool.{pool}.admitted").add(1)
+        # slug-capped dynamic family: pool names are conf-supplied text
+        _M.counter(
+            obs_metrics.dynamic_name("scheduler.pool.", pool, ".admitted")
+        ).add(1)
 
     def _release_locked(self, granted: int, pool: str) -> None:
         self._in_use = max(0, self._in_use - granted)

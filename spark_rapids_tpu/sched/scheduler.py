@@ -41,10 +41,11 @@ _M = obs_metrics.GLOBAL
 def _count_cancelled(reason: str) -> None:
     """One Prometheus series per distinct cancel cause (user action vs
     client disconnect vs deadline vs watchdog stall) next to the
-    aggregate counter."""
+    aggregate counter. Cancel reasons carry free-ish text, so the family
+    is slug-capped (metrics.maxDynamicSlugs → 'other' overflow)."""
     _M.counter("scheduler.cancelled").add(1)
     _M.counter(
-        f"scheduler.cancelled.reason.{obs_metrics.metric_slug(reason)}"
+        obs_metrics.dynamic_name("scheduler.cancelled.reason.", reason)
     ).add(1)
 
 
@@ -53,7 +54,7 @@ def _count_shed(reason: str) -> None:
     rejected counter; this family covers the deadline-aware sheds)."""
     _M.counter("scheduler.shed").add(1)
     _M.counter(
-        f"scheduler.shed.reason.{obs_metrics.metric_slug(reason)}"
+        obs_metrics.dynamic_name("scheduler.shed.reason.", reason)
     ).add(1)
 
 

@@ -225,13 +225,24 @@ class Connection:
         return stream
 
     def sql(self, text: str, params: Optional[List] = None) -> ResultStream:
-        """EXECUTE + FETCH: run one statement, stream its result."""
+        """EXECUTE + FETCH: run one statement, stream its result.
+
+        With an active client-side tracer (obs/trace.py), the request
+        carries a compact span context — trace id, this client span's id,
+        the sampled bit — so the server's query tree parents under this
+        span and both exports merge into one Perfetto trace."""
+        from ..obs import trace as obs_trace
+
         self._begin()
         req = {"sql": text}
         if params is not None:
             req["params"] = params
-        self._send(P.EXECUTE, req)
-        _, body = self._reply(P.RESULT)
+        with obs_trace.span("serve-query", "client", {"sql": text[:120]}):
+            ctx = obs_trace.current_context()
+            if ctx is not None:
+                req["trace"] = ctx.to_wire()
+            self._send(P.EXECUTE, req)
+            _, body = self._reply(P.RESULT)
         return self._fetch(P.decode_json(body))
 
     def prepare(self, text: str) -> PreparedHandle:
@@ -246,12 +257,18 @@ class Connection:
     ) -> ResultStream:
         """EXECUTE_PREPARED + FETCH: run a prepared statement with bound
         parameters (the prepared-plan-cache path)."""
+        from ..obs import trace as obs_trace
+
         self._begin()
-        self._send(
-            P.EXECUTE_PREPARED,
-            {"statement_id": stmt.statement_id, "params": params or []},
-        )
-        _, body = self._reply(P.RESULT)
+        req = {"statement_id": stmt.statement_id, "params": params or []}
+        with obs_trace.span(
+            "serve-execute-prepared", "client", {"statement": stmt.statement_id}
+        ):
+            ctx = obs_trace.current_context()
+            if ctx is not None:
+                req["trace"] = ctx.to_wire()
+            self._send(P.EXECUTE_PREPARED, req)
+            _, body = self._reply(P.RESULT)
         return self._fetch(P.decode_json(body))
 
     # ── control ─────────────────────────────────────────────────────────

@@ -99,16 +99,24 @@ def _metric_slug(name: str) -> str:
     return obs_metrics.metric_slug(name, fallback="anon")
 
 
+#: served-query latency distributions (HISTOGRAM kind — Prometheus
+#: _bucket/_sum/_count): the real replacement for raw-sample percentile
+#: lists; latency_samples remains only as a bounded debugging window
+_M_WAIT_HIST = _M.histogram("serve.queryWaitHist")
+_M_RUN_HIST = _M.histogram("serve.queryRunHist")
+_M_TOTAL_HIST = _M.histogram("serve.queryTotalHist")
+
+
 class _PendingQuery:
     """A planned-but-not-yet-streamed query (between EXECUTE/BIND and its
     FETCH): the compiled plan + execution context, plus an early-cancel
     flag for CANCELs that land before admission mints a token."""
 
     __slots__ = ("query_id", "final_plan", "ctx", "cancelled_reason",
-                 "cache_hit", "traceable")
+                 "cache_hit", "traceable", "wire_trace")
 
     def __init__(self, query_id: str, final_plan, ctx, cache_hit: bool = False,
-                 traceable: bool = True):
+                 traceable: bool = True, wire_trace=None):
         self.query_id = query_id
         self.final_plan = final_plan
         self.ctx = ctx
@@ -118,6 +126,10 @@ class _PendingQuery:
         # per-query plan instances may be traced — prepared-cache plans
         # are SHARED across executions and must stay unwrapped
         self.traceable = traceable
+        # inbound SpanContext (obs/trace.py) from the EXECUTE/BIND frame:
+        # the client's trace id + parent span id + sampled bit — the
+        # Dapper propagation that merges client and server trees
+        self.wire_trace = wire_trace
 
 
 class TpuServer:
@@ -192,6 +204,13 @@ class TpuServer:
             self._warmup_thread.start()
         else:
             self._ready.set()
+        # live scrape endpoint (obs/scrape.py): /metrics + /healthz with
+        # this server's readiness folded in; no-op unless
+        # spark.rapids.tpu.metrics.httpPort asks for it (idempotent when
+        # the session already started one)
+        from ..obs.scrape import ensure_scrape
+
+        ensure_scrape(self.session, serve_server=self)
         _log.info("serving on %s:%d", self.host, self.port)
         return self.host, self.port
 
@@ -525,11 +544,16 @@ class TpuServer:
         )
 
     def _cmd_execute(self, sock, tenant, pending, req) -> None:
+        from ..obs.trace import SpanContext
+
         sql_text = req.get("sql") or ""
         params = req.get("params")
         df = self.session.sql(sql_text, params=params)
         final_plan, ctx = self.session._prepare_plan(df._plan)
-        pq = _PendingQuery(self._next_qid(), final_plan, ctx)
+        pq = _PendingQuery(
+            self._next_qid(), final_plan, ctx,
+            wire_trace=SpanContext.from_wire(req.get("trace")),
+        )
         pending[pq.query_id] = pq
         self._send_result(sock, pq)
 
@@ -553,11 +577,14 @@ class TpuServer:
         stmt = statements.get(sid)
         if stmt is None:
             raise SqlError(f"unknown statement_id {sid!r}")
+        from ..obs.trace import SpanContext
+
         final_plan, ctx, hit = self.prepared.resolve(
             stmt, req.get("params") or []
         )
         pq = _PendingQuery(
-            self._next_qid(), final_plan, ctx, cache_hit=hit, traceable=False
+            self._next_qid(), final_plan, ctx, cache_hit=hit, traceable=False,
+            wire_trace=SpanContext.from_wire(req.get("trace")),
         )
         pending[pq.query_id] = pq
         self._send_result(sock, pq)
@@ -631,7 +658,11 @@ class TpuServer:
 
     def _fetch_stream(self, sock, tenant, pq: _PendingQuery, qid: str) -> None:
         _M.counter("serve.queries").add(1)
-        _M.counter(f"serve.tenant.{_metric_slug(tenant.name)}.queries").add(1)
+        _M.counter(
+            obs_metrics.dynamic_name(
+                "serve.tenant.", tenant.name, ".queries", fallback="anon"
+            )
+        ).add(1)
         max_rows = max(1, cfg.SERVE_STREAM_BATCH_ROWS.get(self.session.conf))
         t0 = time.perf_counter_ns()
         rows = 0
@@ -644,13 +675,36 @@ class TpuServer:
         from ..obs import trace as obs_trace
         from ..resilience import faults as _faults
 
-        tracer = (
-            self.session._maybe_tracer(pq.ctx.query_seq)
-            if pq.traceable
-            else None
-        )
-        if tracer is not None:
+        wire = pq.wire_trace
+        if (
+            wire is not None
+            and wire.sampled
+            and cfg.TRACE_PROPAGATE.get(self.session.conf)
+        ):
+            # the client's sampled bit IS the trace decision (Dapper):
+            # adopt its trace id and parent this query tree under the
+            # client span so both exports merge into one coherent tree.
+            # Prepared statements propagate too — only the per-node plan
+            # instrumentation below is skipped for them (cached plans are
+            # SHARED; the query root + queued + module-level spans still
+            # record), so a traced client's prepared executions never
+            # leave an orphan client span
+            tracer = obs_trace.Tracer(
+                capacity=cfg.TRACE_BUFFER_SPANS.get(self.session.conf),
+                trace_id=wire.trace_id,
+                remote_parent=wire.span_id,
+            )
+        else:
+            tracer = (
+                self.session._maybe_tracer(pq.ctx.query_seq)
+                if pq.traceable
+                else None
+            )
+        if tracer is not None and pq.traceable:
             obs_trace.instrument_plan(pq.final_plan, tracer)
+        led = getattr(pq.ctx, "ledger", None)
+        if led is not None:
+            led.wall_start()  # second wall window: prepare was the first
         try:
             if pq.cancelled_reason:
                 raise QueryCancelledError(
@@ -665,6 +719,8 @@ class TpuServer:
                     tracer=tracer, pool=tenant.pool,
                 ) as adm:
                     pq.ctx.cancel_token = adm.token
+                    if led is not None:
+                        led.add("queue_wait", adm.queue_wait_ns)
                     if pq.cancelled_reason:  # raced the admission
                         adm.token.cancel(pq.cancelled_reason)
                     for rb in self.session.run_plan_stream(
@@ -691,6 +747,11 @@ class TpuServer:
             _M.timer("serve.queryWaitNs").add(adm.queue_wait_ns)
             run_ns = time.perf_counter_ns() - t0 - adm.queue_wait_ns
             _M.timer("serve.queryRunNs").add(max(0, run_ns))
+            # the distribution series (log2-bucket histograms): what the
+            # SLO bench derives its p50/p95/p99 from now
+            _M_WAIT_HIST.observe(adm.queue_wait_ns)
+            _M_RUN_HIST.observe(max(0, run_ns))
+            _M_TOTAL_HIST.observe(adm.queue_wait_ns + max(0, run_ns))
             self.latency_samples.append(
                 (tenant.name, adm.queue_wait_ns / 1e9, max(0, run_ns) / 1e9)
             )
@@ -703,16 +764,23 @@ class TpuServer:
             _M.counter("serve.queryErrors").add(1)
             self._send_error(sock, e, query_id=qid)
         finally:
+            if led is not None:
+                led.wall_stop()
+                self.session._last_ledger = led
             if tracer is not None:
                 self.session._export_trace(
-                    tracer, pq.final_plan, pq.ctx.query_seq
+                    tracer, pq.final_plan, pq.ctx.query_seq, ledger=led
                 )
             self.session._leak_check(pq.ctx)
 
     def _send_batch(self, sock, token, rb: pa.RecordBatch) -> None:
+        from ..obs import ledger as obs_ledger
         from ..resilience.watchdog import stall_phase
 
-        payload = ipc.write_batch(rb)
+        # wire IPC encoding bills the query ledger's 'serialize' phase
+        # (the handler thread carries the stream's current ledger)
+        with obs_ledger.phase("serialize"):
+            payload = ipc.write_batch(rb)
         send_timeout = cfg.SERVE_SEND_TIMEOUT_S.get(self.session.conf)
         try:
             # phase 'client' + a bounded send: a reader that stopped

@@ -32,26 +32,33 @@ from .columnar.host import concat_batches
 from .utils.threads import BIG_STACK_BYTES, STACK_SIZE_LOCK as _STACK_SIZE_LOCK
 
 
-def _token_checked(thunk, token):
+def _token_checked(thunk, token, ledger=None):
     """Wrap a partition thunk so the query's cancel token is checked once
     per result batch — with CPU-only plans (no device loop to check) this
     IS the batch-boundary cancellation guarantee."""
-    if token is None:
+    if token is None and ledger is None:
         return thunk
 
     def it():
         # install the token as this worker thread's watchdog current so
         # blocking regions beneath the pull (kernel compile, shuffle
-        # fetch) can label their stall phase; every check() is a beat
+        # fetch) can label their stall phase; every check() is a beat —
+        # and the query's phase ledger rides the same install so those
+        # regions attribute their time (obs/ledger.py current-ledger)
+        from .obs import ledger as _ledger
         from .resilience import watchdog as _wd
 
-        _wd.set_current(token)
+        if token is not None:
+            _wd.set_current(token)
+        _ledger.set_current(ledger)
         try:
             for rb in thunk():
-                token.check()
+                if token is not None:
+                    token.check()
                 yield rb
         finally:
             _wd.set_current(None)
+            _ledger.set_current(None)
 
     return it
 
@@ -135,6 +142,14 @@ class TpuSession:
         # process-global like the kernel cache it guards
         self._scheduler.breaker = self._breaker
         K.set_compile_deadline(cfg.COMPILE_DEADLINE_S.get(self.conf))
+        # obs wiring: the dynamic-series cardinality cap is process-global
+        # (the registry it guards is), and the live scrape endpoint starts
+        # here for bare sessions (TpuServer.start also ensures it)
+        from .obs import metrics as _obs_metrics
+        from .obs.scrape import ensure_scrape
+
+        _obs_metrics.set_slug_cap(cfg.METRICS_MAX_DYNAMIC_SLUGS.get(self.conf))
+        ensure_scrape(self)
         self._fault_injector = self._build_fault_injector()
         if cfg.MULTIPROC_DRIVER.get(self.conf):
             # fail fast on inconsistent multi-process settings — a missing
@@ -481,18 +496,22 @@ class TpuSession:
         # session's queries execute (no-op when faults are not enabled)
         with _faults.scoped(self._fault_injector):
             final_plan, ctx = self._prepare_plan(lp)
+            from .obs import ledger as obs_ledger
             from .obs import trace as obs_trace
             from .profiling import query_trace
 
             seq = ctx.query_seq
+            led = getattr(ctx, "ledger", None)
             tracer = self._maybe_tracer(seq)
             if tracer is not None:
                 # tracer pinned into the wrappers: a straggling producer
                 # thread keeps recording into ITS query's buffer, never
                 # into a later query's active tracer
                 obs_trace.instrument_plan(final_plan, tracer)
+            if led is not None:
+                led.wall_start()
             try:
-                with obs_trace.query_scope(
+                with obs_ledger.ledger_scope(led), obs_trace.query_scope(
                     tracer, f"query-{seq}", {"seq": seq}
                 ):
                     # multi-tenant admission (sched/): estimate the HBM
@@ -504,12 +523,38 @@ class TpuSession:
                         f"q{seq}", final_plan, self.conf, tracer
                     ) as admission:
                         ctx.cancel_token = admission.token
+                        if led is not None:
+                            led.add("queue_wait", admission.queue_wait_ns)
                         with query_trace(cfg.PROFILE_PATH.get(self.conf)):
                             return self._run_plan(final_plan, ctx)
             finally:
+                if led is not None:
+                    led.wall_stop()
+                    self._last_ledger = led
+                self._harvest_calibration(final_plan)
                 if tracer is not None:
-                    self._export_trace(tracer, final_plan, seq)
+                    self._export_trace(tracer, final_plan, seq, ledger=led)
                 self._leak_check(ctx)
+
+    def _harvest_calibration(self, final_plan) -> None:
+        """Feed the measured per-op cost table at query exit
+        (spark.rapids.tpu.cbo.calibration.enabled): opTime ÷ rows per node
+        into the EWMA, persisted so later sessions plan on measured costs
+        (obs/calibration.py). Never fails a query."""
+        if not cfg.CBO_CALIBRATION_ENABLED.get(self.conf):
+            return
+        from .obs import calibration as obs_cal
+
+        try:
+            cal = obs_cal.get(cfg.CBO_CALIBRATION_FILE.get(self.conf))
+            if cal.observe_plan(final_plan):
+                cal.save()
+        except Exception:
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "cost-calibration harvest failed", exc_info=True
+            )
 
     def _maybe_tracer(self, seq: int):
         """The span tracer for this query when tracing is on AND this query
@@ -529,7 +574,7 @@ class TpuSession:
 
         return Tracer(capacity=cfg.TRACE_BUFFER_SPANS.get(self.conf))
 
-    def _export_trace(self, tracer, plan, seq: int) -> None:
+    def _export_trace(self, tracer, plan, seq: int, ledger=None) -> None:
         """Per-query artifacts (spark.rapids.tpu.trace.dir): the Chrome-
         trace/Perfetto span dump plus the metrics JSON. Export failures
         never fail the query."""
@@ -550,6 +595,7 @@ class TpuSession:
                 plan=plan,
                 session=self,
                 tracer=tracer,
+                ledger=ledger,
             )
         except Exception:
             import logging
@@ -574,7 +620,30 @@ class TpuSession:
     def _prepare_plan(self, lp: L.LogicalPlan):
         """Analysis + physical planning + overrides: everything _execute
         does before running the plan. Split out so ``DataFrame.to_jax`` can
-        execute the same plan WITHOUT the final device→host transition."""
+        execute the same plan WITHOUT the final device→host transition.
+
+        Creates the query's host-overhead ledger (obs/ledger.py, attached
+        as ``ctx.ledger``) and bills this whole pass to its ``parse_plan``
+        phase — nested compile-warm scopes subtract themselves out."""
+        from .obs import ledger as obs_ledger
+
+        led = (
+            obs_ledger.PhaseLedger()
+            if cfg.LEDGER_ENABLED.get(self.conf)
+            else None
+        )
+        if led is not None:
+            led.wall_start()
+        try:
+            with obs_ledger.ledger_scope(led), obs_ledger.phase("parse_plan"):
+                final_plan, ctx = self._prepare_plan_inner(lp)
+        finally:
+            if led is not None:
+                led.wall_stop()
+        ctx.ledger = led
+        return final_plan, ctx
+
+    def _prepare_plan_inner(self, lp: L.LogicalPlan):
         from .plan.pruning import prune_columns
 
         lp = self._resolve_cached(lp)
@@ -620,7 +689,11 @@ class TpuSession:
         self._last_overrides = overrides
         self._assert_test_mode(overrides, final_plan)
         ctx = ExecContext(self.conf, self)
-        if cfg.PROFILE_OPTIME.get(self.conf):
+        if cfg.PROFILE_OPTIME.get(self.conf) or cfg.CBO_CALIBRATION_ENABLED.get(
+            self.conf
+        ):
+            # calibration needs per-op opTime attribution (block-until-ready
+            # per batch — a measurement mode) to harvest measured ns/row
             from .profiling import instrument_plan
 
             instrument_plan(final_plan)
@@ -701,12 +774,13 @@ class TpuSession:
         parts = final_plan.execute(ctx)
         attempts = cfg.TASK_MAX_FAILURES.get(self.conf)
         token = getattr(ctx, "cancel_token", None)
-        yield from self._stream_parts(parts, attempts, token, on_retry)
+        ledger = getattr(ctx, "ledger", None)
+        yield from self._stream_parts(parts, attempts, token, on_retry, ledger)
 
-    def _stream_parts(self, parts, attempts, token, on_retry):
+    def _stream_parts(self, parts, attempts, token, on_retry, ledger=None):
         for thunk in parts.parts:
             for rb in self._run_task(
-                _token_checked(thunk, token), attempts, on_retry
+                _token_checked(thunk, token, ledger), attempts, on_retry
             ):
                 if rb.num_rows:
                     yield rb
@@ -716,6 +790,7 @@ class TpuSession:
         batches: List[pa.RecordBatch] = []
         attempts = cfg.TASK_MAX_FAILURES.get(self.conf)
         token = getattr(ctx, "cancel_token", None)
+        ledger = getattr(ctx, "ledger", None)
         # per-QUERY retry count (concurrent queries must not clobber each
         # other mid-flight); the session attribute becomes the last
         # finished query's total, assigned once in the finally below
@@ -752,7 +827,7 @@ class TpuSession:
                     futures = [
                         pool.submit(
                             self._run_task,
-                            _token_checked(t, token),
+                            _token_checked(t, token, ledger),
                             attempts,
                             on_retry,
                         )
@@ -769,16 +844,22 @@ class TpuSession:
         else:
             try:
                 batches.extend(
-                    self._stream_parts(parts, attempts, token, on_retry)
+                    self._stream_parts(parts, attempts, token, on_retry, ledger)
                 )
             finally:
                 self._task_retries = query_retries[0]
+        from .obs import ledger as obs_ledger
+
         schema = final_plan.output
-        if not batches:
-            return pa.table(
-                {f.name: pa.array([], type=f.data_type.to_arrow()) for f in schema}
-            )
-        return pa.Table.from_batches(batches)
+        with obs_ledger.scope_or_null(ledger, "serialize"):
+            if not batches:
+                return pa.table(
+                    {
+                        f.name: pa.array([], type=f.data_type.to_arrow())
+                        for f in schema
+                    }
+                )
+            return pa.Table.from_batches(batches)
 
     def _assert_test_mode(self, overrides: TpuOverrides, plan: Exec):
         """TEST_CONF: fail when expected-on-device execs fell back
@@ -1519,15 +1600,21 @@ class DataFrame:
             # (the Spark-UI node annotations). Metrics live on the EXECUTED
             # plan instance, so this renders the session's last run —
             # collect() first (matching the UI, which is also post-run).
-            from .obs.export import render_plan_metrics
+            from .obs.export import render_ledger, render_plan_metrics
 
             plan = self._session._last_plan
             if plan is None:
                 s = "<no query executed yet — collect() first>"
             else:
                 # every collected metric (ESSENTIAL always; MODERATE/DEBUG
-                # when the level conf collected them)
+                # when the level conf collected them), headed by the host-
+                # overhead ledger: where the last query's wall clock went
                 s = render_plan_metrics(plan)
+                led = render_ledger(
+                    getattr(self._session, "_last_ledger", None)
+                )
+                if led:
+                    s = led + "\n" + s
             print(s)
             return s
         cpu_plan = plan_physical(self._plan, self._session.conf)

@@ -129,9 +129,20 @@ class ShuffleClient:
 
     # ── fetch orchestration ─────────────────────────────────────────────
     def _request_metadata(self, blocks: List[M.BlockId]) -> List[M.TableMeta]:
+        from ..obs import trace as obs_trace
         from ..resilience.watchdog import stall_phase
 
-        tx = self._conn.request(REQ_METADATA, M.pack_metadata_request(blocks))
+        # cross-process propagation: the request carries this thread's
+        # span context so the serving executor's fetch-serve span lands
+        # in the SAME trace (obs/trace.py merge_chrome joins the exports)
+        span_ctx = obs_trace.current_context()
+        tx = self._conn.request(
+            REQ_METADATA,
+            M.pack_metadata_request(
+                blocks,
+                trace=span_ctx.to_wire() if span_ctx is not None else None,
+            ),
+        )
         try:
             with stall_phase("fetch", f"peer:{self._peer_id}"):
                 tx.wait(self._timeout)
@@ -150,8 +161,28 @@ class ShuffleClient:
         as transfers complete. The caller materializes via the received
         catalog (RapidsShuffleIterator's batch-per-next loop). Safe to call
         from concurrent tasks sharing this client."""
+        from ..obs import metrics as obs_metrics
+        from ..obs import trace as obs_trace
         from ..resilience import retry as R
 
+        t_fetch = time.perf_counter_ns()
+        # pin (tracer, ctx) NOW: the span is recorded in the finally with
+        # an explicit start time — a `with` scope here would stay open
+        # across yields and leak span context into the consumer
+        captured = obs_trace.capture_context()
+        try:
+            yield from self._fetch_blocks_inner(blocks, R)
+        finally:
+            obs_trace.record_span(
+                "shuffle-fetch", "shuffle", t0_ns=t_fetch,
+                args={"peer": str(self._peer_id), "blocks": len(blocks)},
+                captured=captured,
+            )
+            obs_metrics.GLOBAL.histogram("shuffle.fetchHist").observe(
+                time.perf_counter_ns() - t_fetch
+            )
+
+    def _fetch_blocks_inner(self, blocks: List[M.BlockId], R):
         attempt = 0
         while True:
             try:

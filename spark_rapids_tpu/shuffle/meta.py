@@ -139,8 +139,19 @@ class BlockId:
         return cls(*vals), off + struct.calcsize(cls._FMT)
 
 
-def pack_metadata_request(blocks: Sequence[BlockId]) -> bytes:
-    return _pack_list(blocks, BlockId.pack)
+def pack_metadata_request(blocks: Sequence[BlockId], trace=None) -> bytes:
+    """Blocks, plus an OPTIONAL length-prefixed JSON span-context tail
+    (obs/trace.py SpanContext.to_wire) — cross-process trace propagation:
+    the serving executor's fetch-serve span joins the requesting query's
+    trace. Old unpackers read exactly ``n`` blocks and never look past
+    them, so the tail is wire-compatible within the same frame version."""
+    out = _pack_list(blocks, BlockId.pack)
+    if trace:
+        import json
+
+        blob = json.dumps(trace).encode("utf-8")
+        out += struct.pack("<i", len(blob)) + blob
+    return out
 
 
 def unpack_metadata_request(data: bytes) -> List[BlockId]:
@@ -151,6 +162,26 @@ def unpack_metadata_request(data: bytes) -> List[BlockId]:
         b, off = BlockId.unpack(buf, off)
         out.append(b)
     return out
+
+
+def unpack_metadata_trace(data: bytes):
+    """The optional span-context tail of a metadata request (None when
+    absent or unreadable — propagation is best-effort by design)."""
+    import json
+
+    buf = memoryview(data)
+    try:
+        n, off = _unpack_header(buf)
+        off += n * struct.calcsize(BlockId._FMT)
+        if len(buf) < off + 4:
+            return None
+        (ln,) = struct.unpack_from("<i", buf, off)
+        off += 4
+        if ln <= 0 or len(buf) < off + ln:
+            return None
+        return json.loads(bytes(buf[off:off + ln]).decode("utf-8"))
+    except (ValueError, UnicodeDecodeError):
+        return None
 
 
 def pack_metadata_response(metas: Sequence[TableMeta]) -> bytes:

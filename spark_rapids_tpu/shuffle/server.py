@@ -64,15 +64,28 @@ class ShuffleServer:
                 self._pending_bytes -= len(old)
 
     def _on_metadata(self, peer: str, payload: bytes) -> bytes:
+        from ..obs import trace as obs_trace
+
         blocks = M.unpack_metadata_request(payload)
-        all_metas = []
-        for b in blocks:
-            metas, payloads = self._catalog.table_metas(
-                b.shuffle_id, b.map_id, b.start_partition, b.end_partition, self._codec
-            )
-            all_metas.extend(metas)
-            self._put_pending(payloads)
-        return M.pack_metadata_response(all_metas)
+        # cross-process propagation (Dapper): the requester's span context
+        # rides the frame tail; when THIS executor is tracing, the serve
+        # span carries the remote trace/span ids so merge_chrome joins
+        # both processes' exports into one tree
+        wire = obs_trace.SpanContext.from_wire(M.unpack_metadata_trace(payload))
+        args = {"peer": peer, "blocks": len(blocks)}
+        if wire is not None:
+            args["trace_id"] = wire.trace_id
+            args["remote_parent_id"] = wire.span_id
+        with obs_trace.span("shuffle-serve-metadata", "shuffle", args):
+            all_metas = []
+            for b in blocks:
+                metas, payloads = self._catalog.table_metas(
+                    b.shuffle_id, b.map_id, b.start_partition,
+                    b.end_partition, self._codec
+                )
+                all_metas.extend(metas)
+                self._put_pending(payloads)
+            return M.pack_metadata_response(all_metas)
 
     def _on_transfer(self, peer: str, payload: bytes) -> bytes:
         req = M.TransferRequest.unpack(payload)
