@@ -1,0 +1,108 @@
+"""CLI: ``python -m spark_rapids_tpu.analysis [root] [options]``.
+
+Exit 0 when every finding is suppressed or baselined; 1 otherwise.
+``--write-baseline`` regenerates the baseline file from the current
+unsuppressed findings (existing justifications survive; new entries
+require ``--justify``, and protected directories are refused).
+"""
+from __future__ import annotations
+
+import argparse
+import sys
+
+from . import (
+    Project,
+    default_baseline_path,
+    load_baseline,
+    run_passes,
+    write_baseline,
+)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="spark_rapids_tpu.analysis")
+    ap.add_argument("root", nargs="?", default=".")
+    ap.add_argument(
+        "--passes",
+        help="comma-separated pass ids to run (default: all)",
+    )
+    ap.add_argument(
+        "--write-baseline", action="store_true",
+        help="regenerate the baseline from current unsuppressed findings",
+    )
+    ap.add_argument(
+        "--justify",
+        default="",
+        help="justification recorded for NEW baseline entries",
+    )
+    ap.add_argument(
+        "--baseline",
+        help="baseline file path (default: spark_rapids_tpu/analysis/"
+             "BASELINE.lint under root)",
+    )
+    ap.add_argument("-q", "--quiet", action="store_true")
+    args = ap.parse_args(argv)
+
+    project = Project.load(args.root)
+    bl_path = args.baseline or default_baseline_path(args.root)
+
+    selected = None
+    if args.passes:
+        from .passes import all_passes
+
+        want = {p.strip() for p in args.passes.split(",") if p.strip()}
+        selected = [p for p in all_passes() if p.id in want]
+        unknown = want - {p.id for p in selected}
+        if unknown:
+            print(f"graft-lint: unknown pass id(s): {sorted(unknown)}")
+            return 2
+
+    if args.write_baseline:
+        if selected is not None:
+            # regeneration rewrites the WHOLE file: a subset run would
+            # silently drop every unselected pass's justified entries
+            print(
+                "graft-lint: --write-baseline requires the full pass "
+                "suite (drop --passes)"
+            )
+            return 2
+        # the suppression layer still applies; only live, unsuppressed
+        # findings become baseline rows
+        result = run_passes(project, selected, baseline=None)
+        total, fresh = write_baseline(
+            bl_path, result.findings, load_baseline(bl_path), args.justify
+        )
+        print(
+            f"graft-lint: baseline written to {bl_path} "
+            f"({total} entries, {fresh} new)"
+        )
+        return 0
+
+    result = run_passes(project, selected, baseline=load_baseline(bl_path))
+    for f in result.framework:
+        print(f.render())
+    for f in result.findings:
+        print(f.render())
+    n = len(result.findings) + len(result.framework)
+    if n:
+        print(
+            f"graft-lint: {n} finding(s) "
+            f"({len(result.suppressed)} suppressed, "
+            f"{len(result.baselined)} baselined) — fix, suppress with "
+            "'# graft: ok(<pass>: <reason>)', or baseline "
+            "(make lint-baseline JUSTIFY='…'; exec/, serve/, sched/ can "
+            "never be baselined)"
+        )
+        return 1
+    if not args.quiet:
+        print(
+            "graft-lint: clean "
+            f"({len(result.all_findings)} findings total: "
+            f"{len(result.suppressed)} suppressed at the site, "
+            f"{len(result.baselined)} baselined)"
+        )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,118 @@
+"""Declared lock hierarchy — the single source of truth for the static
+``lock-order`` pass and the runtime :mod:`.lockwatch` harness.
+
+The engine holds ~65 module/instance locks. A deadlock needs a *cycle* in
+the acquisition order; the cheap way to make cycles impossible is a
+declared partial order: every lock belongs to a **domain tier**, and while
+holding a lock of tier *t* a thread may only acquire locks of tier >= *t*
+(equal tiers are allowed — sibling leaf locks — and are still covered by
+the cycle check on the concrete acquisition graph).
+
+Tiers run outermost→innermost: session-level entry points first, the obs
+leaf locks (metrics/trace/ledger — never acquire anything) last. A lock's
+domain is derived from the *file that creates it*, which matches how the
+locks are actually organized (one subsystem per module) and lets the
+runtime harness classify a lock from its creation site alone.
+
+Changing this table is a semantic statement about the whole engine —
+document the reasoning in docs/static-analysis.md when you do.
+"""
+from __future__ import annotations
+
+import re
+from typing import Optional, Tuple
+
+#: (tier, domain, [path regexes]) — matched against the repo-relative,
+#: forward-slash path of the file whose code CREATES the lock. First match
+#: wins; unmatched files get no tier (cycle detection still applies).
+DOMAINS = (
+    # serving front-end: connection registry / in-flight gate, held briefly
+    # around bookkeeping while calling into the scheduler
+    (15, "serve", (r"^spark_rapids_tpu/serve/",)),
+    # scheduler registry + cancellation tokens, then the permit pool it
+    # acquires beneath itself
+    (20, "sched", (r"^spark_rapids_tpu/sched/(scheduler|cancel)\.py$",)),
+    (25, "admission", (r"^spark_rapids_tpu/sched/admission\.py$",)),
+    # watchdog scanner state: configured from admission (tier 20 callers),
+    # scans tokens without holding its own lock
+    (28, "watchdog", (r"^spark_rapids_tpu/resilience/watchdog\.py$",)),
+    # operator-local state locks (exchange materialization, AQE memos,
+    # outer-join tail state) and the plan context — held while calling
+    # DOWN into shuffle writers, the spill catalog, and kernel launches
+    (40, "exec", (
+        r"^spark_rapids_tpu/exec/",
+        r"^spark_rapids_tpu/plan/",
+        r"^spark_rapids_tpu/parallel/",
+    )),
+    # shuffle control plane above its data plane; both beneath the
+    # operators that drive them (ensure_written holds its exchange lock
+    # while asking the manager for a writer)
+    (50, "shuffle-ctl", (
+        r"^spark_rapids_tpu/shuffle/(manager|heartbeat|driver_service)\.py$",
+    )),
+    (55, "shuffle-data", (
+        r"^spark_rapids_tpu/shuffle/(tcp|transport|bounce|server|local|"
+        r"client|catalog)\.py$",
+    )),
+    # memory layer: spill catalog / device semaphore — a shared service
+    # acquired beneath operators AND beneath shuffle writers registering
+    # their map output
+    (58, "mem", (r"^spark_rapids_tpu/mem/", r"^spark_rapids_tpu/io/")),
+    # kernel cache + the global compile lock: first-touch compiles run
+    # beneath operator dispatch, never the other way around
+    (60, "kernels", (r"^spark_rapids_tpu/kernels\.py$",)),
+    # resilience counters/injectors consulted from anywhere above
+    (70, "resilience", (
+        r"^spark_rapids_tpu/resilience/(faults|breaker|retry)\.py$",
+    )),
+    # session-cache bookkeeping (df.cache single-flight table, the H2D
+    # upload LRU, the retry counter): LEAF locks — dict/event ops only,
+    # materialization runs OUTSIDE them — acquired from deep inside
+    # operator execution (a broadcast build's H2D upload), so they sit
+    # near the bottom despite living on the session object
+    (78, "session-caches", (r"^spark_rapids_tpu/session\.py$",)),
+    # native/bootstrap singletons
+    (80, "native", (
+        r"^spark_rapids_tpu/native/",
+        r"^spark_rapids_tpu/utils/",
+        r"^spark_rapids_tpu/ops/",
+        r"^spark_rapids_tpu/config\.py$",
+    )),
+    # obs leaf locks: metric registries, trace ring, ledger, calibration —
+    # acquired from EVERY tier above, must never acquire anything themselves
+    (90, "obs", (r"^spark_rapids_tpu/obs/",)),
+)
+
+_COMPILED = tuple(
+    (tier, domain, tuple(re.compile(p) for p in pats))
+    for tier, domain, pats in DOMAINS
+)
+
+#: kept for the ISSUE-facing name: the ordered (tier, domain) pairs
+HIERARCHY = tuple((tier, domain) for tier, domain, _ in DOMAINS)
+
+
+def tier_for_path(rel_path: str) -> Optional[Tuple[int, str]]:
+    """(tier, domain) for the lock created in ``rel_path``; None when the
+    file belongs to no declared domain (tests, fixtures, third-party)."""
+    rel = rel_path.replace("\\", "/")
+    # tolerate absolute paths from runtime stack frames
+    idx = rel.find("spark_rapids_tpu/")
+    if idx > 0:
+        rel = rel[idx:]
+    for tier, domain, pats in _COMPILED:
+        for p in pats:
+            if p.search(rel):
+                return tier, domain
+    return None
+
+
+def ordered_ok(outer_path: str, inner_path: str) -> bool:
+    """May a lock created in ``inner_path`` be acquired while one created
+    in ``outer_path`` is held? True when either side is undeclared or the
+    inner tier is >= the outer tier."""
+    o = tier_for_path(outer_path)
+    i = tier_for_path(inner_path)
+    if o is None or i is None:
+        return True
+    return i[0] >= o[0]

@@ -1,0 +1,270 @@
+"""lockwatch — runtime lock-order race harness (the dynamic teeth of the
+static ``lock-order`` pass).
+
+``install()`` monkey-patches ``threading.Lock`` / ``threading.RLock`` /
+``threading.Condition`` so locks *created by engine code after the
+install* come back instrumented: every successful acquisition records the
+ordered pairs (held-lock → acquired-lock) per thread into one process-wide
+order graph, tagged with the locks' creation sites. ``report()`` then
+checks two things the static pass asserts from source:
+
+* **no cycle** in the observed acquisition-order graph (a cycle between
+  concrete lock sites is a latent deadlock — two threads walking the
+  cycle from different entry points wedge forever);
+* **no hierarchy inversion** against the declared tiers in
+  :mod:`.lock_order` (acquiring a lower-tier lock while holding a
+  higher-tier one).
+
+Locks created by non-engine code (stdlib, site-packages, the test files
+themselves) are handed back un-instrumented, so the harness costs nothing
+outside the engine and the graph stays noise-free. Reentrant RLock
+re-acquisitions record no edges (holding a lock "against itself" is not
+an ordering).
+
+The tier-1 scheduler/serve suites and every ``chaos``-marked test run
+under this harness via the autouse fixture in ``tests/conftest.py``; the
+teardown asserts the report is clean, so a lock-order regression fails
+the suite that actually exercised the interleaving.
+"""
+from __future__ import annotations
+
+import os
+import sys
+import threading
+from typing import Dict, List, Optional, Set, Tuple
+
+from . import lock_order
+
+_REPO_MARKER = os.sep + "spark_rapids_tpu" + os.sep
+
+_state_lock = threading.Lock()
+_installed = False
+_orig: Dict[str, object] = {}
+
+#: creation-site string → creation-site string, with one example holder
+#: stack site; persists across install/uninstall so the assertion is
+#: "never observed", not "not observed in this test"
+_EDGES: Dict[Tuple[str, str], str] = {}
+_SITES: Set[str] = set()
+_TLS = threading.local()
+
+
+def _held_stack() -> List["_WatchedLock"]:
+    st = getattr(_TLS, "stack", None)
+    if st is None:
+        st = []
+        _TLS.stack = st
+    return st
+
+
+def _caller_site(depth: int = 2) -> Optional[str]:
+    """file:line of the engine frame creating the lock; None when the
+    creation site is not engine code (→ hand back a raw lock)."""
+    try:
+        f = sys._getframe(depth)
+    except ValueError:  # pragma: no cover - shallow stacks
+        return None
+    fn = f.f_code.co_filename
+    if _REPO_MARKER not in fn and "spark_rapids_tpu/" not in fn.replace(
+        os.sep, "/"
+    ):
+        return None
+    rel = fn.replace(os.sep, "/")
+    idx = rel.find("spark_rapids_tpu/")
+    if idx >= 0:
+        rel = rel[idx:]
+    return f"{rel}:{f.f_lineno}"
+
+
+class _WatchedLock:
+    """Delegating wrapper around a real Lock/RLock that records the
+    acquisition-order graph. ``__getattr__`` forwards the private
+    protocol ``threading.Condition`` relies on (``_is_owned``,
+    ``_release_save``, ``_acquire_restore``)."""
+
+    def __init__(self, inner, site: str, reentrant: bool):
+        self._inner = inner
+        self._site = site
+        self._reentrant = reentrant
+        _SITES.add(site)
+
+    # ── recording ───────────────────────────────────────────────────────
+    def _record_acquired(self) -> None:
+        stack = _held_stack()
+        if self._reentrant and any(l is self for l in stack):
+            stack.append(self)  # depth only; no edge for a re-entry
+            return
+        if stack:
+            with _state_lock:
+                for held in stack:
+                    # same-site pairs are DISTINCT INSTANCES from one
+                    # creation site (per-exchange/per-partition locks):
+                    # site granularity cannot order instances, and their
+                    # nesting follows the acyclic plan DAG — recording
+                    # them would report every such nest as a self-cycle
+                    if held is self or held._site == self._site:
+                        continue
+                    _EDGES.setdefault(
+                        (held._site, self._site),
+                        threading.current_thread().name,
+                    )
+        stack.append(self)
+
+    def _record_released(self) -> None:
+        stack = _held_stack()
+        for i in range(len(stack) - 1, -1, -1):
+            if stack[i] is self:
+                del stack[i]
+                return
+
+    # ── lock protocol ───────────────────────────────────────────────────
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        got = self._inner.acquire(blocking, timeout)
+        if got:
+            self._record_acquired()
+        return got
+
+    def release(self) -> None:
+        self._inner.release()
+        self._record_released()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self.release()
+        return False
+
+    def locked(self) -> bool:
+        return self._inner.locked()
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+
+def _make_lock_factory(kind: str):
+    real_lock = _orig["Lock"]
+    real_rlock = _orig["RLock"]
+
+    def factory():
+        inner = real_lock() if kind == "Lock" else real_rlock()
+        site = _caller_site(2)
+        if site is None:
+            return inner
+        return _WatchedLock(inner, site, reentrant=(kind == "RLock"))
+
+    factory.__name__ = kind
+    return factory
+
+
+def _make_condition_factory():
+    real_condition = _orig["Condition"]
+    real_rlock = _orig["RLock"]
+
+    def Condition(lock=None):
+        if lock is None:
+            site = _caller_site(2)
+            if site is not None:
+                lock = _WatchedLock(real_rlock(), site, reentrant=True)
+        return real_condition(lock)
+
+    return Condition
+
+
+def install() -> None:
+    """Patch the threading constructors (idempotent)."""
+    global _installed
+    with _state_lock:
+        if _installed:
+            return
+        _orig["Lock"] = threading.Lock
+        _orig["RLock"] = threading.RLock
+        _orig["Condition"] = threading.Condition
+        _installed = True
+    threading.Lock = _make_lock_factory("Lock")
+    threading.RLock = _make_lock_factory("RLock")
+    threading.Condition = _make_condition_factory()
+
+
+def uninstall() -> None:
+    """Restore the real constructors; recorded observations persist."""
+    global _installed
+    with _state_lock:
+        if not _installed:
+            return
+        threading.Lock = _orig["Lock"]
+        threading.RLock = _orig["RLock"]
+        threading.Condition = _orig["Condition"]
+        _installed = False
+
+
+def reset() -> None:
+    """Drop every recorded observation (test isolation)."""
+    with _state_lock:
+        _EDGES.clear()
+        _SITES.clear()
+
+
+class Report:
+    def __init__(self, cycles, inversions, edges):
+        self.cycles: List[List[str]] = cycles
+        self.inversions: List[str] = inversions
+        self.edges = edges
+
+    @property
+    def ok(self) -> bool:
+        return not self.cycles and not self.inversions
+
+    def describe(self) -> str:
+        out = []
+        for cyc in self.cycles:
+            out.append("lock-order cycle observed: " + " -> ".join(cyc))
+        out.extend(self.inversions)
+        return "\n".join(out) or "lockwatch: clean"
+
+
+def report() -> Report:
+    with _state_lock:
+        edges = dict(_EDGES)
+    adj: Dict[str, List[str]] = {}
+    for (a, b), _thr in edges.items():
+        adj.setdefault(a, []).append(b)
+
+    cycles: List[List[str]] = []
+    WHITE, GREY, BLACK = 0, 1, 2
+    color: Dict[str, int] = {}
+    stack: List[str] = []
+
+    def dfs(node: str) -> None:
+        color[node] = GREY
+        stack.append(node)
+        for nxt in adj.get(node, ()):
+            c = color.get(nxt, WHITE)
+            if c == GREY:
+                i = stack.index(nxt)
+                cycles.append(stack[i:] + [nxt])
+            elif c == WHITE:
+                dfs(nxt)
+        stack.pop()
+        color[node] = BLACK
+
+    for node in sorted(adj):
+        if color.get(node, WHITE) == WHITE:
+            dfs(node)
+
+    inversions: List[str] = []
+    for (a, b), thread in sorted(edges.items()):
+        a_path = a.rsplit(":", 1)[0]
+        b_path = b.rsplit(":", 1)[0]
+        if not lock_order.ordered_ok(a_path, b_path):
+            ta = lock_order.tier_for_path(a_path)
+            tb = lock_order.tier_for_path(b_path)
+            inversions.append(
+                f"hierarchy inversion (thread {thread}): lock {b} "
+                f"(tier {tb[0]} {tb[1]}) acquired while holding {a} "
+                f"(tier {ta[0]} {ta[1]}) — declared order is "
+                "outer(lower) before inner(higher); see "
+                "analysis/lock_order.py"
+            )
+    return Report(cycles, inversions, edges)

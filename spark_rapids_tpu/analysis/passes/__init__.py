@@ -1,0 +1,19 @@
+"""Pass registry for graft-lint. Order matters only for report grouping;
+passes are independent."""
+from __future__ import annotations
+
+from typing import List
+
+from .. import LintPass
+
+
+def all_passes() -> List[LintPass]:
+    from . import cancel_beat, conf_keys, host_sync, locks, metrics
+
+    return [
+        host_sync.PASS,
+        locks.PASS,
+        conf_keys.PASS,
+        cancel_beat.PASS,
+        metrics.PASS,
+    ]

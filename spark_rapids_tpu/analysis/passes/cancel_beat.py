@@ -1,0 +1,147 @@
+"""cancel-beat — watchdog/cancellation coverage of batch-granular loops.
+
+The PR-5 cancellation contract and the PR-7 progress watchdog both hinge
+on one invariant: every loop that streams batches stamps a progress beat
+— ``CancelToken.check()`` (which raises on cancel AND stamps the beat),
+``token.beat()``, or an explicit ``stall_phase(...)`` scope around a long
+legitimate wait. A batch loop without a beat is invisible: a cancelled
+query keeps dispatching until the loop ends, and the watchdog
+misattributes the silence as a stall of whatever ran *before* the loop.
+
+Statically, "batch-granular loop" means a ``for``/``while`` loop that
+**yields** from inside its body (the engine's operators are pull-based
+generators — the loops that stream batches downstream are exactly the
+generator loops) in the device-execution and serving modules. Loops whose
+body delegates streaming to an already-beating driver
+(``run_device``, ``pipelined_partition``, ``run_with_retry``,
+``_stream_probe_join``) are covered through the delegate.
+
+Drain loops (consume everything, yield nothing) are out of scope: their
+upstream generators carry the beats, and flagging every drain would bury
+the signal. Suppress intentional beat-less generators (host-side
+re-chunking of one already-materialized batch, trace-time iteration) with
+``# graft: ok(cancel-beat: <why>)``.
+"""
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterable, List
+
+from .. import Finding, LintPass, Project
+
+SCOPE_PATTERNS = (
+    r"^spark_rapids_tpu/exec/(?!cpu)",
+    r"^spark_rapids_tpu/serve/server\.py$",
+    r"^spark_rapids_tpu/shuffle/(client|manager|server)\.py$",
+)
+_SCOPE = tuple(re.compile(p) for p in SCOPE_PATTERNS)
+
+#: calls that stamp a beat (or raise on cancel, which is better)
+_BEAT_ATTRS = {"check", "beat"}
+_BEAT_NAMES = {"stall_phase"}
+
+#: generator drivers that beat internally — a loop delegating its yields
+#: to one of these is covered
+_DELEGATES = {
+    "run_device", "pipelined_partition", "run_with_retry",
+    "_stream_probe_join", "_transfer_wave", "fetch_blocks",
+}
+
+
+def _in_scope(rel: str) -> bool:
+    return any(p.search(rel) for p in _SCOPE)
+
+
+class _LoopBody:
+    """Walk a loop body without crossing into nested function defs (their
+    yields/beats belong to the nested generator, not this loop)."""
+
+    def __init__(self, body):
+        self.yields = False
+        self.beats = False
+        self.delegated = False
+        for stmt in body:
+            self._walk(stmt)
+
+    def _walk(self, node: ast.AST) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            return
+        if isinstance(node, ast.Yield):
+            self.yields = True
+        elif isinstance(node, ast.YieldFrom):
+            self.yields = True
+            if self._delegate_call(node.value):
+                self.delegated = True
+        elif isinstance(node, ast.Call):
+            fn = node.func
+            if isinstance(fn, ast.Attribute) and fn.attr in _BEAT_ATTRS \
+                    and not node.args and not node.keywords:
+                self.beats = True
+            elif isinstance(fn, ast.Name) and fn.id in _BEAT_NAMES:
+                self.beats = True
+            elif isinstance(fn, ast.Attribute) and fn.attr in _BEAT_NAMES:
+                self.beats = True
+            elif self._delegate_call(node):
+                self.delegated = True
+        for child in ast.iter_child_nodes(node):
+            self._walk(child)
+
+    @staticmethod
+    def _delegate_call(node: ast.AST) -> bool:
+        if not isinstance(node, ast.Call):
+            return False
+        fn = node.func
+        name = (
+            fn.id if isinstance(fn, ast.Name)
+            else fn.attr if isinstance(fn, ast.Attribute)
+            else None
+        )
+        return name in _DELEGATES
+
+
+class _Visitor(ast.NodeVisitor):
+    def __init__(self, pass_: "CancelBeatPass", rel: str):
+        self.p = pass_
+        self.rel = rel
+        self.findings: List[Finding] = []
+
+    def _check_loop(self, node) -> None:
+        body = _LoopBody(node.body)
+        # a for-loop ITERATING a beating driver is covered by it
+        if isinstance(node, ast.For) and _LoopBody._delegate_call(node.iter):
+            body.delegated = True
+        if body.yields and not body.beats and not body.delegated:
+            kind = "for" if isinstance(node, ast.For) else "while"
+            self.findings.append(self.p.finding(
+                self.rel, node.lineno,
+                f"batch-streaming {kind} loop yields without a "
+                "cancellation beat — add token.check() (raises on "
+                "cancel, stamps the watchdog beat) at the top of the "
+                "body, wrap the long wait in stall_phase(...), or "
+                "acknowledge with '# graft: ok(cancel-beat: <why>)'",
+            ))
+        self.generic_visit(node)
+
+    def visit_For(self, node: ast.For) -> None:
+        self._check_loop(node)
+
+    def visit_While(self, node: ast.While) -> None:
+        self._check_loop(node)
+
+
+class CancelBeatPass(LintPass):
+    id = "cancel-beat"
+    title = "cancellation/watchdog beats in batch-streaming loops"
+
+    def run(self, project: Project) -> Iterable[Finding]:
+        for sf in project.files:
+            if not _in_scope(sf.rel) or sf.tree is None:
+                continue
+            v = _Visitor(self, sf.rel)
+            v.visit(sf.tree)
+            yield from v.findings
+
+
+PASS = CancelBeatPass()

@@ -1,0 +1,154 @@
+"""host-sync — the static host-synchronization leak detector.
+
+The PR-9 host-overhead ledger measures, at runtime, how much of a query's
+wall clock is ``glue`` — host time nothing accounts for, most of it
+blocking device→host syncs the author never noticed (`np.asarray` on a
+device array, a scalar pull inside a per-batch loop, an implicit
+`bool()`). This pass is the static complement: inside the engine's
+**hot-path modules** (the device-side operator code) every construct that
+forces a device→host round trip must be either absent or explicitly
+acknowledged with a ``# graft: ok(host-sync: <why>)`` suppression naming
+the reason the sync is intentional (the D2H result pack, a bounded
+once-per-partition shape decision, an ANSI error check).
+
+Flagged constructs:
+
+* ``np.asarray(...)`` / ``np.array(...)`` — materializes a device array
+  on host (the classic silent sync);
+* ``jax.device_get(...)`` — explicit transfer;
+* ``.block_until_ready(...)`` / ``jax.block_until_ready(...)`` — blocks
+  the host on device completion;
+* ``.item()`` / ``.tolist()`` — scalar/element pulls;
+* ``.row_count()`` — the engine's own documented on-demand sync
+  (columnar/device.py);
+* ``int(x)`` / ``float(x)`` where ``x`` follows the device-array naming
+  convention (``*_dev`` / ``dev_*``) — scalar conversion syncs.
+
+Host-side engine layers (the CPU oracle ``exec/cpu*``, ``columnar/`` —
+which IS the D2H pack —, ``mem/spill.py`` whose job is host
+materialization, io/, shuffle host plumbing) are out of scope by
+construction.
+"""
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterable
+
+from .. import Finding, LintPass, Project
+
+#: hot-path scope: device operator code + the kernel cache + the
+#: expression tree (traced device code) + the shuffle device path
+HOT_PATTERNS = (
+    r"^spark_rapids_tpu/exec/(?!cpu)",      # device execs, task, pipeline
+    r"^spark_rapids_tpu/kernels\.py$",
+    r"^spark_rapids_tpu/expr/",
+    r"^spark_rapids_tpu/shuffle/(manager|client|serializer)\.py$",
+)
+_HOT = tuple(re.compile(p) for p in HOT_PATTERNS)
+
+_NUMPY_NAMES = {"np", "numpy", "onp"}
+_DEV_NAME = re.compile(r"(^dev_|_dev$|_dev\d*$)")
+
+#: expression code runs INSIDE jit tracing (device path) or on host numpy
+#: (the ``not ctx.is_device`` CPU branches): a numpy materialization or an
+#: element pull on a device tracer raises TracerArrayConversionError
+#: outright, so every np.asarray/.item()/.tolist() that survives there is
+#: trace-time constant prep or CPU-oracle host work — once per compile or
+#: on the host path, never a per-batch device sync. The unambiguous sync
+#: constructs (device_get, block_until_ready, row_count) stay flagged.
+_NUMPY_EXEMPT = re.compile(r"^spark_rapids_tpu/expr/")
+
+
+def _is_hot(rel: str) -> bool:
+    return any(p.search(rel) for p in _HOT)
+
+
+class _Visitor(ast.NodeVisitor):
+    def __init__(self, pass_: "HostSyncPass", rel: str):
+        self.p = pass_
+        self.rel = rel
+        self.findings = []
+
+    def _hit(self, node: ast.AST, what: str, why: str) -> None:
+        self.findings.append(
+            self.p.finding(
+                self.rel, node.lineno,
+                f"{what} forces a device->host sync on the hot path — "
+                f"{why}; keep the value device-resident (accumulate as a "
+                "device scalar like exec/task.py's row_base), batch the "
+                "pull into the single D2H pack, or acknowledge the sync "
+                "with '# graft: ok(host-sync: <why>)'",
+            )
+        )
+
+    def visit_Call(self, node: ast.Call) -> None:
+        fn = node.func
+        if isinstance(fn, ast.Attribute):
+            recv = fn.value
+            recv_name = recv.id if isinstance(recv, ast.Name) else None
+            if (
+                recv_name in _NUMPY_NAMES
+                and fn.attr in ("asarray", "array")
+                and not _NUMPY_EXEMPT.search(self.rel)
+            ):
+                self._hit(
+                    node, f"{recv_name}.{fn.attr}()",
+                    "numpy materialization of a (possibly device) array "
+                    "blocks until the device value lands on host",
+                )
+            elif recv_name == "jax" and fn.attr == "device_get":
+                self._hit(
+                    node, "jax.device_get()",
+                    "an explicit transfer stalls the dispatch pipeline at "
+                    "this exact point",
+                )
+            elif fn.attr == "block_until_ready":
+                self._hit(
+                    node, "block_until_ready()",
+                    "the host parks on device completion",
+                )
+            elif (
+                fn.attr in ("item", "tolist")
+                and not node.args
+                and not _NUMPY_EXEMPT.search(self.rel)
+            ):
+                self._hit(
+                    node, f".{fn.attr}()",
+                    "an element pull is a full host round trip per call",
+                )
+            elif fn.attr == "row_count" and not node.args:
+                self._hit(
+                    node, ".row_count()",
+                    "the live-row scalar syncs on demand "
+                    "(columnar/device.py) — per-batch calls serialize the "
+                    "pipeline",
+                )
+        elif (
+            isinstance(fn, ast.Name)
+            and fn.id in ("int", "float")
+            and len(node.args) == 1
+            and isinstance(node.args[0], ast.Name)
+            and _DEV_NAME.search(node.args[0].id)
+        ):
+            self._hit(
+                node, f"{fn.id}({node.args[0].id})",
+                "scalar conversion of a device value blocks on the device",
+            )
+        self.generic_visit(node)
+
+
+class HostSyncPass(LintPass):
+    id = "host-sync"
+    title = "device->host synchronization leaks in hot-path modules"
+
+    def run(self, project: Project) -> Iterable[Finding]:
+        for sf in project.files:
+            if not _is_hot(sf.rel) or sf.tree is None:
+                continue
+            v = _Visitor(self, sf.rel)
+            v.visit(sf.tree)
+            yield from v.findings
+
+
+PASS = HostSyncPass()

@@ -1,0 +1,499 @@
+"""lock-order — static lock-acquisition-graph analysis.
+
+Three checks over every ``threading.Lock``/``RLock``/``Condition`` the
+engine creates (~65 of them):
+
+1. **Cycle detection.** Build the acquisition graph — an edge A→B when a
+   ``with B`` (or a call into a function that acquires B) sits inside a
+   ``with A`` body — and flag every cycle with both acquisition sites.
+   A cycle is a latent deadlock: two threads entering it from different
+   ends wedge forever.
+2. **Hierarchy.** Edges must respect the declared domain tiers in
+   :mod:`..lock_order` (outer tiers acquire inner tiers, never the
+   reverse).
+3. **Blocking-under-lock.** Inside a ``with``-lock body, flag calls that
+   can block indefinitely on something *other than the CPU*: socket ops
+   (``recv``/``accept``/``connect``/``sendall``), ``time.sleep``,
+   ``Future.result``, thread ``join``, foreign ``wait``s, and first-touch
+   kernel compiles (``warm``/``lower``/``precompile``) — the exact shape
+   of the PR-7 nested-compile deadlock (``_COMPILE_LOCK`` held while
+   joining a helper thread that needs it).
+
+Call edges resolve one level of indirection within the same module
+(module functions and ``self.`` methods), then close transitively, so a
+lock acquired three helpers deep still produces the edge. Acquisitions
+through dynamic dispatch stay invisible — that is what the runtime
+:mod:`..lockwatch` harness is for.
+"""
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from .. import Finding, LintPass, Project
+from .. import lock_order
+
+_LOCK_CTORS = {"Lock", "RLock", "Condition"}
+_BLOCKING_SOCKET = {"recv", "recv_into", "accept", "connect", "sendall",
+                    "makefile"}
+_COMPILE_ATTRS = {"warm", "lower"}
+_THREADISH = re.compile(r"(^t$|^th$|thread|worker|proc|helper)", re.I)
+
+
+@dataclass
+class LockDef:
+    lock_id: str          # "<rel>::<name>" or "<rel>::<Class>.<attr>"
+    rel: str              # defining file
+    line: int
+    kind: str             # Lock | RLock | Condition
+
+
+@dataclass
+class Acquisition:
+    lock_id: str
+    rel: str
+    line: int
+
+
+@dataclass
+class FuncInfo:
+    qual: str                     # "<rel>::<Class>.<fn>" / "<rel>::<fn>"
+    rel: str
+    direct_locks: List[Acquisition] = field(default_factory=list)
+    #: calls made anywhere in the function: bare-name / self-method keys
+    calls: Set[str] = field(default_factory=set)
+    #: (outer acquisition, inner acquisition) direct nesting pairs
+    nested: List[Tuple[Acquisition, Acquisition]] = field(
+        default_factory=list
+    )
+    #: (acquisition, callee key, call line) — calls under a held lock
+    calls_under: List[Tuple[Acquisition, str, int]] = field(
+        default_factory=list
+    )
+    #: (acquisition, description, line) — blocking calls under a held lock
+    blocking: List[Tuple[Acquisition, str, int]] = field(
+        default_factory=list
+    )
+
+
+def _lock_ctor_kind(node: ast.expr) -> Optional[str]:
+    """'Lock'/'RLock'/'Condition' when ``node`` is a
+    ``threading.<ctor>()`` (or bare ``<ctor>()``) call."""
+    if not isinstance(node, ast.Call):
+        return None
+    fn = node.func
+    if isinstance(fn, ast.Attribute) and fn.attr in _LOCK_CTORS:
+        if isinstance(fn.value, ast.Name) and fn.value.id in (
+            "threading", "_threading",
+        ):
+            return fn.attr
+    if isinstance(fn, ast.Name) and fn.id in _LOCK_CTORS:
+        return fn.id
+    return None
+
+
+def _src(node: ast.expr) -> str:
+    try:
+        return ast.unparse(node)
+    except Exception:  # pragma: no cover - unparse covers all real exprs
+        return "<expr>"
+
+
+class _ModuleScan(ast.NodeVisitor):
+    """One file's lock definitions, imported lock bindings, and per-
+    function acquisition structure."""
+
+    def __init__(self, rel: str):
+        self.rel = rel
+        self.defs: Dict[str, LockDef] = {}      # local key -> LockDef
+        self.imports: Dict[str, str] = {}       # name -> source module tail
+        self.funcs: Dict[str, FuncInfo] = {}
+        self._class: Optional[str] = None
+        self._func: Optional[FuncInfo] = None
+        #: stack of held acquisitions while walking a function body
+        self._held: List[Acquisition] = []
+
+    # ── definitions ─────────────────────────────────────────────────────
+    def _define(self, key: str, node: ast.expr, kind: str) -> None:
+        self.defs.setdefault(
+            key, LockDef(f"{self.rel}::{key}", self.rel, node.lineno, kind)
+        )
+
+    def visit_ImportFrom(self, node: ast.ImportFrom) -> None:
+        for alias in node.names:
+            self.imports[alias.asname or alias.name] = (
+                f"{node.module or ''}.{alias.name}"
+            )
+        self.generic_visit(node)
+
+    def visit_ClassDef(self, node: ast.ClassDef) -> None:
+        prev, self._class = self._class, node.name
+        self.generic_visit(node)
+        self._class = prev
+
+    def _scan_assign(self, target: ast.expr, value: ast.expr) -> None:
+        kind = _lock_ctor_kind(value)
+        if kind is None:
+            return
+        if isinstance(target, ast.Name):
+            if self._func is None:
+                self._define(target.id, value, kind)
+            else:
+                # function-local lock (closure state): scoped by function
+                fn_tail = self._func.qual.split("::", 1)[1]
+                self._define(f"{fn_tail}.{target.id}", value, kind)
+        elif (
+            isinstance(target, ast.Attribute)
+            and isinstance(target.value, ast.Name)
+            and target.value.id == "self"
+            and self._class is not None
+        ):
+            self._define(f"{self._class}.{target.attr}", value, kind)
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        for t in node.targets:
+            self._scan_assign(t, node.value)
+        self.generic_visit(node)
+
+    def visit_AnnAssign(self, node: ast.AnnAssign) -> None:
+        if node.value is not None:
+            self._scan_assign(node.target, node.value)
+        self.generic_visit(node)
+
+    # ── acquisition resolution ──────────────────────────────────────────
+    def _resolve_lock(self, expr: ast.expr) -> Optional[str]:
+        """lock_id for a with-item / acquire receiver, else None."""
+        if isinstance(expr, ast.Name):
+            # function-local first, then module-level, then imported
+            if self._func is not None:
+                fn_tail = self._func.qual.split("::", 1)[1]
+                d = self.defs.get(f"{fn_tail}.{expr.id}")
+                if d is not None:
+                    return d.lock_id
+            d = self.defs.get(expr.id)
+            if d is not None:
+                return d.lock_id
+            src = self.imports.get(expr.id)
+            if src is not None:
+                return f"import:{src}"  # resolved project-wide later
+            return None
+        if isinstance(expr, ast.Attribute):
+            if (
+                isinstance(expr.value, ast.Name)
+                and expr.value.id == "self"
+                and self._class is not None
+            ):
+                d = self.defs.get(f"{self._class}.{expr.attr}")
+                if d is not None:
+                    return d.lock_id
+                return None
+            # module alias: mod.X where X is some module's lock — resolved
+            # project-wide from the alias's import
+            if isinstance(expr.value, ast.Name):
+                src = self.imports.get(expr.value.id)
+                if src is not None:
+                    return f"import:{src}.{expr.attr}"
+        return None
+
+    # ── function walk ───────────────────────────────────────────────────
+    def _enter_function(self, node) -> None:
+        prefix = f"{self._class}." if self._class else ""
+        qual = f"{self.rel}::{prefix}{node.name}"
+        prev_fn, prev_held = self._func, self._held
+        self._func = self.funcs.setdefault(qual, FuncInfo(qual, self.rel))
+        # a nested def does not run under the enclosing with at def time
+        self._held = []
+        for stmt in node.body:
+            self.visit(stmt)
+        self._func, self._held = prev_fn, prev_held
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        self._enter_function(node)
+
+    def visit_AsyncFunctionDef(self, node) -> None:
+        self._enter_function(node)
+
+    def visit_With(self, node: ast.With) -> None:
+        if self._func is None:
+            self.generic_visit(node)
+            return
+        acquired: List[Acquisition] = []
+        for item in node.items:
+            lid = self._resolve_lock(item.context_expr)
+            if lid is not None:
+                acq = Acquisition(lid, self.rel, item.context_expr.lineno)
+                if self._held:
+                    self._func.nested.append((self._held[-1], acq))
+                self._func.direct_locks.append(acq)
+                self._held.append(acq)
+                acquired.append(acq)
+        for stmt in node.body:
+            self.visit(stmt)
+        for _ in acquired:
+            self._held.pop()
+
+    def visit_Call(self, node: ast.Call) -> None:
+        if self._func is not None:
+            fn = node.func
+            callee: Optional[str] = None
+            if isinstance(fn, ast.Name):
+                callee = fn.id
+            elif (
+                isinstance(fn, ast.Attribute)
+                and isinstance(fn.value, ast.Name)
+                and fn.value.id == "self"
+                and self._class is not None
+            ):
+                callee = f"{self._class}.{fn.attr}"
+            if callee is not None:
+                self._func.calls.add(callee)
+                if self._held:
+                    self._func.calls_under.append(
+                        (self._held[-1], callee, node.lineno)
+                    )
+            if self._held:
+                desc = self._blocking_desc(node)
+                if desc is not None:
+                    self._func.blocking.append(
+                        (self._held[-1], desc, node.lineno)
+                    )
+        self.generic_visit(node)
+
+    def _blocking_desc(self, node: ast.Call) -> Optional[str]:
+        fn = node.func
+        if isinstance(fn, ast.Name):
+            if fn.id in ("sleep", "precompile"):
+                return f"{fn.id}()"
+            return None
+        if not isinstance(fn, ast.Attribute):
+            return None
+        attr = fn.attr
+        recv = fn.value
+        if attr == "sleep":
+            return f"{_src(recv)}.sleep()"
+        if attr in _BLOCKING_SOCKET:
+            return f"{_src(recv)}.{attr}() (socket op)"
+        if attr == "result":
+            return f"{_src(recv)}.result() (Future wait)"
+        if attr in _COMPILE_ATTRS:
+            return f"{_src(recv)}.{attr}() (first-touch kernel compile)"
+        if attr == "join":
+            # str.join is the overwhelmingly common false positive —
+            # only thread-shaped receivers count
+            if isinstance(recv, ast.Constant):
+                return None
+            if isinstance(recv, ast.Name) and _THREADISH.search(recv.id):
+                return f"{recv.id}.join() (thread join)"
+            if isinstance(recv, ast.Attribute) and _THREADISH.search(
+                recv.attr
+            ):
+                return f"{_src(recv)}.join() (thread join)"
+            return None
+        if attr == "wait":
+            # waiting on the condition you hold RELEASES it — only a
+            # foreign wait (another object's event/queue) blocks while
+            # still holding this lock
+            held_srcs = {a.lock_id for a in self._held}
+            lid = self._resolve_lock(recv)
+            if lid is not None and lid in held_srcs:
+                return None
+            if lid is None and isinstance(recv, ast.Name):
+                return None  # unknown local waitable — too noisy to call
+            if lid is None:
+                return None
+            return f"{_src(recv)}.wait() (foreign wait)"
+        return None
+
+
+class LockOrderPass(LintPass):
+    id = "lock-order"
+    title = "lock-acquisition cycles, hierarchy inversions, blocking-under-lock"
+
+    def run(self, project: Project) -> Iterable[Finding]:
+        scans: Dict[str, _ModuleScan] = {}
+        for sf in project.files:
+            if sf.tree is None:
+                continue
+            scan = _ModuleScan(sf.rel)
+            scan.visit(sf.tree)
+            scans[sf.rel] = scan
+
+        # project-wide lock table: lock_id -> LockDef, plus resolution of
+        # "import:<module tail>" references to defining modules by the
+        # imported name's last component
+        defs: Dict[str, LockDef] = {}
+        by_name: Dict[str, List[LockDef]] = {}
+        for scan in scans.values():
+            for key, d in scan.defs.items():
+                defs[d.lock_id] = d
+                if "." not in key:  # module-level name, importable
+                    by_name.setdefault(key, []).append(d)
+
+        def canon(lock_id: str) -> Optional[str]:
+            if not lock_id.startswith("import:"):
+                return lock_id
+            name = lock_id.rsplit(".", 1)[-1]
+            cands = by_name.get(name, [])
+            if len(cands) == 1:
+                return cands[0].lock_id
+            return None  # ambiguous or external — drop
+
+        # locks acquired per function, closed transitively over same-
+        # module bare-name / self-method calls
+        acq_by_func: Dict[str, Set[Tuple[str, str, int]]] = {}
+        for scan in scans.values():
+            for qual, fi in scan.funcs.items():
+                acq_by_func[qual] = {
+                    (c, a.rel, a.line)
+                    for a in fi.direct_locks
+                    for c in (canon(a.lock_id),)
+                    if c is not None
+                }
+
+        def resolve_callee(rel: str, callee: str) -> Optional[str]:
+            scan = scans.get(rel)
+            if scan is None:
+                return None
+            q = f"{rel}::{callee}"
+            return q if q in scan.funcs else None
+
+        changed = True
+        while changed:
+            changed = False
+            for scan in scans.values():
+                for qual, fi in scan.funcs.items():
+                    mine = acq_by_func[qual]
+                    for callee in fi.calls:
+                        cq = resolve_callee(fi.rel, callee)
+                        if cq is None:
+                            continue
+                        extra = acq_by_func.get(cq, set()) - mine
+                        if extra:
+                            mine |= extra
+                            changed = True
+
+        # edges: (outer lock, inner lock) -> example (outer site, inner site)
+        edges: Dict[Tuple[str, str], Tuple[Acquisition, Tuple[str, int]]] = {}
+        findings: List[Finding] = []
+        for scan in scans.values():
+            for fi in scan.funcs.values():
+                for outer, inner in fi.nested:
+                    co, ci = canon(outer.lock_id), canon(inner.lock_id)
+                    if co is None or ci is None:
+                        continue
+                    if co == ci:
+                        d = defs.get(co)
+                        if d is not None and d.kind == "Lock":
+                            findings.append(self.finding(
+                                inner.rel, inner.line,
+                                f"non-reentrant lock {co} re-acquired "
+                                f"while already held (outer acquisition "
+                                f"{outer.rel}:{outer.line}) — guaranteed "
+                                "self-deadlock",
+                            ))
+                        continue
+                    edges.setdefault(
+                        (co, ci), (outer, (inner.rel, inner.line))
+                    )
+                for outer, callee, line in fi.calls_under:
+                    co = canon(outer.lock_id)
+                    if co is None:
+                        continue
+                    cq = resolve_callee(fi.rel, callee)
+                    if cq is None:
+                        continue
+                    for ci, crel, cline in acq_by_func.get(cq, ()):
+                        if ci == co:
+                            d = defs.get(co)
+                            if d is not None and d.kind == "Lock":
+                                findings.append(self.finding(
+                                    fi.rel, line,
+                                    f"call to {callee}() while holding "
+                                    f"non-reentrant lock {co} "
+                                    f"(acquired {outer.rel}:{outer.line}) "
+                                    f"re-acquires it at {crel}:{cline} — "
+                                    "self-deadlock",
+                                ))
+                            continue
+                        edges.setdefault((co, ci), (outer, (crel, cline)))
+
+        # hierarchy inversions
+        for (a, b), (outer, (irel, iline)) in sorted(edges.items()):
+            da, db = defs.get(a), defs.get(b)
+            if da is None or db is None:
+                continue
+            if not lock_order.ordered_ok(da.rel, db.rel):
+                ta = lock_order.tier_for_path(da.rel)
+                tb = lock_order.tier_for_path(db.rel)
+                findings.append(self.finding(
+                    irel, iline,
+                    f"hierarchy inversion: {b} (tier {tb[0]} {tb[1]}) "
+                    f"acquired at {irel}:{iline} while holding {a} "
+                    f"(tier {ta[0]} {ta[1]}, acquired "
+                    f"{outer.rel}:{outer.line}) — declared order in "
+                    "analysis/lock_order.py says the reverse; invert the "
+                    "nesting or move the work outside the outer lock",
+                ))
+
+        # cycles (DFS over the edge graph, reported once per cycle set)
+        adj: Dict[str, List[str]] = {}
+        for (a, b) in edges:
+            adj.setdefault(a, []).append(b)
+        seen_cycles: Set[frozenset] = set()
+        color: Dict[str, int] = {}
+        stack: List[str] = []
+
+        def dfs(n: str) -> None:
+            color[n] = 1
+            stack.append(n)
+            for m in adj.get(n, ()):
+                c = color.get(m, 0)
+                if c == 1:
+                    cyc = stack[stack.index(m):] + [m]
+                    key = frozenset(cyc)
+                    if key not in seen_cycles:
+                        seen_cycles.add(key)
+                        sites = []
+                        for x, y in zip(cyc, cyc[1:]):
+                            _o, (irel, iline) = edges[(x, y)]
+                            sites.append(f"{y} at {irel}:{iline}")
+                        head = defs.get(cyc[0])
+                        findings.append(self.finding(
+                            head.rel if head else "spark_rapids_tpu",
+                            head.line if head else 0,
+                            "lock-order cycle: "
+                            + " -> ".join(cyc)
+                            + " (acquisition sites: "
+                            + "; ".join(sites)
+                            + ") — two threads entering this cycle from "
+                            "different ends deadlock",
+                        ))
+                elif c == 0:
+                    dfs(m)
+            stack.pop()
+            color[n] = 2
+
+        for n in sorted(adj):
+            if color.get(n, 0) == 0:
+                dfs(n)
+
+        # blocking calls under a held lock
+        for scan in scans.values():
+            for fi in scan.funcs.values():
+                for acq, desc, line in fi.blocking:
+                    lid = canon(acq.lock_id) or acq.lock_id
+                    findings.append(self.finding(
+                        fi.rel, line,
+                        f"blocking call {desc} while holding lock {lid} "
+                        f"(acquired {acq.rel}:{acq.line}) — a peer "
+                        "needing this lock now waits on your I/O/compile; "
+                        "move the blocking work outside the critical "
+                        "section or acknowledge with "
+                        "'# graft: ok(lock-order: <why>)'",
+                    ))
+        return findings
+
+
+PASS = LockOrderPass()

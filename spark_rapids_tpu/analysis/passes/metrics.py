@@ -1,0 +1,94 @@
+"""metrics — metric-catalog drift (the PR-9 ``metrics_lint`` check,
+folded into the framework as a pass).
+
+Every LITERAL metric name passed to a GLOBAL-registry accessor must be
+pre-registered in ``obs.metrics.CATALOG``; every f-string name must start
+with a declared dynamic-family prefix (``obs.metrics.DYNAMIC_PREFIXES``);
+every ``dynamic_name("<prefix>", …)`` call must use a declared prefix.
+Rationale and receiver conventions: ``spark_rapids_tpu/metrics_lint.py``
+(kept as the PR-9 entry-point shim).
+"""
+from __future__ import annotations
+
+import re
+from typing import Iterable
+
+from .. import Finding, LintPass, Project
+
+_RECEIVERS = (
+    r"GLOBAL",
+    r"_M",
+    r"_obs",
+    r"_GLOBAL_METRICS",
+    r"obs_metrics\.GLOBAL",
+    r"metrics\.GLOBAL",
+)
+_KINDS = r"(?:counter|timer|gauge|watermark|histogram|get_or_create)"
+_LITERAL_CALL = re.compile(
+    r"(?:^|[^\w.])(?:" + "|".join(_RECEIVERS) + r")\s*\.\s*" + _KINDS
+    + r"\(\s*([frbu]{0,2})([\"'])((?:[^\"'\\]|\\.)*?)\2",
+    re.MULTILINE,
+)
+_DYNAMIC_NAME_CALL = re.compile(
+    r"\bdynamic_name\(\s*([\"'])((?:[^\"'\\]|\\.)*?)\1",
+    re.MULTILINE,
+)
+
+#: the catalog itself and the two lint homes (docstrings full of examples)
+_SKIP = (
+    "spark_rapids_tpu/obs/metrics.py",
+    "spark_rapids_tpu/metrics_lint.py",
+    "spark_rapids_tpu/analysis/passes/metrics.py",
+)
+
+
+class MetricsPass(LintPass):
+    id = "metrics"
+    title = "metric names catalogued in obs.metrics.CATALOG"
+
+    def run(self, project: Project) -> Iterable[Finding]:
+        from ...obs import metrics as OM
+
+        catalog = {name for name, _kind, _doc in OM.CATALOG}
+        dynamic = tuple(OM.DYNAMIC_PREFIXES)
+        for sf in project.files:
+            if sf.rel in _SKIP:
+                continue
+            text = sf.text
+            for m in _LITERAL_CALL.finditer(text):
+                lineno = text.count("\n", 0, m.start()) + 1
+                name = m.group(3)
+                if "f" in m.group(1):
+                    static_prefix = name.split("{", 1)[0]
+                    if not any(
+                        static_prefix.startswith(p)
+                        or p.startswith(static_prefix)
+                        for p in dynamic
+                    ):
+                        yield self.finding(
+                            sf.rel, lineno,
+                            f"dynamic metric name f\"{name}\" does not "
+                            "match any declared dynamic-family prefix "
+                            "(obs.metrics.DYNAMIC_PREFIXES) — route it "
+                            "through dynamic_name() with a declared "
+                            "prefix",
+                        )
+                elif name not in catalog:
+                    yield self.finding(
+                        sf.rel, lineno,
+                        f"metric {name!r} is not pre-registered in the "
+                        "GLOBAL catalog (obs.metrics.CATALOG) — add it "
+                        "there so exports, docs, and dashboards see the "
+                        "series",
+                    )
+            for m in _DYNAMIC_NAME_CALL.finditer(text):
+                lineno = text.count("\n", 0, m.start()) + 1
+                if m.group(2) not in dynamic:
+                    yield self.finding(
+                        sf.rel, lineno,
+                        f"dynamic_name prefix {m.group(2)!r} is not "
+                        "declared in obs.metrics.DYNAMIC_PREFIXES",
+                    )
+
+
+PASS = MetricsPass()

@@ -1023,6 +1023,15 @@ def registry() -> dict[str, ConfEntry]:
     return dict(_REGISTRY)
 
 
+def startup_only_keys() -> set:
+    """Keys frozen when the session is constructed (topology, backend,
+    shims). THE single source of truth for conf scope: docs_gen renders
+    configs.md's Scope column from it, and graft-lint's conf-key pass
+    flags any re-read of one of these outside the session-init surface
+    (docs/static-analysis.md)."""
+    return {k for k, e in _REGISTRY.items() if e.startup_only}
+
+
 def generate_docs() -> str:
     """Markdown doc table — the analogue of RapidsConf.scala's doc generator
     (:1052-1149), so configuration docs cannot drift from the code."""

@@ -137,6 +137,8 @@ def _placed_partitions(ctx: "ExecContext", pset: PartitionSet) -> PartitionSet:
     def make(p, t):
         def it():
             dev = mc.device_for(p)
+            # graft: ok(cancel-beat: upstream partition iterator beats per
+            # batch; put_batch is one async device placement)
             for db in t():
                 yield put_batch(db, dev)
 
@@ -188,6 +190,10 @@ class HostToDeviceExec(Exec):
                 rows_m.add(rb.num_rows)
                 bytes_m.add(rb.nbytes)
                 for off in range(0, rb.num_rows, max_rows):
+                    if tok is not None:
+                        tok.check()  # beat per uploaded chunk, not just
+                        # per host batch — one oversized source batch
+                        # re-chunks into many uploads
                     chunk = (
                         rb
                         if rb.num_rows <= max_rows
@@ -289,6 +295,8 @@ class HostToDeviceExec(Exec):
                             # replay: keep the metric honest, no device sync
                             rows_m.add(entry["rows"][p])
                             for db in built:
+                                if tok is not None:
+                                    tok.check()
                                 ctx.semaphore.acquire_if_necessary()
                                 yield db
                             return
@@ -304,6 +312,8 @@ class HostToDeviceExec(Exec):
                                     entry["building"][p] = None
                                 ev.set()
                             for db in out:
+                                if tok is not None:
+                                    tok.check()
                                 yield db
                             return
                         # another query is uploading this partition: wait
@@ -367,7 +377,11 @@ class DeviceToHostExec(Exec):
             from ..ops.concat import concat_device
             from ..ops.gather import bulk_shrink
 
+            tok = ctx.cancel_token
             while True:
+                if tok is not None:
+                    tok.check()  # beat per D2H window: the pull below is
+                    # where a collect() spends its host time
                 # shrink to the live bucket before packing: the pack kernel
                 # flattens the whole capacity, so a 6-row aggregate output in
                 # a 512k-capacity batch would otherwise ship ~30MB over PJRT.
@@ -389,6 +403,8 @@ class DeviceToHostExec(Exec):
                     # from 'd2h' at the only point the host truly waits
                     if led is not None:
                         with led.scope("device_execute"):
+                            # graft: ok(host-sync: ledger attribution split
+                            # — the D2H pull below would block here anyway)
                             jax.block_until_ready(chunk[0])
                     if timing:
                         with _lscope(led, "d2h"), time_m.timed():
@@ -419,11 +435,15 @@ class DeviceToHostExec(Exec):
                 ):
                     shrunk = [concat_device(shrunk)]
                 for db in shrunk:
+                    if tok is not None:
+                        tok.check()
                     from ..mem.spill import with_oom_retry
 
                     pull = lambda b: device_to_host(b, shrink=False)  # noqa: E731
                     if led is not None:
                         with led.scope("device_execute"):
+                            # graft: ok(host-sync: ledger attribution split
+                            # — the D2H pull below would block here anyway)
                             jax.block_until_ready(db)
                     if timing:
                         with _lscope(led, "d2h"), time_m.timed():
@@ -501,8 +521,11 @@ class TpuRangeExec(Exec):
             def make(lo=lo, cnt=cnt):
                 def it():
                     ctx.semaphore.acquire_if_necessary()
+                    tok = ctx.cancel_token
                     done = 0
                     while done < cnt:
+                        if tok is not None:
+                            tok.check()
                         m = min(batch_rows, cnt - done)
                         cap = bucket_capacity(max(m, 1))
                         first = start + (lo + done) * step
@@ -539,6 +562,10 @@ class _ErrorCheckingKernel:
 
             from ..expr.base import AnsiError
 
+            # graft: ok(host-sync: ANSI error-site check — kernels with
+            # registered error expressions must surface the raise at THIS
+            # batch; non-ANSI trees return a statically-empty flag vector
+            # and never reach this sync)
             flags = np.asarray(errs)
             if flags.any():
                 raise AnsiError(self._sites[int(np.argmax(flags))])
@@ -732,6 +759,8 @@ class TpuCoalescePartitionsExec(Exec):
 
         def it():
             if n_workers <= 1 or len(child_parts.parts) == 1:
+                # graft: ok(cancel-beat: delegates to the upstream
+                # partition iterators, which beat per batch)
                 for t in child_parts.parts:
                     yield from t()
                 return
@@ -756,6 +785,9 @@ class TpuCoalescePartitionsExec(Exec):
                     for i in range(min(n_workers, len(parts)))
                 }
                 nxt = len(pending)
+                # graft: ok(cancel-beat: the worker threads drive the
+                # upstream iterators (which beat per batch); a cancel
+                # raises inside run_one and surfaces through result())
                 for i in range(len(parts)):
                     batches = pending.pop(i).result()
                     if nxt < len(parts):
@@ -1586,7 +1618,10 @@ class TpuExpandExec(Exec):
         fn = self._fn
 
         def run(it):
+            tok = ctx.cancel_token
             for db in it:
+                if tok is not None:
+                    tok.check()
                 yield from fn(db)
 
         return self.children[0].execute(ctx).map_partitions(run)
@@ -1682,8 +1717,14 @@ class TpuGenerateExec(Exec):
         lk = self._lengths_kernel()
 
         def run(it):
+            tok = ctx.cancel_token
             for db in it:
+                if tok is not None:
+                    tok.check()
                 lengths, total_dev = lk(db)
+                # graft: ok(host-sync: the explode output CAPACITY must be
+                # chosen on host (bucketed jit signature) — one scalar
+                # pull per batch is inherent to row-expanding generators)
                 total = int(total_dev)
                 if total == 0:
                     continue
@@ -2099,6 +2140,9 @@ class TpuShuffleExchangeExec(Exec):
                         dev_valid.append(
                             jnp.broadcast_to(db.num_rows > 0, (SAMPLE_PER_BATCH,))
                         )
+                    # graft: ok(host-sync: range bounds need the samples on
+                    # host — ONE batched transfer for every chip's samples,
+                    # once per exchange materialization)
                     host_samples, host_valid = jax.device_get(
                         (dev_samples, dev_valid)
                     )
@@ -2195,12 +2239,16 @@ class TpuShuffleExchangeExec(Exec):
 
         # Multi-process query (spark.rapids.shuffle.multiproc.*): this
         # executor maps only the child partitions its rank owns; peers map
-        # the rest and serve them over the TCP transport (DCN path).
-        mp_size = cfg.MULTIPROC_SIZE.get(ctx.conf)
-        mp_rank = cfg.MULTIPROC_RANK.get(ctx.conf)
+        # the rest and serve them over the TCP transport (DCN path). The
+        # topology comes from the CONTEXT, frozen at session init — the
+        # multiproc keys are startup_only, and re-reading the conf here
+        # would let a live set_conf disagree with the running transport
+        # (the conf-key lint's scope rule).
+        mp_size = ctx.mp_size
+        mp_rank = ctx.mp_rank
         in_broadcast = getattr(ctx, "broadcast_depth", 0) > 0
         multiproc = (
-            bool(cfg.MULTIPROC_DRIVER.get(ctx.conf))
+            bool(ctx.mp_driver)
             and mp_size > 1
             and cfg.SHUFFLE_MANAGER_ENABLED.get(ctx.conf)
             and not in_broadcast
@@ -2259,6 +2307,9 @@ class TpuShuffleExchangeExec(Exec):
                     )
                 sample_words = None
                 if batches:
+                    # graft: ok(host-sync: ONE batched pull for all range
+                    # samples, once per exchange — the per-batch np.asarray
+                    # alternative is what the comment above rules out)
                     host_samples, host_valid = jax.device_get((dev_samples, dev_valid))
                     sample_words = [
                         np.concatenate(
@@ -2278,6 +2329,9 @@ class TpuShuffleExchangeExec(Exec):
                     # analogue, GpuRangePartitioner.createRangeBounds).
                     payload = {
                         "targets": local_targets,
+                        # graft: ok(host-sync: host numpy after the single
+                        # batched device_get above — JSON payload for the
+                        # driver's bounds sync, no device traffic)
                         "words": [w.tolist() for w in (sample_words or [])],
                     }
                     contribs = ctx.shuffle_manager.registry.range_bounds_sync(
@@ -2376,6 +2430,10 @@ class TpuShuffleExchangeExec(Exec):
                     )
                     for p, bucket in enumerate(materialize()):
                         for db in bucket:
+                            # graft: ok(host-sync: shuffle-manager write
+                            # filter — serializing an empty bucket batch
+                            # costs a frame per peer; one scalar pull per
+                            # bucket batch on the manager path only)
                             if db.row_count():
                                 writer.write(p, db)
                     writer.commit()
@@ -2466,6 +2524,9 @@ class TpuShuffleExchangeExec(Exec):
                 # exchange's sizes (tunnel RTTs are the budget).
                 if aqe_state.get("sizes") is None:
                     buckets = materialize()
+                    # graft: ok(host-sync: AQE needs measured sizes on host
+                    # to plan coalescing — ONE pipelined device_get for all
+                    # bucket counts, memoized per exchange)
                     counts = jax.device_get(
                         [[db.num_rows for db in b] for b in buckets]
                     )
@@ -2523,11 +2584,16 @@ class TpuShuffleExchangeExec(Exec):
             def make_aqe(p):
                 def it():
                     buckets = materialize()
+                    tok = ctx.cancel_token
                     for src, j, k in assignment()[p]:
+                        if tok is not None:
+                            tok.check()
                         if k == 1:
                             yield from buckets[src]
                         else:
                             for db in buckets[src]:
+                                if tok is not None:
+                                    tok.check()
                                 part = _row_range_slice(db, j, k)
                                 if part is not None:
                                     yield part
@@ -2538,7 +2604,10 @@ class TpuShuffleExchangeExec(Exec):
 
         def make(p):
             def it():
+                tok = ctx.cancel_token
                 for db in materialize()[p]:
+                    if tok is not None:
+                        tok.check()
                     yield db
 
             return it
@@ -2576,13 +2645,19 @@ class TpuLimitExec(Exec):
 
         def it():
             remaining = limit
+            tok = ctx.cancel_token
 
             def consume(src):
                 nonlocal remaining
                 for db in src:
+                    if tok is not None:
+                        tok.check()
                     if remaining <= 0:
                         return
                     out = slice_head(db, jnp.asarray(remaining, jnp.int32))
+                    # graft: ok(host-sync: LIMIT must learn the row count
+                    # to know when to stop — the documented per-batch sync
+                    # the pipelined prefetch window exists to hide)
                     n = out.row_count()
                     remaining -= n
                     if n:
@@ -2647,6 +2722,7 @@ class TpuCoalesceBatchesExec(Exec):
         batches_m = self.metric("numOutputBatches", "ESSENTIAL")
 
         def fn(it):
+            tok = ctx.cancel_token
             acc: list = []
             acc_bytes = 0
 
@@ -2660,6 +2736,8 @@ class TpuCoalesceBatchesExec(Exec):
                 return out
 
             for db in it:
+                if tok is not None:
+                    tok.check()
                 sz = db.size_bytes()
                 if (
                     goal.target_bytes >= 0

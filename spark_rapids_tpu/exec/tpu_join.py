@@ -140,10 +140,18 @@ def _stream_probe_join(node, get_build, probe_thunk, phase1, phase2, jt,
                 window.append((probe, phase1(build, probe)))
         if not window:
             return
+        # graft: ok(host-sync: output capacities must be chosen on host
+        # (bucketed jit signatures) — ONE batched pull for the whole probe
+        # window instead of a sync per batch)
         totals = jax.device_get([c.sum() for (_p, (_b, _l, c)) in window])
+        tok = ctx.cancel_token if ctx is not None else None
         for i, total_dev in enumerate(totals):
+            if tok is not None:
+                tok.check()
             probe, (build_order, lower, counts) = window[i]
             window[i] = None  # release as consumed
+            # graft: ok(host-sync: already on host — item of the single
+            # windowed device_get above)
             total = int(total_dev)
             out_cap = bucket_capacity(max(total, 1))
             out, probe_matched, bmatch = phase2(
@@ -484,8 +492,6 @@ class TpuBroadcastHashJoinExec(TpuShuffledHashJoinExec):
 
             return PartitionSet([make(lt) for lt in lparts.parts])
 
-        import numpy as np
-
         state = {"remaining": len(lparts.parts), "mask": None, "emitted": False}
         lock = threading.Lock()
 
@@ -519,12 +525,19 @@ class TpuBroadcastHashJoinExec(TpuShuffledHashJoinExec):
                         if acc["m"] is not None:
                             # merging a partial mask (failed/abandoned
                             # attempt) is safe: recorded matches are real,
-                            # and a retry re-merges the complete mask
-                            local = np.asarray(acc["m"])
+                            # and a retry re-merges the complete mask.
+                            # DEVICE-resident accumulation (the PR-1
+                            # row-base pattern): the OR dispatches async —
+                            # the old per-partition np.asarray pull paid a
+                            # blocking host sync per finished partition.
+                            # Masks from partitions placed on OTHER chips
+                            # commit to the accumulator's device first
+                            # (one bool[capacity] transfer per partition).
+                            prev = state["mask"]
                             state["mask"] = (
-                                local
-                                if state["mask"] is None
-                                else state["mask"] | local
+                                acc["m"]
+                                if prev is None
+                                else prev | _colocated(prev, acc["m"])
                             )
                         # decrement once per FINISHED partition, never for a
                         # failed attempt — task retry (_run_task) re-runs the
@@ -543,9 +556,12 @@ class TpuBroadcastHashJoinExec(TpuShuffledHashJoinExec):
                     if last and done:
                         build = seen_build.get("b") or right.broadcast_batch(ctx)
                         mask = state["mask"]
+                        rm = build.row_mask()
                         if mask is None:
-                            mask = np.zeros(build.capacity, dtype=bool)
-                        unmatched = jnp.asarray(~mask) & build.row_mask()
+                            mask = jnp.zeros(build.capacity, dtype=bool)
+                        # the accumulated mask may live on another chip
+                        # than this (last) partition's build replica
+                        unmatched = (~_colocated(rm, mask)) & rm
                         yield self._null_extend(build, unmatched, "right")
 
             return it
@@ -559,6 +575,22 @@ class TpuBroadcastHashJoinExec(TpuShuffledHashJoinExec):
         )
 
 
+def _colocated(anchor, arr):
+    """Commit ``arr`` to ``anchor``'s device when the two device arrays
+    landed on different chips (placed partitions commit their batches —
+    and so the per-partition match masks — to their own devices); an op
+    over two differently-committed arrays raises in jax. No-op (and no
+    transfer) when the devices already agree or placement is unsharded."""
+    try:
+        a_dev = anchor.devices()
+        if arr.devices() != a_dev:
+            (dev,) = a_dev
+            arr = jax.device_put(arr, dev)
+    except Exception:
+        pass
+    return arr
+
+
 def _chunk_device_batch(db: DeviceBatch, rows: int):
     """Slice a device batch into static sub-batches of <= rows (shared by
     the nested-loop and cartesian pair loops)."""
@@ -569,6 +601,8 @@ def _chunk_device_batch(db: DeviceBatch, rows: int):
     # scalar and syncing it costs a tunnel round trip; padded capacity is at
     # most ~2x the live rows, and the clip below keeps tail chunks empty-valid
     n = db.capacity
+    # graft: ok(cancel-beat: slices one already-resident batch; the
+    # consuming join loop beats per chunk)
     for lo in range(0, max(n, 1), rows):
         idx = jnp.arange(rows, dtype=jnp.int32) + lo
         live = idx < db.num_rows
@@ -662,8 +696,11 @@ class TpuBroadcastNestedLoopJoinExec(Exec):
                 m = build.capacity
                 lrows = self._stream_rows(m)
                 build_matched = jnp.zeros(m, dtype=bool)
+                tok = ctx.cancel_token
                 for stream in lt():
                     for lb in chunk(stream, lrows):
+                        if tok is not None:
+                            tok.check()
                         out, lmatch, rmatch = kernel(lb, build)
                         build_matched = build_matched | rmatch
                         if jt in ("left_semi", "left_anti"):
@@ -903,8 +940,11 @@ class TpuCartesianProductExec(TpuBroadcastNestedLoopJoinExec):
                     concat_device(rbatches) if rbatches else empty_batch(right.output)
                 )
                 p = self._stream_rows(build.capacity)
+                tok = ctx.cancel_token
                 for stream in lt():
                     for lb in chunk(stream, p):
+                        if tok is not None:
+                            tok.check()
                         out, _lm, _rm = kernel(lb, build)
                         if out is not None:
                             yield out

@@ -182,6 +182,11 @@ class GuardedJit:
         with obs_ledger.phase("compile"), _M_WARM_NS.timed():
             if jax.default_backend() == "cpu":
                 with _COMPILE_LOCK:
+                    # graft: ok(lock-order: the compile lock EXISTS to
+                    # serialize XLA:CPU compiles (concurrent-compile
+                    # SIGSEGV) — compiling under it is the design, and
+                    # the deadline helper owns the lock on its own
+                    # thread so a blown budget cannot wedge it)
                     self._fn.lower(*args).compile()
             else:
                 self._fn.lower(*args).compile()

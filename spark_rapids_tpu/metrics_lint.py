@@ -1,118 +1,42 @@
-"""Static metric-catalog lint — the docs_gen-style drift check for the
-metric registry.
+"""Static metric-catalog lint — the PR-9 entry-point shim.
 
-The process-wide registry (``obs/metrics.py GLOBAL``) is pre-registered
-from ``CATALOG`` so exporters always emit the full series set and
-``docs/observability.md`` can document it. Nothing enforced that, though:
-a call site minting ``GLOBAL.counter("kernel.newThing")`` silently grows
-an uncatalogued series that scrapes see but docs and dashboards don't —
-catalog drift. This lint closes the loop statically:
+The check itself now lives in the graft-lint framework as the ``metrics``
+pass (``analysis/passes/metrics.py``): every LITERAL metric name passed
+to a GLOBAL-registry accessor (``counter``/``timer``/``gauge``/
+``watermark``/``histogram``/``get_or_create`` on a known GLOBAL alias —
+module aliases ``GLOBAL``/``_M``/``_obs``/``_GLOBAL_METRICS``/
+``obs_metrics.GLOBAL``/``metrics.GLOBAL``) must be in
+``obs.metrics.CATALOG``; every f-string name must start with a declared
+dynamic-family prefix; every ``dynamic_name("<prefix>", …)`` call must
+use a declared prefix. Per-operator metrics (``Exec.metric``) live on
+plan instances, not the process registry, and are out of scope.
 
-- every LITERAL metric name passed to a GLOBAL-registry accessor
-  (``counter``/``timer``/``gauge``/``watermark``/``histogram``/
-  ``get_or_create`` on a known GLOBAL alias) must be in ``CATALOG``;
-- every f-string metric name must start with a declared dynamic-family
-  prefix (``metrics.DYNAMIC_PREFIXES`` — the slug-capped families);
-- every ``dynamic_name("<prefix>", …)`` call must use a declared prefix.
-
-Per-operator metrics (``Exec.metric`` — numInputRows, opTime, pipe*) live
-on plan instances, not the process registry, and are out of scope here.
-
-Run: ``python -m spark_rapids_tpu.metrics_lint`` (or ``make
-metrics-lint``; the tier-1 suite runs it via tests/test_metrics_lint.py).
-Exit code 1 on drift, with file:line per finding.
+This module keeps the PR-9 entry points working unchanged:
+``python -m spark_rapids_tpu.metrics_lint`` / ``make metrics-lint`` /
+``tests/test_metrics_lint.py`` — all thin wrappers over the framework
+(which also runs the pass inside ``make lint`` and tier-1's
+tests/test_analysis.py meta-test).
 """
 from __future__ import annotations
 
 import os
-import re
 import sys
-from typing import List, Tuple
-
-#: receiver spellings that mean "the process-wide GLOBAL registry" at the
-#: project's call sites (module aliases included)
-_RECEIVERS = (
-    r"GLOBAL",
-    r"_M",
-    r"_obs",
-    r"_GLOBAL_METRICS",
-    r"obs_metrics\.GLOBAL",
-    r"metrics\.GLOBAL",
-)
-
-_KINDS = r"(?:counter|timer|gauge|watermark|histogram|get_or_create)"
-
-_LITERAL_CALL = re.compile(
-    r"(?:^|[^\w.])(?:" + "|".join(_RECEIVERS) + r")\s*\.\s*" + _KINDS
-    + r"\(\s*([frbu]{0,2})([\"'])((?:[^\"'\\]|\\.)*?)\2",
-    re.MULTILINE,
-)
-
-_DYNAMIC_NAME_CALL = re.compile(
-    r"\bdynamic_name\(\s*([\"'])((?:[^\"'\\]|\\.)*?)\1",
-    re.MULTILINE,
-)
-
-
-def _iter_source_files(root: str):
-    pkg = os.path.join(root, "spark_rapids_tpu")
-    for base, _dirs, files in os.walk(pkg):
-        for f in files:
-            if f.endswith(".py"):
-                yield os.path.join(base, f)
-    bench = os.path.join(root, "bench.py")
-    if os.path.exists(bench):
-        yield bench
+from typing import List
 
 
 def lint(root: str) -> List[str]:
-    from .obs import metrics as OM
+    """Run the metrics pass standalone; returns rendered findings
+    (inline ``# graft: ok(metrics: …)`` suppressions are honored, like
+    the full framework run)."""
+    from .analysis import Project, load_baseline, run_passes
+    from .analysis import default_baseline_path
+    from .analysis.passes.metrics import PASS
 
-    catalog = {name for name, _kind, _doc in OM.CATALOG}
-    dynamic = tuple(OM.DYNAMIC_PREFIXES)
-    findings: List[str] = []
-    self_path = os.path.join("spark_rapids_tpu", "obs", "metrics.py")
-
-    def check_name(path: str, lineno: int, prefixes: Tuple[str, ...],
-                   name: str, is_fstring: bool) -> None:
-        if is_fstring:
-            static_prefix = name.split("{", 1)[0]
-            if not any(static_prefix.startswith(p) or p.startswith(static_prefix)
-                       for p in prefixes):
-                findings.append(
-                    f"{path}:{lineno}: dynamic metric name f\"{name}\" does "
-                    "not match any declared dynamic-family prefix "
-                    "(obs.metrics.DYNAMIC_PREFIXES) — route it through "
-                    "dynamic_name() with a declared prefix"
-                )
-            return
-        if name not in catalog:
-            findings.append(
-                f"{path}:{lineno}: metric {name!r} is not pre-registered in "
-                "the GLOBAL catalog (obs.metrics.CATALOG) — add it there so "
-                "exports, docs, and dashboards see the series"
-            )
-
-    skip = (self_path, os.path.join("spark_rapids_tpu", "metrics_lint.py"))
-    for path in _iter_source_files(root):
-        rel = os.path.relpath(path, root)
-        if rel.endswith(skip):
-            continue  # the catalog itself + this lint's own docstring
-        with open(path, encoding="utf-8") as fh:
-            text = fh.read()
-        for m in _LITERAL_CALL.finditer(text):
-            lineno = text.count("\n", 0, m.start()) + 1
-            check_name(rel, lineno, dynamic, m.group(3),
-                       is_fstring="f" in m.group(1))
-        for m in _DYNAMIC_NAME_CALL.finditer(text):
-            lineno = text.count("\n", 0, m.start()) + 1
-            prefix = m.group(2)
-            if prefix not in dynamic:
-                findings.append(
-                    f"{rel}:{lineno}: dynamic_name prefix {prefix!r} "
-                    "is not declared in obs.metrics.DYNAMIC_PREFIXES"
-                )
-    return findings
+    project = Project.load(root)
+    result = run_passes(
+        project, [PASS], baseline=load_baseline(default_baseline_path(root))
+    )
+    return [f.render() for f in result.findings]
 
 
 def main(argv=None) -> int:

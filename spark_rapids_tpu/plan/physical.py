@@ -48,6 +48,23 @@ class ExecContext:
 
         self.retry_policy = RetryPolicy.from_conf(conf)
         self.breaker = getattr(session, "_breaker", None)
+        # Multiproc topology: startup_only keys, so the per-query surfaces
+        # (the exchange's rank split, the shuffle manager) read THESE
+        # fields, frozen here from the session's init-time tuple — never
+        # the conf (conf-key lint, scope rule). A session-less context
+        # (unit rigs) freezes its own view once, at construction.
+        if session is not None:
+            self.mp_driver, self.mp_rank, self.mp_size = (
+                session.multiproc_topology()
+            )
+        else:
+            # graft: ok(conf-key: session-less context freezes the value at
+            # construction — read once, never re-read per query)
+            self.mp_driver = cfg.MULTIPROC_DRIVER.get(conf)
+            # graft: ok(conf-key: session-less construction-time freeze)
+            self.mp_rank = cfg.MULTIPROC_RANK.get(conf)
+            # graft: ok(conf-key: session-less construction-time freeze)
+            self.mp_size = cfg.MULTIPROC_SIZE.get(conf)
         # spark.rapids.tpu.metrics.level wins when set; else the reference's
         # spark.rapids.sql.metrics.level key (obs/metrics.py taxonomy)
         level = (
@@ -111,7 +128,9 @@ class ExecContext:
         # Mesh execution: session-held MeshContext (stable across queries so
         # exchange programs stay compile-cached); None = single-device mode.
         self.mesh = None
-        if cfg.MESH_ENABLED.get(conf) and session is not None:
+        if session is not None and getattr(session, "_mesh_on", False):
+            # session-init frozen flag, not the conf: mesh mode committed
+            # the partition arity and exchange lowering at construction
             self.mesh = session.mesh_context()
 
     @property
@@ -138,7 +157,7 @@ class ExecContext:
             from ..shuffle.local import InProcessRegistry, InProcessTransport
             from ..shuffle.manager import MapOutputRegistry, ShuffleEnv, TpuShuffleManager
 
-            driver = cfg.MULTIPROC_DRIVER.get(self.conf)
+            driver = self.mp_driver  # frozen topology, never the live conf
             if driver:
                 # one executor of a multi-process query: TCP data plane +
                 # driver-service control plane (shuffle/driver_service.py).
@@ -156,7 +175,7 @@ class ExecContext:
 
                 host, _, port = driver.rpartition(":")
                 heartbeats, registry = ds.connect((host, int(port)))
-                rank = cfg.MULTIPROC_RANK.get(self.conf)
+                rank = self.mp_rank
                 executor_id = f"executor-{rank}"
                 transport = TcpTransport(
                     executor_id,

@@ -874,6 +874,8 @@ def _rechunk(rb: pa.RecordBatch, max_rows: int):
         yield rb
         return
     off = 0
+    # graft: ok(cancel-beat: zero-copy slicing of one already-materialized
+    # host batch; the _fetch_stream send loop around it beats per frame)
     while off < rb.num_rows:
         yield rb.slice(off, min(max_rows, rb.num_rows - off))
         off += max_rows

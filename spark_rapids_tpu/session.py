@@ -10,6 +10,7 @@ integration_tests asserts.py:313-377).
 """
 from __future__ import annotations
 
+from contextlib import contextmanager as _contextmanager
 from typing import Any, Iterable, List, Optional, Sequence, Union
 
 import pyarrow as pa
@@ -93,7 +94,11 @@ class TpuSession:
 
         _ps.set_enabled(cfg.PALLAS_ENABLED.get(self.conf))
         self._mesh_ctx = None
-        if cfg.MESH_ENABLED.get(self.conf):
+        # startup_only: mesh mode is committed at construction (partition
+        # arity, exchange lowering); per-query surfaces read this frozen
+        # flag, never the conf (conf-key lint, scope rule)
+        self._mesh_on = cfg.MESH_ENABLED.get(self.conf)
+        if self._mesh_on:
             # mesh mode: one exchange partition per chip, so the planner's
             # shuffle arity matches the mesh unless the user pinned it
             if self.conf.get_raw(cfg.SHUFFLE_PARTITIONS.key) is None:
@@ -151,20 +156,35 @@ class TpuSession:
         _obs_metrics.set_slug_cap(cfg.METRICS_MAX_DYNAMIC_SLUGS.get(self.conf))
         ensure_scrape(self)
         self._fault_injector = self._build_fault_injector()
-        if cfg.MULTIPROC_DRIVER.get(self.conf):
+        mp_driver = cfg.MULTIPROC_DRIVER.get(self.conf)
+        mp_rank = cfg.MULTIPROC_RANK.get(self.conf)
+        mp_size = cfg.MULTIPROC_SIZE.get(self.conf)
+        if mp_driver:
             # fail fast on inconsistent multi-process settings — a missing
             # piece silently double-counts (every rank runs the full query)
-            size = cfg.MULTIPROC_SIZE.get(self.conf)
-            rank = cfg.MULTIPROC_RANK.get(self.conf)
             if not cfg.SHUFFLE_MANAGER_ENABLED.get(self.conf):
                 raise ValueError(
                     "spark.rapids.shuffle.multiproc.driver requires "
                     "spark.rapids.shuffle.manager.enabled=true"
                 )
-            if size < 2 or not (0 <= rank < size):
+            if mp_size < 2 or not (0 <= mp_rank < mp_size):
                 raise ValueError(
-                    f"multiproc rank/size invalid: rank={rank} size={size}"
+                    f"multiproc rank/size invalid: rank={mp_rank} "
+                    f"size={mp_size}"
                 )
+        # The multiproc keys are startup_only: the transport, executor id,
+        # and driver registration commit to this topology NOW, so every
+        # per-query surface (ExecContext, the exchange's rank split) reads
+        # the frozen tuple instead of re-reading the conf — a live
+        # set_conf can no longer make the plan disagree with the running
+        # transport (conf-key lint, scope rule). The thread-local override
+        # lets subquery resolution run single-process WITHOUT mutating the
+        # shared conf (the old saved/restored-conf dance raced concurrent
+        # queries on other threads into multiproc-off planning).
+        self._mp_topology = (
+            (mp_driver, mp_rank, mp_size) if mp_driver else ("", 0, 1)
+        )
+        self._mp_off_tls = _threading.local()
 
     def _build_fault_injector(self):
         """One injector for the session's lifetime, so every-Nth fault
@@ -230,6 +250,31 @@ class TpuSession:
         flagged. The session stays fully usable afterwards."""
         return self._scheduler.cancel_all(reason)
 
+    def multiproc_topology(self) -> tuple:
+        """``(driver, rank, size)`` as frozen at session construction —
+        the only sanctioned read of the startup_only multiproc keys on
+        the query path. Returns the single-process tuple while the
+        calling thread is inside a subquery-resolution scope (see
+        ``_resolve_subqueries``: subqueries must run WHOLE on every
+        rank, and the thread-local override gets that without mutating
+        the shared conf under concurrent queries)."""
+        if getattr(self._mp_off_tls, "depth", 0) > 0:
+            return ("", 0, 1)
+        return self._mp_topology
+
+    @_contextmanager
+    def _single_process_scope(self):
+        """Thread-local multiproc-off scope for subquery resolution. A
+        DEPTH counter, not a flag: a subquery nested inside another
+        subquery must not re-enable multiproc for the still-executing
+        outer one when the inner scope exits."""
+        tls = self._mp_off_tls
+        tls.depth = getattr(tls, "depth", 0) + 1
+        try:
+            yield
+        finally:
+            tls.depth -= 1
+
     def mesh_context(self):
         """Lazily build the session's MeshContext (mesh mode only)."""
         if self._mesh_ctx is None:
@@ -293,16 +338,14 @@ class TpuSession:
         def run_whole(plan):
             """Subqueries resolve to literals every executor needs — under a
             multi-process query each process computes the WHOLE subquery
-            locally (rank-splitting it would inline a partial aggregate)."""
-            if cfg.MULTIPROC_DRIVER.get(self.conf):
-                saved = self.conf
-                try:
-                    self.conf = saved.set(cfg.MULTIPROC_DRIVER.key, "").set(
-                        cfg.MULTIPROC_SIZE.key, "1"
-                    )
+            locally (rank-splitting it would inline a partial aggregate).
+            The single-process override is THREAD-LOCAL (ExecContext reads
+            multiproc_topology() at construction): the old save/restore of
+            the shared conf let a concurrent query on another thread plan
+            itself multiproc-off mid-subquery."""
+            if self._mp_topology[0]:
+                with self._single_process_scope():
                     return self._execute(plan)
-                finally:
-                    self.conf = saved
             return self._execute(plan)
 
         def fix(e):

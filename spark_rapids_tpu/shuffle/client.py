@@ -214,6 +214,8 @@ class ShuffleClient:
                 # ALREADY in the received catalog (frame path ran), so
                 # yielding them here — instead of re-fetching — keeps the
                 # retry from leaking the first copy
+                # graft: ok(cancel-beat: non-blocking get_nowait drain of
+                # already-landed buffers; exits on first Empty)
                 while True:
                     try:
                         item = completions.get_nowait()

@@ -245,9 +245,13 @@ class CachingReader:
         # group remote requests per peer executor (one metadata round trip
         # per peer, the RapidsShuffleIterator batching)
         remote: Dict[str, List[M.BlockId]] = {}
+        # graft: ok(cancel-beat: local map-output replay from the
+        # executor's own catalog — no network wait; the remote loop below
+        # beats through fetch_blocks' stall phases)
         for s in statuses:
             if any(s.sizes[p] for p in range(start_part, min(end_part, len(s.sizes)))):
                 if s.executor_id == self._env.executor_id:
+                    # graft: ok(cancel-beat: same local catalog replay)
                     for bid, handle, _rows in self._env.catalog.blocks_for(
                         shuffle_id, s.map_id, start_part, end_part
                     ):

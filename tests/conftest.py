@@ -96,3 +96,38 @@ def _bound_jit_code_size():
 
     K.clear()
     jax.clear_caches()
+
+
+#: tier-1 suites that exercise the engine's real multi-thread interleavings
+#: (concurrent admissions, serve workers, pipeline producers) — they run
+#: under the lockwatch harness; chaos-marked tests ride it too (ISSUE 10)
+_LOCKWATCH_MODULES = {"test_scheduler", "test_serve"}
+
+
+@pytest.fixture(autouse=True)
+def _lockwatch_harness(request):
+    """Lock-order race harness (spark_rapids_tpu/analysis/lockwatch.py):
+    instrument every engine-created Lock/RLock/Condition for the duration
+    of the test, record real acquisition orderings into the process-wide
+    order graph, and assert that no cycle and no declared-hierarchy
+    inversion was EVER observed — the dynamic teeth of the static
+    lock-order pass. Observations accumulate across tests on purpose:
+    an inversion is a property of the engine, not of one test."""
+    module = getattr(request.node, "module", None)
+    name = getattr(module, "__name__", "").rsplit(".", 1)[-1]
+    armed = (
+        name in _LOCKWATCH_MODULES
+        or request.node.get_closest_marker("chaos") is not None
+    )
+    if not armed:
+        yield
+        return
+    from spark_rapids_tpu.analysis import lockwatch
+
+    lockwatch.install()
+    try:
+        yield
+    finally:
+        lockwatch.uninstall()
+    report = lockwatch.report()
+    assert report.ok, report.describe()

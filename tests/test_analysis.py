@@ -1,0 +1,583 @@
+"""graft-lint framework + passes (ISSUE 10): synthetic positive/negative
+fixtures per pass, the suppression/baseline machinery, the lockwatch
+runtime harness, and the repo-wide meta-test asserting the tree is clean
+modulo the checked-in baseline (which is how the lint rides tier-1)."""
+import os
+import threading
+
+import pytest
+
+from spark_rapids_tpu.analysis import (
+    PROTECTED_DIRS,
+    Baseline,
+    BaselineEntry,
+    Project,
+    default_baseline_path,
+    load_baseline,
+    run_passes,
+    write_baseline,
+)
+from spark_rapids_tpu.analysis.passes.cancel_beat import PASS as BEAT_PASS
+from spark_rapids_tpu.analysis.passes.conf_keys import PASS as CONF_PASS
+from spark_rapids_tpu.analysis.passes.host_sync import PASS as SYNC_PASS
+from spark_rapids_tpu.analysis.passes.locks import PASS as LOCK_PASS
+from spark_rapids_tpu.analysis.passes.metrics import PASS as METRICS_PASS
+
+ROOT = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+
+
+def _mini(tmp_path, files: dict) -> Project:
+    """Build a throwaway project mirroring the package layout."""
+    for rel, src in files.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(src)
+    return Project.load(str(tmp_path))
+
+
+def _run(project, passes):
+    return run_passes(project, passes, baseline=None)
+
+
+# ── host-sync ───────────────────────────────────────────────────────────────
+
+
+def test_host_sync_hit_and_suppressed(tmp_path):
+    proj = _mini(tmp_path, {
+        "spark_rapids_tpu/exec/hot.py": (
+            "import numpy as np\n"
+            "def f(db):\n"
+            "    a = np.asarray(db)\n"
+            "    # graft: ok(host-sync: test says so)\n"
+            "    b = np.asarray(db)\n"
+            "    c = np.asarray(db)  # graft: ok(host-sync: inline form)\n"
+        ),
+    })
+    r = _run(proj, [SYNC_PASS])
+    assert len(r.findings) == 1 and r.findings[0].line == 3
+    assert len(r.suppressed) == 2
+
+
+def test_host_sync_variants(tmp_path):
+    proj = _mini(tmp_path, {
+        "spark_rapids_tpu/exec/hot.py": (
+            "import jax\n"
+            "def f(db, x_dev):\n"
+            "    jax.device_get(db)\n"
+            "    db.block_until_ready()\n"
+            "    db.num_rows.item()\n"
+            "    db.row_count()\n"
+            "    n = int(x_dev)\n"
+        ),
+    })
+    r = _run(proj, [SYNC_PASS])
+    assert len(r.findings) == 5
+    rendered = "\n".join(f.render() for f in r.findings)
+    for what in ("device_get", "block_until_ready", ".item()",
+                 ".row_count()", "int(x_dev)"):
+        assert what in rendered
+
+
+def test_host_sync_scope(tmp_path):
+    """CPU-oracle exec files and trace-time expr numpy stay unflagged;
+    genuinely-syncing constructs in expr/ stay flagged."""
+    proj = _mini(tmp_path, {
+        "spark_rapids_tpu/exec/cpu_thing.py": (
+            "import numpy as np\n"
+            "def f(t):\n"
+            "    return np.asarray(t)\n"
+        ),
+        "spark_rapids_tpu/expr/strings2.py": (
+            "import numpy as np\n"
+            "import jax\n"
+            "def f(v):\n"
+            "    a = np.asarray(v)\n"      # trace-time prep: exempt
+            "    b = v.tolist()\n"          # CPU-branch host work: exempt
+            "    jax.device_get(v)\n"       # real sync: flagged
+        ),
+    })
+    r = _run(proj, [SYNC_PASS])
+    assert len(r.findings) == 1
+    assert r.findings[0].path.endswith("strings2.py")
+    assert "device_get" in r.findings[0].message
+
+
+# ── lock-order ──────────────────────────────────────────────────────────────
+
+
+def test_lock_cycle_reported_with_both_sites(tmp_path):
+    """The PR-7 deadlock shape: two lock-acquisition paths that close a
+    cycle — the report names the cycle and both acquisition sites."""
+    proj = _mini(tmp_path, {
+        "spark_rapids_tpu/exec/fix.py": (
+            "import threading\n"
+            "COMPILE_LOCK = threading.RLock()\n"
+            "STATE_LOCK = threading.Lock()\n"
+            "def first_touch():\n"
+            "    with COMPILE_LOCK:\n"
+            "        with STATE_LOCK:\n"
+            "            pass\n"
+            "def stats():\n"
+            "    with STATE_LOCK:\n"
+            "        warm_all()\n"
+            "def warm_all():\n"
+            "    with COMPILE_LOCK:\n"
+            "        pass\n"
+        ),
+    })
+    r = _run(proj, [LOCK_PASS])
+    cycles = [f for f in r.findings if "cycle" in f.message]
+    assert len(cycles) == 1
+    msg = cycles[0].message
+    assert "COMPILE_LOCK" in msg and "STATE_LOCK" in msg
+    # both acquisition sites present: the nested with (line 6) and the
+    # transitive acquisition through warm_all (line 12)
+    assert "fix.py:6" in msg and "fix.py:12" in msg
+
+
+def test_lock_dag_clean(tmp_path):
+    proj = _mini(tmp_path, {
+        "spark_rapids_tpu/exec/ok.py": (
+            "import threading\n"
+            "A = threading.Lock()\n"
+            "B = threading.Lock()\n"
+            "def f():\n"
+            "    with A:\n"
+            "        with B:\n"
+            "            pass\n"
+            "def g():\n"
+            "    with A:\n"
+            "        with B:\n"
+            "            pass\n"
+        ),
+    })
+    r = _run(proj, [LOCK_PASS])
+    assert not r.findings
+
+
+def test_blocking_under_lock(tmp_path):
+    proj = _mini(tmp_path, {
+        "spark_rapids_tpu/exec/blk.py": (
+            "import threading, time\n"
+            "L = threading.Lock()\n"
+            "def f(sock, fut, worker_thread):\n"
+            "    with L:\n"
+            "        time.sleep(1)\n"
+            "        sock.recv(4)\n"
+            "        fut.result()\n"
+            "        worker_thread.join()\n"
+            "    ', '.join(['not', 'flagged'])\n"
+            "def g(kern, args):\n"
+            "    with L:\n"
+            "        kern.warm(*args)\n"
+        ),
+    })
+    r = _run(proj, [LOCK_PASS])
+    rendered = "\n".join(f.render() for f in r.findings)
+    assert len(r.findings) == 5
+    for what in ("sleep", "recv", "result", "join", "warm"):
+        assert what in rendered
+
+
+def test_self_deadlock_nonreentrant(tmp_path):
+    proj = _mini(tmp_path, {
+        "spark_rapids_tpu/exec/self.py": (
+            "import threading\n"
+            "L = threading.Lock()\n"
+            "def f():\n"
+            "    with L:\n"
+            "        with L:\n"
+            "            pass\n"
+        ),
+    })
+    r = _run(proj, [LOCK_PASS])
+    assert len(r.findings) == 1
+    assert "self-deadlock" in r.findings[0].message
+
+
+def test_hierarchy_inversion(tmp_path):
+    """An obs-tier (leaf) lock held while acquiring a sched-tier lock is
+    an inversion against analysis/lock_order.py's declared order."""
+    proj = _mini(tmp_path, {
+        "spark_rapids_tpu/sched/scheduler.py": (
+            "import threading\n"
+            "SCHED_LOCK = threading.Lock()\n"
+        ),
+        "spark_rapids_tpu/obs/metrics2.py": (
+            "import threading\n"
+            "from ..sched.scheduler import SCHED_LOCK\n"
+            "OBS_LOCK = threading.Lock()\n"
+            "def f():\n"
+            "    with OBS_LOCK:\n"
+            "        with SCHED_LOCK:\n"
+            "            pass\n"
+        ),
+    })
+    r = _run(proj, [LOCK_PASS])
+    inv = [f for f in r.findings if "hierarchy inversion" in f.message]
+    assert len(inv) == 1
+    assert "SCHED_LOCK" in inv[0].message
+
+
+# ── conf-key ────────────────────────────────────────────────────────────────
+
+
+def test_conf_key_existence(tmp_path):
+    proj = _mini(tmp_path, {
+        "spark_rapids_tpu/thing.py": (
+            "KNOWN = 'spark.rapids.tpu.scheduler.permits'\n"
+            "FAMILY = 'spark.rapids.tpu.faults'\n"
+            "RULE = 'spark.rapids.sql.exec.MadeUpExec'\n"
+            "BAD = 'spark.rapids.tpu.scheduler.permitz'\n"
+        ),
+    })
+    r = _run(proj, [CONF_PASS])
+    assert len(r.findings) == 1
+    assert "permitz" in r.findings[0].message
+
+
+def test_conf_startup_scope(tmp_path):
+    src = (
+        "from . import config as cfg\n"
+        "def f(conf):\n"
+        "    return cfg.MESH_ENABLED.get(conf)\n"
+    )
+    proj = _mini(tmp_path, {
+        "spark_rapids_tpu/exec/q.py": src.replace(
+            "from . import", "from .. import"
+        ),
+        # the session-construction surface may read startup keys
+        "spark_rapids_tpu/session.py": src,
+    })
+    r = _run(proj, [CONF_PASS])
+    assert len(r.findings) == 1
+    assert r.findings[0].path == "spark_rapids_tpu/exec/q.py"
+    assert "startup_only" in r.findings[0].message
+    # per-query keys are fine anywhere
+    proj2 = _mini(tmp_path / "b", {
+        "spark_rapids_tpu/exec/q.py": (
+            "from .. import config as cfg\n"
+            "def f(conf):\n"
+            "    return cfg.SCHEDULER_PERMITS.get(conf)\n"
+        ),
+    })
+    assert not _run(proj2, [CONF_PASS]).findings
+
+
+# ── cancel-beat ─────────────────────────────────────────────────────────────
+
+
+def test_cancel_beat_fixtures(tmp_path):
+    proj = _mini(tmp_path, {
+        "spark_rapids_tpu/exec/loops.py": (
+            "def beatless(it):\n"
+            "    for db in it:\n"
+            "        yield db\n"
+            "def beating(it, tok):\n"
+            "    for db in it:\n"
+            "        tok.check()\n"
+            "        yield db\n"
+            "def delegated(catalog, fn, it, policy):\n"
+            "    for db in it:\n"
+            "        yield from run_with_retry(catalog, fn, db, policy)\n"
+            "def drain(it):\n"
+            "    out = []\n"
+            "    for db in it:\n"
+            "        out.append(db)\n"
+            "    return out\n"
+            "def suppressed(it):\n"
+            "    # graft: ok(cancel-beat: test fixture)\n"
+            "    for db in it:\n"
+            "        yield db\n"
+        ),
+    })
+    r = _run(proj, [BEAT_PASS])
+    assert len(r.findings) == 1 and r.findings[0].line == 2
+    assert len(r.suppressed) == 1
+
+
+# ── metrics (the folded-in PR-9 pass) ───────────────────────────────────────
+
+
+def test_metrics_pass_drift(tmp_path):
+    proj = _mini(tmp_path, {
+        "spark_rapids_tpu/drifted.py": (
+            '_M.counter("kernel.doesNotExist").add(1)\n'
+            '_M.counter("kernel.builds").add(1)\n'
+            'GLOBAL.counter(f"bogus.{x}.y").add(1)\n'
+        ),
+    })
+    r = _run(proj, [METRICS_PASS])
+    assert len(r.findings) == 2
+    rendered = "\n".join(f.render() for f in r.findings)
+    assert "kernel.doesNotExist" in rendered and "bogus." in rendered
+
+
+# ── suppression + baseline machinery ────────────────────────────────────────
+
+
+def test_malformed_graft_marker(tmp_path):
+    proj = _mini(tmp_path, {
+        "spark_rapids_tpu/bad.py": "x = 1  # graft: okay then\n",
+    })
+    r = _run(proj, [])
+    assert len(r.framework) == 1
+    assert "malformed graft marker" in r.framework[0].message
+
+
+def test_multiline_suppression_block(tmp_path):
+    proj = _mini(tmp_path, {
+        "spark_rapids_tpu/exec/hot.py": (
+            "import numpy as np\n"
+            "def f(db):\n"
+            "    # graft: ok(host-sync: a reason long enough that the\n"
+            "    # author wrapped it over two comment lines)\n"
+            "    return np.asarray(db)\n"
+        ),
+    })
+    r = _run(proj, [SYNC_PASS])
+    assert not r.findings and len(r.suppressed) == 1 and not r.framework
+
+
+def test_baseline_roundtrip_and_staleness(tmp_path):
+    files = {
+        "spark_rapids_tpu/kernels.py": (
+            "import numpy as np\n"
+            "def f(db):\n"
+            "    return np.asarray(db)\n"
+        ),
+    }
+    proj = _mini(tmp_path, files)
+    bl_path = str(tmp_path / "BASELINE.lint")
+    r = _run(proj, [SYNC_PASS])
+    assert len(r.findings) == 1
+    # refuse a new entry without justification
+    with pytest.raises(SystemExit):
+        write_baseline(bl_path, r.findings, Baseline(bl_path), justify="")
+    write_baseline(bl_path, r.findings, Baseline(bl_path), justify="legacy")
+    r2 = run_passes(proj, [SYNC_PASS], baseline=load_baseline(bl_path))
+    assert r2.ok and len(r2.baselined) == 1
+    # fixing the finding makes the baseline row STALE — a failure, so the
+    # file can only shrink honestly
+    (tmp_path / "spark_rapids_tpu/kernels.py").write_text(
+        "def f(db):\n    return db\n"
+    )
+    proj3 = Project.load(str(tmp_path))
+    r3 = run_passes(proj3, [SYNC_PASS], baseline=load_baseline(bl_path))
+    assert not r3.ok
+    assert any("stale baseline entry" in f.message for f in r3.framework)
+
+
+def test_baseline_protected_dirs(tmp_path):
+    proj = _mini(tmp_path, {
+        "spark_rapids_tpu/exec/hot.py": (
+            "import numpy as np\n"
+            "def f(db):\n"
+            "    return np.asarray(db)\n"
+        ),
+    })
+    r = _run(proj, [SYNC_PASS])
+    bl_path = str(tmp_path / "BASELINE.lint")
+    # the writer refuses exec/ findings outright
+    with pytest.raises(SystemExit):
+        write_baseline(bl_path, r.findings, Baseline(bl_path), justify="no")
+    # and a hand-edited protected row is rejected at load
+    with open(bl_path, "w") as fh:
+        fh.write(
+            "host-sync | spark_rapids_tpu/exec/hot.py | deadbeef0123 | x\n"
+        )
+    bl = load_baseline(bl_path)
+    assert not bl.entries
+    assert any("protected directory" in e for e in bl.errors)
+
+
+def test_baseline_requires_justification(tmp_path):
+    bl_path = str(tmp_path / "BASELINE.lint")
+    with open(bl_path, "w") as fh:
+        fh.write("host-sync | spark_rapids_tpu/shuffle/x.py | abc123 |\n")
+    bl = load_baseline(bl_path)
+    assert any("malformed" in e or "justification" in e for e in bl.errors)
+
+
+# ── lockwatch (runtime harness) ─────────────────────────────────────────────
+
+
+def _watched(name, site):
+    from spark_rapids_tpu.analysis import lockwatch as lw
+
+    return lw._WatchedLock(threading.Lock(), site, reentrant=False)
+
+
+def test_lockwatch_detects_inversion_cycle():
+    from spark_rapids_tpu.analysis import lockwatch as lw
+
+    lw.reset()
+    a = _watched("a", "spark_rapids_tpu/exec/x.py:10")
+    b = _watched("b", "spark_rapids_tpu/exec/x.py:20")
+    with a:
+        with b:
+            pass
+    with b:
+        with a:
+            pass
+    rep = lw.report()
+    assert rep.cycles, rep.describe()
+    lw.reset()
+
+
+def test_lockwatch_hierarchy_inversion():
+    from spark_rapids_tpu.analysis import lockwatch as lw
+
+    lw.reset()
+    leaf = _watched("obs", "spark_rapids_tpu/obs/metrics.py:10")
+    outer = _watched("sched", "spark_rapids_tpu/sched/scheduler.py:10")
+    with leaf:
+        with outer:  # leaf (tier 90) held while taking sched (tier 20)
+            pass
+    rep = lw.report()
+    assert rep.inversions, rep.describe()
+    lw.reset()
+
+
+def test_lockwatch_clean_order():
+    from spark_rapids_tpu.analysis import lockwatch as lw
+
+    lw.reset()
+    outer = _watched("sched", "spark_rapids_tpu/sched/scheduler.py:10")
+    leaf = _watched("obs", "spark_rapids_tpu/obs/metrics.py:10")
+    with outer:
+        with leaf:
+            pass
+    rep = lw.report()
+    assert rep.ok, rep.describe()
+    lw.reset()
+
+
+def test_lockwatch_install_wraps_engine_locks_only(tmp_path):
+    from spark_rapids_tpu.analysis import lockwatch as lw
+
+    lw.reset()
+    lw.install()
+    try:
+        # a lock created from NON-engine code comes back raw
+        raw = threading.Lock()
+        assert not isinstance(raw, lw._WatchedLock)
+        # engine code (simulated via the compile filename) gets wrapped
+        ns: dict = {}
+        code = compile(
+            "import threading\nL = threading.Lock()\n",
+            os.path.join("spark_rapids_tpu", "exec", "fake.py"),
+            "exec",
+        )
+        exec(code, ns)
+        assert isinstance(ns["L"], lw._WatchedLock)
+        with ns["L"]:
+            pass
+    finally:
+        lw.uninstall()
+        lw.reset()
+    assert threading.Lock is lw._orig["Lock"] or not lw._installed
+
+
+# ── the repo-wide meta-test: graft-lint rides tier-1 ────────────────────────
+
+
+def test_repo_is_lint_clean():
+    """`make lint` truth inside the suite: zero unsuppressed, unbaselined
+    findings over the whole tree, and the protected dirs carry no
+    baseline rows (load_baseline enforces that structurally)."""
+    project = Project.load(ROOT)
+    baseline = load_baseline(default_baseline_path(ROOT))
+    assert not baseline.errors, baseline.errors
+    for e in baseline.entries:
+        for prot in PROTECTED_DIRS:
+            assert not e.path.startswith(prot)
+    result = run_passes(project, baseline=baseline)
+    rendered = "\n".join(
+        f.render() for f in result.framework + result.findings
+    )
+    assert result.ok, rendered
+
+
+def test_fingerprint_stability():
+    """Baseline fingerprints survive line drift: inserting lines above a
+    finding must not change its fingerprint."""
+    import textwrap
+
+    def fp(prefix):
+        src = prefix + (
+            "import numpy as np\n"
+            "def f(db):\n"
+            "    return np.asarray(db)\n"
+        )
+        import tempfile
+
+        with tempfile.TemporaryDirectory() as d:
+            pkg = os.path.join(d, "spark_rapids_tpu")
+            os.makedirs(pkg)
+            with open(os.path.join(pkg, "kernels.py"), "w") as fh:
+                fh.write(textwrap.dedent(src))
+            proj = Project.load(d)
+            r = run_passes(proj, [SYNC_PASS], baseline=None)
+            assert len(r.findings) == 1
+            return r.findings[0].fingerprint
+
+    assert fp("") == fp("# pad\n# pad\n")
+
+
+def test_pass_subset_does_not_stale_other_baseline_entries():
+    """--passes metrics must not declare the lock-order baseline entry
+    stale (staleness is only decidable for passes that ran)."""
+    from spark_rapids_tpu.analysis.__main__ import main
+
+    assert main([ROOT, "--passes", "metrics", "-q"]) == 0
+
+
+def test_write_baseline_refuses_pass_subset(tmp_path, capsys):
+    from spark_rapids_tpu.analysis.__main__ import main
+
+    (tmp_path / "spark_rapids_tpu").mkdir()
+    (tmp_path / "spark_rapids_tpu" / "empty.py").write_text("x = 1\n")
+    rc = main([str(tmp_path), "--passes", "metrics", "--write-baseline"])
+    assert rc == 2
+    assert "full pass suite" in capsys.readouterr().out
+
+
+def test_outer_mask_merge_colocates_across_devices():
+    """The full-outer tail's device-resident mask OR must survive masks
+    committed to different chips (placed partitions)."""
+    import jax
+    import jax.numpy as jnp
+
+    from spark_rapids_tpu.exec.tpu_join import _colocated
+
+    devs = jax.devices()
+    if len(devs) < 2:
+        pytest.skip("needs >=2 devices")
+    a = jax.device_put(jnp.zeros(8, dtype=bool), devs[0])
+    b = jax.device_put(
+        jnp.arange(8) % 2 == 0, devs[1]
+    )
+    merged = a | _colocated(a, b)
+    assert (devs[0],) == tuple(merged.devices())
+    assert int(merged.sum()) == 4
+    # same-device path: no transfer, plain OR
+    c = jax.device_put(jnp.ones(8, dtype=bool), devs[0])
+    assert bool((a | _colocated(a, c)).all())
+
+
+def test_single_process_scope_nests():
+    """A subquery nested inside a subquery must not re-enable multiproc
+    for the still-executing outer scope (depth counter, not a flag)."""
+    from spark_rapids_tpu import TpuSession
+
+    s = TpuSession()
+    s._mp_topology = ("host:1", 0, 2)
+    assert s.multiproc_topology() == ("host:1", 0, 2)
+    with s._single_process_scope():
+        assert s.multiproc_topology() == ("", 0, 1)
+        with s._single_process_scope():
+            assert s.multiproc_topology() == ("", 0, 1)
+        # the inner scope's exit must NOT restore multiproc here
+        assert s.multiproc_topology() == ("", 0, 1)
+    assert s.multiproc_topology() == ("host:1", 0, 2)

@@ -1,7 +1,13 @@
 """Config hygiene + generated docs — r1 verdict #9: a registered key that
 nothing reads is worse than no key (the reference's keys all gate behavior),
 and docs are generated from code so they cannot drift
-(RapidsConf.scala:1052-1149, TypeChecks.scala:1581)."""
+(RapidsConf.scala:1052-1149, TypeChecks.scala:1581).
+
+The inverse direction — every key LITERAL at a call site must exist in
+the registry, with startup_only keys never re-read per query — is now
+graft-lint's conf-key pass (analysis/passes/conf_keys.py, tier-1 via
+tests/test_analysis.py), which supersedes the docs-only drift check this
+file used to be the sole guard for."""
 import os
 import re
 
