@@ -138,7 +138,17 @@ bench-serve-overload:
 chaos-serve:
 	$(PYTEST) tests/test_chaos_serve.py -q -m chaos
 
-# The full chaos surface (in-process + serve-path).
+# Restart/corruption chaos suite (ISSUE 11): boot a server, kill it
+# mid-compile, restart against the same compile-cache dir, and drive every
+# faults.compileCache.* damage point (truncate, bit flip, stale version
+# fence, crash-between-temp-and-rename, wedged lock holder) — asserts
+# bit-identical TPC-H results, quarantine+rebuild, and a near-zero
+# second-boot compile ledger.
+.PHONY: chaos-restart
+chaos-restart:
+	$(PYTEST) tests/test_chaos_restart.py -q -m chaos
+
+# The full chaos surface (in-process + serve-path + restart/corruption).
 .PHONY: chaos
 chaos:
 	$(PYTEST) -q -m chaos

@@ -66,8 +66,9 @@ def ensure_backend(total_budget_s: float = 300.0) -> dict:
         else "import jax; "
     )
     probe = (
-        pin + "import json; ds = jax.devices(); "
-        "print(json.dumps({'platform': ds[0].platform, 'n': len(ds)}))"
+        pin + "import json, jaxlib; ds = jax.devices(); "
+        "print(json.dumps({'platform': ds[0].platform, 'n': len(ds), "
+        "'jax': jax.__version__, 'jaxlib': jaxlib.__version__}))"
     )
     deadline = time.monotonic() + total_budget_s
     delay = 5.0
@@ -142,6 +143,30 @@ def time_query_split(build, n_run: int = N_RUN):
         _collect_retry(build)
         best = min(best, time.perf_counter() - t0)
     return first, best
+
+
+def platform_header() -> dict:
+    """Self-describing platform block for every emitted artifact (BENCH
+    diag, SLO JSON): which backend actually ran, on how many devices, and
+    under which jax/jaxlib. Exists because SLO_r07.json was a CPU smoke
+    run that read as a TPU result — an artifact must carry enough header
+    to refute a misreading on its own."""
+    out = {}
+    try:
+        import jax
+        import jaxlib
+
+        devs = jax.devices()
+        out = {
+            "default_backend": jax.default_backend(),
+            "device_count": len(devs),
+            "device_kind": str(getattr(devs[0], "device_kind", "")),
+            "jax": jax.__version__,
+            "jaxlib": jaxlib.__version__,
+        }
+    except Exception as e:  # noqa: BLE001 - a dead backend still benches CPU paths
+        out = {"error": str(e)[-200:]}
+    return out
 
 
 def plan_diagnostics(session, wall_s: float) -> dict:
@@ -783,7 +808,15 @@ def main() -> None:
     })
     cpu = TpuSession({"spark.rapids.sql.enabled": False, **shuffle_conf})
 
-    detail: dict = {"backend": backend, "suite": suite, "smoke": smoke}
+    detail: dict = {
+        "backend": backend,
+        # the in-process truth (the subprocess probe can disagree with
+        # what this process actually initialized): backend, device count,
+        # jax/jaxlib — the "is this really a TPU result?" header
+        "platform": platform_header(),
+        "suite": suite,
+        "smoke": smoke,
+    }
     speedups = []
 
     if serve_clients > 0:
@@ -934,6 +967,22 @@ def main() -> None:
                                     session=tpu))
         detail["trace_dir"] = trace_dir
         log({"trace_dir": trace_dir, "prometheus": prom_path})
+
+    # compile-cache outcome for the run: hit/miss/corrupt series plus the
+    # store's residency — the "warm restart compiles ~0" evidence block
+    try:
+        from spark_rapids_tpu.cache import xla_store as _xc
+        from spark_rapids_tpu.obs.metrics import GLOBAL as _G
+
+        cache_view = _G.view("cache.xla.", strip=False)
+        store = _xc.active_store()
+        if store is not None or any(cache_view.values()):
+            detail["compile_cache"] = {
+                "metrics": cache_view,
+                "store": store.stats() if store is not None else None,
+            }
+    except Exception:  # noqa: BLE001 - reporting must not fail the rig
+        pass
 
     geo = geomean(speedups)
     detail["wall_s"] = round(time.monotonic() - t_start, 1)

@@ -1,0 +1,11 @@
+"""Persistent caches that survive process boundaries.
+
+``xla_store`` — the crash-safe on-disk XLA executable store behind
+``kernels.GuardedJit`` (spark.rapids.tpu.compileCache.*): a restarted
+server deserializes yesterday's compiled executables instead of re-paying
+6–90s first-touch XLA compiles per query shape. See docs/operations.md
+("Restart runbook") for the operator contract.
+"""
+from . import xla_store  # noqa: F401
+
+__all__ = ["xla_store"]

@@ -790,6 +790,44 @@ COMPILE_DEADLINE_S = conf("spark.rapids.tpu.compile.deadlineSeconds").doc(
 ).double_conf(0.0)
 
 
+# ── persistent XLA executable cache (cache/xla_store.py) ───────────────────
+
+COMPILE_CACHE_ENABLED = conf("spark.rapids.tpu.compileCache.enabled").doc(
+    "Crash-safe on-disk XLA executable store (cache/xla_store.py): "
+    "kernels.GuardedJit serializes compiled executables keyed by kernel "
+    "structural identity + batch geometry + jax/jaxlib/XLA version + "
+    "backend fingerprint, and consults the store before compiling — a "
+    "restarted server deserializes yesterday's binaries in milliseconds "
+    "instead of re-paying 6-90s first-touch compiles per query shape. "
+    "Corrupt, truncated, or version-skewed entries degrade to a fresh "
+    "compile (quarantine + cache.xla.corrupt), never to a failure. "
+    "Process-global; reconfigured on set_conf."
+).boolean_conf(True)
+
+COMPILE_CACHE_DIR = conf("spark.rapids.tpu.compileCache.dir").doc(
+    "Directory for the executable store. Empty (default) auto-selects "
+    "~/.cache/spark_rapids_tpu/xc-<backend> (or "
+    "$SPARK_RAPIDS_TPU_COMPILE_CACHE/xc-<backend>). Point every server "
+    "of a fleet at ONE shared directory: a per-entry file lock makes the "
+    "fleet compile each shape once (docs/operations.md restart runbook)."
+).string_conf(None)
+
+COMPILE_CACHE_MAX_BYTES = conf("spark.rapids.tpu.compileCache.maxBytes").doc(
+    "Disk budget for the executable store; oldest-use entries (mtime LRU "
+    "— loads touch their entry) are evicted past it. 0 = unbounded."
+).bytes_conf(2 << 30)
+
+COMPILE_CACHE_LOCK_TIMEOUT_S = conf(
+    "spark.rapids.tpu.compileCache.lockTimeout"
+).doc(
+    "Seconds to wait on another process's per-entry compile lock before "
+    "giving up the single-flight dedup and compiling anyway "
+    "(cache.xla.lockTimeouts). The flock dies with its holder, so a "
+    "CRASHED peer never blocks past its own death; this bounds a WEDGED "
+    "one. Size it above your slowest expected compile."
+).double_conf(120.0)
+
+
 # ── network serving front-end (serve/) ─────────────────────────────────────
 
 SERVE_HOST = conf("spark.rapids.tpu.serve.host").doc(
@@ -868,6 +906,16 @@ SERVE_WARMUP_STATEMENTS = conf("spark.rapids.tpu.serve.warmupStatements").doc(
     "warm pool is primed, so a rolling restart can wait for readiness "
     "before shifting traffic. Empty = ready immediately."
 ).string_conf(None)
+
+SERVE_READY_TIMEOUT_S = conf("spark.rapids.tpu.serve.readyTimeout").doc(
+    "Readiness budget the server ADVERTISES to clients (HELLO_OK and "
+    "STATUS carry it): Connection.wait_ready() with no explicit timeout "
+    "polls this long before giving up. Size it above the server's worst "
+    "cold warmup (one q8-class XLA compile is ~90s); warm restarts "
+    "against a populated compile cache finish in seconds regardless. "
+    "STATUS reports per-warmup-statement progress so a caller can "
+    "distinguish 'still compiling' from 'hung'."
+).double_conf(600.0)
 
 SERVE_PREPARED_CACHE_ENTRIES = conf(
     "spark.rapids.tpu.serve.preparedCacheEntries"
@@ -973,6 +1021,54 @@ FAULTS_COMPILE_DELAY_EVERY_N = conf(
 
 FAULTS_COMPILE_DELAY_MS = conf("spark.rapids.tpu.faults.compileDelayMs").doc(
     "Injected delay for the compile-delay point."
+).double_conf(500.0)
+
+FAULTS_CACHE_TRUNCATE_EVERY_N = conf(
+    "spark.rapids.tpu.faults.compileCache.truncateEveryN"
+).doc(
+    "Truncate every Nth compile-cache entry to half its size right after "
+    "it is published (a torn write that survived the rename) — the load "
+    "path must quarantine it and rebuild; 0 disables."
+).int_conf(0)
+
+FAULTS_CACHE_CORRUPT_EVERY_N = conf(
+    "spark.rapids.tpu.faults.compileCache.corruptEveryN"
+).doc(
+    "Flip one payload byte in every Nth published compile-cache entry "
+    "AFTER its CRC is stamped — the payload CRC on load must catch it "
+    "(quarantine + cache.xla.corrupt, fresh compile); 0 disables."
+).int_conf(0)
+
+FAULTS_CACHE_STALE_VERSION_EVERY_N = conf(
+    "spark.rapids.tpu.faults.compileCache.staleVersionEveryN"
+).doc(
+    "Write every Nth compile-cache entry with a perturbed engine schema "
+    "revision in its header — the version fence must turn it into a "
+    "SILENT miss (no load attempt, no quarantine); 0 disables."
+).int_conf(0)
+
+FAULTS_CACHE_CRASH_BEFORE_RENAME_EVERY_N = conf(
+    "spark.rapids.tpu.faults.compileCache.crashBeforeRenameEveryN"
+).doc(
+    "Abandon every Nth compile-cache publish between its temp-file fsync "
+    "and the rename (a crash at the worst moment of the atomic-write "
+    "protocol) — the orphan must never serve a load and a later boot "
+    "sweeps it; 0 disables."
+).int_conf(0)
+
+FAULTS_CACHE_LOCK_HOLDER_EVERY_N = conf(
+    "spark.rapids.tpu.faults.compileCache.lockHolderEveryN"
+).doc(
+    "On every Nth compile-cache single-flight acquisition, a simulated "
+    "wedged peer grabs the entry's flock first and holds it for "
+    "lockHolderHoldMs — past compileCache.lockTimeout the caller must "
+    "compile without the dedup instead of hanging; 0 disables."
+).int_conf(0)
+
+FAULTS_CACHE_LOCK_HOLDER_HOLD_MS = conf(
+    "spark.rapids.tpu.faults.compileCache.lockHolderHoldMs"
+).doc(
+    "How long the simulated wedged lock holder keeps the entry flock."
 ).double_conf(500.0)
 
 

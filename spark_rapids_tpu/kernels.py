@@ -53,6 +53,10 @@ def kernel(key: tuple, builder: Callable):
 
     ``builder`` returns the (usually jitted) callable; it must close over
     nothing whose lifetime matters — everything semantic belongs in the key.
+    The key doubles as the kernel's PERSISTENT identity: the on-disk
+    executable store (cache/xla_store.py) digests it together with each
+    call's arg signature, so a restarted process deserializes yesterday's
+    binaries instead of recompiling.
     """
     fn = _KERNELS.get(key)
     if fn is None:
@@ -60,6 +64,7 @@ def kernel(key: tuple, builder: Callable):
             fn = _KERNELS.get(key)
             if fn is None:
                 fn = builder()
+                _adopt_store_key(fn, key)
                 _KERNELS[key] = fn
                 _M_BUILDS.add(1)
                 return fn
@@ -67,9 +72,24 @@ def kernel(key: tuple, builder: Callable):
     return fn
 
 
+def _adopt_store_key(fn, key: tuple) -> None:
+    """Attach the kernel-cache key as the persistent store identity of the
+    GuardedJit behind ``fn`` (directly, or one wrapper deep — the
+    _ErrorCheckingKernel shape). GuardedJits built without a key stay
+    memory-only: no stable identity, no disk entry."""
+    gj = fn if isinstance(fn, GuardedJit) else getattr(fn, "_fn", None)
+    if isinstance(gj, GuardedJit) and gj._store_key is None:
+        gj._store_key = key
+
+
 # Reentrant: tracing one kernel may invoke another GuardedJit (e.g. a fused
 # kernel built from cached sub-kernels); a plain lock would self-deadlock.
 _COMPILE_LOCK = threading.RLock()
+
+#: sentinel returned by GuardedJit._prove_loaded when a cache-loaded
+#: executable blew up its proving run (the caller falls back to a fresh
+#: compile; a kernel result can never BE this object)
+_PROVE_FAILED = object()
 
 # ── compile deadline (spark.rapids.tpu.compile.deadlineSeconds) ─────────────
 # Process-global like the kernel cache itself: the session stamps it at init
@@ -156,29 +176,71 @@ class GuardedJit:
     signature takes a global compile lock; the compiled fast path stays
     lock-free."""
 
-    __slots__ = ("_fn", "_seen", "_orig", "_warmed")
+    __slots__ = ("_fn", "_seen", "_orig", "_warmed", "_store_key", "_loaded",
+                 "_unproven", "_digests")
 
-    def __init__(self, fn):
+    def __init__(self, fn, store_key: tuple | None = None):
         self._orig = fn
         self._fn = jax.jit(fn)
         self._seen = set()
         self._warmed = set()
+        #: persistent identity for the on-disk executable store — the
+        #: kernel-cache key (kernel()); None = memory-only kernel
+        self._store_key = store_key
+        #: sig -> AOT Compiled executable (disk-cache loads AND fresh AOT
+        #: compiles); takes precedence over the jit fast path so a loaded
+        #: binary serves every call without re-tracing
+        self._loaded: dict = {}
+        #: sigs whose loaded executable has not yet survived one real
+        #: call — a blowup there is treated as cache poison, not a query
+        #: failure (see _proving_call)
+        self._unproven: set = set()
+        #: sig -> digest memo (digesting walks the whole key; do it once)
+        self._digests: dict = {}
+
+    def _store_digest(self, sig):
+        if self._store_key is None:
+            return None
+        if sig in self._digests:
+            return self._digests[sig]
+        from .cache import xla_store as _xc
+
+        d = _xc.digest_for(self._store_key, sig)
+        if len(self._digests) > 128:
+            self._digests.clear()
+        self._digests[sig] = d
+        return d
 
     def warm(self, *args) -> bool:
         """Pre-compilation (the tentpole's compile-warm pass): lower +
         compile against ``args`` — usually jax.ShapeDtypeStruct pytrees —
-        WITHOUT executing. The compiled binary lands in the persistent
-        on-disk cache (enable_persistent_cache), so the first real call
-        pays a cache deserialization instead of a full XLA compile — the
-        closest TPU analogue of cuDF shipping pre-built kernels.
+        WITHOUT executing, retaining the AOT executable so the first real
+        call runs it directly. The binary also lands in the persistent
+        executable store (cache/xla_store.py), the TPU analogue of cuDF
+        shipping pre-built kernels — and when the store already HOLDS this
+        signature, the warm short-circuits to a deserialization BEFORE
+        touching the global compile lock, so a warm restart never queues
+        disk hits behind a slow compile.
 
-        Serialized through the global compile lock on XLA:CPU (the known
-        concurrent-compile SIGSEGV); on other backends warms run
-        concurrently, bounded by the precompile pool. Returns False when
-        the signature was already compiled or warmed."""
+        Fresh compiles are serialized through the global compile lock on
+        XLA:CPU (the known concurrent-compile SIGSEGV); on other backends
+        warms run concurrently, bounded by the precompile pool. Returns
+        False when the signature was already compiled or warmed."""
         sig = _args_sig(args)
-        if sig in self._seen or sig in self._warmed:
+        if sig in self._seen or sig in self._warmed or sig in self._loaded:
             return False
+        from .cache import xla_store as _xc
+
+        digest = (
+            self._store_digest(sig) if _xc.active_store() is not None else None
+        )
+        if digest is not None:
+            loaded = _xc.load_executable(digest)
+            if loaded is not None:
+                self._loaded[sig] = loaded
+                self._unproven.add(sig)
+                self._warmed.add(sig)
+                return True
         with obs_ledger.phase("compile"), _M_WARM_NS.timed():
             if jax.default_backend() == "cpu":
                 with _COMPILE_LOCK:
@@ -187,12 +249,41 @@ class GuardedJit:
                     # SIGSEGV) — compiling under it is the design, and
                     # the deadline helper owns the lock on its own
                     # thread so a blown budget cannot wedge it)
-                    self._fn.lower(*args).compile()
+                    compiled, from_store = self._warm_compile(args, digest)
             else:
-                self._fn.lower(*args).compile()
+                compiled, from_store = self._warm_compile(args, digest)
+        self._loaded[sig] = compiled
         self._warmed.add(sig)
-        _M_WARMS.add(1)
+        if from_store:
+            self._unproven.add(sig)
+        else:
+            _M_WARMS.add(1)
         return True
+
+    def _warm_compile(self, args, digest):
+        """The warm-miss slow path (under _COMPILE_LOCK on XLA:CPU).
+        Publishing compiles take the cross-process single-flight lock so
+        a FLEET cold boot — N servers warming the same statements against
+        one cache dir — compiles each shape once; once the flight slot is
+        ours the store is re-checked (a peer may have published while we
+        waited). Returns (executable, came_from_store)."""
+        from .cache import xla_store as _xc
+
+        store = _xc.active_store() if digest is not None else None
+        if store is None:
+            return self._fn.lower(*args).compile(), False
+        with store.single_flight(digest):
+            loaded = _xc.load_executable(digest)
+            if loaded is not None:
+                return loaded, True
+            compiled = self._fn.lower(*args).compile()
+            # the native executable SERIALIZER shares the compiler's
+            # thread-unsafety on XLA:CPU — the caller holds the compile
+            # lock around this whole helper there
+            payload = _xc.serialize_executable(compiled)
+            if payload is not None:
+                _xc.store_executable(digest, payload)
+            return compiled, False
 
     def __call__(self, *args):
         from .resilience import faults as _faults
@@ -214,6 +305,15 @@ class GuardedJit:
         # passing check here implies our capture predates the clear, so we
         # execute the OLD compiled fn — never a first compile off-lock
         fn = self._fn
+        loaded = self._loaded.get(sig)
+        if loaded is not None:
+            if sig in self._unproven:
+                return self._proving_call(loaded, sig, args)
+            if sig not in self._seen:
+                # _seen doubles as "this signature has executed" for the
+                # precompile pass's warm-hit accounting
+                self._seen.add(sig)
+            return loaded(*args)
         if sig in self._seen:
             return fn(*args)
 
@@ -223,7 +323,7 @@ class GuardedJit:
             # first-touch compiles (fused kernels tracing into cached
             # sub-kernels) re-enter the RLock on the thread that holds it
             with _COMPILE_LOCK:
-                out = self._first_call(args)
+                out = self._first_call(args, sig)
                 self._seen.add(sig)
                 return out
 
@@ -242,24 +342,94 @@ class GuardedJit:
         with _wd.stall_phase("compile"), obs_ledger.phase("compile"):
             return _call_with_deadline(locked_first, deadline)
 
-    def _first_call(self, args):
-        """First execution per signature = trace + compile. Two recoveries:
-        a Mosaic (pallas) failure flips the pallas plane off for the
-        process (one-shot) and re-traces through the bit-identical XLA
-        lowering; transient remote-compile errors (the tunneled compile
-        service round-robins over helpers of mixed health) retry with
-        backoff. Runs under _COMPILE_LOCK."""
+    def _prove_loaded(self, loaded, sig, digest, args):
+        """First real run of a cache-loaded executable. A blowup here that
+        is neither a device OOM (the retry machinery's jurisdiction) nor
+        an injected fault is a bad deserialization in disguise — the entry
+        is quarantined (so no path can reload it) and ``_PROVE_FAILED``
+        is returned for the caller to fall back to a fresh compile: a
+        poisoned cache can cost latency but never a query."""
+        self._loaded[sig] = loaded
+        self._unproven.add(sig)
+        try:
+            out = loaded(*args)
+        except Exception as e:  # noqa: BLE001 - classify, then decide
+            from .cache import xla_store as _xc
+            from .resilience import faults as _faults
+            from .resilience import retry as _retry
+
+            if isinstance(e, _faults.InjectedFault) or _retry.is_oom_error(e):
+                raise
+            self._loaded.pop(sig, None)
+            self._unproven.discard(sig)
+            self._warmed.discard(sig)
+            self._seen.discard(sig)
+            _xc.record_load_failure(digest, e)
+            return _PROVE_FAILED
+        self._unproven.discard(sig)
+        self._seen.add(sig)
+        return out
+
+    def _proving_call(self, loaded, sig, args):
+        """The __call__-fast-path proving wrapper (warm-loaded sigs). On
+        poison, re-enter __call__: no flock is held HERE, so the re-entry
+        may safely take the single-flight again — the quarantine above
+        guarantees it misses and compiles fresh."""
+        out = self._prove_loaded(loaded, sig, self._store_digest(sig), args)
+        if out is _PROVE_FAILED:
+            return self.__call__(*args)
+        return out
+
+    def _first_call(self, args, sig=None):
+        """First execution per signature. With the persistent executable
+        store active, this consults the disk under a cross-process
+        single-flight lock (N servers sharing a cache dir compile each
+        shape once) before compiling; a miss compiles AOT and publishes
+        the serialized binary. Two in-flight recoveries: a Mosaic (pallas)
+        failure flips the pallas plane off for the process (one-shot) and
+        re-traces through the bit-identical XLA lowering; transient
+        remote-compile errors (the tunneled compile service round-robins
+        over helpers of mixed health) retry with backoff. Runs under
+        _COMPILE_LOCK."""
         import logging
         import time
 
+        from .cache import xla_store as _xc
+
         log = logging.getLogger(__name__)
+        store = _xc.active_store() if sig is not None else None
+        digest = self._store_digest(sig) if store is not None else None
+        if digest is None:
+            store = None
+        if store is not None:
+            with store.single_flight(digest):
+                # re-check under the lock: a fleet peer may have published
+                # this entry while we waited for the flight slot
+                loaded = _xc.load_executable(digest)
+                if loaded is not None:
+                    out = self._prove_loaded(loaded, sig, digest, args)
+                    if out is not _PROVE_FAILED:
+                        return out
+                    # poison (quarantined above): compile fresh while we
+                    # STILL hold the flight slot — re-entering
+                    # single_flight here would self-contend (flock
+                    # conflicts across fds within one process) and burn
+                    # the whole lockTimeout under _COMPILE_LOCK
+                return self._first_compile(args, sig, digest, log)
+        return self._first_compile(args, sig, None, log)
+
+    def _first_compile(self, args, sig, digest, log):
+        import time
+
+        from .cache import xla_store as _xc
+        from .resilience import watchdog as _wd
+
         attempts = 4
         i = 0
         mosaic_fallback_used = False
         # once per first execution — retry attempts and the Mosaic-fallback
         # retrace accumulate compile TIME but are not more first calls
         _M_FIRST_CALLS.add(1)
-        from .resilience import watchdog as _wd
 
         while True:
             try:
@@ -272,7 +442,19 @@ class GuardedJit:
                         # it) and transient compile failure on the Nth
                         # first-touch compile — recovered by the retry loop
                         _faults.on_kernel_compile()
-                    return self._fn(*args)
+                    if digest is None:
+                        return self._fn(*args), None
+                    # AOT path: keep the Compiled stage so it can be
+                    # serialized into the store; the serializer runs here
+                    # — under _COMPILE_LOCK — because on XLA:CPU it
+                    # shares the compiler's thread-unsafety
+                    compiled = self._fn.lower(*args).compile()
+                    payload = _xc.serialize_executable(compiled)
+                    # register BEFORE the first run: the binary is valid
+                    # even if this batch OOMs — the retry's re-entry must
+                    # reuse it, not recompile
+                    self._loaded[sig] = compiled
+                    return compiled(*args), payload
 
                 # the compile is a long legitimate beat gap: the stall
                 # phase stamps beats at entry/exit and labels a watchdog
@@ -285,11 +467,16 @@ class GuardedJit:
                             obs_trace.span("xla-compile", "kernel"), \
                             obs_ledger.phase("compile"), \
                             _M_COMPILE_NS.timed():
-                        return attempt()
+                        out, payload = attempt()
                 finally:
                     _M_COMPILE_HIST.observe(
                         time.perf_counter_ns() - t_compile
                     )
+                if payload is not None:
+                    # disk IO outside the timed compile scope; the
+                    # single-flight flock (when held) spans this publish
+                    _xc.store_executable(digest, payload)
+                return out
             except Exception as e:  # noqa: BLE001 - classify, then re-raise
                 msg = str(e)
                 from .ops import pallas_strings as _ps
@@ -312,6 +499,8 @@ class GuardedJit:
                     # captured the old fn (see __call__)
                     self._seen.clear()
                     self._warmed.clear()
+                    self._loaded.clear()
+                    self._unproven.clear()
                     self._fn = jax.jit(self._orig)
                     continue  # retrace; does not consume a retry attempt
                 transient = any(
@@ -368,17 +557,22 @@ def warm_count() -> int:
 
 def precompile_worthwhile() -> bool:
     """Whether warming ahead of execution can pay: compiles overlap on
-    non-CPU backends, and the persistent cache carries warmed binaries to
-    later processes. On XLA:CPU with the cache disabled, a warm is the
-    SAME serial compile the first touch would do — pure waste — so the
-    default-on precompile pass skips itself there (an explicitly set
+    non-CPU backends, and the persistent caches (jax's HLO cache and the
+    executable store) carry warmed binaries to later processes. On
+    XLA:CPU with both caches disabled, a warm is the SAME serial compile
+    the first touch would do — pure waste — so the default-on precompile
+    pass skips itself there (an explicitly set
     spark.rapids.tpu.precompile.enabled=true overrides)."""
     try:
         if jax.default_backend() != "cpu":
             return True
     except Exception:
         return False
-    return _PERSISTENT_ENABLED
+    if _PERSISTENT_ENABLED:
+        return True
+    from .cache import xla_store as _xc
+
+    return _xc.active_store() is not None
 
 
 def precompile(specs: list, parallelism: int = 0) -> dict:

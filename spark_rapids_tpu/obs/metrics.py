@@ -386,6 +386,31 @@ CATALOG: Iterable[tuple] = (
     ("kernel.compileDeadlines", MetricKind.COUNTER,
      "first-touch compiles abandoned at spark.rapids.tpu.compile."
      "deadlineSeconds (the op force-opens its circuit breaker)"),
+    # cache/xla_store.py — the persistent XLA executable store
+    ("cache.xla.hit", MetricKind.COUNTER,
+     "compiled executables deserialized from the on-disk store instead "
+     "of compiled (the warm-restart fast path)"),
+    ("cache.xla.miss", MetricKind.COUNTER,
+     "store consults that found no usable entry (absent, version-fenced, "
+     "corrupt, or undeserializable) — a fresh compile follows"),
+    ("cache.xla.stores", MetricKind.COUNTER,
+     "executables published to the store (atomic temp+fsync+rename)"),
+    ("cache.xla.storeNs", MetricKind.NANOS,
+     "time serializing + publishing executables to the store"),
+    ("cache.xla.loadNs", MetricKind.NANOS,
+     "time deserializing executables from the store"),
+    ("cache.xla.evicted", MetricKind.COUNTER,
+     "entries removed by LRU eviction at compileCache.maxBytes"),
+    ("cache.xla.corrupt", MetricKind.COUNTER,
+     "entries quarantined for structural damage or CRC mismatch "
+     "(moved to <dir>/quarantine for triage; the kernel rebuilds fresh)"),
+    ("cache.xla.deserializeFailures", MetricKind.COUNTER,
+     "CRC-valid entries that failed to deserialize or blew up on their "
+     "proving run (quarantined; repeated failures trip the load breaker "
+     "and disable the store for the process)"),
+    ("cache.xla.lockTimeouts", MetricKind.COUNTER,
+     "single-flight compile locks held past compileCache.lockTimeout "
+     "(the caller compiled without the cross-process dedup)"),
     # mem/spill.py — spill bytes by tier transition + HBM watermark
     ("spill.bytesDeviceToHost", MetricKind.COUNTER, "bytes spilled HBM → host RAM"),
     ("spill.bytesHostToDisk", MetricKind.COUNTER, "bytes spilled host RAM → disk"),

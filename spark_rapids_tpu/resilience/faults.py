@@ -54,6 +54,14 @@ class FaultConfig:
     kernel_stall_ms: float = 0.0
     compile_delay_every_n: int = 0  # delay first-touch compiles
     compile_delay_ms: float = 0.0
+    # compile-cache (cache/xla_store.py) damage points — every way an
+    # on-disk entry can lie to a later boot
+    cache_truncate_every_n: int = 0  # torn write surviving the rename
+    cache_corrupt_every_n: int = 0  # payload bit flip after CRC stamp
+    cache_stale_version_every_n: int = 0  # header from a "different engine"
+    cache_crash_before_rename_every_n: int = 0  # die between temp and rename
+    cache_lock_holder_every_n: int = 0  # wedged peer holds the entry flock
+    cache_lock_holder_hold_ms: float = 0.0
 
 
 class FaultInjector:
@@ -167,6 +175,50 @@ class FaultInjector:
             return True
         return False
 
+    # ── compile-cache damage points (cache/xla_store.py) ────────────────
+    def cache_stale_fence(self) -> bool:
+        """Whether this entry's header should carry a perturbed engine
+        schema revision (version-skew simulation — the load fence must
+        silently miss it)."""
+        if self._tick("cache_stale_version",
+                      self.config.cache_stale_version_every_n):
+            self._record("cache_stale_version")
+            return True
+        return False
+
+    def cache_crash_before_rename(self) -> bool:
+        """Whether this publish should 'crash' between its temp-file fsync
+        and the rename, leaving an orphan staging file."""
+        if self._tick("cache_crash_before_rename",
+                      self.config.cache_crash_before_rename_every_n):
+            self._record("cache_crash_before_rename")
+            return True
+        return False
+
+    def cache_post_write_damage(self) -> Optional[str]:
+        """Damage to apply to a just-published entry: 'truncate' (torn
+        write) or 'corrupt' (payload bit flip), else None. The next load
+        must quarantine either and rebuild fresh."""
+        if self._tick("cache_truncate", self.config.cache_truncate_every_n):
+            self._record("cache_truncate")
+            return "truncate"
+        if self._tick("cache_corrupt", self.config.cache_corrupt_every_n):
+            self._record("cache_corrupt")
+            return "corrupt"
+        return None
+
+    def cache_lock_holder_ms(self) -> float:
+        """How long a simulated wedged peer should hold this entry's
+        single-flight flock before the caller gets its turn (0 = no
+        injection)."""
+        c = self.config
+        if c.cache_lock_holder_hold_ms > 0 and self._tick(
+            "cache_lock_holder", c.cache_lock_holder_every_n
+        ):
+            self._record("cache_lock_holder")
+            return c.cache_lock_holder_hold_ms
+        return 0.0
+
 
 _ACTIVE: Optional[FaultInjector] = None
 _ACTIVE_COUNT = 0  # concurrent scoped() entries holding _ACTIVE installed
@@ -250,6 +302,34 @@ def on_kernel_stall() -> None:
         inj.on_kernel_stall()
 
 
+def cache_stale_fence() -> bool:
+    inj = _ACTIVE
+    if inj is not None:
+        return inj.cache_stale_fence()
+    return False
+
+
+def cache_crash_before_rename() -> bool:
+    inj = _ACTIVE
+    if inj is not None:
+        return inj.cache_crash_before_rename()
+    return False
+
+
+def cache_post_write_damage() -> Optional[str]:
+    inj = _ACTIVE
+    if inj is not None:
+        return inj.cache_post_write_damage()
+    return None
+
+
+def cache_lock_holder_ms() -> float:
+    inj = _ACTIVE
+    if inj is not None:
+        return inj.cache_lock_holder_ms()
+    return 0.0
+
+
 @contextmanager
 def scoped(config_or_injector):
     """Install a fault scenario process-wide for the duration of the block
@@ -331,4 +411,18 @@ def config_from_conf(conf) -> Optional[FaultConfig]:
         kernel_stall_ms=cfg.FAULTS_KERNEL_STALL_MS.get(conf),
         compile_delay_every_n=cfg.FAULTS_COMPILE_DELAY_EVERY_N.get(conf),
         compile_delay_ms=cfg.FAULTS_COMPILE_DELAY_MS.get(conf),
+        cache_truncate_every_n=cfg.FAULTS_CACHE_TRUNCATE_EVERY_N.get(conf),
+        cache_corrupt_every_n=cfg.FAULTS_CACHE_CORRUPT_EVERY_N.get(conf),
+        cache_stale_version_every_n=(
+            cfg.FAULTS_CACHE_STALE_VERSION_EVERY_N.get(conf)
+        ),
+        cache_crash_before_rename_every_n=(
+            cfg.FAULTS_CACHE_CRASH_BEFORE_RENAME_EVERY_N.get(conf)
+        ),
+        cache_lock_holder_every_n=(
+            cfg.FAULTS_CACHE_LOCK_HOLDER_EVERY_N.get(conf)
+        ),
+        cache_lock_holder_hold_ms=(
+            cfg.FAULTS_CACHE_LOCK_HOLDER_HOLD_MS.get(conf)
+        ),
     )

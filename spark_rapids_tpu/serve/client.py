@@ -144,6 +144,10 @@ class Connection:
         self.tenant = hello.get("tenant")
         self.pool = hello.get("pool")
         self.protocol = hello.get("protocol")
+        #: server-advertised readiness budget (spark.rapids.tpu.serve.
+        #: readyTimeout) — wait_ready()'s default deadline; older servers
+        #: that do not advertise one fall back to 30s
+        self.ready_timeout_s = float(hello.get("ready_timeout_s") or 30.0)
         self._stream: Optional[ResultStream] = None
         # CANCELs that lost the race to their stream's END: the server
         # acks them as standalone commands, so that many CANCEL_OK frames
@@ -296,12 +300,21 @@ class Connection:
         _, body = self._reply(P.STATUS_OK)
         return P.decode_json(body)
 
-    def wait_ready(self, timeout: float = 30.0, poll_s: float = 0.1) -> bool:
+    def wait_ready(self, timeout: Optional[float] = None,
+                   poll_s: float = 0.1) -> bool:
         """Poll STATUS until the server reports ``ready`` (warm pool
         primed, not draining) — the client side of the rolling-restart
-        contract. Returns False on timeout."""
+        contract. ``timeout=None`` uses the budget the server ADVERTISES
+        (``spark.rapids.tpu.serve.readyTimeout``), which is sized above
+        its worst cold compile — a hardcoded client default shorter than
+        one q8-class compile (90s) turns every cold boot into a spurious
+        False. STATUS carries per-warmup-statement progress
+        (``status()["warmup"]``) so a caller can tell "statement k of n
+        still compiling" from "hung". Returns False on timeout."""
         import time as _time
 
+        if timeout is None:
+            timeout = self.ready_timeout_s
         deadline = _time.monotonic() + timeout
         while True:
             try:

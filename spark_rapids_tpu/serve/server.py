@@ -174,6 +174,16 @@ class TpuServer:
             s.strip() for s in raw_warm.split(";") if s.strip()
         ]
         self._warmup_thread: Optional[threading.Thread] = None
+        #: per-statement warmup progress surfaced in STATUS so a caller
+        #: waiting on readiness can distinguish "still compiling
+        #: statement k of n" from "hung" (updated only by the warmup
+        #: thread; plain assignments — readers take a snapshot)
+        self._warmup_progress = {
+            "total": len(self._warmup),
+            "done": 0,
+            "failed": 0,
+            "current": None,
+        }
         #: in-flight FETCH streams (drain waits on these)
         self._inflight = 0
         self._inflight_cond = threading.Condition()
@@ -219,15 +229,27 @@ class TpuServer:
         (session._prepare_plan runs the kernel pre-compilation pass), then
         flip readiness. A failed statement logs and is skipped — a typo
         must not hold the server not-ready forever."""
-        for text in self._warmup:
+        for i, text in enumerate(self._warmup):
             if self._stopping.is_set() or self._draining.is_set():
                 return
+            self._warmup_progress = dict(
+                self._warmup_progress, current=text[:120],
+            )
             try:
                 df = self.session.sql(text)
                 self.session._prepare_plan(df._plan)
+                self._warmup_progress = dict(
+                    self._warmup_progress,
+                    done=self._warmup_progress["done"] + 1,
+                )
             except Exception:  # noqa: BLE001 - warmup is best-effort
                 _log.warning("warmup statement failed: %r", text[:120],
                              exc_info=True)
+                self._warmup_progress = dict(
+                    self._warmup_progress,
+                    failed=self._warmup_progress["failed"] + 1,
+                )
+        self._warmup_progress = dict(self._warmup_progress, current=None)
         self._ready.set()
         _log.info("warm pool primed (%d statements); server READY",
                   len(self._warmup))
@@ -490,6 +512,12 @@ class TpuServer:
                 "pool": tenant.pool,
                 "protocol": P.PROTOCOL_VERSION,
                 "server": "spark-rapids-tpu",
+                # advertised readiness budget: wait_ready() with no
+                # explicit timeout polls this long — conf-sized so a
+                # cold boot's worst-case compile fits inside it
+                "ready_timeout_s": cfg.SERVE_READY_TIMEOUT_S.get(
+                    self.session.conf
+                ),
             },
         )
         return tenant
@@ -615,6 +643,13 @@ class TpuServer:
                 "live": True,
                 "ready": self.is_ready(),
                 "draining": self._draining.is_set(),
+                # warmup progress: "compiling statement k of n" vs "hung"
+                # is exactly the distinction a restart orchestrator needs
+                # while ready=false
+                "warmup": dict(self._warmup_progress),
+                "ready_timeout_s": cfg.SERVE_READY_TIMEOUT_S.get(
+                    self.session.conf
+                ),
                 "inflight": self._inflight,
                 "active": self.session.active_queries(),
                 "scheduler": self.session.scheduler.state(),

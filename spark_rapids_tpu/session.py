@@ -147,6 +147,12 @@ class TpuSession:
         # process-global like the kernel cache it guards
         self._scheduler.breaker = self._breaker
         K.set_compile_deadline(cfg.COMPILE_DEADLINE_S.get(self.conf))
+        # restart survivability: the process-global on-disk XLA executable
+        # store (cache/xla_store.py) — GuardedJit consults it before
+        # compiling, so a restarted server starts hot in seconds
+        from .cache import xla_store as _xc
+
+        _xc.configure(self.conf)
         # obs wiring: the dynamic-series cardinality cap is process-global
         # (the registry it guards is), and the live scrape endpoint starts
         # here for bare sessions (TpuServer.start also ensures it)
@@ -322,6 +328,10 @@ class TpuSession:
             from . import kernels as K
 
             K.set_compile_deadline(cfg.COMPILE_DEADLINE_S.get(self.conf))
+        if key.startswith("spark.rapids.tpu.compileCache."):
+            from .cache import xla_store as _xc
+
+            _xc.configure(self.conf)
 
     # ── execution ───────────────────────────────────────────────────────
     def _resolve_subqueries(self, lp: L.LogicalPlan) -> L.LogicalPlan:

@@ -1,0 +1,190 @@
+"""Warm-restart proof (ISSUE 11 acceptance): a server booted against a
+populated compile-cache directory starts hot.
+
+'Restart' here is the in-process equivalent of a process boot for the
+kernel plane: ``kernels.clear()`` + ``jax.clear_caches()`` drop every
+compiled executable and jit trace this process holds, so the only warm
+state that can survive is the on-disk store — exactly what survives a
+real restart. The assertions are the acceptance criteria verbatim:
+
+(a) second-boot ``wait_ready()`` completes with the compile ledger at
+    ≤ 5% of the first boot's;
+(b) zero fresh XLA compiles on the warm boot (``cache.xla.hit`` > 0,
+    ``cache.xla.miss`` delta 0, compile-time delta ≈ 0);
+(c) TPC-H q1/q6 results bit-identical across cold-compiled,
+    cache-loaded, and corruption-quarantined (entry deliberately
+    truncated → rebuilt) runs.
+"""
+from __future__ import annotations
+
+import glob
+import os
+
+import jax
+import pytest
+
+from spark_rapids_tpu import TpuSession
+from spark_rapids_tpu import kernels as K
+from spark_rapids_tpu.cache import xla_store as xc
+from spark_rapids_tpu.obs.metrics import GLOBAL
+from spark_rapids_tpu.tpch import gen_table
+from spark_rapids_tpu.tpch.sql_queries import tpch_sql
+
+SF = 0.005
+QUERIES = (1, 6)  # lineitem-only: the classic compile-heavy agg pair
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _no_leaks(serve_leak_guard):
+    yield
+
+
+@pytest.fixture(scope="module")
+def lineitem():
+    return gen_table("lineitem", SF)
+
+
+@pytest.fixture()
+def cache_dir(tmp_path):
+    d = str(tmp_path / "xc")
+    yield d
+    xc.reset_for_tests()
+    K.clear()
+
+
+def _restart() -> None:
+    """Drop every in-memory compiled artifact — what a process death
+    takes with it. The disk store is what must carry the warmth."""
+    K.clear()
+    jax.clear_caches()
+
+
+def _session(cache_dir: str, lineitem) -> TpuSession:
+    tpu = TpuSession({
+        "spark.rapids.sql.enabled": True,
+        "spark.rapids.tpu.compileCache.enabled": True,
+        "spark.rapids.tpu.compileCache.dir": cache_dir,
+        "spark.sql.shuffle.partitions": 2,
+    })
+    tpu.create_dataframe(lineitem).create_or_replace_temp_view("lineitem")
+    return tpu
+
+
+def _compile_ns() -> int:
+    """Total XLA compile nanoseconds this process has accrued — the same
+    scopes that bill the per-query ledger's 'compile' phase."""
+    return (
+        GLOBAL.timer("kernel.compileTimeNs").value
+        + GLOBAL.timer("kernel.warmTimeNs").value
+    )
+
+
+def test_server_warm_restart_boots_hot(cache_dir, lineitem):
+    """Boot A compiles and publishes; boot B against the same cache dir
+    reaches ready with ~zero compile time and zero store misses."""
+    from spark_rapids_tpu.serve import TpuServer, connect
+
+    warmup = [tpch_sql(n) for n in QUERIES]
+
+    def boot():
+        tpu = _session(cache_dir, lineitem)
+        tpu.set_conf("spark.rapids.tpu.serve.readyTimeout", 300)
+        server = TpuServer(tpu, port=0, warmup=warmup)
+        host, port = server.start()
+        conn = connect(host, port)
+        ok = conn.wait_ready()  # conf-driven default (the satellite)
+        return server, conn, ok
+
+    _restart()
+    c0 = _compile_ns()
+    server1, conn1, ok1 = boot()
+    try:
+        assert ok1, "cold boot never became ready"
+        first_boot_compile = _compile_ns() - c0
+        assert first_boot_compile > 0, "cold warmup compiled nothing"
+        assert GLOBAL.counter("cache.xla.stores").value > 0
+        # the advertised readiness budget + per-statement progress
+        # (the wait_ready/STATUS satellites)
+        assert conn1.ready_timeout_s == pytest.approx(300.0)
+        st = conn1.status()
+        assert st["warmup"]["total"] == len(QUERIES)
+        assert st["warmup"]["done"] == len(QUERIES)
+        assert st["warmup"]["failed"] == 0
+        assert st["warmup"]["current"] is None
+        assert st["ready_timeout_s"] == pytest.approx(300.0)
+    finally:
+        conn1.close()
+        server1.stop()
+
+    _restart()  # the server "process" dies; the cache dir survives
+    hit0 = GLOBAL.counter("cache.xla.hit").value
+    miss0 = GLOBAL.counter("cache.xla.miss").value
+    c1 = _compile_ns()
+    server2, conn2, ok2 = boot()
+    try:
+        assert ok2, "warm boot never became ready"
+        second_boot_compile = _compile_ns() - c1
+        assert GLOBAL.counter("cache.xla.hit").value > hit0, (
+            "warm boot loaded nothing from the store"
+        )
+        assert GLOBAL.counter("cache.xla.miss").value == miss0, (
+            "warm boot recorded fresh compiles (store misses)"
+        )
+        assert second_boot_compile <= 0.05 * first_boot_compile, (
+            f"second-boot compile ledger {second_boot_compile / 1e9:.2f}s "
+            f"exceeds 5% of first boot "
+            f"({first_boot_compile / 1e9:.2f}s)"
+        )
+    finally:
+        conn2.close()
+        server2.stop()
+
+
+def test_results_bit_identical_cold_loaded_and_quarantined(
+    cache_dir, lineitem
+):
+    """q1/q6 rows must be EXACTLY equal across (1) the cold compile run,
+    (2) the cache-loaded run, and (3) a run whose store entry was
+    deliberately truncated (quarantined + rebuilt) — the never-a-wrong-
+    answer half of the store's contract. Also pins acceptance (a): the
+    warm run's per-query ledger 'compile' phase at ≤5% of cold."""
+
+    def run(tpu):
+        rows, compile_ns = [], 0
+        for n in QUERIES:
+            rows.append(tpu.sql(tpch_sql(n)).collect())
+            compile_ns += tpu._last_ledger.snapshot().get("compile", 0)
+        return rows, compile_ns
+
+    _restart()
+    rows_cold, led_cold = run(_session(cache_dir, lineitem))
+    assert led_cold > 0, "cold run billed no ledger compile time"
+    entries = glob.glob(os.path.join(cache_dir, "*.xc"))
+    assert entries, "cold run published nothing"
+
+    _restart()
+    hit0 = GLOBAL.counter("cache.xla.hit").value
+    rows_loaded, led_loaded = run(_session(cache_dir, lineitem))
+    assert GLOBAL.counter("cache.xla.hit").value > hit0
+    assert rows_loaded == rows_cold, (
+        "cache-loaded results differ from cold-compiled"
+    )
+    assert led_loaded <= 0.05 * led_cold, (
+        f"warm ledger compile {led_loaded / 1e6:.1f}ms > 5% of cold "
+        f"({led_cold / 1e6:.1f}ms)"
+    )
+
+    # deliberately truncate one entry: quarantine + rebuild, same rows
+    victim = entries[0]
+    with open(victim, "r+b") as f:
+        f.truncate(os.path.getsize(victim) // 3)
+    _restart()
+    c0 = GLOBAL.counter("cache.xla.corrupt").value
+    rows_q, _ = run(_session(cache_dir, lineitem))
+    assert rows_q == rows_cold, (
+        "results after corruption-quarantine differ from cold-compiled"
+    )
+    assert GLOBAL.counter("cache.xla.corrupt").value == c0 + 1
+    assert os.path.exists(victim), "quarantined entry was not rebuilt"
+    store = xc.active_store()
+    assert store is not None and store.stats()["quarantined"] >= 1
