@@ -60,6 +60,14 @@ BASELINE_NAME = "BASELINE.lint"
 _SUPPRESS_RE = re.compile(
     r"#\s*graft:\s*ok\(\s*([A-Za-z0-9_-]+)\s*:\s*([^)]+?)\s*\)"
 )
+#: the guarded-by annotation grammar (passes/guarded_by.py): names the
+#: lock protecting the attribute/global initialized on this line, as in
+#: "self._plans = {}" followed by "graft: guarded_by(_lock)" in a
+#: comment (spelled obliquely here: a literal example would annotate
+#: the next assignment of THIS module)
+_GUARDED_RE = re.compile(
+    r"#\s*graft:\s*guarded_by\(\s*([A-Za-z_][A-Za-z0-9_.]*)\s*\)"
+)
 _GRAFT_MARKER_RE = re.compile(r"#\s*graft\s*:")
 
 
@@ -97,12 +105,19 @@ class SourceFile:
         self._parse_error: Optional[SyntaxError] = None
         # line → [(pass_id, reason)]
         self.suppressions: Dict[int, List[Tuple[str, str]]] = {}
+        # line → lock name (the guarded_by annotation grammar)
+        self.guarded_by: Dict[int, str] = {}
         self.malformed_graft: List[int] = []
         i = 1
         n = len(self.lines)
         while i <= n:
             line = self.lines[i - 1]
             if not _GRAFT_MARKER_RE.search(line):
+                i += 1
+                continue
+            guard = _GUARDED_RE.search(line)
+            if guard is not None:
+                self.guarded_by[i] = guard.group(1)
                 i += 1
                 continue
             hits = _SUPPRESS_RE.findall(line)

@@ -1,6 +1,16 @@
 """CLI: ``python -m spark_rapids_tpu.analysis [root] [options]``.
 
-Exit 0 when every finding is suppressed or baselined; 1 otherwise.
+Exit codes: 0 — every finding is suppressed or baselined; 1 — live
+findings (or framework errors: malformed markers, stale/invalid
+baseline rows); 2 — usage errors (unknown pass id, ``--write-baseline``
+with a pass subset).
+
+``--format json`` emits one machine-readable document (for CI
+annotation) instead of the human report: every finding with its pass,
+path, line, fingerprint, message, and suppression state
+(``fail`` / ``suppressed`` / ``baselined`` / ``framework``), plus the
+summary counts — same exit codes either way.
+
 ``--write-baseline`` regenerates the baseline file from the current
 unsuppressed findings (existing justifications survive; new entries
 require ``--justify``, and protected directories are refused).
@@ -8,6 +18,7 @@ require ``--justify``, and protected directories are refused).
 from __future__ import annotations
 
 import argparse
+import json
 import sys
 
 from . import (
@@ -39,6 +50,13 @@ def main(argv=None) -> int:
         "--baseline",
         help="baseline file path (default: spark_rapids_tpu/analysis/"
              "BASELINE.lint under root)",
+    )
+    ap.add_argument(
+        "--format",
+        choices=("text", "json"),
+        default="text",
+        help="findings output: human text (default) or one JSON document "
+             "with per-finding suppression state for CI annotation",
     )
     ap.add_argument("-q", "--quiet", action="store_true")
     args = ap.parse_args(argv)
@@ -79,6 +97,34 @@ def main(argv=None) -> int:
         return 0
 
     result = run_passes(project, selected, baseline=load_baseline(bl_path))
+    if args.format == "json":
+        def row(f, state):
+            return {
+                "pass": f.pass_id,
+                "path": f.path,
+                "line": f.line,
+                "fingerprint": f.fingerprint,
+                "message": f.message,
+                "state": state,
+            }
+
+        doc = {
+            "ok": result.ok,
+            "counts": {
+                "fail": len(result.findings),
+                "suppressed": len(result.suppressed),
+                "baselined": len(result.baselined),
+                "framework": len(result.framework),
+            },
+            "findings": (
+                [row(f, "fail") for f in result.findings]
+                + [row(f, "framework") for f in result.framework]
+                + [row(f, "suppressed") for f in result.suppressed]
+                + [row(f, "baselined") for f in result.baselined]
+            ),
+        }
+        print(json.dumps(doc, indent=2, sort_keys=True))
+        return 0 if result.ok else 1
     for f in result.framework:
         print(f.render())
     for f in result.findings:

@@ -1,0 +1,42 @@
+"""graft-flow — the flow-sensitive layer of graft-lint.
+
+The five PR-10 passes are purely syntactic: they see *lines*, not
+*paths*. Every concurrency/leak bug fixed since PR 3 — permit leaks
+between admit and first batch, accept/reader thread leaks, stale
+fault-injector resurrection, flock re-entry under ``_COMPILE_LOCK`` —
+was a resource released on the happy path but not on an exception path,
+or shared state mutated under a lock at one site and bare at another.
+Those are path properties, so this package adds the smallest engine
+that can see paths:
+
+* :mod:`.cfg` — an intraprocedural control-flow graph per function:
+  branches, loops, ``try``/``except``/``finally`` (with synthetic
+  dispatch and finally-entry nodes), ``with`` bodies, and an exception
+  edge from every statement that can plausibly raise to its innermost
+  handler/finally (or the function exit).
+* :mod:`.engine` — the dataflow half: must-release reachability from an
+  acquire node to the function exit, with full leaking-path
+  reconstruction (the finding prints the path line by line), plus the
+  one-level same-module call summaries :mod:`..passes.locks` already
+  pioneered.
+* :mod:`.resources` — the acquire/release registry: one declarative
+  table of every resource the engine balances (scheduler permits, flocks,
+  sockets, files, threads, spill pins, span/ledger/fault scopes), shared
+  verbatim by the static ``resource-lifecycle`` pass and the runtime
+  :mod:`..reswatch` harness so the static model and reality cross-check
+  each other.
+
+Known blind spots (documented, on purpose — docs/static-analysis.md):
+the CFG is intraprocedural (a resource handed to another function is
+*transferred*, not tracked), ``break``/``continue`` do not route through
+intervening ``finally`` blocks, generators are analyzed as plain
+functions, and statements on the non-raising allowlist (event flips,
+container ops, logging, clock reads) carry no exception edge.
+"""
+from .cfg import CFG, Node, build_cfg  # noqa: F401
+from .engine import find_leak_path, module_release_summaries  # noqa: F401
+from .resources import (  # noqa: F401
+    RESOURCE_KINDS,
+    ResourceKind,
+    kind_by_name,
+)

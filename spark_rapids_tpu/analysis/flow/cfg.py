@@ -1,0 +1,303 @@
+"""Intraprocedural control-flow graph over the stdlib ``ast``.
+
+One :class:`CFG` per function body. Nodes are statements (plus three
+synthetic kinds); edges carry a kind so the leak reporter can say *how*
+a path left a statement:
+
+* ``next``     — ordinary fallthrough / branch edge
+* ``except``   — this statement raised and control jumped to the
+                 innermost handler dispatch / finally / function exit
+* ``loop``     — back edge to a loop header
+* ``finally``  — entry into a ``finally`` suite
+* ``reraise``  — leaving a ``finally`` with a pending exception
+
+Modeling decisions (all biased toward the leak pass's needs):
+
+* A statement gets an exception edge iff it contains a ``Call``,
+  ``Raise``, ``Assert``, ``Await``, ``Yield``/``YieldFrom`` — minus a
+  small allowlist of methods that cannot meaningfully raise
+  (``Event.set``/``is_set``, container ops, logging, clock reads).
+  Compound statements contribute only their header expression
+  (``If.test``, ``For.iter``, with-items), never their body.
+* ``return`` routes through the innermost enclosing ``finally`` (whose
+  exit already reaches the function exit via its ``reraise`` edge);
+  without one it goes straight to the exit node.
+* ``finally`` suites are built once: every exit of the protected suite
+  and of each handler flows in, and the suite's exit flows both to the
+  normal successor and (``reraise``) to the next outer exception target.
+  This merges the pending-exception and normal continuations — a benign
+  over-approximation for a must-release analysis.
+* ``with`` bodies are ordinary statements; context-manager semantics
+  (the guaranteed ``__exit__``) are the *pass's* concern: a resource
+  acquired in a with-item is balanced by construction and never tracked.
+* ``break``/``continue`` jump straight to the loop exit/header without
+  routing through intervening ``finally`` suites (documented blind spot).
+"""
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import List, Optional, Sequence, Tuple
+
+#: method names whose calls are treated as non-raising — the pragmatic
+#: noise filter: an exception edge out of ``self._stopping.is_set()``
+#: would make every loop body a leak path. Raising through any of these
+#: is either impossible or a process-fatal interpreter condition the
+#: engine does not model.
+NON_RAISING_METHODS = frozenset({
+    "is_set", "set", "clear",                      # threading.Event
+    "append", "appendleft", "extend", "add", "discard", "pop", "popleft",
+    "popitem", "get", "setdefault", "update", "items", "keys", "values",
+    "count", "copy", "remove",                     # container ops
+    "debug", "info", "warning", "error", "exception", "log",  # logging
+    "monotonic", "time", "perf_counter", "perf_counter_ns",   # clocks
+    "getattr", "isinstance", "len", "id", "repr", "str", "int", "float",
+    "min", "max", "round", "sorted", "join", "split", "strip", "format",
+    "startswith", "endswith", "lower", "upper", "rsplit", "replace",
+})
+
+
+@dataclass
+class Node:
+    """One CFG node. ``stmt`` is the underlying AST statement for real
+    nodes and ``None`` for the synthetic kinds (``entry``, ``exit``,
+    ``except-dispatch``, ``finally-entry``)."""
+
+    idx: int
+    stmt: Optional[ast.stmt]
+    lineno: int
+    kind: str = "stmt"      # stmt | entry | exit | dispatch | finally
+    can_raise: bool = False
+    #: (target node idx, edge kind)
+    succ: List[Tuple[int, str]] = field(default_factory=list)
+
+
+class CFG:
+    def __init__(self) -> None:
+        self.nodes: List[Node] = []
+        self.entry: int = 0
+        self.exit: int = 0
+
+    def node(self, stmt: Optional[ast.stmt], lineno: int,
+             kind: str = "stmt") -> Node:
+        n = Node(len(self.nodes), stmt, lineno, kind)
+        self.nodes.append(n)
+        return n
+
+    def edge(self, a: int, b: int, kind: str = "next") -> None:
+        pair = (b, kind)
+        if pair not in self.nodes[a].succ:
+            self.nodes[a].succ.append(pair)
+
+
+def _expr_can_raise(expr: Optional[ast.expr]) -> bool:
+    if expr is None:
+        return False
+    for sub in ast.walk(expr):
+        if isinstance(sub, (ast.Await, ast.Yield, ast.YieldFrom)):
+            return True
+        if isinstance(sub, ast.Call):
+            fn = sub.func
+            name = None
+            if isinstance(fn, ast.Name):
+                name = fn.id
+            elif isinstance(fn, ast.Attribute):
+                name = fn.attr
+            if name not in NON_RAISING_METHODS:
+                return True
+    return False
+
+
+def _stmt_can_raise(stmt: ast.stmt) -> bool:
+    """Exception-edge eligibility for the node representing ``stmt`` —
+    compound statements contribute only their header expression."""
+    if isinstance(stmt, (ast.Raise, ast.Assert)):
+        return True
+    if isinstance(stmt, ast.If):
+        return _expr_can_raise(stmt.test)
+    if isinstance(stmt, ast.While):
+        return _expr_can_raise(stmt.test)
+    if isinstance(stmt, (ast.For, ast.AsyncFor)):
+        # iterator protocol: every iteration may raise
+        return True
+    if isinstance(stmt, (ast.With, ast.AsyncWith)):
+        return any(_expr_can_raise(i.context_expr) for i in stmt.items)
+    if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                         ast.ClassDef)):
+        return False  # a def is a binding, not a call
+    if isinstance(stmt, ast.Return):
+        return _expr_can_raise(stmt.value)
+    if isinstance(stmt, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+        value = getattr(stmt, "value", None)
+        if _expr_can_raise(value):
+            return True
+        # subscript stores on foreign objects may raise (KeyError on
+        # delete, custom __setitem__) — keep plain name/attr stores quiet
+        return False
+    if isinstance(stmt, ast.Expr):
+        return _expr_can_raise(stmt.value)
+    if isinstance(stmt, ast.Delete):
+        return True
+    return False
+
+
+class _Builder:
+    def __init__(self) -> None:
+        self.cfg = CFG()
+        entry = self.cfg.node(None, 0, "entry")
+        exit_ = self.cfg.node(None, 0, "exit")
+        self.cfg.entry, self.cfg.exit = entry.idx, exit_.idx
+        #: innermost-last stack of exception targets (node idxs)
+        self.exc: List[int] = [exit_.idx]
+        #: innermost-last stack of finally-entry node idxs
+        self.finallies: List[int] = []
+        #: innermost-last stack of (header idx, break collector list)
+        self.loops: List[Tuple[int, List[int]]] = []
+
+    # ── helpers ─────────────────────────────────────────────────────────
+    def _wire(self, frontier: Sequence[int], target: int,
+              kind: str = "next") -> None:
+        for f in frontier:
+            self.cfg.edge(f, target, kind)
+
+    def _stmt_node(self, stmt: ast.stmt, frontier: Sequence[int]) -> Node:
+        n = self.cfg.node(stmt, stmt.lineno)
+        self._wire(frontier, n.idx)
+        if _stmt_can_raise(stmt):
+            n.can_raise = True
+            self.cfg.edge(n.idx, self.exc[-1], "except")
+        return n
+
+    # ── suite builder ───────────────────────────────────────────────────
+    def build_suite(self, stmts: Sequence[ast.stmt],
+                    frontier: List[int]) -> List[int]:
+        """Wire ``stmts`` after ``frontier``; returns the dangling exits.
+        An empty returned frontier means the suite never falls through
+        (it always returns/raises/breaks)."""
+        for stmt in stmts:
+            if not frontier:
+                break  # unreachable code after a terminator
+            frontier = self._build_stmt(stmt, frontier)
+        return frontier
+
+    def _build_stmt(self, stmt: ast.stmt,
+                    frontier: List[int]) -> List[int]:
+        if isinstance(stmt, ast.If):
+            n = self._stmt_node(stmt, frontier)
+            then_out = self.build_suite(stmt.body, [n.idx])
+            else_out = (
+                self.build_suite(stmt.orelse, [n.idx])
+                if stmt.orelse else [n.idx]
+            )
+            return then_out + else_out
+
+        if isinstance(stmt, (ast.While, ast.For, ast.AsyncFor)):
+            header = self._stmt_node(stmt, frontier)
+            breaks: List[int] = []
+            self.loops.append((header.idx, breaks))
+            body_out = self.build_suite(stmt.body, [header.idx])
+            self.loops.pop()
+            self._wire(body_out, header.idx, "loop")
+            else_out = (
+                self.build_suite(stmt.orelse, [header.idx])
+                if stmt.orelse else [header.idx]
+            )
+            return else_out + breaks
+
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            n = self._stmt_node(stmt, frontier)
+            return self.build_suite(stmt.body, [n.idx])
+
+        if isinstance(stmt, ast.Try):
+            return self._build_try(stmt, frontier)
+
+        if isinstance(stmt, ast.Return):
+            n = self._stmt_node(stmt, frontier)
+            if self.finallies:
+                self.cfg.edge(n.idx, self.finallies[-1], "finally")
+            else:
+                self.cfg.edge(n.idx, self.cfg.exit, "return")
+            return []
+
+        if isinstance(stmt, ast.Raise):
+            n = self._stmt_node(stmt, frontier)  # wires the except edge
+            return []
+
+        if isinstance(stmt, ast.Break):
+            n = self._stmt_node(stmt, frontier)
+            if self.loops:
+                self.loops[-1][1].append(n.idx)
+            return []
+
+        if isinstance(stmt, ast.Continue):
+            n = self._stmt_node(stmt, frontier)
+            if self.loops:
+                self.cfg.edge(n.idx, self.loops[-1][0], "loop")
+            return []
+
+        # plain statement (incl. nested defs, which are opaque bindings)
+        n = self._stmt_node(stmt, frontier)
+        return [n.idx]
+
+    def _build_try(self, stmt: ast.Try, frontier: List[int]) -> List[int]:
+        fin_entry: Optional[Node] = None
+        if stmt.finalbody:
+            fin_entry = self.cfg.node(
+                None, stmt.finalbody[0].lineno, "finally"
+            )
+
+        outer_exc = self.exc[-1]
+        dispatch: Optional[Node] = None
+        if stmt.handlers:
+            dispatch = self.cfg.node(None, stmt.lineno, "dispatch")
+            body_exc = dispatch.idx
+        elif fin_entry is not None:
+            body_exc = fin_entry.idx
+        else:
+            body_exc = outer_exc
+
+        self.exc.append(body_exc)
+        if fin_entry is not None:
+            self.finallies.append(fin_entry.idx)
+        body_out = self.build_suite(stmt.body, list(frontier))
+        if stmt.orelse:
+            body_out = self.build_suite(stmt.orelse, body_out)
+        self.exc.pop()
+
+        handler_exc = fin_entry.idx if fin_entry is not None else outer_exc
+        handler_outs: List[int] = []
+        caught_all = False
+        if dispatch is not None:
+            for h in stmt.handlers:
+                hn = self.cfg.node(h, h.lineno)
+                self.cfg.edge(dispatch.idx, hn.idx, "except")
+                self.exc.append(handler_exc)
+                handler_outs += self.build_suite(h.body, [hn.idx])
+                self.exc.pop()
+                if h.type is None or (
+                    isinstance(h.type, ast.Name)
+                    and h.type.id in ("BaseException", "Exception")
+                ):
+                    caught_all = True
+            if not caught_all:
+                # an exception matching no handler propagates
+                self.cfg.edge(dispatch.idx, handler_exc, "except")
+        if fin_entry is not None:
+            self.finallies.pop()
+            self._wire(body_out + handler_outs, fin_entry.idx, "finally")
+            self.exc.append(outer_exc)
+            fin_out = self.build_suite(stmt.finalbody, [fin_entry.idx])
+            self.exc.pop()
+            # pending-exception continuation out of the finally
+            self._wire(fin_out, outer_exc, "reraise")
+            return fin_out
+        return body_out + handler_outs
+
+
+def build_cfg(fn: ast.AST) -> CFG:
+    """CFG for a ``FunctionDef`` / ``AsyncFunctionDef`` body (the body is
+    walked directly — nested defs become opaque single nodes)."""
+    b = _Builder()
+    out = b.build_suite(list(fn.body), [b.cfg.entry])
+    b._wire(out, b.cfg.exit, "return")
+    return b.cfg

@@ -1,0 +1,125 @@
+"""The dataflow half of graft-flow: must-release reachability.
+
+``find_leak_path(cfg, acquire_idx, kills)`` answers the one question the
+``resource-lifecycle`` pass asks per acquire site: *is there a path from
+this acquire to the function exit that passes no release/transfer?* —
+and when there is, returns the whole path (node, entering-edge-kind)
+pairs so the finding can print it file:line by file:line.
+
+Semantics:
+
+* The search starts at the acquire node's **non-exception** successors:
+  if the acquire call itself raised, the resource was never obtained.
+* A node where ``kills`` holds terminates that path — optimistically for
+  *all* its out-edges (a ``release()`` that itself raises still counted;
+  modeling "the release failed" would flag every release and teach
+  people to suppress the pass).
+* Loops are walked once per node (visited set) — a leak that needs two
+  trips around a loop is also reachable in one.
+
+``module_release_summaries`` provides the one-level same-module call
+summaries the ``lock-order`` pass already pioneered: which resource
+kinds a function releases anywhere in its body, so a call into
+``self._release_locked(...)`` counts as a release at the call site.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Callable, Dict, List, Optional, Set, Tuple
+
+from .cfg import CFG
+
+
+def find_leak_path(
+    cfg: CFG,
+    acquire_idx: int,
+    kills: Callable[[int], bool],
+) -> Optional[List[Tuple[int, str]]]:
+    """BFS from the acquire node to the exit, skipping killed nodes.
+    Returns ``[(node_idx, edge_kind_entered_by), ...]`` for the shortest
+    leaking path (acquire node first, exit node last), or None when every
+    path releases. BFS keeps the printed path minimal — the closest
+    reproduction of the bug, not a scenic tour."""
+    start = [
+        (t, k) for (t, k) in cfg.nodes[acquire_idx].succ if k != "except"
+    ]
+    parent: Dict[int, Tuple[int, str]] = {}
+    queue: List[int] = []
+    seen: Set[int] = {acquire_idx}
+    for t, k in start:
+        if t not in seen:
+            seen.add(t)
+            parent[t] = (acquire_idx, k)
+            queue.append(t)
+    qi = 0
+    while qi < len(queue):
+        idx = queue[qi]
+        qi += 1
+        if kills(idx):
+            continue
+        if idx == cfg.exit:
+            # reconstruct: exit back to acquire
+            path: List[Tuple[int, str]] = []
+            cur = idx
+            while cur != acquire_idx:
+                prev, kind = parent[cur]
+                path.append((cur, kind))
+                cur = prev
+            path.append((acquire_idx, "acquire"))
+            path.reverse()
+            return path
+        for t, k in cfg.nodes[idx].succ:
+            if t not in seen:
+                seen.add(t)
+                parent[t] = (idx, k)
+                queue.append(t)
+    return None
+
+
+def _call_name(call: ast.Call) -> Optional[str]:
+    fn = call.func
+    if isinstance(fn, ast.Name):
+        return fn.id
+    if isinstance(fn, ast.Attribute):
+        return fn.attr
+    return None
+
+
+def module_release_summaries(
+    tree: ast.AST,
+    release_methods: Dict[str, Set[str]],
+) -> Dict[str, Set[str]]:
+    """For every function/method in ``tree``: the set of resource-kind
+    names it releases anywhere in its body (one level — summaries do not
+    chain through further calls; the runtime reswatch harness covers what
+    static depth cannot).
+
+    ``release_methods`` maps method name -> {kind names} (one call name
+    may release several kinds: ``close`` ends sockets and files).
+    Returns {callee key -> kinds}, keyed both bare (``fn``) and
+    class-qualified (``Cls.fn``) so ``self._helper()`` and module-level
+    ``helper()`` call sites both resolve."""
+    out: Dict[str, Set[str]] = {}
+
+    def scan(fn_node: ast.AST) -> Set[str]:
+        kinds: Set[str] = set()
+        for sub in ast.walk(fn_node):
+            if isinstance(sub, ast.Call):
+                name = _call_name(sub)
+                if name in release_methods:
+                    kinds |= release_methods[name]
+        return kinds
+
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef):
+            for item in node.body:
+                if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    kinds = scan(item)
+                    if kinds:
+                        out[f"{node.name}.{item.name}"] = kinds
+                        out.setdefault(item.name, set()).update(kinds)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            kinds = scan(node)
+            if kinds:
+                out.setdefault(node.name, set()).update(kinds)
+    return out

@@ -1,0 +1,151 @@
+"""The acquire/release registry — one table naming every resource the
+engine must keep balanced.
+
+Each :class:`ResourceKind` describes how a resource is acquired and
+released *syntactically*; the static ``resource-lifecycle`` pass matches
+call sites against it and demands a release (or an ownership transfer)
+on every path to the function exit, and the runtime :mod:`..reswatch`
+harness instruments the same kinds' real implementations and asserts
+end-of-test balance — the static model and reality cross-check each
+other through this table.
+
+Matching model (shared vocabulary with the pass):
+
+* an *acquire* is a call whose method/function name is in
+  ``acquire_methods`` and whose receiver source text matches
+  ``recv_hint`` (empty hint = any receiver; for constructor-style kinds
+  the call name itself is the match);
+* the resource's identity is the receiver text plus, when the result is
+  assigned, the bound variable;
+* a *release* is a call in ``release_methods`` on the same receiver/
+  variable, or a call into a same-module function whose summary releases
+  this kind;
+* acquiring in a ``with`` item is balanced by construction;
+* storing the result into a ``self.`` attribute or container, returning
+  it, passing it to a call, or capturing it in a nested ``def``
+  *transfers ownership* out of the function — the intraprocedural
+  analysis stops there (reswatch owns the rest).
+
+``fcntl.flock`` is registered for naming/runtime purposes but matched
+specially by the pass (acquire vs release is an *argument* — LOCK_EX vs
+LOCK_UN — not a method name).
+"""
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass
+from typing import Dict, Optional, Set, Tuple
+
+
+@dataclass(frozen=True)
+class ResourceKind:
+    name: str                       # registry key ("permit", "socket", …)
+    noun: str                       # human description for findings
+    acquire_methods: Tuple[str, ...]
+    release_methods: Tuple[str, ...]
+    #: regex the acquire receiver's source text must match; '' = any.
+    #: Constructor-style kinds (socket/Thread/open) match the call name.
+    recv_hint: str = ""
+    #: acquire returns (resource, extra) — bind the first tuple element
+    tuple_first: bool = False
+    #: constructor call (``socket.socket(...)``, ``Thread(...)``) rather
+    #: than a method on an existing manager object
+    constructor: bool = False
+    #: a ``daemon=True`` keyword makes the spawn fire-and-forget (threads)
+    daemon_exempt: bool = False
+    #: the call's RESULT is the resource (bindable to the assignment
+    #: target). False for the scope kind: ``inj = ctx.__enter__()``
+    #: yields the managed value, but the scope that must be exited is
+    #: the receiver ``ctx``
+    result_is_resource: bool = True
+
+    def recv_matches(self, recv_src: str) -> bool:
+        if not self.recv_hint:
+            return True
+        return re.search(self.recv_hint, recv_src, re.I) is not None
+
+
+#: the registry — ordered so the most specific kinds match first
+RESOURCE_KINDS: Tuple[ResourceKind, ...] = (
+    # NOTE: DeviceSemaphore's acquire_if_necessary/release_if_necessary
+    # are deliberately absent: they are idempotent task-duration holds
+    # (acquired at first device touch, released by the task driver at
+    # task end) whose balance is cross-function by design — the runtime
+    # reswatch harness owns them; the static pass would only teach
+    # people to suppress it.
+    ResourceKind(
+        name="permit",
+        noun="scheduler/device permits",
+        acquire_methods=("acquire",),
+        release_methods=("release",),
+        recv_hint=r"pool|sem|permit",
+    ),
+    ResourceKind(
+        name="lock",
+        noun="explicitly-acquired lock",
+        acquire_methods=("acquire",),
+        release_methods=("release",),
+        recv_hint=r"lock|cond|mutex",
+    ),
+    ResourceKind(
+        name="scope",
+        noun="manually-entered context scope (span/ledger/fault scope)",
+        acquire_methods=("__enter__",),
+        release_methods=("__exit__",),
+        result_is_resource=False,
+    ),
+    ResourceKind(
+        name="socket",
+        noun="socket",
+        acquire_methods=("socket", "create_connection", "accept"),
+        release_methods=("close",),
+        tuple_first=True,  # accept() returns (conn, addr)
+        constructor=True,
+    ),
+    ResourceKind(
+        name="file",
+        noun="open file",
+        acquire_methods=("open",),
+        release_methods=("close",),
+        constructor=True,
+    ),
+    ResourceKind(
+        name="thread",
+        noun="spawned thread",
+        acquire_methods=("Thread",),
+        release_methods=("join",),
+        constructor=True,
+        daemon_exempt=True,
+    ),
+    ResourceKind(
+        name="spill-pin",
+        noun="spill-buffer hold",
+        acquire_methods=("register",),
+        release_methods=("unpin", "close"),
+        recv_hint=r"catalog",
+    ),
+    ResourceKind(
+        name="flock",
+        noun="advisory file lock (fcntl.flock LOCK_EX)",
+        acquire_methods=("flock",),
+        release_methods=("flock", "close"),
+        constructor=True,
+    ),
+)
+
+_BY_NAME = {k.name: k for k in RESOURCE_KINDS}
+
+
+def kind_by_name(name: str) -> Optional[ResourceKind]:
+    return _BY_NAME.get(name)
+
+
+def release_method_index() -> Dict[str, Set[str]]:
+    """method name -> {kind names} — the input shape
+    :func:`..flow.engine.module_release_summaries` consumes (``close``
+    releases sockets, files, and spill pins alike)."""
+    idx: Dict[str, Set[str]] = {}
+    for k in RESOURCE_KINDS:
+        for m in k.release_methods:
+            idx.setdefault(m, set()).add(k.name)
+    return idx

@@ -8,7 +8,15 @@ from .. import LintPass
 
 
 def all_passes() -> List[LintPass]:
-    from . import cancel_beat, conf_keys, host_sync, locks, metrics
+    from . import (
+        cancel_beat,
+        conf_keys,
+        guarded_by,
+        host_sync,
+        locks,
+        metrics,
+        resource_lifecycle,
+    )
 
     return [
         host_sync.PASS,
@@ -16,4 +24,6 @@ def all_passes() -> List[LintPass]:
         conf_keys.PASS,
         cancel_beat.PASS,
         metrics.PASS,
+        resource_lifecycle.PASS,
+        guarded_by.PASS,
     ]

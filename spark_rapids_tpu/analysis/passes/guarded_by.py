@@ -1,0 +1,485 @@
+"""guarded-by — lock/attribute consistency, Clang thread-safety style.
+
+Shared mutable state in this engine is class attributes (scheduler pool
+queues, prepared-plan LRUs, connection registries, metric maps) guarded
+by a sibling lock attribute. The compiler cannot check that pairing;
+this pass does, from two evidence sources:
+
+* **annotation** (ground truth): ``# graft: guarded_by(<lock>)`` on the
+  attribute's initializing assignment (same line or the comment line
+  directly above). ``<lock>`` names a sibling ``self.<lock>`` attribute
+  for class state, or a module-level lock name for module globals.
+* **inference** (majority-of-sites): an attribute written outside
+  ``__init__`` whose accesses are at least 80% under one specific lock
+  (and at least 5 sites) is inferred guarded by it — the hand-annotated
+  known-hot structs mean inference is the backstop, not the source of
+  truth.
+
+Any access to a guarded attribute outside its lock — or under a
+*different* lock — is a finding. ``__init__`` is construction-time and
+exempt; a private helper (``_name``) called *only* with the lock held
+inherits the lock at every call site (the one-level same-module call
+summary, matching ``lock_order.py``); ``self.__dict__.get/setdefault
+("X", …)`` counts as an access to ``X``.
+
+Scope: the concurrency-bearing subsystems (``serve/``, ``sched/``,
+``shuffle/``, ``cache/``, ``obs/``, ``exec/pipeline.py``,
+``mem/``) — plus ANY file that carries a ``guarded_by`` annotation
+(annotating state opts its file in).
+"""
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from .. import Finding, LintPass, Project, SourceFile
+
+#: directories whose classes are analyzed even without annotations
+GUARD_DIRS = (
+    "spark_rapids_tpu/serve/",
+    "spark_rapids_tpu/sched/",
+    "spark_rapids_tpu/shuffle/",
+    "spark_rapids_tpu/cache/",
+    "spark_rapids_tpu/obs/",
+    "spark_rapids_tpu/mem/",
+    "spark_rapids_tpu/exec/pipeline.py",
+)
+
+#: inference thresholds: at least this many non-__init__ sites, at least
+#: this fraction under ONE lock, and at least one write outside __init__
+INFER_MIN_SITES = 5
+INFER_RATIO = 0.8
+
+_LOCK_CTORS = {"Lock", "RLock", "Condition"}
+
+
+def _is_lock_ctor(node: ast.expr) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    fn = node.func
+    if isinstance(fn, ast.Attribute) and fn.attr in _LOCK_CTORS:
+        return True
+    return isinstance(fn, ast.Name) and fn.id in _LOCK_CTORS
+
+
+@dataclass
+class _Access:
+    attr: str
+    method: str
+    lineno: int
+    write: bool
+    held: frozenset          # lock attr names held at the access
+
+
+@dataclass
+class _ClassScan:
+    name: str
+    locks: Set[str] = field(default_factory=set)
+    #: attr -> (lock name, annotation line)
+    annotated: Dict[str, Tuple[str, int]] = field(default_factory=dict)
+    accesses: List[_Access] = field(default_factory=list)
+    #: method -> [(calling method, held set) per internal call site]
+    call_sites: Dict[str, List[Tuple[str, frozenset]]] = field(
+        default_factory=dict
+    )
+    methods: Set[str] = field(default_factory=set)
+
+
+def _annotation_for(sf: SourceFile, lineno: int) -> Optional[str]:
+    """guarded_by lock name attached to ``lineno``: same line, or the
+    directly-preceding pure-comment line."""
+    name = sf.guarded_by.get(lineno)
+    if name is not None:
+        return name
+    name = sf.guarded_by.get(lineno - 1)
+    if name is not None and sf.line_text(
+        lineno - 1
+    ).lstrip().startswith("#"):
+        return name
+    return None
+
+
+def _norm_lock(name: str) -> str:
+    return name[5:] if name.startswith("self.") else name
+
+
+class _MethodWalker(ast.NodeVisitor):
+    """One method body: records self.<attr> accesses with the held-lock
+    set, and internal self.<method>() call sites."""
+
+    def __init__(self, scan: _ClassScan, method: str, sf: SourceFile,
+                 collect: bool):
+        self.scan = scan
+        self.method = method
+        self.sf = sf
+        self.collect = collect       # False for __init__: calls only
+        self.held: List[str] = []
+
+    def _self_attr(self, node: ast.expr) -> Optional[str]:
+        if (
+            isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "self"
+        ):
+            return node.attr
+        return None
+
+    def visit_With(self, node: ast.With) -> None:
+        pushed = 0
+        for item in node.items:
+            attr = self._self_attr(item.context_expr)
+            if attr is not None and attr in self.scan.locks:
+                self.held.append(attr)
+                pushed += 1
+        for stmt in node.body:
+            self.visit(stmt)
+        for _ in range(pushed):
+            self.held.pop()
+
+    visit_AsyncWith = visit_With
+
+    def visit_FunctionDef(self, node) -> None:
+        # nested defs run later, not under the current lock
+        prev, self.held = self.held, []
+        for stmt in node.body:
+            self.visit(stmt)
+        self.held = prev
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def _record(self, attr: str, lineno: int, write: bool) -> None:
+        if attr in self.scan.locks or attr.startswith("__"):
+            return
+        if self.collect:
+            self.scan.accesses.append(_Access(
+                attr, self.method, lineno, write, frozenset(self.held)
+            ))
+
+    def visit_Attribute(self, node: ast.Attribute) -> None:
+        attr = self._self_attr(node)
+        if attr is not None:
+            self._record(
+                attr, node.lineno, isinstance(node.ctx, (ast.Store, ast.Del))
+            )
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node: ast.AugAssign) -> None:
+        attr = self._self_attr(node.target)
+        if attr is not None:
+            self._record(attr, node.lineno, True)
+            self.visit(node.value)
+            return
+        self.generic_visit(node)
+
+    def visit_Subscript(self, node: ast.Subscript) -> None:
+        # `self._queues[k] = …` mutates the container: a write to the
+        # attribute for guard purposes, even though the Attribute node
+        # itself loads
+        attr = self._self_attr(node.value)
+        if attr is not None and isinstance(node.ctx, (ast.Store, ast.Del)):
+            self._record(attr, node.lineno, True)
+            self.visit(node.slice)
+            return
+        self.generic_visit(node)
+
+    def visit_Call(self, node: ast.Call) -> None:
+        fn = node.func
+        # self.m(...) internal call site (for held-lock propagation);
+        # the attribute itself is a method lookup, not a state access
+        attr = self._self_attr(fn) if isinstance(fn, ast.Attribute) else None
+        if attr is not None and attr in self.scan.methods:
+            self.scan.call_sites.setdefault(attr, []).append(
+                (self.method, frozenset(self.held))
+            )
+            for arg in list(node.args) + [k.value for k in node.keywords]:
+                self.visit(arg)
+            return
+        # self.__dict__.get("X") / setdefault("X", …) / ["X"] is an
+        # access to X (the lazy-attr idiom)
+        if (
+            isinstance(fn, ast.Attribute)
+            and fn.attr in ("get", "setdefault", "pop")
+            and isinstance(fn.value, ast.Attribute)
+            and fn.value.attr == "__dict__"
+            and isinstance(fn.value.value, ast.Name)
+            and fn.value.value.id == "self"
+            and node.args
+            and isinstance(node.args[0], ast.Constant)
+            and isinstance(node.args[0].value, str)
+        ):
+            self._record(
+                node.args[0].value, node.lineno,
+                fn.attr in ("setdefault", "pop"),
+            )
+        self.generic_visit(node)
+
+
+def _scan_class(sf: SourceFile, node: ast.ClassDef) -> _ClassScan:
+    scan = _ClassScan(node.name)
+    methods = [
+        m for m in node.body
+        if isinstance(m, (ast.FunctionDef, ast.AsyncFunctionDef))
+    ]
+    scan.methods = {m.name for m in methods}
+    # pass 1: lock attrs + annotations (any method; __init__ is typical)
+    for m in methods:
+        for sub in ast.walk(m):
+            targets: List[ast.expr] = []
+            value: Optional[ast.expr] = None
+            if isinstance(sub, ast.Assign):
+                targets, value = sub.targets, sub.value
+            elif isinstance(sub, ast.AnnAssign) and sub.value is not None:
+                targets, value = [sub.target], sub.value
+            for t in targets:
+                if not (
+                    isinstance(t, ast.Attribute)
+                    and isinstance(t.value, ast.Name)
+                    and t.value.id == "self"
+                ):
+                    continue
+                if _is_lock_ctor(value):
+                    scan.locks.add(t.attr)
+                    continue
+                ann = _annotation_for(sf, sub.lineno)
+                if ann is not None and t.attr not in scan.annotated:
+                    scan.annotated[t.attr] = (_norm_lock(ann), sub.lineno)
+    # pass 2: accesses + call sites
+    for m in methods:
+        walker = _MethodWalker(
+            sf=sf, scan=scan, method=m.name, collect=m.name != "__init__"
+        )
+        for stmt in m.body:
+            walker.visit(stmt)
+    return scan
+
+
+def _propagate_held(scan: _ClassScan) -> None:
+    """A private helper called ONLY with lock L held (every internal call
+    site, at least one) inherits L for its own accesses. A small fixpoint
+    over the class's call graph so helper-of-helper chains (``acquire``
+    → ``_dispatch`` → ``_grant_locked``) inherit through each hop — the
+    one-level call-summary idea of ``lock_order.py``, closed within one
+    class."""
+    inherited: Dict[str, frozenset] = {}
+    for _ in range(len(scan.methods) + 1):
+        changed = False
+        for method, sites in scan.call_sites.items():
+            if not method.startswith("_") or not sites:
+                continue
+            effective = [
+                held | inherited.get(caller, frozenset())
+                for caller, held in sites
+            ]
+            common = frozenset.intersection(*effective)
+            if common and not common <= inherited.get(method, frozenset()):
+                inherited[method] = (
+                    inherited.get(method, frozenset()) | common
+                )
+                changed = True
+        if not changed:
+            break
+    for acc in scan.accesses:
+        extra = inherited.get(acc.method)
+        if extra:
+            acc.held = acc.held | extra
+
+
+@dataclass
+class _ModuleGlobal:
+    name: str
+    lock: str
+    lineno: int
+
+
+def _module_globals(sf: SourceFile, tree: ast.AST) -> List[_ModuleGlobal]:
+    out: List[_ModuleGlobal] = []
+    for stmt in getattr(tree, "body", []):
+        targets: List[ast.expr] = []
+        if isinstance(stmt, ast.Assign):
+            targets = stmt.targets
+        elif isinstance(stmt, ast.AnnAssign):
+            targets = [stmt.target]
+        for t in targets:
+            if isinstance(t, ast.Name):
+                ann = _annotation_for(sf, stmt.lineno)
+                if ann is not None:
+                    out.append(_ModuleGlobal(t.id, ann, stmt.lineno))
+    return out
+
+
+class _GlobalWalker(ast.NodeVisitor):
+    """Accesses to annotated module globals with module-lock held sets."""
+
+    def __init__(self, watched: Dict[str, str]):
+        self.watched = watched       # global name -> lock name
+        self.held: List[str] = []
+        self.in_func: int = 0
+        #: (name, lineno, write, held)
+        self.hits: List[Tuple[str, int, bool, frozenset]] = []
+
+    def visit_With(self, node: ast.With) -> None:
+        pushed = 0
+        for item in node.items:
+            ce = item.context_expr
+            if isinstance(ce, ast.Name) and ce.id in set(
+                self.watched.values()
+            ):
+                self.held.append(ce.id)
+                pushed += 1
+        for stmt in node.body:
+            self.visit(stmt)
+        for _ in range(pushed):
+            self.held.pop()
+
+    visit_AsyncWith = visit_With
+
+    def visit_FunctionDef(self, node) -> None:
+        self.in_func += 1
+        prev, self.held = self.held, []
+        for stmt in node.body:
+            self.visit(stmt)
+        self.held = prev
+        self.in_func -= 1
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Name(self, node: ast.Name) -> None:
+        if node.id in self.watched and self.in_func > 0:
+            self.hits.append((
+                node.id, node.lineno,
+                isinstance(node.ctx, (ast.Store, ast.Del)),
+                frozenset(self.held),
+            ))
+
+
+class GuardedByPass(LintPass):
+    id = "guarded-by"
+    title = "lock/attribute consistency (annotated + majority-inferred)"
+
+    def run(self, project: Project) -> Iterable[Finding]:
+        findings: List[Finding] = []
+        for sf in project.files:
+            tree = sf.tree
+            if tree is None:
+                continue
+            in_scope = any(
+                sf.rel.startswith(d) or sf.rel == d for d in GUARD_DIRS
+            )
+            if not in_scope and not sf.guarded_by:
+                continue
+            for node in ast.walk(tree):
+                if isinstance(node, ast.ClassDef):
+                    findings.extend(self._check_class(sf, node))
+            findings.extend(self._check_globals(sf, tree))
+        return findings
+
+    # ── class attributes ────────────────────────────────────────────────
+    def _check_class(self, sf: SourceFile,
+                     node: ast.ClassDef) -> Iterable[Finding]:
+        scan = _scan_class(sf, node)
+        if not scan.locks and not scan.annotated:
+            return []
+        _propagate_held(scan)
+        findings: List[Finding] = []
+        by_attr: Dict[str, List[_Access]] = {}
+        for acc in scan.accesses:
+            by_attr.setdefault(acc.attr, []).append(acc)
+
+        for attr, (lock, ann_line) in sorted(scan.annotated.items()):
+            if lock not in scan.locks:
+                findings.append(self.finding(
+                    sf.rel, ann_line,
+                    f"guarded_by({lock}) on {scan.name}.{attr}: no lock "
+                    f"attribute self.{lock} exists on {scan.name} — the "
+                    "annotation names a sibling threading.Lock/RLock/"
+                    "Condition attribute",
+                ))
+                continue
+            for acc in by_attr.get(attr, ()):
+                if lock in acc.held:
+                    continue
+                findings.append(self.finding(
+                    sf.rel, acc.lineno,
+                    self._msg(scan.name, attr, lock, acc, "annotation"),
+                ))
+
+        # inference over unannotated attrs with post-init writes: an
+        # attribute only ever written during construction is safe
+        # publication, not shared mutable state
+        for attr, accs in sorted(by_attr.items()):
+            if attr in scan.annotated or not any(a.write for a in accs):
+                continue
+            if len(accs) < INFER_MIN_SITES:
+                continue
+            counts: Dict[str, int] = {}
+            for acc in accs:
+                for lock in acc.held:
+                    if lock in scan.locks:
+                        counts[lock] = counts.get(lock, 0) + 1
+            if not counts:
+                continue
+            lock, n = max(counts.items(), key=lambda kv: kv[1])
+            if n / len(accs) < INFER_RATIO:
+                continue
+            if not any(a.write and lock in a.held for a in accs):
+                continue
+            for acc in accs:
+                if lock not in acc.held:
+                    findings.append(self.finding(
+                        sf.rel, acc.lineno,
+                        self._msg(
+                            scan.name, attr, lock, acc,
+                            f"inferred from {n}/{len(accs)} sites",
+                        ),
+                    ))
+        return findings
+
+    def _msg(self, cls: str, attr: str, lock: str, acc: _Access,
+             evidence: str) -> str:
+        what = "write to" if acc.write else "read of"
+        if acc.held:
+            ctx = (
+                "under a DIFFERENT lock ("
+                + ", ".join(sorted(acc.held)) + ")"
+            )
+        else:
+            ctx = "with no lock held"
+        return (
+            f"{what} {cls}.{attr} {ctx}, but self.{lock} guards it "
+            f"({evidence}) — take self.{lock}, or annotate the real "
+            "guard with '# graft: guarded_by(<lock>)', or acknowledge "
+            "with '# graft: ok(guarded-by: <why>)'"
+        )
+
+    # ── annotated module globals ────────────────────────────────────────
+    def _check_globals(self, sf: SourceFile,
+                       tree: ast.AST) -> Iterable[Finding]:
+        watched = {
+            g.name: g.lock for g in _module_globals(sf, tree)
+        }
+        if not watched:
+            return []
+        walker = _GlobalWalker(watched)
+        for stmt in getattr(tree, "body", []):
+            walker.visit(stmt)
+        findings: List[Finding] = []
+        for name, lineno, write, held in walker.hits:
+            lock = watched[name]
+            if lock in held:
+                continue
+            what = "write to" if write else "read of"
+            ctx = (
+                "under a DIFFERENT lock (" + ", ".join(sorted(held)) + ")"
+                if held else "with no lock held"
+            )
+            findings.append(self.finding(
+                sf.rel, lineno,
+                f"{what} module global {name} {ctx}, but {lock} guards "
+                "it (annotation) — take the lock or acknowledge with "
+                "'# graft: ok(guarded-by: <why>)'",
+            ))
+        return findings
+
+
+PASS = GuardedByPass()

@@ -1,0 +1,440 @@
+"""resource-lifecycle — must-release-on-all-paths over graft-flow CFGs.
+
+The bug class every PR since 3 has hand-fixed at least once: a resource
+acquired and released on the happy path, leaked on an exception edge —
+permits held between admit and first batch, accept/reader sockets
+dropped by a raced shutdown, a fault-injector scope never exited, a
+flock re-entered instead of released. This pass walks every function's
+CFG (:mod:`..flow.cfg`) and, for each acquire site matched by the
+registry (:mod:`..flow.resources`), demands that **every** path to the
+function exit — including every exception edge — does one of:
+
+* release the resource (matching release method on the same receiver/
+  variable, or a call into a same-module function whose one-level
+  summary releases this kind),
+* transfer ownership out of the function (return/yield it, store it
+  into an attribute or container, pass it to a call, capture it in a
+  nested ``def``),
+* or never leak by construction (acquired in a ``with`` item; daemon
+  thread spawns).
+
+Anything else is a finding that prints the full leaking path
+file:line by file:line, exception edges marked — the reviewer replays
+the leak instead of hunting for it.
+"""
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from .. import Finding, LintPass, Project, SourceFile
+from ..flow.cfg import CFG, build_cfg
+from ..flow.engine import find_leak_path, module_release_summaries
+from ..flow.resources import (
+    RESOURCE_KINDS,
+    ResourceKind,
+    release_method_index,
+)
+
+
+def _src(node: ast.AST) -> str:
+    try:
+        return ast.unparse(node)
+    except Exception:  # pragma: no cover
+        return "<expr>"
+
+
+def _call_name(call: ast.Call) -> Optional[str]:
+    fn = call.func
+    if isinstance(fn, ast.Name):
+        return fn.id
+    if isinstance(fn, ast.Attribute):
+        return fn.attr
+    return None
+
+
+def _flock_mode(call: ast.Call) -> Optional[str]:
+    """'acquire' for LOCK_EX flocks, 'release' for LOCK_UN, else None."""
+    if _call_name(call) != "flock" or len(call.args) < 2:
+        return None
+    flags = _src(call.args[1])
+    if "LOCK_UN" in flags:
+        return "release"
+    if "LOCK_EX" in flags or "LOCK_SH" in flags:
+        return "acquire"
+    return None
+
+
+def _flock_base(call: ast.Call) -> str:
+    """Identity of a flock'd fd: the variable under ``X.fileno()`` (or
+    the raw first-arg source) — ``f.fileno()`` and ``f.close()`` must
+    match the same resource."""
+    arg = call.args[0]
+    if (
+        isinstance(arg, ast.Call)
+        and isinstance(arg.func, ast.Attribute)
+        and arg.func.attr == "fileno"
+    ):
+        return _src(arg.func.value)
+    return _src(arg)
+
+
+@dataclass
+class _Acquire:
+    kind: ResourceKind
+    node_idx: int
+    lineno: int
+    recv: str            # receiver source text ('' for constructors)
+    var: str             # bound variable name ('' when receiver-bound)
+    detail: str          # rendered acquire expression for the message
+
+
+def _node_exprs(stmt: ast.stmt) -> List[ast.AST]:
+    """The expressions that belong to THIS CFG node (compound statements
+    contribute only their header, mirroring the CFG's can-raise rule)."""
+    if isinstance(stmt, ast.If):
+        return [stmt.test]
+    if isinstance(stmt, ast.While):
+        return [stmt.test]
+    if isinstance(stmt, (ast.For, ast.AsyncFor)):
+        return [stmt.iter]
+    if isinstance(stmt, (ast.With, ast.AsyncWith)):
+        return [i.context_expr for i in stmt.items]
+    if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                         ast.ClassDef)):
+        return []  # handled as closures by the kill scan
+    return [stmt]
+
+
+class _FunctionAnalysis:
+    def __init__(self, sf: SourceFile, fn: ast.AST,
+                 summaries: Dict[str, Set[str]],
+                 class_name: Optional[str]):
+        self.sf = sf
+        self.fn = fn
+        self.summaries = summaries
+        self.class_name = class_name
+        self.cfg: CFG = build_cfg(fn)
+
+    # ── acquire detection ───────────────────────────────────────────────
+    def acquires(self) -> List[_Acquire]:
+        # a context-manager class's __enter__ acquiring onto self IS the
+        # ctx protocol: the paired release lives in __exit__, and the
+        # runtime reswatch harness owns that cross-method balance
+        if getattr(self.fn, "name", "") == "__enter__":
+            return [
+                a for a in self._raw_acquires()
+                if not a.recv.startswith("self.") and a.recv != "self"
+            ]
+        return self._raw_acquires()
+
+    def _raw_acquires(self) -> List[_Acquire]:
+        out: List[_Acquire] = []
+        for node in self.cfg.nodes:
+            stmt = node.stmt
+            if stmt is None or isinstance(stmt, (ast.With, ast.AsyncWith)):
+                continue  # with-item acquires are balanced by construction
+            if isinstance(stmt, ast.Assign):
+                acq = self._match_assign(stmt, node.idx)
+                if acq is not None:
+                    out.append(acq)
+            elif isinstance(stmt, ast.Expr) and isinstance(
+                stmt.value, ast.Call
+            ):
+                acq = self._match_bare(stmt.value, node.idx)
+                if acq is not None:
+                    out.append(acq)
+        return out
+
+    def _match_kind(self, call: ast.Call) -> Optional[ResourceKind]:
+        name = _call_name(call)
+        if name is None:
+            return None
+        if name == "flock":
+            if _flock_mode(call) == "acquire":
+                return next(
+                    k for k in RESOURCE_KINDS if k.name == "flock"
+                )
+            return None
+        for kind in RESOURCE_KINDS:
+            if kind.name == "flock" or name not in kind.acquire_methods:
+                continue
+            if kind.constructor:
+                return kind
+            recv = (
+                _src(call.func.value)
+                if isinstance(call.func, ast.Attribute) else ""
+            )
+            if recv and kind.recv_matches(recv):
+                return kind
+        return None
+
+    def _daemon_spawn(self, call: ast.Call) -> bool:
+        for kw in call.keywords:
+            if kw.arg == "daemon" and isinstance(kw.value, ast.Constant):
+                return bool(kw.value.value)
+        return False
+
+    def _match_assign(self, stmt: ast.Assign,
+                      node_idx: int) -> Optional[_Acquire]:
+        if not isinstance(stmt.value, ast.Call):
+            return None  # nested acquires are transferred at birth
+        call = stmt.value
+        kind = self._match_kind(call)
+        if kind is None:
+            return None
+        if kind.daemon_exempt and self._daemon_spawn(call):
+            return None
+        if len(stmt.targets) != 1:
+            return None
+        if not kind.result_is_resource:
+            # `inj = ctx.__enter__()`: the scope that must exit is the
+            # RECEIVER — analyze like the bare-call form
+            return self._match_bare(call, node_idx)
+        target = stmt.targets[0]
+        var = ""
+        if isinstance(target, ast.Name):
+            var = target.id
+        elif (
+            isinstance(target, ast.Tuple)
+            and kind.tuple_first
+            and target.elts
+            and isinstance(target.elts[0], ast.Name)
+        ):
+            var = target.elts[0].id
+        elif isinstance(target, (ast.Attribute, ast.Subscript)):
+            return None  # stored into an owner object at birth: transfer
+        if kind.name == "flock":
+            recv = _flock_base(call)
+        else:
+            recv = (
+                _src(call.func.value)
+                if isinstance(call.func, ast.Attribute) else ""
+            )
+        if kind.constructor:
+            recv = ""  # the result IS the resource; receiver irrelevant
+            if not var:
+                return None
+        return _Acquire(kind, node_idx, stmt.lineno, recv, var, _src(call))
+
+    def _match_bare(self, call: ast.Call,
+                    node_idx: int) -> Optional[_Acquire]:
+        kind = self._match_kind(call)
+        if kind is None or kind.constructor and kind.name != "flock":
+            return None  # discarded constructor results stay un-flagged
+        if kind.name == "flock":
+            return _Acquire(
+                kind, node_idx, call.lineno, _flock_base(call), "",
+                _src(call),
+            )
+        recv = (
+            _src(call.func.value)
+            if isinstance(call.func, ast.Attribute) else ""
+        )
+        return _Acquire(kind, node_idx, call.lineno, recv, "", _src(call))
+
+    # ── kill (release / transfer) detection ─────────────────────────────
+    def _names_in(self, node: ast.AST) -> Set[str]:
+        return {
+            sub.id for sub in ast.walk(node) if isinstance(sub, ast.Name)
+        }
+
+    def _summary_releases(self, call: ast.Call, kind: ResourceKind) -> bool:
+        fn = call.func
+        key: Optional[str] = None
+        if isinstance(fn, ast.Name):
+            key = fn.id
+        elif (
+            isinstance(fn, ast.Attribute)
+            and isinstance(fn.value, ast.Name)
+            and fn.value.id == "self"
+        ):
+            qual = f"{self.class_name}.{fn.attr}" if self.class_name else ""
+            if qual in self.summaries:
+                return kind.name in self.summaries[qual]
+            key = fn.attr
+        if key is not None and key in self.summaries:
+            return kind.name in self.summaries[key]
+        return False
+
+    def _call_kills(self, call: ast.Call, acq: _Acquire) -> bool:
+        name = _call_name(call)
+        # 1. direct release on the matching receiver / variable
+        if acq.kind.name == "flock":
+            if name == "flock" and _flock_mode(call) == "release":
+                if _flock_base(call) == acq.recv:
+                    return True
+            if name == "close" and isinstance(call.func, ast.Attribute):
+                if _src(call.func.value) == acq.recv:
+                    return True
+        elif name in acq.kind.release_methods:
+            if isinstance(call.func, ast.Attribute):
+                recv = _src(call.func.value)
+                if recv and recv in (acq.recv, acq.var):
+                    return True
+                # pool.release(granted): the grant variable going back
+                # through ANY matching release receiver counts
+                if acq.var and acq.var in {
+                    a.id for a in call.args if isinstance(a, ast.Name)
+                }:
+                    return True
+        # 2. one-level same-module call summary
+        if self._summary_releases(call, acq.kind):
+            return True
+        # 3. ownership transfer: the bound variable passed to any call —
+        # except a flock on the resource's own fd, which borrows it
+        if acq.var and name != "flock":
+            for arg in list(call.args) + [kw.value for kw in call.keywords]:
+                if acq.var in self._names_in(arg):
+                    return True
+        return False
+
+    def _id_names(self, acq: _Acquire) -> Set[str]:
+        """The plain identifiers that denote this resource (its bound
+        variable, and the receiver when it is a bare name)."""
+        names = set()
+        if acq.var:
+            names.add(acq.var)
+        if acq.recv and acq.recv.isidentifier() and acq.recv != "self":
+            names.add(acq.recv)
+        return names
+
+    def _node_kills(self, idx: int, acq: _Acquire) -> bool:
+        if idx == acq.node_idx:
+            return False
+        stmt = self.cfg.nodes[idx].stmt
+        if stmt is None:
+            return False
+        ids = self._id_names(acq)
+        # closure capture: a nested def that references the resource owns
+        # its release (the _wedge_lock shape)
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return bool(ids & self._names_in(stmt))
+        # correlated conditional release: `if span is not None:
+        # span.__exit__(...)` — the branch condition names the resource,
+        # so the un-releasing branch is exactly the never-acquired case
+        # (the one correlation a path-insensitive CFG cannot see)
+        if isinstance(stmt, ast.If) and ids & self._names_in(stmt.test):
+            for sub in ast.walk(stmt):
+                if isinstance(sub, ast.Call) and self._call_kills(sub, acq):
+                    return True
+        if acq.var:
+            if isinstance(stmt, (ast.Return, ast.Expr)) and isinstance(
+                getattr(stmt, "value", None),
+                (ast.Yield, ast.YieldFrom),
+            ):
+                val = stmt.value.value if isinstance(
+                    stmt.value, ast.Yield
+                ) else stmt.value
+                if val is not None and acq.var in self._names_in(val):
+                    return True
+            if isinstance(stmt, ast.Return) and stmt.value is not None:
+                if acq.var in self._names_in(stmt.value):
+                    return True
+            if isinstance(stmt, ast.Assign) and any(
+                isinstance(t, (ast.Attribute, ast.Subscript))
+                for t in stmt.targets
+            ):
+                if acq.var in self._names_in(stmt.value):
+                    return True
+        for expr in _node_exprs(stmt):
+            for sub in ast.walk(expr):
+                if isinstance(sub, ast.Call) and self._call_kills(sub, acq):
+                    return True
+        return False
+
+    # ── the check ───────────────────────────────────────────────────────
+    def leak_paths(self) -> Iterable[Tuple[_Acquire, List[Tuple[int, str]]]]:
+        for acq in self.acquires():
+            path = find_leak_path(
+                self.cfg, acq.node_idx, lambda i, a=acq: self._node_kills(i, a)
+            )
+            if path is not None:
+                yield acq, path
+
+
+def _render_path(sf: SourceFile, cfg: CFG,
+                 path: Sequence[Tuple[int, str]]) -> str:
+    parts: List[str] = []
+    for i, (idx, edge) in enumerate(path):
+        node = cfg.nodes[idx]
+        if node.kind == "exit":
+            parts.append(
+                "exit (exception propagates)" if edge in ("except", "reraise")
+                else "exit"
+            )
+            continue
+        if node.kind == "dispatch":
+            parts.append(f"except-dispatch:{node.lineno}")
+            continue
+        if node.kind == "finally":
+            parts.append(f"finally:{node.lineno}")
+            continue
+        tag = f"{sf.rel}:{node.lineno}"
+        # the statement whose exception edge the path follows is the
+        # one that raises — mark it, not its landing site
+        if i + 1 < len(path) and path[i + 1][1] == "except":
+            tag += " (raises)"
+        parts.append(tag)
+    return " -> ".join(parts)
+
+
+class ResourceLifecyclePass(LintPass):
+    id = "resource-lifecycle"
+    title = "must-release-on-all-paths for registered resources"
+
+    def run(self, project: Project) -> Iterable[Finding]:
+        release_idx = release_method_index()
+        findings: List[Finding] = []
+        for sf in project.files:
+            tree = sf.tree
+            if tree is None:
+                continue
+            summaries = module_release_summaries(tree, release_idx)
+            for cls, fn in _functions(tree):
+                fa = _FunctionAnalysis(sf, fn, summaries, cls)
+                for acq, path in fa.leak_paths():
+                    where = acq.recv or acq.var or "<anonymous>"
+                    findings.append(self.finding(
+                        sf.rel, acq.lineno,
+                        f"{acq.kind.noun} acquired by {acq.detail} "
+                        f"({where}) can leak: a path reaches the function "
+                        "exit with no release, ownership transfer, or "
+                        "covering finally/with — leaking path: "
+                        + _render_path(sf, fa.cfg, path)
+                        + "; release on every path (try/finally), hand "
+                        "ownership off explicitly, or acknowledge with "
+                        "'# graft: ok(resource-lifecycle: <why>)'",
+                    ))
+        return findings
+
+
+def _functions(tree: ast.AST):
+    """(class name | None, function node) for every def in the module,
+    including methods — nested defs are analyzed as their own functions
+    (their CFG treats the enclosing frame's variables as free)."""
+    out = []
+
+    class V(ast.NodeVisitor):
+        def __init__(self):
+            self.cls: Optional[str] = None
+
+        def visit_ClassDef(self, node: ast.ClassDef):
+            prev, self.cls = self.cls, node.name
+            self.generic_visit(node)
+            self.cls = prev
+
+        def visit_FunctionDef(self, node: ast.FunctionDef):
+            out.append((self.cls, node))
+            self.generic_visit(node)
+
+        def visit_AsyncFunctionDef(self, node):
+            out.append((self.cls, node))
+            self.generic_visit(node)
+
+    V().visit(tree)
+    return out
+
+
+PASS = ResourceLifecyclePass()

@@ -1,0 +1,324 @@
+"""reswatch — the runtime resource-balance harness (the dynamic teeth of
+the static ``resource-lifecycle`` pass).
+
+The static pass proves per-function must-release over the CFG; everything
+it declares a *transfer* (ownership handed to another object, released in
+another method, joined by another thread) lands here. ``install()``
+instruments the real implementations of the same registry kinds
+(:mod:`.flow.resources`) — scheduler permit pools, the device semaphore,
+spill catalogs, scheduler registries, trace spans, ledger phase scopes,
+compile-cache flocks — and ``report(snapshot)`` asserts **end-of-test
+balance**: every counter back to its entry value, no permits in use, no
+queued waiters, no live engine threads or fds beyond the entry snapshot,
+no pinned spill buffers, no resident fault injector beyond the fixture's
+own. The tier-1 scheduler/serve suites and every chaos-marked test run
+under it via the autouse fixture in ``tests/conftest.py`` — so the static
+model and reality cross-check each other: a leak the CFG cannot see
+(dynamic dispatch, cross-thread handoff) still fails the suite that
+exercised it.
+
+Instrumentation is patch-once, process-wide, and snapshot-relative: all
+assertions compare against the values recorded by ``snapshot()`` at
+fixture entry, so long-lived session state (a warm server, a populated
+cache) never counts as a leak — only what the test failed to put back.
+"""
+from __future__ import annotations
+
+import functools
+import os
+import threading
+import time
+import weakref
+from contextlib import contextmanager
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+_state_lock = threading.Lock()
+_installed = False
+_orig: Dict[str, object] = {}
+
+#: live scope balances (enter minus exit since install), by kind name
+_COUNTS: Dict[str, int] = {}
+
+#: instance registries (weak: a collected pool cannot leak)
+_POOLS: "weakref.WeakSet" = weakref.WeakSet()
+_SEMAPHORES: "weakref.WeakSet" = weakref.WeakSet()
+_CATALOGS: "weakref.WeakSet" = weakref.WeakSet()
+_SCHEDULERS: "weakref.WeakSet" = weakref.WeakSet()
+
+#: engine thread-name prefixes the balance check owns; lazily-created
+#: process singletons that legitimately outlive any one test are named
+#: separately and excluded
+_ENGINE_THREAD_PREFIXES = ("srt-", "tpu-serve-")
+_SINGLETON_THREADS = ("srt-watchdog", "srt-compile-deadline")
+
+
+def _bump(kind: str, delta: int) -> None:
+    with _state_lock:
+        _COUNTS[kind] = _COUNTS.get(kind, 0) + delta
+
+
+def _count(kind: str) -> int:
+    with _state_lock:
+        return _COUNTS.get(kind, 0)
+
+
+# ── instrumentation ─────────────────────────────────────────────────────────
+
+
+def _wrap_init(cls, registry: "weakref.WeakSet", key: str):
+    orig = cls.__init__
+
+    @functools.wraps(orig)
+    def __init__(self, *a, **kw):
+        orig(self, *a, **kw)
+        registry.add(self)
+
+    _orig[key] = (cls, orig)
+    cls.__init__ = __init__
+
+
+def _wrap_scope(cls, key: str, kind: str):
+    orig_enter, orig_exit = cls.__enter__, cls.__exit__
+
+    @functools.wraps(orig_enter)
+    def __enter__(self):
+        _bump(kind, 1)
+        try:
+            return orig_enter(self)
+        except BaseException:
+            _bump(kind, -1)
+            raise
+
+    @functools.wraps(orig_exit)
+    def __exit__(self, *exc):
+        try:
+            return orig_exit(self, *exc)
+        finally:
+            _bump(kind, -1)
+
+    _orig[key] = (cls, orig_enter, orig_exit)
+    cls.__enter__ = __enter__
+    cls.__exit__ = __exit__
+
+
+def install() -> None:
+    """Patch the registry kinds' real implementations (idempotent; stays
+    installed for the process — all assertions are snapshot-relative)."""
+    global _installed
+    with _state_lock:
+        if _installed:
+            return
+        _installed = True
+
+    from ..cache import xla_store as XS
+    from ..mem.semaphore import DeviceSemaphore
+    from ..mem.spill import BufferCatalog
+    from ..obs import ledger as OL
+    from ..obs import trace as OT
+    from ..sched.admission import WeightedPermitPool
+    from ..sched.scheduler import QueryScheduler
+
+    _wrap_init(WeightedPermitPool, _POOLS, "pool.__init__")
+    _wrap_init(DeviceSemaphore, _SEMAPHORES, "sem.__init__")
+    _wrap_init(BufferCatalog, _CATALOGS, "catalog.__init__")
+    _wrap_init(QueryScheduler, _SCHEDULERS, "sched.__init__")
+    _wrap_scope(OT._OpenSpan, "span.scope", "span")
+    _wrap_scope(OL._Scope, "ledger.scope", "ledger-phase")
+
+    orig_sf = XS.XlaStore.single_flight
+    _orig["store.single_flight"] = (XS.XlaStore, orig_sf)
+
+    @functools.wraps(orig_sf)
+    @contextmanager
+    def single_flight(self, digest):
+        _bump("flock", 1)
+        try:
+            with orig_sf(self, digest) as got:
+                yield got
+        finally:
+            _bump("flock", -1)
+
+    XS.XlaStore.single_flight = single_flight
+
+
+def uninstall() -> None:
+    """Restore the original implementations (unit tests only — the
+    conftest fixture installs once and leaves the patches in place)."""
+    global _installed
+    with _state_lock:
+        if not _installed:
+            return
+        _installed = False
+    for key, saved in list(_orig.items()):
+        cls = saved[0]
+        if key.endswith(".__init__"):
+            cls.__init__ = saved[1]
+        elif key == "store.single_flight":
+            cls.single_flight = saved[1]
+        else:
+            cls.__enter__, cls.__exit__ = saved[1], saved[2]
+    _orig.clear()
+
+
+def reset() -> None:
+    with _state_lock:
+        _COUNTS.clear()
+
+
+# ── snapshot / report ───────────────────────────────────────────────────────
+
+
+def _fd_count() -> int:
+    try:
+        return len(os.listdir("/proc/self/fd"))
+    except OSError:
+        return 0
+
+
+def _engine_threads() -> frozenset:
+    out = set()
+    for t in threading.enumerate():
+        if not t.is_alive():
+            continue
+        name = t.name
+        if any(name.startswith(p) for p in _SINGLETON_THREADS):
+            continue
+        if any(name.startswith(p) for p in _ENGINE_THREAD_PREFIXES):
+            out.add(t)
+    return frozenset(out)
+
+
+def _fault_depth() -> int:
+    from ..resilience import faults
+
+    return (0 if faults._ACTIVE is None else faults._ACTIVE_COUNT) + sum(
+        c for _inj, c in faults._SHADOWED
+    )
+
+
+@dataclass
+class Snapshot:
+    counts: Dict[str, int] = field(default_factory=dict)
+    threads: frozenset = frozenset()
+    fds: int = 0
+    fault_depth: int = 0
+    catalog_buffers: Dict[int, int] = field(default_factory=dict)
+
+
+def snapshot() -> Snapshot:
+    with _state_lock:
+        counts = dict(_COUNTS)
+    return Snapshot(
+        counts=counts,
+        threads=_engine_threads(),
+        fds=_fd_count(),
+        fault_depth=_fault_depth(),
+        catalog_buffers={
+            id(c): len(c.leak_report()) for c in list(_CATALOGS)
+        },
+    )
+
+
+class Report:
+    def __init__(self, imbalances: List[str]):
+        self.imbalances = imbalances
+
+    @property
+    def ok(self) -> bool:
+        return not self.imbalances
+
+    def describe(self) -> str:
+        if self.ok:
+            return "reswatch: balanced"
+        return "reswatch: unbalanced resources at test end:\n  " + (
+            "\n  ".join(self.imbalances)
+        )
+
+
+def _check(entry: Snapshot, fd_slack: int) -> List[str]:
+    out: List[str] = []
+    for pool in list(_POOLS):
+        if pool._in_use or pool._queued:
+            out.append(
+                f"permit pool {id(pool):#x}: {pool._in_use} permits in "
+                f"use, {pool._queued} waiters queued (want 0/0)"
+            )
+    for sem in list(_SEMAPHORES):
+        inner = sem._sem
+        initial = getattr(inner, "_initial_value", None)
+        if initial is not None and inner._value != initial:
+            out.append(
+                f"device semaphore {id(sem):#x}: {initial - inner._value} "
+                "task slot(s) still held"
+            )
+    for sched in list(_SCHEDULERS):
+        n = len(sched._active)
+        if n:
+            out.append(
+                f"scheduler {id(sched):#x}: {n} admission(s) still "
+                "registered (cancel tokens never unregistered)"
+            )
+    for cat in list(_CATALOGS):
+        entry_n = entry.catalog_buffers.get(id(cat))
+        now = cat.leak_report()
+        base = entry_n if entry_n is not None else 0
+        if len(now) > base:
+            out.append(
+                f"spill catalog {id(cat):#x}: {len(now) - base} buffer(s) "
+                f"registered beyond the entry snapshot "
+                f"(first: {now[-1]})"
+            )
+        pinned = [b for b in now if b.get("pinned")]
+        if pinned:
+            out.append(
+                f"spill catalog {id(cat):#x}: {len(pinned)} buffer(s) "
+                "still PINNED"
+            )
+    with _state_lock:
+        counts = dict(_COUNTS)
+    for kind in sorted(set(counts) | set(entry.counts)):
+        now_v = counts.get(kind, 0)
+        was = entry.counts.get(kind, 0)
+        if now_v != was:
+            out.append(
+                f"{kind}: {now_v - was:+d} open scope(s) vs the entry "
+                "snapshot (every enter must exit)"
+            )
+    depth = _fault_depth()
+    if depth != entry.fault_depth:
+        out.append(
+            f"fault injector: scoped() depth {depth} vs {entry.fault_depth} "
+            "at entry (a stale injector would resurrect faults in later "
+            "tests)"
+        )
+    leaked = _engine_threads() - entry.threads
+    if leaked:
+        out.append(
+            "live engine thread(s) beyond the entry snapshot: "
+            + ", ".join(sorted(t.name for t in leaked))
+        )
+    fds = _fd_count()
+    if fds > entry.fds + fd_slack:
+        out.append(
+            f"open fds grew {entry.fds} -> {fds} "
+            f"(> +{fd_slack} tolerance)"
+        )
+    return out
+
+
+def report(entry: Snapshot, grace_s: float = 15.0,
+           fd_slack: int = 2) -> Report:
+    """Balance check against the entry snapshot, polling up to
+    ``grace_s``: worker threads unwind asynchronously after a cancel and
+    CPython closes sockets on GC — bounded settling is part of the
+    contract, an unbounded leak is not."""
+    import gc
+
+    deadline = time.monotonic() + max(0.0, grace_s)
+    imbalances = _check(entry, fd_slack)
+    while imbalances and time.monotonic() < deadline:
+        time.sleep(0.1)
+        gc.collect()
+        imbalances = _check(entry, fd_slack)
+    return Report(imbalances)

@@ -529,16 +529,29 @@ class XlaStore:
                     fcntl.flock(f.fileno(), fcntl.LOCK_UN)
             except OSError:
                 pass
-            f.close()
+            finally:
+                # close unconditionally — even a non-OSError out of the
+                # unlock (or a cancellation landing there) must not
+                # leak the lock-file fd
+                f.close()
 
     @staticmethod
     def _wedge_lock(path: str, hold_ms: float) -> None:
         try:
             import fcntl
 
+            # graft: ok(resource-lifecycle: flock on the next line raises
+            # OSError only, and that handler closes wf — the unmatched-
+            # exception edge the CFG also sees cannot fire in practice)
             wf = open(path, "ab")
+        except OSError:
+            return
+        try:
             fcntl.flock(wf.fileno(), fcntl.LOCK_EX | fcntl.LOCK_NB)
         except OSError:
+            # the entry is already locked — the wedge scenario is moot,
+            # but the opened lock-file fd must not leak with it
+            wf.close()
             return
 
         def _release():
@@ -603,7 +616,7 @@ def _pid_alive(pid: int) -> bool:
 
 # ── process-global configuration ────────────────────────────────────────────
 
-_STORE: Optional[XlaStore] = None
+_STORE: Optional[XlaStore] = None  # graft: guarded_by(_STORE_LOCK)
 _STORE_LOCK = threading.Lock()
 
 #: XLA:CPU deserializes through the same native loader the compiler uses —
@@ -669,6 +682,9 @@ def configure(conf) -> Optional[XlaStore]:
 
 
 def active_store() -> Optional[XlaStore]:
+    # graft: ok(guarded-by: published-singleton snapshot read —
+    # one ref load under the GIL; writers swap the whole object under
+    # _STORE_LOCK and a stale snapshot is a cache miss, never corruption)
     return _STORE
 
 
@@ -705,6 +721,9 @@ def record_load_failure(digest: Optional[str], err: BaseException) -> None:
     proving run: quarantine the entry (the rebuild must not reload it),
     count it, and feed the breaker."""
     _M_DESER_FAIL.add(1)
+    # graft: ok(guarded-by: published-singleton snapshot read —
+    # one ref load under the GIL; writers swap the whole object under
+    # _STORE_LOCK and a stale snapshot is a cache miss, never corruption)
     store = _STORE
     if store is not None and digest:
         store.quarantine_digest(digest, f"deserialize/proving failure: {err}")
@@ -715,6 +734,9 @@ def load_executable(digest: Optional[str]):
     """Deserialized executable for ``digest``, or None. Counts
     ``cache.xla.hit``/``miss`` (a CRC-valid payload that fails to
     deserialize is a miss plus a ``deserializeFailures``)."""
+    # graft: ok(guarded-by: published-singleton snapshot read —
+    # one ref load under the GIL; writers swap the whole object under
+    # _STORE_LOCK and a stale snapshot is a cache miss, never corruption)
     store = _STORE
     if store is None or not digest or loads_disabled():
         return None
@@ -765,6 +787,9 @@ def serialize_executable(compiled) -> Optional[bytes]:
 
 
 def store_executable(digest: Optional[str], payload: Optional[bytes]) -> bool:
+    # graft: ok(guarded-by: published-singleton snapshot read —
+    # one ref load under the GIL; writers swap the whole object under
+    # _STORE_LOCK and a stale snapshot is a cache miss, never corruption)
     store = _STORE
     if store is None or not digest or payload is None:
         return False

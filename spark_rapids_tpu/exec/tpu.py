@@ -237,7 +237,7 @@ class HostToDeviceExec(Exec):
             # KeyError, double-insert): all cache BOOKKEEPING serializes
             # under one session lock; the uploads themselves stay outside it
             with ctx.session._h2d_lock:
-                cache = ctx.session.__dict__.setdefault("_h2d_cache", {})
+                cache = ctx.session._h2d_cache
                 entry = cache.get(key)
                 if entry is None:
                     entry = {

@@ -27,10 +27,15 @@ class DeviceSemaphore:
     def acquire_if_necessary(self):
         """Idempotent per-thread acquire (GpuSemaphore.acquireIfNecessary)."""
         if getattr(self._held, "count", 0) == 0:
+            # graft: ok(resource-lifecycle: task-duration hold — the
+            # paired release lives in release_if_necessary, called by the
+            # task driver at task end; reswatch asserts the balance)
             if not self._sem.acquire(blocking=False):
                 # contended path only pays the timer (the common uncontended
                 # acquire stays two branch instructions)
                 t0 = time.perf_counter_ns()
+                # graft: ok(resource-lifecycle: same task-duration hold —
+                # blocking retry of the non-blocking acquire above)
                 self._sem.acquire()
                 _M_WAIT_NS.add(time.perf_counter_ns() - t0)
             self._held.count = 1

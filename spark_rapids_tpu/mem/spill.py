@@ -499,16 +499,20 @@ class BufferCatalog:
             self.device_bytes_by_dev.pop(dev, None)
 
     def stats(self) -> dict:
-        return {
-            "device_bytes": self.device_bytes,
-            "device_bytes_by_dev": {
-                str(k): v for k, v in self.device_bytes_by_dev.items()
-            },
-            "host_bytes": self.host_bytes,
-            "disk_bytes": self.disk_bytes,
-            "buffers": len(self._buffers),
-            "spill_count": self.spill_count,
-        }
+        # under the catalog lock: the byte counters and the buffer map
+        # move together during a spill — a report taken mid-transition
+        # would double- or zero-count the buffer being moved
+        with self._lock:
+            return {
+                "device_bytes": self.device_bytes,
+                "device_bytes_by_dev": {
+                    str(k): v for k, v in self.device_bytes_by_dev.items()
+                },
+                "host_bytes": self.host_bytes,
+                "disk_bytes": self.disk_bytes,
+                "buffers": len(self._buffers),
+                "spill_count": self.spill_count,
+            }
 
 
 def with_oom_retry(catalog: Optional[BufferCatalog], fn: Callable, *args, retries: int = 2):

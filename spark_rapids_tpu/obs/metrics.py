@@ -127,7 +127,7 @@ class Metric:
         kind: Optional[str] = None,
     ):
         self.name = name
-        self.value = 0
+        self.value = 0  # graft: guarded_by(_lock)
         self.level = level
         self.kind = kind or infer_kind(name)
         self._lock = threading.Lock()
@@ -164,6 +164,8 @@ class Metric:
         return Metric._Timer(self)
 
     def __repr__(self):
+        # graft: ok(guarded-by: debug repr — a torn read of a CPython int
+        # is impossible and a stale one is fine here)
         return f"Metric({self.name}={self.value}, {self.kind}/{self.level})"
 
 

@@ -101,13 +101,13 @@ class WeightedPermitPool:
 
     def __init__(self, permits: int = 8, max_queued: int = 32):
         self._lock = threading.Lock()
-        self._permits = max(1, int(permits))
-        self._max_queued = max(0, int(max_queued))
-        self._pools: Dict[str, PoolSpec] = {}
-        self._queues: Dict[str, deque] = {}
-        self._pass: Dict[str, float] = {}
-        self._in_use = 0
-        self._queued = 0
+        self._permits = max(1, int(permits))  # graft: guarded_by(_lock)
+        self._max_queued = max(0, int(max_queued))  # graft: guarded_by(_lock)
+        self._pools: Dict[str, PoolSpec] = {}  # graft: guarded_by(_lock)
+        self._queues: Dict[str, deque] = {}  # graft: guarded_by(_lock)
+        self._pass: Dict[str, float] = {}  # graft: guarded_by(_lock)
+        self._in_use = 0  # graft: guarded_by(_lock)
+        self._queued = 0  # graft: guarded_by(_lock)
         self._seq = itertools.count()
 
     # ── configuration (re-read per query by the scheduler) ──────────────
@@ -136,19 +136,25 @@ class WeightedPermitPool:
 
     @property
     def permits(self) -> int:
-        return self._permits
+        with self._lock:
+            return self._permits
 
     @property
     def in_use(self) -> int:
-        return self._in_use
+        with self._lock:
+            return self._in_use
 
     @property
     def queued(self) -> int:
-        return self._queued
+        with self._lock:
+            return self._queued
 
     def effective_permits(self) -> int:
         """The live admission limit: the configured permit count, halved
         (floor 1) while the process-wide OOM-pressure signal holds."""
+        # graft: ok(guarded-by: called both under the pool lock (from
+        # _dispatch) and bare (monitoring) — a single aligned int read;
+        # admission decisions re-read it under the lock)
         limit = self._permits
         try:
             from ..resilience.retry import oom_pressure
@@ -162,6 +168,9 @@ class WeightedPermitPool:
     def clamp(self, need: int) -> int:
         """Bound a requested share to [1, permits] so one huge query can
         always run alone rather than deadlocking the pool."""
+        # graft: ok(guarded-by: pre-admission advisory clamp — _dispatch
+        # re-clamps against the live value under the lock, so a racy
+        # read here can never wedge the queue)
         return max(1, min(int(need), self._permits))
 
     # ── acquire / release ───────────────────────────────────────────────

@@ -176,11 +176,13 @@ class RunCalibration:
 
     @property
     def samples(self) -> int:
-        return self._samples
+        with self._lock:
+            return self._samples
 
     def avg_run_s(self) -> float:
         """EWMA run seconds of recent queries (0.0 = uncalibrated)."""
-        return self._avg_run_s
+        with self._lock:
+            return self._avg_run_s
 
     def estimate_run_s(self, est_bytes: int) -> float:
         """Predicted run seconds for a query of ``est_bytes``: the

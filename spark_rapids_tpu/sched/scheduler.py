@@ -181,7 +181,7 @@ class QueryScheduler:
         from ..resilience.watchdog import Watchdog
 
         self.pool = WeightedPermitPool()
-        self._active: Dict[str, Admission] = {}
+        self._active: Dict[str, Admission] = {}  # graft: guarded_by(_lock)
         self._lock = threading.Lock()
         # bumped by cancel_all: preparation-phase waits that predate a
         # query's admission (no token yet — e.g. blocking on another
@@ -342,12 +342,14 @@ class QueryScheduler:
     def state(self) -> dict:
         """One snapshot for bench/diagnostics: pool occupancy + the
         scheduler slice of the process metric registry."""
+        with self._lock:
+            n_active = len(self._active)
         out = {
             "permits": self.pool.permits,
             "effective_permits": self.pool.effective_permits(),
             "in_use": self.pool.in_use,
             "queued": self.pool.queued,
-            "active": len(self._active),
+            "active": n_active,
             "watchdog_running": self.watchdog.running,
             "retry_after_hint_s": self.retry_after_hint(),
         }

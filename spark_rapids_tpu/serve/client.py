@@ -362,17 +362,17 @@ def connect(
     and the connection marks itself dead, so the next new query redials
     (``reconnect``)."""
     sock = socket.create_connection((host, port), timeout=timeout)
-    sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
     # the dial timeout (still armed from create_connection) bounds the
     # HELLO exchange too — a server that accepts but never greets must
     # not hang the client; op_timeout takes over for the session proper
     try:
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         P.send_json(sock, P.HELLO, {"token": token or "", "client": "python"})
         _, body = P.expect_frame(sock, P.HELLO_OK)
+        sock.settimeout(op_timeout)
     except BaseException:
         sock.close()
         raise
-    sock.settimeout(op_timeout)
     dial = {"host": host, "port": port, "token": token, "timeout": timeout,
             "op_timeout": op_timeout}
     return Connection(sock, P.decode_json(body), dial=dial,

@@ -71,8 +71,10 @@ class PreparedPlanCache:
             else cfg.SERVE_PREPARED_CACHE_ENTRIES.get(session.conf)
         )
         self._lock = threading.Lock()
-        self._plans: OrderedDict = OrderedDict()  # key -> final_plan
-        self._by_canon: dict = {}  # canonical_key -> key (share index)
+        # key -> final_plan  # graft: guarded_by(_lock)
+        self._plans: OrderedDict = OrderedDict()
+        # canonical_key -> key (share index)  # graft: guarded_by(_lock)
+        self._by_canon: dict = {}
         self._ids = itertools.count(1)
 
     def next_statement_id(self) -> str:

@@ -156,7 +156,7 @@ class TpuServer:
         self._qids = itertools.count(1)
         self._sock: Optional[socket.socket] = None
         self._accept_thread: Optional[threading.Thread] = None
-        self._conns: set = set()
+        self._conns: set = set()  # graft: guarded_by(_conn_lock)
         self._conn_lock = threading.Lock()
         self._stopping = threading.Event()
         # ── survivability state ─────────────────────────────────────────
@@ -185,12 +185,12 @@ class TpuServer:
             "current": None,
         }
         #: in-flight FETCH streams (drain waits on these)
-        self._inflight = 0
+        self._inflight = 0  # graft: guarded_by(_inflight_cond)
         self._inflight_cond = threading.Condition()
         #: per-tenant connection / in-flight-query occupancy (the caps
         #: that stop one tenant wedging the accept loop for everyone)
-        self._tenant_conns: Dict[str, int] = {}
-        self._tenant_inflight: Dict[str, int] = {}
+        self._tenant_conns: Dict[str, int] = {}  # graft: guarded_by(_conn_lock)
+        self._tenant_inflight: Dict[str, int] = {}  # graft: guarded_by(_inflight_cond)
         #: (tenant, wait_s, run_s) per served query — the SLO bench's
         #: percentile source (bounded; aggregate totals live in serve.*)
         self.latency_samples: deque = deque(maxlen=8192)
@@ -198,10 +198,15 @@ class TpuServer:
     # ── lifecycle ───────────────────────────────────────────────────────
     def start(self) -> tuple:
         sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
-        sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-        sock.bind((self.host, self.port))
-        sock.listen(128)
-        self.host, self.port = sock.getsockname()[:2]
+        try:
+            sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            sock.bind((self.host, self.port))
+            sock.listen(128)
+            self.host, self.port = sock.getsockname()[:2]
+        except BaseException:
+            # a failed bind/listen (port taken) must not leak the fd
+            sock.close()
+            raise
         self._sock = sock
         self._accept_thread = threading.Thread(
             target=self._accept_loop, name="tpu-serve-accept", daemon=True
@@ -380,6 +385,7 @@ class TpuServer:
             )
             if not over:
                 self._conns.add(sock)
+            n_conns = len(self._conns)
         if over:
             _M.counter("serve.connectionsRejected").add(1)
             try:
@@ -394,7 +400,7 @@ class TpuServer:
             sock.close()
             return
         sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-        _M.gauge("serve.connectionsActive").set(len(self._conns))
+        _M.gauge("serve.connectionsActive").set(n_conns)
         tenant: Optional[_Tenant] = None
         tenant_counted = False
         pending: Dict[str, _PendingQuery] = {}
@@ -467,7 +473,8 @@ class TpuServer:
                         self._tenant_conns.pop(tenant.name, None)
                     else:
                         self._tenant_conns[tenant.name] = n
-            _M.gauge("serve.connectionsActive").set(len(self._conns))
+                n_conns = len(self._conns)
+            _M.gauge("serve.connectionsActive").set(n_conns)
             try:
                 sock.close()
             except OSError:
@@ -632,6 +639,8 @@ class TpuServer:
         P.send_json(sock, P.CANCEL_OK, {"query_id": qid, "found": found})
 
     def _cmd_status(self, sock, tenant) -> None:
+        with self._inflight_cond:
+            inflight = self._inflight
         P.send_json(
             sock, P.STATUS_OK,
             {
@@ -650,7 +659,7 @@ class TpuServer:
                 "ready_timeout_s": cfg.SERVE_READY_TIMEOUT_S.get(
                     self.session.conf
                 ),
-                "inflight": self._inflight,
+                "inflight": inflight,
                 "active": self.session.active_queries(),
                 "scheduler": self.session.scheduler.state(),
                 "serve": _M.view("serve.", strip=False),

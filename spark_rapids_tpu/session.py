@@ -135,6 +135,10 @@ class TpuSession:
         # store (single-flight per cache key) and the device-upload LRU
         self._cache_lock = _threading.Lock()
         self._h2d_lock = _threading.Lock()
+        # the caches those locks guard (previously lazy __dict__ entries;
+        # eager init lets the guarded-by pass anchor its annotations)
+        self._cache_store: dict = {}  # graft: guarded_by(_cache_lock)
+        self._h2d_cache: dict = {}  # graft: guarded_by(_h2d_lock)
         # resilience: session-lifetime CPU-fallback circuit breaker (runtime
         # kernel failures flip ops to CPU at the next planning pass) and the
         # deterministic fault-injection scenario (None unless
@@ -478,7 +482,7 @@ class TpuSession:
 
         while True:
             with self._cache_lock:
-                store = self.__dict__.setdefault("_cache_store", {})
+                store = self._cache_store
                 entry = store.get(lp.cache_key)
                 owner = entry is None
                 if owner:
@@ -530,7 +534,7 @@ class TpuSession:
 
     def uncache(self, key: int) -> None:
         with self._cache_lock:
-            entry = self.__dict__.setdefault("_cache_store", {}).pop(key, None)
+            entry = self._cache_store.pop(key, None)
         if entry and entry.get("table") is not None:
             # also evict the device uploads anchored on the decoded table —
             # unpersist() must actually free HBM. Same lock as the H2D
@@ -538,7 +542,7 @@ class TpuSession:
             # race this iteration.
             tid = id(entry["table"])
             with self._h2d_lock:
-                h2d = self.__dict__.get("_h2d_cache", {})
+                h2d = self._h2d_cache
                 for k in [k for k in h2d if len(k) > 1 and k[1] == tid]:
                     h2d.pop(k, None)
 

@@ -325,10 +325,15 @@ class TcpTransport(Transport):
         connect and reconnect-on-drop (``conn`` rebinds to the new
         channel's frame delivery)."""
         sock = socket.create_connection(tuple(address))
-        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-        lock = threading.Lock()
-        _send_frame(sock, lock, _HELLO, 0, 0, self.executor_id.encode())
-        ch = _TcpChannel(self, sock, peer_executor_id, wlock=lock)
+        try:
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            lock = threading.Lock()
+            _send_frame(sock, lock, _HELLO, 0, 0, self.executor_id.encode())
+            ch = _TcpChannel(self, sock, peer_executor_id, wlock=lock)
+        except BaseException:
+            # a failed handshake must not orphan the dialed socket
+            sock.close()
+            raise
         if conn is not None:
             ch.client_conn = conn
         return ch

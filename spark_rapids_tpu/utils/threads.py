@@ -28,6 +28,9 @@ def start_big_stack_thread(
     with STACK_SIZE_LOCK:
         prev = threading.stack_size(BIG_STACK_BYTES)
         try:
+            # graft: ok(resource-lifecycle: an unstarted Thread object
+            # holds no OS resources — if start() raises there is nothing
+            # to join; once started, ownership returns to the caller)
             t = threading.Thread(target=target, name=name, daemon=daemon)
             t.start()
         finally:

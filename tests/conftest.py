@@ -103,6 +103,49 @@ def _bound_jit_code_size():
 #: under the lockwatch harness; chaos-marked tests ride it too (ISSUE 10)
 _LOCKWATCH_MODULES = {"test_scheduler", "test_serve"}
 
+#: suites that run under the reswatch resource-balance harness (ISSUE 15):
+#: same armed set as lockwatch — the suites whose tests acquire and must
+#: return permits, spans, flocks, threads, and fds
+_RESWATCH_MODULES = _LOCKWATCH_MODULES
+
+
+@pytest.fixture(autouse=True)
+def _reswatch_harness(request):
+    """Resource-balance harness (spark_rapids_tpu/analysis/reswatch.py):
+    snapshot every registered resource kind at test entry — permit pools,
+    device semaphore slots, scheduler admission registries, spill-catalog
+    buffers, open span/ledger/flock scopes, the fault-injector refcount,
+    live engine threads, open fds — and assert at teardown that the test
+    put every one of them back. The runtime complement of the static
+    resource-lifecycle pass: what the CFG calls an ownership transfer
+    must still balance here.
+
+    Gating: armed for the scheduler/serve tier-1 suites and every
+    chaos-marked test; SRT_RESWATCH=1 arms it for EVERY test,
+    SRT_RESWATCH=0 disables it entirely (plain pytest runs stay cheap —
+    unarmed tests pay nothing)."""
+    env = os.environ.get("SRT_RESWATCH", "")
+    if env in ("0", "off", "false"):
+        yield
+        return
+    module = getattr(request.node, "module", None)
+    name = getattr(module, "__name__", "").rsplit(".", 1)[-1]
+    armed = (
+        env in ("1", "on", "true", "all")
+        or name in _RESWATCH_MODULES
+        or request.node.get_closest_marker("chaos") is not None
+    )
+    if not armed:
+        yield
+        return
+    from spark_rapids_tpu.analysis import reswatch
+
+    reswatch.install()  # idempotent; assertions are snapshot-relative
+    snap = reswatch.snapshot()
+    yield
+    rep = reswatch.report(snap)
+    assert rep.ok, rep.describe()
+
 
 @pytest.fixture(autouse=True)
 def _lockwatch_harness(request):

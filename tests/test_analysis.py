@@ -499,6 +499,22 @@ def test_repo_is_lint_clean():
     assert result.ok, rendered
 
 
+def test_flow_passes_ride_the_default_suite():
+    """The ISSUE-15 flow passes are part of the default pass set — the
+    repo-wide meta-test above (and therefore tier-1 and `make lint`)
+    cannot silently drop them, and no protected-dir finding of theirs
+    can hide in the baseline."""
+    from spark_rapids_tpu.analysis.passes import all_passes
+
+    ids = {p.id for p in all_passes()}
+    assert {"resource-lifecycle", "guarded-by"} <= ids
+    baseline = load_baseline(default_baseline_path(ROOT))
+    for e in baseline.entries:
+        if e.pass_id in ("resource-lifecycle", "guarded-by"):
+            for prot in PROTECTED_DIRS:
+                assert not e.path.startswith(prot)
+
+
 def test_fingerprint_stability():
     """Baseline fingerprints survive line drift: inserting lines above a
     finding must not change its fingerprint."""

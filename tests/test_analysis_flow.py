@@ -1,0 +1,625 @@
+"""graft-flow (ISSUE 15): CFG/dataflow engine fixtures, the
+resource-lifecycle and guarded-by passes (positive / negative /
+suppressed / annotated), the seeded PR-7 bug shapes both passes exist to
+catch, the JSON findings output, and the reswatch runtime harness."""
+import json
+import os
+import threading
+
+import pytest
+
+from spark_rapids_tpu.analysis import Project, run_passes
+from spark_rapids_tpu.analysis.passes.guarded_by import PASS as GUARD_PASS
+from spark_rapids_tpu.analysis.passes.resource_lifecycle import (
+    PASS as LIFE_PASS,
+)
+
+ROOT = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+
+
+def _mini(tmp_path, files: dict) -> Project:
+    for rel, src in files.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(src)
+    return Project.load(str(tmp_path))
+
+
+def _run(project, passes):
+    return run_passes(project, passes, baseline=None)
+
+
+# ── the CFG itself ──────────────────────────────────────────────────────────
+
+
+def test_cfg_models_try_finally_and_exception_edges():
+    import ast
+
+    from spark_rapids_tpu.analysis.flow import build_cfg
+
+    src = (
+        "def f(pool):\n"
+        "    g = pool.acquire(2)\n"
+        "    try:\n"
+        "        work()\n"
+        "    finally:\n"
+        "        pool.release(g)\n"
+    )
+    fn = ast.parse(src).body[0]
+    cfg = build_cfg(fn)
+    kinds = {n.kind for n in cfg.nodes}
+    assert "finally" in kinds
+    # work() can raise: it must carry an except edge into the finally
+    work = next(
+        n for n in cfg.nodes
+        if n.stmt is not None and n.lineno == 4
+    )
+    assert any(k == "except" for (_t, k) in work.succ)
+
+
+# ── resource-lifecycle: the seeded PR-7 permit-leak shape ───────────────────
+
+
+def test_permit_leak_on_exception_edge(tmp_path):
+    """The PR-7 bug: permits acquired at admission, released after the
+    first batch — any raise in between leaks them. The finding must
+    print the full leaking path."""
+    proj = _mini(tmp_path, {
+        "spark_rapids_tpu/sched/leaky.py": (
+            "def admit_and_run(pool, plan):\n"
+            "    granted = pool.acquire(4)\n"
+            "    first_batch = run(plan)\n"
+            "    pool.release(granted)\n"
+            "    return first_batch\n"
+        ),
+    })
+    r = _run(proj, [LIFE_PASS])
+    assert len(r.findings) == 1
+    msg = r.findings[0].message
+    assert r.findings[0].line == 2
+    assert "scheduler/device permits" in msg
+    # the leaking path is printed file:line by file:line, with the
+    # raising statement marked
+    assert "leaky.py:3 (raises)" in msg
+    assert "exit (exception propagates)" in msg
+
+
+def test_permit_leak_fixed_by_finally(tmp_path):
+    proj = _mini(tmp_path, {
+        "spark_rapids_tpu/sched/fixed.py": (
+            "def admit_and_run(pool, plan):\n"
+            "    granted = pool.acquire(4)\n"
+            "    try:\n"
+            "        return run(plan)\n"
+            "    finally:\n"
+            "        pool.release(granted)\n"
+        ),
+    })
+    assert not _run(proj, [LIFE_PASS]).findings
+
+
+def test_leak_on_except_edge_only(tmp_path):
+    """An except handler that re-raises without releasing leaks even when
+    the happy path releases."""
+    proj = _mini(tmp_path, {
+        "spark_rapids_tpu/sched/partial.py": (
+            "def f(pool):\n"
+            "    g = pool.acquire(1)\n"
+            "    try:\n"
+            "        work()\n"
+            "    except ValueError:\n"
+            "        raise\n"
+            "    pool.release(g)\n"
+        ),
+    })
+    r = _run(proj, [LIFE_PASS])
+    assert len(r.findings) == 1
+
+
+def test_ownership_transfer_is_not_a_leak(tmp_path):
+    proj = _mini(tmp_path, {
+        "spark_rapids_tpu/sched/xfer.py": (
+            "def enter(self, pool):\n"
+            "    self._granted = pool.acquire(2)\n"   # stored on owner
+            "def dial(addr):\n"
+            "    sock = socket.socket()\n"
+            "    return wrap(sock)\n"                  # returned
+            "def spawn(work):\n"
+            "    t = threading.Thread(target=work, daemon=True)\n"
+            "    t.start()\n"                          # daemon: exempt
+        ),
+    })
+    assert not _run(proj, [LIFE_PASS]).findings
+
+
+def test_with_acquire_is_balanced(tmp_path):
+    proj = _mini(tmp_path, {
+        "spark_rapids_tpu/exec/withok.py": (
+            "def f(path):\n"
+            "    with open(path) as fh:\n"
+            "        return fh.read()\n"
+        ),
+    })
+    assert not _run(proj, [LIFE_PASS]).findings
+
+
+def test_socket_leak_and_suppression(tmp_path):
+    proj = _mini(tmp_path, {
+        "spark_rapids_tpu/shuffle/dial.py": (
+            "import socket\n"
+            "def leaky(addr):\n"
+            "    sock = socket.create_connection(addr)\n"
+            "    handshake(sock.fileno())\n"          # arg is not sock
+            "def acknowledged(addr):\n"
+            "    # graft: ok(resource-lifecycle: test fixture)\n"
+            "    sock = socket.create_connection(addr)\n"
+            "    handshake(sock.fileno())\n"
+        ),
+    })
+    r = _run(proj, [LIFE_PASS])
+    # sock.fileno() inside handshake's args references sock → transfer;
+    # build a truly leaking variant to assert the positive
+    proj2 = _mini(tmp_path / "b", {
+        "spark_rapids_tpu/shuffle/dial.py": (
+            "import socket\n"
+            "def leaky(addr):\n"
+            "    sock = socket.create_connection(addr)\n"
+            "    handshake(addr)\n"
+            "def acknowledged(addr):\n"
+            "    # graft: ok(resource-lifecycle: test fixture)\n"
+            "    sock = socket.create_connection(addr)\n"
+            "    handshake(addr)\n"
+        ),
+    })
+    r2 = _run(proj2, [LIFE_PASS])
+    assert len(r2.findings) == 1 and r2.findings[0].line == 3
+    assert len(r2.suppressed) == 1
+
+
+def test_stale_injector_shape_manual_enter(tmp_path):
+    """The PR-7 stale-injector bug class: a fault scope entered manually
+    and not exited on the error path resurrects the injector for later
+    queries. The scope kind (explicit __enter__) catches it."""
+    proj = _mini(tmp_path, {
+        "spark_rapids_tpu/exec/inject.py": (
+            "def leaky(cfg):\n"
+            "    ctx = scoped(cfg)\n"
+            "    inj = ctx.__enter__()\n"
+            "    run_queries(inj)\n"
+            "    ctx.__exit__(None, None, None)\n"
+            "def balanced(cfg):\n"
+            "    ctx = scoped(cfg)\n"
+            "    inj = ctx.__enter__()\n"
+            "    try:\n"
+            "        run_queries(inj)\n"
+            "    finally:\n"
+            "        ctx.__exit__(None, None, None)\n"
+        ),
+    })
+    r = _run(proj, [LIFE_PASS])
+    assert len(r.findings) == 1 and r.findings[0].line == 3
+    assert "context scope" in r.findings[0].message
+
+
+def test_flock_release_via_close_and_closure(tmp_path):
+    proj = _mini(tmp_path, {
+        "spark_rapids_tpu/cache/locks2.py": (
+            "import fcntl\n"
+            "def balanced(path):\n"
+            "    f = open(path, 'ab')\n"
+            "    try:\n"
+            "        fcntl.flock(f.fileno(), fcntl.LOCK_EX)\n"
+            "    finally:\n"
+            "        f.close()\n"                      # close releases
+            "def leaky(path):\n"
+            "    f = open(path, 'ab')\n"
+            "    fcntl.flock(f.fileno(), fcntl.LOCK_EX)\n"
+            "    might_raise()\n"
+            "    fcntl.flock(f.fileno(), fcntl.LOCK_UN)\n"
+            "    f.close()\n"
+        ),
+    })
+    r = _run(proj, [LIFE_PASS])
+    # the leaky variant leaks BOTH the file and the flock
+    lines = sorted(f.line for f in r.findings)
+    assert lines == [9, 10]
+
+
+def test_correlated_conditional_release(tmp_path):
+    """`if span is not None: span.__exit__(...)` — the branch condition
+    names the resource, so the non-releasing branch is the
+    never-acquired case, not a leak."""
+    proj = _mini(tmp_path, {
+        "spark_rapids_tpu/sched/span2.py": (
+            "def f(tracer):\n"
+            "    span = tracer.span('x') if tracer else None\n"
+            "    try:\n"
+            "        if span is not None:\n"
+            "            span.__enter__()\n"
+            "        work()\n"
+            "    finally:\n"
+            "        if span is not None:\n"
+            "            span.__exit__(None, None, None)\n"
+        ),
+    })
+    assert not _run(proj, [LIFE_PASS]).findings
+
+
+def test_same_module_release_summary(tmp_path):
+    """A call into a same-module helper that performs the release counts
+    as a release at the call site (one-level summaries)."""
+    proj = _mini(tmp_path, {
+        "spark_rapids_tpu/sched/helper.py": (
+            "def f(pool):\n"
+            "    g = pool.acquire(1)\n"
+            "    try:\n"
+            "        work()\n"
+            "    finally:\n"
+            "        give_back(pool, g)\n"
+            "def give_back(pool, g):\n"
+            "    pool.release(g)\n"
+        ),
+    })
+    assert not _run(proj, [LIFE_PASS]).findings
+
+
+# ── guarded-by ──────────────────────────────────────────────────────────────
+
+
+def test_guarded_by_annotation_flags_bare_access(tmp_path):
+    proj = _mini(tmp_path, {
+        "spark_rapids_tpu/sched/guard1.py": (
+            "import threading\n"
+            "class Pool:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"
+            "        self._queues = {}  # graft: guarded_by(_lock)\n"
+            "    def ok(self):\n"
+            "        with self._lock:\n"
+            "            return len(self._queues)\n"
+            "    def bare_read(self):\n"
+            "        return len(self._queues)\n"
+            "    def bare_write(self, k):\n"
+            "        self._queues[k] = []\n"
+        ),
+    })
+    r = _run(proj, [GUARD_PASS])
+    assert len(r.findings) == 2
+    msgs = "\n".join(f.message for f in r.findings)
+    assert "read of Pool._queues" in msgs
+    assert "write to Pool._queues" in msgs
+
+
+def test_guarded_by_wrong_lock(tmp_path):
+    proj = _mini(tmp_path, {
+        "spark_rapids_tpu/serve/guard2.py": (
+            "import threading\n"
+            "class S:\n"
+            "    def __init__(self):\n"
+            "        self._a = threading.Lock()\n"
+            "        self._b = threading.Lock()\n"
+            "        self._conns = set()  # graft: guarded_by(_a)\n"
+            "    def f(self):\n"
+            "        with self._b:\n"
+            "            self._conns.add(1)\n"
+        ),
+    })
+    r = _run(proj, [GUARD_PASS])
+    assert len(r.findings) == 1
+    assert "DIFFERENT lock" in r.findings[0].message
+
+
+def test_guarded_by_inference_majority(tmp_path):
+    """Majority-of-sites inference: 5 locked sites (with a write) + 1
+    bare site → the bare site is flagged, no annotation needed."""
+    body_locked = "".join(
+        f"    def m{i}(self):\n"
+        "        with self._lock:\n"
+        "            self._state['k'] = 1\n"
+        for i in range(5)
+    )
+    proj = _mini(tmp_path, {
+        "spark_rapids_tpu/shuffle/guard3.py": (
+            "import threading\n"
+            "class M:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"
+            "        self._state = {}\n"
+            + body_locked +
+            "    def bare(self):\n"
+            "        return self._state.get('k')\n"
+        ),
+    })
+    r = _run(proj, [GUARD_PASS])
+    assert len(r.findings) == 1
+    assert "inferred from 5/6 sites" in r.findings[0].message
+
+
+def test_guarded_by_annotation_overrides_inference(tmp_path):
+    """An annotation is ground truth even where majority evidence points
+    at another lock."""
+    body = "".join(
+        f"    def m{i}(self):\n"
+        "        with self._other:\n"
+        "            self._state['k'] = 1\n"
+        for i in range(5)
+    )
+    proj = _mini(tmp_path, {
+        "spark_rapids_tpu/shuffle/guard4.py": (
+            "import threading\n"
+            "class M:\n"
+            "    def __init__(self):\n"
+            "        self._real = threading.Lock()\n"
+            "        self._other = threading.Lock()\n"
+            "        self._state = {}  # graft: guarded_by(_real)\n"
+            + body
+        ),
+    })
+    r = _run(proj, [GUARD_PASS])
+    # every _other-locked site violates the annotated guard
+    assert len(r.findings) == 5
+    assert all("DIFFERENT lock" in f.message for f in r.findings)
+
+
+def test_guarded_by_helper_inherits_lock(tmp_path):
+    """A private helper called only under the lock inherits it — the
+    _grant_locked/_dispatch chain must stay clean."""
+    proj = _mini(tmp_path, {
+        "spark_rapids_tpu/sched/guard5.py": (
+            "import threading\n"
+            "class P:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"
+            "        self._n = 0  # graft: guarded_by(_lock)\n"
+            "    def acquire(self):\n"
+            "        with self._lock:\n"
+            "            self._dispatch()\n"
+            "    def release(self):\n"
+            "        with self._lock:\n"
+            "            self._dispatch()\n"
+            "    def _dispatch(self):\n"
+            "        self._grant()\n"
+            "    def _grant(self):\n"
+            "        self._n += 1\n"
+        ),
+    })
+    assert not _run(proj, [GUARD_PASS]).findings
+
+
+def test_guarded_by_module_global_annotation(tmp_path):
+    proj = _mini(tmp_path, {
+        "spark_rapids_tpu/cache/guard6.py": (
+            "import threading\n"
+            "_LOCK = threading.Lock()\n"
+            "_MEMO = {}  # graft: guarded_by(_LOCK)\n"
+            "def ok(k, v):\n"
+            "    with _LOCK:\n"
+            "        _MEMO[k] = v\n"
+            "def bare(k):\n"
+            "    return _MEMO.get(k)\n"
+            "def acknowledged(k):\n"
+            "    # graft: ok(guarded-by: test fixture)\n"
+            "    return _MEMO.get(k)\n"
+        ),
+    })
+    r = _run(proj, [GUARD_PASS])
+    assert len(r.findings) == 1 and r.findings[0].line == 8
+    assert len(r.suppressed) == 1
+
+
+def test_guarded_by_unknown_lock_annotation(tmp_path):
+    proj = _mini(tmp_path, {
+        "spark_rapids_tpu/sched/guard7.py": (
+            "import threading\n"
+            "class P:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"
+            "        self._x = 0  # graft: guarded_by(_nope)\n"
+        ),
+    })
+    r = _run(proj, [GUARD_PASS])
+    assert len(r.findings) == 1
+    assert "no lock attribute" in r.findings[0].message
+
+
+def test_guarded_by_init_exempt_and_dict_idiom(tmp_path):
+    proj = _mini(tmp_path, {
+        "spark_rapids_tpu/serve/guard8.py": (
+            "import threading\n"
+            "class S:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"
+            "        self._cache = {}  # graft: guarded_by(_lock)\n"
+            "        self._cache['warm'] = 1\n"        # __init__: exempt
+            "    def ok(self):\n"
+            "        with self._lock:\n"
+            "            return self.__dict__.get('_cache')\n"
+            "    def bare(self):\n"
+            "        return self.__dict__.get('_cache')\n"
+        ),
+    })
+    r = _run(proj, [GUARD_PASS])
+    assert len(r.findings) == 1 and r.findings[0].line == 11
+
+
+# ── the JSON findings output ────────────────────────────────────────────────
+
+
+def test_json_format_output(tmp_path, capsys):
+    from spark_rapids_tpu.analysis.__main__ import main
+
+    _mini(tmp_path, {
+        "spark_rapids_tpu/sched/leaky.py": (
+            "def f(pool):\n"
+            "    g = pool.acquire(1)\n"
+            "    work()\n"
+            "    pool.release(g)\n"
+            "def g2(pool):\n"
+            "    # graft: ok(resource-lifecycle: fixture)\n"
+            "    h = pool.acquire(1)\n"
+            "    work()\n"
+            "    pool.release(h)\n"
+        ),
+    })
+    rc = main([str(tmp_path), "--format", "json",
+               "--passes", "resource-lifecycle"])
+    doc = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    assert doc["ok"] is False
+    assert doc["counts"] == {
+        "fail": 1, "suppressed": 1, "baselined": 0, "framework": 0,
+    }
+    states = {f["state"] for f in doc["findings"]}
+    assert states == {"fail", "suppressed"}
+    for f in doc["findings"]:
+        assert set(f) == {
+            "pass", "path", "line", "fingerprint", "message", "state",
+        }
+        assert f["pass"] == "resource-lifecycle"
+        assert f["fingerprint"]
+
+
+def test_json_format_clean_exit_zero(tmp_path, capsys):
+    from spark_rapids_tpu.analysis.__main__ import main
+
+    _mini(tmp_path, {"spark_rapids_tpu/empty.py": "x = 1\n"})
+    rc = main([str(tmp_path), "--format", "json"])
+    doc = json.loads(capsys.readouterr().out)
+    assert rc == 0 and doc["ok"] is True
+
+
+# ── baseline round-trip for the new pass names ──────────────────────────────
+
+
+def test_new_passes_baseline_roundtrip(tmp_path):
+    from spark_rapids_tpu.analysis import (
+        Baseline,
+        load_baseline,
+        write_baseline,
+    )
+
+    proj = _mini(tmp_path, {
+        "spark_rapids_tpu/shuffle/leak3.py": (
+            "import socket\n"
+            "def f(addr):\n"
+            "    sock = socket.create_connection(addr)\n"
+            "    handshake(addr)\n"
+        ),
+    })
+    bl_path = str(tmp_path / "BASELINE.lint")
+    r = _run(proj, [LIFE_PASS])
+    assert len(r.findings) == 1
+    write_baseline(bl_path, r.findings, Baseline(bl_path), justify="legacy")
+    r2 = run_passes(proj, [LIFE_PASS], baseline=load_baseline(bl_path))
+    assert r2.ok and len(r2.baselined) == 1
+
+
+# ── reswatch (runtime harness) ──────────────────────────────────────────────
+
+
+def test_reswatch_balanced_scopes():
+    from spark_rapids_tpu.analysis import reswatch as rw
+    from spark_rapids_tpu.obs.trace import Tracer
+
+    rw.install()
+    try:
+        snap = rw.snapshot()
+        tr = Tracer()
+        with tr.span("work", "op"):
+            pass
+        rep = rw.report(snap, grace_s=0.5)
+        assert rep.ok, rep.describe()
+    finally:
+        rw.uninstall()
+
+
+def test_reswatch_detects_unexited_span():
+    from spark_rapids_tpu.analysis import reswatch as rw
+    from spark_rapids_tpu.obs.trace import Tracer
+
+    rw.install()
+    try:
+        snap = rw.snapshot()
+        tr = Tracer()
+        span = tr.span("leaky", "op")
+        span.__enter__()                      # never exited
+        rep = rw.report(snap, grace_s=0.2)
+        assert not rep.ok
+        assert "span" in rep.describe()
+        span.__exit__(None, None, None)
+        assert rw.report(snap, grace_s=0.5).ok
+    finally:
+        rw.uninstall()
+
+
+def test_reswatch_detects_held_permits():
+    from spark_rapids_tpu.analysis import reswatch as rw
+
+    rw.install()
+    try:
+        from spark_rapids_tpu.sched.admission import WeightedPermitPool
+
+        snap = rw.snapshot()
+        pool = WeightedPermitPool(permits=4, max_queued=4)
+        granted = pool.acquire(2, "t")
+        rep = rw.report(snap, grace_s=0.2)
+        assert not rep.ok and "permit" in rep.describe()
+        pool.release(granted, "t")
+        assert rw.report(snap, grace_s=0.5).ok, rw.report(snap).describe()
+    finally:
+        rw.uninstall()
+
+
+def test_reswatch_detects_stale_fault_injector():
+    from spark_rapids_tpu.analysis import reswatch as rw
+    from spark_rapids_tpu.resilience import faults
+
+    rw.install()
+    try:
+        snap = rw.snapshot()
+        ctx = faults.scoped(faults.FaultConfig(seed=1))
+        ctx.__enter__()                       # the stale-injector shape
+        rep = rw.report(snap, grace_s=0.2)
+        assert not rep.ok and "fault injector" in rep.describe()
+        ctx.__exit__(None, None, None)
+        assert rw.report(snap, grace_s=0.5).ok
+    finally:
+        rw.uninstall()
+
+
+def test_reswatch_install_scoping_and_idempotence():
+    """install() twice is one patch; uninstall() restores the original
+    class methods; snapshot-relative counting ignores pre-install
+    state."""
+    from spark_rapids_tpu.analysis import reswatch as rw
+    from spark_rapids_tpu.obs import trace as OT
+
+    orig_enter = OT._OpenSpan.__enter__
+    rw.install()
+    rw.install()
+    patched = OT._OpenSpan.__enter__
+    assert patched is not orig_enter
+    rw.uninstall()
+    assert OT._OpenSpan.__enter__ is orig_enter
+    rw.uninstall()                            # second uninstall: no-op
+    assert OT._OpenSpan.__enter__ is orig_enter
+
+
+def test_reswatch_thread_balance():
+    from spark_rapids_tpu.analysis import reswatch as rw
+
+    rw.install()
+    try:
+        snap = rw.snapshot()
+        stop = threading.Event()
+        t = threading.Thread(
+            target=stop.wait, name="tpu-serve-fake", daemon=True
+        )
+        t.start()
+        rep = rw.report(snap, grace_s=0.2)
+        assert not rep.ok and "tpu-serve-fake" in rep.describe()
+        stop.set()
+        t.join()
+        assert rw.report(snap, grace_s=2.0).ok
+    finally:
+        rw.uninstall()
