@@ -112,6 +112,16 @@ bench-dry:
 	BENCH_PLATFORM=cpu BENCH_SF=0.02 BENCH_PARTITIONS=2 \
 	  BENCH_SHUFFLE_PARTITIONS=2 BENCH_RUNS=1 $(PY) bench.py
 
+# The recorded BENCH_r06 invocation: full TPC-H on the real TPU backend
+# with whole-stage fusion + shape bucketing (default-on) and calibrated
+# engine routing enabled. BENCH_ASSERT_BACKEND makes the rig exit 2 if the
+# process initialized anything but a TPU — a CPU smoke run must never ship
+# under the r06 label. The result JSON lands in BENCH_r06.json.
+.PHONY: bench-r06
+bench-r06:
+	BENCH_ASSERT_BACKEND=tpu BENCH_OUT=BENCH_r06.json BENCH_ROUTING=1 \
+	  $(PY) bench.py
+
 # Start the Arrow-IPC SQL endpoint with the TPC-H demo catalog registered
 # as temp views (docs/serving.md). Connect with:
 #   python -c "from spark_rapids_tpu.serve import connect; \

@@ -47,8 +47,30 @@ MIN_STR_WIDTH = 8
 
 
 def bucket_capacity(n: int) -> int:
-    """Round a row count up to the next power of two (>= MIN_CAPACITY) so the
-    number of distinct compiled shapes per schema is logarithmic."""
+    """Round a row count up to the shape-bucket lattice: the next power of
+    two at or above ``kernels.shape_bucket_floor()`` (>= MIN_CAPACITY), so
+    the number of distinct compiled shapes per schema is logarithmic AND
+    every batch below the floor shares ONE geometry — one cached executable
+    serves them all (spark.rapids.tpu.shapeBuckets.*). Padding rows above
+    ``num_rows`` are masked inert by the batch invariant."""
+    from .. import kernels as K
+
+    cap = K.shape_bucket_floor()
+    if cap < MIN_CAPACITY:
+        cap = MIN_CAPACITY
+    while cap < n:
+        cap <<= 1
+    return cap
+
+
+def tight_capacity(n: int) -> int:
+    """Round a row count up to the next power of two >= MIN_CAPACITY,
+    ignoring the shape-bucket lattice floor. The shrink-to-fit path
+    (ops/gather.shrink_one) exists to CUT device footprint before
+    non-splittable merges and D2H packing; re-bucketing it to the lattice
+    floor would pin tiny batches (13-group partial-aggregate outputs) at
+    the ingest geometry and re-inflate exactly the buffers it is meant to
+    shrink."""
     cap = MIN_CAPACITY
     while cap < n:
         cap <<= 1
@@ -362,23 +384,37 @@ def host_to_device(
     batch instead of one per buffer. ``max_str_bytes``
     (spark.rapids.tpu.string.maxBytes) caps the padded string width the
     fixed-width layout will materialize."""
+    import time as _time
+
+    from ..obs import ledger as _ledger
+    from ..obs import metrics as _metrics
+
     n = rb.num_rows
     cap = capacity or bucket_capacity(max(n, 1))
     schema = Schema.from_arrow(rb.schema)
     host_cols = []
-    for i, field in enumerate(schema):
-        arr = rb.column(i)
-        if isinstance(arr, pa.ChunkedArray):  # pragma: no cover - RecordBatch cols are flat
-            arr = arr.combine_chunks()
-        host_cols.append(
-            _np_col_from_arrow(
-                arr,
-                field.data_type,
-                cap,
-                (str_widths or {}).get(i),
-                max_str_bytes,
+    # padding to the bucketed capacity is host work worth attributing: the
+    # shape-bucket lattice trades it for compile reuse, and the ledger's
+    # exclusive `pad` phase (carved out of the enclosing h2d scope) is how
+    # the trade stays measurable per query
+    t0 = _time.perf_counter_ns()
+    with _ledger.phase("pad"):
+        for i, field in enumerate(schema):
+            arr = rb.column(i)
+            if isinstance(arr, pa.ChunkedArray):  # pragma: no cover - RecordBatch cols are flat
+                arr = arr.combine_chunks()
+            host_cols.append(
+                _np_col_from_arrow(
+                    arr,
+                    field.data_type,
+                    cap,
+                    (str_widths or {}).get(i),
+                    max_str_bytes,
+                )
             )
-        )
+    _metrics.GLOBAL.timer("batch.padTimeNs").add(
+        _time.perf_counter_ns() - t0
+    )
     num_rows, cols = jax.device_put((np.asarray(n, np.int32), host_cols))
     return DeviceBatch(schema, list(cols), num_rows)
 

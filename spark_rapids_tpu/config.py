@@ -541,6 +541,61 @@ PRECOMPILE_PARALLELISM = conf("spark.rapids.tpu.precompile.parallelism").doc(
     "automatically (1 on the CPU backend, up to 4 elsewhere)."
 ).int_conf(0)
 
+FUSION_ENABLED = conf("spark.rapids.tpu.fusion.enabled").doc(
+    "Whole-stage fusion (plan/fusion.py): maximal chains of adjacent "
+    "device project/filter operators collapse into a single StageExec "
+    "whose body is ONE jitted XLA program — one kernel launch (and one "
+    "downstream D2H sync) per stage instead of one per operator. "
+    "Bit-identical to per-op execution by construction; chains break at "
+    "task-dependent expressions (row_base semantics) and at kernels with "
+    "ANSI error sites (their per-op error channel must keep its batch "
+    "attribution). Kill switch for the fused path."
+).boolean_conf(True)
+
+FUSION_MAX_OPS = conf("spark.rapids.tpu.fusion.maxOps").doc(
+    "Maximum operators fused into one StageExec program; longer chains "
+    "split into consecutive stages. Bounds single-program XLA trace and "
+    "compile time."
+).int_conf(16)
+
+SHAPE_BUCKETS_ENABLED = conf("spark.rapids.tpu.shapeBuckets.enabled").doc(
+    "Pow-2 shape-bucket lattice (kernels.shape_bucket_floor): batch "
+    "capacities round up to at least shapeBuckets.minRows, so one cached "
+    "XLA executable serves every batch geometry inside the bucket — "
+    "first-touch compiles amortize across batch sizes and the persistent "
+    "xla_store entry count collapses for warm restarts. Padding rows are "
+    "masked inert (the existing capacity > num_rows invariant); results "
+    "are bit-identical. Off restores exact pow-2-of-row-count capacities."
+).boolean_conf(True)
+
+SHAPE_BUCKETS_MIN_ROWS = conf("spark.rapids.tpu.shapeBuckets.minRows").doc(
+    "Floor of the shape-bucket lattice: the smallest batch capacity the "
+    "engine compiles for (rounded up to a power of two). Larger floors "
+    "mean fewer distinct compiled shapes at the cost of more masked "
+    "padding per small batch."
+).int_conf(1024)
+
+ROUTING_ENABLED = conf("spark.rapids.tpu.routing.enabled").doc(
+    "Calibrated engine routing (plan/overrides.py): with a measured cost "
+    "table present (obs/calibration.py), predict each device island's "
+    "device time (ns/row x estimated rows + per-launch and transfer "
+    "overheads) against its CPU-engine time and route sub-threshold "
+    "islands — the tiny-input, full-dispatch-tax shape — back to the CPU "
+    "engine, with the prediction and its numbers in the explain reason. "
+    "Off (default), or with no calibration data, planning is unchanged."
+).boolean_conf(False)
+
+ROUTING_LAUNCH_OVERHEAD_NS = conf("spark.rapids.tpu.routing.launchOverheadNs").doc(
+    "Fixed per-kernel-launch host overhead the routing predictor charges "
+    "each device operator (dispatch + enqueue tax measured by the "
+    "attribution ledger's dispatch phase)."
+).int_conf(1_500_000)
+
+ROUTING_TRANSFER_OVERHEAD_NS = conf("spark.rapids.tpu.routing.transferOverheadNs").doc(
+    "Fixed per-island transfer overhead the routing predictor charges a "
+    "device island (H2D upload + D2H result round trip on the PJRT link)."
+).int_conf(4_000_000)
+
 UPLOAD_CACHE_MAX_BYTES = conf("spark.rapids.tpu.uploadCache.maxBytes").doc(
     "Byte budget for the session's device-upload (H2D) cache of in-memory "
     "relations — the LRU bound standing between many-table sessions and "

@@ -423,9 +423,13 @@ class DeviceToHostExec(Exec):
                         # the count came back with the failed speculation —
                         # shrink without a second sync (and skip bulk_shrink,
                         # whose row-count fetch would re-pay that sync)
-                        shrunk = [shrink_one(chunk[0], n_true)]
+                        shrunk = [shrink_one(chunk[0], n_true, tight=False)]
                 if shrunk is None:
-                    shrunk = bulk_shrink(chunk)
+                    # lattice-quantized (tight=False): the pack kernel keeps
+                    # one stable geometry per shape bucket instead of
+                    # compiling per live-row count — still cuts sparse
+                    # multi-k capacities down to the floor
+                    shrunk = bulk_shrink(chunk, tight=False)
                 # merge SMALL shrunk batches on device: every pull is a full
                 # tunnel round trip, so 8 tiny result batches as one packed
                 # transfer beat 8 separate ones by ~8 RTTs

@@ -103,6 +103,29 @@ def set_compile_deadline(seconds: float) -> None:
     _COMPILE_DEADLINE_S[0] = max(0.0, float(seconds))
 
 
+# ── shape-bucket lattice ────────────────────────────────────────────────────
+# Compile-geometry policy: batch capacities round up to a pow-2 lattice with
+# this floor (columnar/device.py bucket_capacity reads it), so one cached
+# executable serves every batch geometry inside a bucket. Process-global
+# like the kernel cache whose entry count it bounds: the session stamps it
+# at init and on set_conf (spark.rapids.tpu.shapeBuckets.*). Boxed so
+# readers never race a rebind. The floor never drops below 8 (MIN_CAPACITY
+# — the lattice degenerates to plain pow-2-of-row-count bucketing there).
+_SHAPE_BUCKET_FLOOR = [8]
+
+
+def set_shape_bucket_floor(rows: int) -> None:
+    """Install the lattice floor, rounded up to a power of two (>= 8)."""
+    f = 8
+    while f < min(int(rows), 1 << 24):
+        f <<= 1
+    _SHAPE_BUCKET_FLOOR[0] = f
+
+
+def shape_bucket_floor() -> int:
+    return _SHAPE_BUCKET_FLOOR[0]
+
+
 #: set on the deadline helper thread: a NESTED first-touch compile inside
 #: the guarded region (a fused kernel tracing into a cached sub-kernel's
 #: first call) must run inline there — the outer budget already bounds the

@@ -12,6 +12,11 @@ nanoseconds per phase:
     ``compile``      — XLA first-touch trace+compile and pre-compilation
                        warms (``kernels.GuardedJit``)
     ``h2d``          — host→device upload (``HostToDeviceExec``)
+    ``pad``          — shape-bucket padding: filling batches out to the
+                       pow-2 lattice capacity before upload
+                       (``columnar/device.py host_to_device``; nested
+                       inside the h2d scope, so the exclusive design
+                       carves it out rather than double-counting)
     ``dispatch``     — upstream batch production: kernel enqueue + operator
                        host work (pipeline producer pulls / the direct pull
                        loop / ``run_device`` launches)
@@ -54,6 +59,7 @@ PHASES = (
     "queue_wait",
     "compile",
     "h2d",
+    "pad",
     "dispatch",
     "device_execute",
     "d2h",

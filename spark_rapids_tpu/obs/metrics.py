@@ -418,6 +418,11 @@ CATALOG: Iterable[tuple] = (
     ("spill.bytesHostToDisk", MetricKind.COUNTER, "bytes spilled host RAM → disk"),
     ("spill.bytesDiskToHost", MetricKind.COUNTER, "bytes re-materialized disk → host RAM"),
     ("spill.count", MetricKind.COUNTER, "tier-transition spill operations"),
+    # columnar/device.py — shape-bucket padding overhead (the lattice's
+    # cost side; the ledger's `pad` phase is the per-query view)
+    ("batch.padTimeNs", MetricKind.NANOS,
+     "host time padding batches out to the pow-2 shape-bucket lattice "
+     "capacity before H2D upload (spark.rapids.tpu.shapeBuckets.*)"),
     ("mem.deviceBytesHighWatermark", MetricKind.WATERMARK,
      "peak registered spillable bytes on device, sampled at batch boundaries"),
     # mem/semaphore.py — admission control

@@ -31,13 +31,23 @@ def gather_batch(batch: DeviceBatch, idx: jax.Array, new_num_rows) -> DeviceBatc
     return DeviceBatch(batch.schema, cols, jnp.asarray(new_num_rows, jnp.int32))
 
 
-def shrink_one(batch: DeviceBatch, n: int) -> DeviceBatch:
+def shrink_one(batch: DeviceBatch, n: int, tight: bool = True) -> DeviceBatch:
     """Re-bucket a batch to the capacity its ``n`` live rows need (no-op when
-    already tight). Cached fused kernel per (schema, in-cap, out-cap)."""
-    from ..columnar.device import bucket_capacity
+    already tight). Cached fused kernel per (schema, in-cap, out-cap).
+
+    ``tight=True`` (default) uses the raw pow-2 capacity, ignoring the
+    shape-bucket lattice: footprint-critical sites (pre-merge concat, OOM
+    split/retry, exchange slicing) need tiny batches to actually BE tiny —
+    a 1024-row lattice floor would make shrinking a no-op for exactly the
+    13-group partial-aggregate outputs it exists for. ``tight=False``
+    quantizes to the lattice instead: the local D2H pack window uses it so
+    collect-tail pack kernels keep ONE stable geometry per bucket (still
+    cutting a 512k-capacity sparse batch to the floor) instead of
+    compiling per live-row count."""
+    from ..columnar.device import bucket_capacity, tight_capacity
     from .. import kernels as K
 
-    cap2 = bucket_capacity(max(n, 1))
+    cap2 = (tight_capacity if tight else bucket_capacity)(max(n, 1))
     if cap2 >= batch.capacity:
         return batch
     fn = K.kernel(
@@ -49,13 +59,16 @@ def shrink_one(batch: DeviceBatch, n: int) -> DeviceBatch:
     return fn(batch)
 
 
-def bulk_shrink(batches: list[DeviceBatch]) -> list[DeviceBatch]:
+def bulk_shrink(
+    batches: list[DeviceBatch], tight: bool = True
+) -> list[DeviceBatch]:
     """Re-bucket batches whose live prefix is much smaller than capacity
     (partial-aggregate outputs, selective filters). ONE bulk row-count fetch
     for the whole list — the work feeding every batch is already dispatched
     asynchronously, so the wait overlaps all of it instead of serializing
     per batch. Downstream kernels (exchange slicing, concat, merge sort,
-    D2H packing) then compile and run at the small capacities."""
+    D2H packing) then compile and run at the small capacities. ``tight``
+    forwards to ``shrink_one`` (lattice-quantized vs raw pow-2 targets)."""
     import numpy as np
 
     if not batches:
@@ -73,7 +86,7 @@ def bulk_shrink(batches: list[DeviceBatch]) -> list[DeviceBatch]:
         # mesh mode gathers batches from several chips: device_get pipelines
         # the per-device pulls (copy_to_host_async per leaf)
         counts = np.asarray(jax.device_get([b.num_rows for b in batches]))
-    return [shrink_one(b, int(n)) for b, n in zip(batches, counts)]
+    return [shrink_one(b, int(n), tight) for b, n in zip(batches, counts)]
 
 
 def partition_slices(batch: DeviceBatch, pids: jax.Array, nparts: int,

@@ -970,6 +970,18 @@ class TpuOverrides:
             if measured:
                 self._cbo_weights = measured
                 self._cbo_source = "measured"
+        # calibrated engine routing: with measured per-op ns/row present,
+        # predict each device island's device-vs-host time and route
+        # sub-threshold islands (tiny input, full dispatch+transfer tax —
+        # the q6/q15 shape) back to the CPU engine. No calibration data or
+        # conf off: planning is unchanged.
+        self._routing_cal = None
+        if cfg.ROUTING_ENABLED.get(conf):
+            from ..obs import calibration as obs_cal
+
+            cal = obs_cal.get(cfg.CBO_CALIBRATION_FILE.get(conf))
+            if cal.snapshot():
+                self._routing_cal = cal
 
     def apply(self, plan: Exec) -> Exec:
         if not self.conf.is_enabled(cfg.SQL_ENABLED):
@@ -977,6 +989,8 @@ class TpuOverrides:
         converted = self._convert(plan)
         if self.conf.is_enabled(cfg.CBO_ENABLED):
             converted = self._cost_optimize(converted)
+        if self._routing_cal is not None:
+            converted = self._route(converted)
         if converted.is_device:
             # the query root funnels to the driver anyway (collect); merging
             # partitions ON DEVICE first lets the D2H window concatenate
@@ -1011,37 +1025,56 @@ class TpuOverrides:
                 w += self._island_weight(c)
         return w
 
-    def _unconvert_island(self, plan: Exec, weight: Optional[int] = None) -> Exec:
+    def _unconvert_island(
+        self,
+        plan: Exec,
+        weight: Optional[int] = None,
+        reason: Optional[str] = None,
+        again: Optional[Callable] = None,
+    ) -> Exec:
+        """Put a device island back on the CPU engine via each node's
+        ``_cpu_original`` seam. ``reason`` is the explain message (default:
+        the CBO island-weight wording, with the numeric detail only at the
+        root where ``weight`` is passed); ``again`` is the pass to resume on
+        the island's host children (default: CBO cost analysis — the
+        routing pass hands itself in)."""
+        if again is None:
+            again = self._cost_optimize
         if not plan.is_device:
-            return self._cost_optimize(plan)
-        kids = [self._unconvert_island(c) for c in plan.children]
+            return again(plan)
+        kids = [
+            self._unconvert_island(c, reason=reason, again=again)
+            for c in plan.children
+        ]
         orig = getattr(plan, "_cpu_original", None)
         if orig is None:
             return plan.with_new_children(kids)
-        detail = (
-            f" ({self._cbo_source} weights: island {weight} < "
-            f"transition cost {self._CBO_TRANSITION_COST})"
-            if weight is not None
-            else ""
-        )
-        self.explain.append(
-            ExplainEntry(
-                orig.node_string(),
-                False,
-                [
-                    "cost-based optimizer: island too small to pay "
-                    f"transitions{detail}"
-                ],
+        if reason is None:
+            detail = (
+                f" ({self._cbo_source} weights: island {weight} < "
+                f"transition cost {self._CBO_TRANSITION_COST})"
+                if weight is not None
+                else ""
             )
+            node_reason = (
+                "cost-based optimizer: island too small to pay "
+                f"transitions{detail}"
+            )
+        else:
+            node_reason = reason
+        self.explain.append(
+            ExplainEntry(orig.node_string(), False, [node_reason])
         )
         return orig.with_new_children(kids)
 
-    def _keep_island(self, plan: Exec) -> Exec:
+    def _keep_island(self, plan: Exec, again: Optional[Callable] = None) -> Exec:
         """Inside a kept island: never re-evaluate interior sub-islands (the
         transition boundary wouldn't move, only device work would be lost);
         resume cost analysis below the island's host boundaries."""
+        if again is None:
+            again = self._cost_optimize
         kids = [
-            self._keep_island(c) if c.is_device else self._cost_optimize(c)
+            self._keep_island(c, again) if c.is_device else again(c)
             for c in plan.children
         ]
         return plan.with_new_children(kids)
@@ -1054,6 +1087,105 @@ class TpuOverrides:
             return self._keep_island(plan)
         return plan.with_new_children(
             [self._cost_optimize(c) for c in plan.children]
+        )
+
+    # calibrated engine routing ────────────────────────────────────────────
+    # The CBO above reasons in unitless weights; this pass reasons in
+    # *nanoseconds*. With a measured cost table (obs/calibration.py) it
+    # predicts each device island's wall time on both engines — per-op
+    # ns/row times the island's estimated input rows, plus the fixed
+    # per-launch dispatch and H2D/D2H transfer taxes the ledger measured —
+    # and sends the island to whichever engine is predicted faster. The
+    # q6/q15 shape (one tiny filter+agg over a small scan) loses more to
+    # dispatch+transfer than the device saves in compute; the prediction
+    # makes that decision auditable instead of folkloric.
+
+    #: plumbing nodes with no per-row ns of their own — they ride along
+    #: with whatever engine the island lands on
+    _ROUTING_FREE = frozenset(
+        {"TpuCoalescePartitionsExec", "TpuCoalesceBatchesExec"}
+    )
+
+    def _route(self, plan: Exec) -> Exec:
+        if plan.is_device:
+            reason = self._route_verdict(plan)
+            if reason is not None:
+                return self._unconvert_island(
+                    plan, reason=reason, again=self._route
+                )
+            return self._keep_island(plan, again=self._route)
+        return plan.with_new_children(
+            [self._route(c) for c in plan.children]
+        )
+
+    def _route_verdict(self, plan: Exec) -> Optional[str]:
+        """Predicted-time comparison for the island rooted at ``plan``.
+        Returns the explain reason when the HOST engine is predicted
+        faster (island should be unconverted), None to stay on device.
+        Conservative by construction: any node either engine has no
+        measurement for, or an island with no estimable input rows, stays
+        on device — routing only ever acts on numbers it actually has."""
+        from ..sched.estimate import _leaf_bytes_rows, _walk as _est_walk
+
+        cal = self._routing_cal
+        island: List[Exec] = []
+        boundary_rows = 0
+
+        def collect(n: Exec) -> None:
+            island.append(n)
+            for c in n.children:
+                if c.is_device:
+                    collect(c)
+
+        collect(plan)
+        # input rows: what the host boundaries feed the island. Leaf
+        # sources *inside* the island (TpuRangeExec) count too.
+        for n in island:
+            lb = _leaf_bytes_rows(n)
+            if lb is not None:
+                boundary_rows += lb[1]
+            for c in n.children:
+                if not c.is_device:
+                    boundary_rows += sum(
+                        r
+                        for leaf in _est_walk(c)
+                        for (_b, r) in [_leaf_bytes_rows(leaf) or (0, 0)]
+                    )
+        if boundary_rows <= 0:
+            return None
+        device_ns = 0.0
+        host_ns = 0.0
+        launches = 0
+        op_detail = []
+        for n in island:
+            tpu_name = type(n).__name__
+            if tpu_name in self._ROUTING_FREE:
+                continue
+            orig = getattr(n, "_cpu_original", None)
+            if orig is None:
+                return None  # no CPU form to route to
+            cpu_name = type(orig).__name__
+            d = cal.ns_per_row(tpu_name, device=True)
+            h = cal.ns_per_row(cpu_name, device=False)
+            if d is None or h is None:
+                return None  # unmeasured op: keep on device
+            device_ns += d * boundary_rows
+            host_ns += h * boundary_rows
+            launches += 1
+            op_detail.append(f"{tpu_name} {d:g}ns/row vs {cpu_name} {h:g}ns/row")
+        if not launches:
+            return None
+        device_ns += (
+            launches * cfg.ROUTING_LAUNCH_OVERHEAD_NS.get(self.conf)
+            + cfg.ROUTING_TRANSFER_OVERHEAD_NS.get(self.conf)
+        )
+        if device_ns <= host_ns:
+            return None
+        return (
+            "calibrated routing: predicted device "
+            f"{device_ns / 1e6:.3f}ms > host {host_ns / 1e6:.3f}ms "
+            f"for ~{boundary_rows} rows over {launches} launches "
+            f"({'; '.join(op_detail)})"
         )
 
     # conversion walk (meta.tagForGpu + convertIfNeeded)

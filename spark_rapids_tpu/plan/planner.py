@@ -977,7 +977,7 @@ def _h2d_hints(node, conf: TpuConf) -> Optional[list]:
     return list(hints.values()) or None
 
 
-def _project_out_hints(node, hints) -> Optional[list]:
+def _project_out_hints(exprs, out_schema, hints) -> Optional[list]:
     """Propagate geometry through a projection: capacity is preserved;
     string widths survive only for passthrough (BoundReference) columns —
     a computed string's width is data-dependent and stays unknown, which
@@ -990,7 +990,7 @@ def _project_out_hints(node, hints) -> Optional[list]:
     out = []
     for cap, widths in hints:
         ow: dict = {}
-        for j, (e, f) in enumerate(zip(node.exprs, node.output)):
+        for j, (e, f) in enumerate(zip(exprs, out_schema)):
             if not isinstance(f.data_type, StringType):
                 continue
             t = e.child if isinstance(e, Alias) else e
@@ -1012,6 +1012,7 @@ def precompile_plan(plan: Exec, conf: TpuConf) -> dict:
     from ..columnar.device import abstract_batch
     from ..exec import task as task_mod
     from ..exec import tpu as T
+    from .fusion import StageExec
 
     specs: list = []
     seen: set = set()
@@ -1048,7 +1049,18 @@ def precompile_plan(plan: Exec, conf: TpuConf) -> dict:
         if isinstance(node, T.TpuProjectExec):
             hints = derive(node.children[0])
             warm_batch_kernel(node, hints)
-            return _project_out_hints(node, hints)
+            return _project_out_hints(node.exprs, node.output, hints)
+        if isinstance(node, StageExec):
+            # one warm per input geometry compiles the WHOLE fused stage;
+            # output hints fold through the steps exactly as the unfused
+            # chain would have propagated them
+            hints = derive(node.children[0])
+            warm_batch_kernel(node, hints)
+            for step in node.fused:
+                if step[0] == "project":
+                    hints = _project_out_hints(step[1], step[2], hints)
+                # filter steps: compact() preserves capacity and schema
+            return hints
         if isinstance(node, T.TpuHashAggregateExec):
             child, pre_filter = node._fused_child()
             hints = derive(child)

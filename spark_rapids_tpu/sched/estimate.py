@@ -154,16 +154,27 @@ def permits_for_plan(plan, conf, pool_size: int) -> int:
 
 
 class RunCalibration:
-    """EWMA of completed-query (run seconds, bytes/second)."""
+    """EWMA of completed-query (run seconds, bytes/second), plus per-plan
+    EWMA buckets keyed by the plan's structural identity
+    (``plan/reuse.canonical_key``): a repeated query's prediction comes
+    from ITS OWN history, not the global average a dashboard query and a
+    TPC-H join both pollute. Unseen plans fall back to the global EWMA.
+    Buckets are LRU-bounded — a long-lived serving session cycling ad-hoc
+    queries must not grow without bound."""
+
+    _MAX_PLANS = 256
 
     def __init__(self, alpha: float = 0.2):
+        from collections import OrderedDict
+
         self._lock = __import__("threading").Lock()
         self._alpha = alpha
         self._avg_run_s = 0.0
         self._bytes_per_s = 0.0
         self._samples = 0
+        self._plans: "OrderedDict" = OrderedDict()  # key -> [run_s, samples]
 
-    def record(self, est_bytes: int, run_s: float) -> None:
+    def record(self, est_bytes: int, run_s: float, plan_key=None) -> None:
         if run_s <= 0:
             return
         with self._lock:
@@ -173,6 +184,21 @@ class RunCalibration:
                 rate = est_bytes / run_s
                 self._bytes_per_s += a * (rate - self._bytes_per_s)
             self._samples += 1
+            if plan_key is not None:
+                e = self._plans.pop(plan_key, None)
+                if e is None:
+                    e = [run_s, 1]
+                else:
+                    e[0] += self._alpha * (run_s - e[0])
+                    e[1] += 1
+                self._plans[plan_key] = e  # (re)insert at MRU end
+                while len(self._plans) > self._MAX_PLANS:
+                    self._plans.popitem(last=False)
+
+    def plan_samples(self, plan_key) -> int:
+        with self._lock:
+            e = self._plans.get(plan_key)
+            return e[1] if e is not None else 0
 
     @property
     def samples(self) -> int:
@@ -184,12 +210,18 @@ class RunCalibration:
         with self._lock:
             return self._avg_run_s
 
-    def estimate_run_s(self, est_bytes: int) -> float:
-        """Predicted run seconds for a query of ``est_bytes``: the
-        calibrated rate when it exists, the plain average otherwise,
-        0.0 while uncalibrated (shedding then never fires on run-time —
-        a cold scheduler must not refuse its first queries)."""
+    def estimate_run_s(self, est_bytes: int, plan_key=None) -> float:
+        """Predicted run seconds: this plan's own EWMA when its
+        ``plan_key`` has history, else the calibrated global rate, else
+        the plain average, 0.0 while uncalibrated (shedding then never
+        fires on run-time — a cold scheduler must not refuse its first
+        queries)."""
         with self._lock:
+            if plan_key is not None:
+                e = self._plans.get(plan_key)
+                if e is not None:
+                    self._plans.move_to_end(plan_key)
+                    return e[0]
             if self._samples == 0:
                 return 0.0
             if est_bytes > 0 and self._bytes_per_s > 0:
@@ -205,6 +237,7 @@ class RunCalibration:
             self._avg_run_s = 0.0
             self._bytes_per_s = 0.0
             self._samples = 0
+            self._plans.clear()
 
 
 CALIBRATION = RunCalibration()

@@ -160,7 +160,9 @@ class Admission:
             from .estimate import CALIBRATION
 
             CALIBRATION.record(
-                self.est_bytes, time.monotonic() - self._granted_at
+                self.est_bytes,
+                time.monotonic() - self._granted_at,
+                plan_key=getattr(self, "plan_key", None),
             )
         if exc_type is not None and issubclass(
             exc_type, QueryTimeoutError
@@ -228,6 +230,18 @@ class QueryScheduler:
         self.watchdog.configure(conf)
         need = permits_for_plan(plan, conf, permits) if enabled else 1
         est_bytes = estimate_plan_bytes(plan, conf) if enabled else 0
+        plan_key = None
+        if enabled:
+            # per-plan calibration bucket: a repeated query predicts from
+            # its own run history (canonical structural identity — the
+            # exchange-reuse key). Plans with incomparable parameters
+            # simply stay on the global estimate.
+            try:
+                from ..plan.reuse import canonical_key
+
+                plan_key = canonical_key(plan)
+            except Exception:
+                plan_key = None
         timeout = cfg.SCHEDULER_QUERY_TIMEOUT_S.get(conf)
         token = CancelToken(
             query_id, timeout_s=timeout if timeout > 0 else None
@@ -237,7 +251,7 @@ class QueryScheduler:
             and timeout > 0
             and cfg.SCHEDULER_SHED_EXPIRED.get(conf)
         ):
-            est_run = CALIBRATION.estimate_run_s(est_bytes)
+            est_run = CALIBRATION.estimate_run_s(est_bytes, plan_key)
             est_wait = self.estimated_queue_wait_s()
             # shed only under actual queue pressure: an uncontended query
             # with a tight deadline keeps its normal timeout semantics
@@ -260,6 +274,7 @@ class QueryScheduler:
             self, query_id, need, pool_name, token, enabled, tracer
         )
         adm.est_bytes = est_bytes
+        adm.plan_key = plan_key
         return adm
 
     # ── overload hints ──────────────────────────────────────────────────

@@ -120,6 +120,7 @@ class TpuSession:
         self._temp_views: dict = {}  # lower-case name -> DataFrame
         self._last_plan: Optional[Exec] = None
         self._last_overrides: Optional[TpuOverrides] = None
+        self._last_fused_stages = 0
         self._task_retries = 0
         self._query_seq = 0
         import threading as _threading
@@ -151,6 +152,13 @@ class TpuSession:
         # process-global like the kernel cache it guards
         self._scheduler.breaker = self._breaker
         K.set_compile_deadline(cfg.COMPILE_DEADLINE_S.get(self.conf))
+        # shape-bucket lattice: process-global like the kernel cache whose
+        # entry count it bounds (columnar/device.py bucket_capacity reads it)
+        K.set_shape_bucket_floor(
+            cfg.SHAPE_BUCKETS_MIN_ROWS.get(self.conf)
+            if cfg.SHAPE_BUCKETS_ENABLED.get(self.conf)
+            else 1
+        )
         # restart survivability: the process-global on-disk XLA executable
         # store (cache/xla_store.py) — GuardedJit consults it before
         # compiling, so a restarted server starts hot in seconds
@@ -336,6 +344,14 @@ class TpuSession:
             from .cache import xla_store as _xc
 
             _xc.configure(self.conf)
+        if key.startswith("spark.rapids.tpu.shapeBuckets."):
+            from . import kernels as K
+
+            K.set_shape_bucket_floor(
+                cfg.SHAPE_BUCKETS_MIN_ROWS.get(self.conf)
+                if cfg.SHAPE_BUCKETS_ENABLED.get(self.conf)
+                else 1
+            )
 
     # ── execution ───────────────────────────────────────────────────────
     def _resolve_subqueries(self, lp: L.LogicalPlan) -> L.LogicalPlan:
@@ -736,6 +752,15 @@ class TpuSession:
         cpu_plan = plan_physical(lp, self.conf)
         overrides = TpuOverrides(self.conf, breaker=self._breaker)
         final_plan = overrides.apply(cpu_plan)
+        # whole-stage fusion BEFORE exchange reuse: fusing rewrites operator
+        # chains consistently across the plan, so identical exchange
+        # subtrees still canonicalize identically — while fusing after
+        # reuse would rewrite through physically-shared nodes
+        from .plan.fusion import fuse_stages
+
+        final_plan, self._last_fused_stages = fuse_stages(
+            final_plan, self.conf
+        )
         if cfg.EXCHANGE_REUSE_ENABLED.get(self.conf):
             from .plan.reuse import reuse_exchanges
 

@@ -1,0 +1,435 @@
+"""Whole-stage fusion + shape bucketing + calibrated routing tests.
+
+Three claims under test (plan/fusion.py, columnar/device.py lattice,
+plan/overrides.py _route):
+
+1. fused stages are BIT-IDENTICAL to unfused execution — same rows on the
+   same queries, including empty batches, all-null columns, and batches
+   landing exactly on a bucket boundary;
+2. the pow-2 shape-bucket lattice collapses executable counts: varied
+   batch sizes inside one bucket compile ~0 new programs after the first;
+3. calibrated routing moves sub-threshold plans to the CPU engine with the
+   decision + numbers in the explain output, and the opposite calibration
+   keeps them on device.
+"""
+from __future__ import annotations
+
+import json
+
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu import kernels as K
+from spark_rapids_tpu.functions import col
+from spark_rapids_tpu.obs import calibration as obs_cal
+from spark_rapids_tpu.obs.metrics import GLOBAL
+from spark_rapids_tpu.plan.fusion import StageExec
+from spark_rapids_tpu.tpch import gen_table, tpch_query
+
+from harness import cpu_session, tpu_session, _normalize, _values_equal
+
+
+def _plan_types(plan) -> list:
+    out = []
+
+    def walk(n):
+        out.append(type(n).__name__)
+        for c in n.children:
+            walk(c)
+
+    walk(plan)
+    return out
+
+
+def _chain_query(df):
+    return (
+        df.filter(col("a") > 10)
+        .select((col("a") + 1).alias("x"), (col("b") * 2.0).alias("y"))
+        .filter(col("x") < 10**9)
+    )
+
+
+def _table(n: int) -> pa.Table:
+    return pa.table(
+        {
+            "a": list(range(n)),
+            "b": [float(i) * 0.5 for i in range(n)],
+        }
+    )
+
+
+# ── fusion: plan shape + kill switch ───────────────────────────────────────
+
+
+def test_chain_fuses_into_stage_exec():
+    s = tpu_session()
+    _chain_query(s.create_dataframe(_table(100))).collect()
+    types = _plan_types(s._last_plan)
+    assert "StageExec" in types
+    # the whole filter->project->filter chain is ONE stage: no standalone
+    # project/filter nodes survive
+    assert "TpuProjectExec" not in types
+    assert "TpuFilterExec" not in types
+    assert s._last_fused_stages == 1
+
+
+def test_fusion_kill_switch():
+    s = tpu_session({"spark.rapids.tpu.fusion.enabled": False})
+    _chain_query(s.create_dataframe(_table(100))).collect()
+    types = _plan_types(s._last_plan)
+    assert "StageExec" not in types
+    assert "TpuProjectExec" in types
+    assert s._last_fused_stages == 0
+
+
+def test_single_op_stays_unfused():
+    """Lone project: no chain, no StageExec — parent-side fusions (agg,
+    exchange) keep first claim on single nodes."""
+    s = tpu_session()
+    df = s.create_dataframe(_table(50))
+    df.select((col("a") + 1).alias("x")).collect()
+    assert "StageExec" not in _plan_types(s._last_plan)
+
+
+def test_ansi_error_site_breaks_fusion():
+    """ANSI cast carries an error channel attributed per op — such
+    expressions must never be swallowed into a stage."""
+    from spark_rapids_tpu.types import INT
+
+    s = tpu_session({"spark.sql.ansi.enabled": True})
+    df = s.create_dataframe(_table(50))
+    q = (
+        df.filter(col("a") > 1)
+        .select(col("a").cast(INT).alias("x"))
+        .filter(col("x") < 10**6)
+    )
+    q.collect()
+    types = _plan_types(s._last_plan)
+    # the cast-bearing project stays standalone; the surrounding filters
+    # are non-adjacent singletons, so nothing fuses
+    assert "TpuProjectExec" in types
+
+
+# ── fusion: bit-identical results ──────────────────────────────────────────
+
+
+def _fused_vs_unfused(table, build):
+    s_f = tpu_session()
+    s_u = tpu_session({"spark.rapids.tpu.fusion.enabled": False})
+    rows_f = build(s_f.create_dataframe(table)).collect()
+    rows_u = build(s_u.create_dataframe(table)).collect()
+    assert s_f._last_fused_stages >= 1, "query did not exercise fusion"
+    assert rows_f == rows_u
+    return rows_f
+
+
+def test_fused_bit_identical_basic():
+    rows = _fused_vs_unfused(_table(105), _chain_query)
+    cpu_rows = _chain_query(cpu_session().create_dataframe(_table(105))).collect()
+    assert rows == cpu_rows
+
+
+def test_fused_empty_batch():
+    """First filter removes every row: downstream steps see an empty
+    compacted batch and must agree with the unfused pipeline."""
+
+    def q(df):
+        return (
+            df.filter(col("a") > 10**9)
+            .select((col("a") * 2).alias("x"))
+            .filter(col("x") > 0)
+        )
+
+    assert _fused_vs_unfused(_table(64), q) == []
+
+
+def test_fused_empty_input_table():
+    t = pa.table({"a": pa.array([], type=pa.int64()),
+                  "b": pa.array([], type=pa.float64())})
+    assert _fused_vs_unfused(t, _chain_query) == []
+
+
+def test_fused_all_null_column():
+    t = pa.table(
+        {
+            "a": pa.array([None] * 40, type=pa.int64()),
+            "b": [float(i) for i in range(40)],
+        }
+    )
+    rows = _fused_vs_unfused(t, _chain_query)
+    cpu_rows = _chain_query(cpu_session().create_dataframe(t)).collect()
+    assert rows == cpu_rows == []  # NULL > 10 is never true
+
+
+def test_fused_nulls_propagate_through_projection():
+    t = pa.table(
+        {
+            "a": [None if i % 3 == 0 else i for i in range(60)],
+            "b": [None if i % 5 == 0 else float(i) for i in range(60)],
+        }
+    )
+    rows = _fused_vs_unfused(t, _chain_query)
+    assert rows == _chain_query(cpu_session().create_dataframe(t)).collect()
+
+
+def test_fused_batch_exactly_on_bucket_boundary():
+    """num_rows == bucket capacity: zero padding rows, the mask is all
+    ones — the degenerate lattice cell must still be exact."""
+    n = K.shape_bucket_floor()
+    assert n == 1024  # the conf default
+    rows = _fused_vs_unfused(_table(n), _chain_query)
+    assert rows == _chain_query(cpu_session().create_dataframe(_table(n))).collect()
+
+
+@pytest.mark.parametrize("n", (1, 6, 3, 14))
+def test_tpch_fused_vs_unfused(n):
+    """TPC-H queries through both modes: fusion is a pure execution-
+    granularity change, so results are bit-identical row for row."""
+    from spark_rapids_tpu.tpch.datagen import TABLES
+
+    tables = {name: gen_table(name, 0.002) for name in TABLES}
+
+    def run(extra):
+        s = tpu_session({"spark.sql.shuffle.partitions": 2, **extra})
+
+        def acc(name):
+            return s.create_dataframe(tables[name], num_partitions=2)
+
+        return tpch_query(n, acc, sf=1.0).collect(), s
+
+    rows_f, s_f = run({})
+    rows_u, _ = run({"spark.rapids.tpu.fusion.enabled": False})
+    a, b = _normalize(rows_f, True), _normalize(rows_u, True)
+    assert len(a) == len(b)
+    for ra, rb in zip(a, b):
+        assert all(_values_equal(x, y, False) for x, y in zip(ra, rb)), (
+            f"q{n} fused row {ra} != unfused {rb}"
+        )
+
+
+# ── shape buckets ──────────────────────────────────────────────────────────
+
+
+def test_bucket_capacity_lattice():
+    from spark_rapids_tpu.columnar.device import bucket_capacity
+
+    s = tpu_session()  # installs the conf floor (default 1024)
+    assert K.shape_bucket_floor() == 1024
+    assert bucket_capacity(1) == 1024
+    assert bucket_capacity(1024) == 1024
+    assert bucket_capacity(1025) == 2048
+    s.set_conf("spark.rapids.tpu.shapeBuckets.minRows", 64)
+    assert K.shape_bucket_floor() == 64
+    assert bucket_capacity(1) == 64
+    s.set_conf("spark.rapids.tpu.shapeBuckets.enabled", False)
+    assert K.shape_bucket_floor() == 8  # back to the raw pow-2 round-up
+    s.set_conf("spark.rapids.tpu.shapeBuckets.enabled", True)
+    assert K.shape_bucket_floor() == 64
+
+
+def test_bucket_sweep_compiles_nothing_new():
+    """Varied batch sizes inside one bucket after a priming run: zero new
+    first-touch compiles — one executable serves the whole cell."""
+    s = tpu_session()
+
+    def run(n):
+        return _chain_query(s.create_dataframe(_table(n))).collect()
+
+    run(700)
+    first0 = GLOBAL.counter("kernel.firstCalls").value
+    expected = {}
+    for n in (64, 350, 512, 900, 1023, 1024):
+        expected[n] = run(n)
+    assert GLOBAL.counter("kernel.firstCalls").value == first0, (
+        "a batch size inside the primed bucket triggered a fresh compile"
+    )
+    # and the results are still exact per size
+    for n, rows in expected.items():
+        assert rows == _chain_query(
+            cpu_session().create_dataframe(_table(n))
+        ).collect()
+
+
+def test_pad_phase_in_ledger():
+    s = tpu_session()
+    _chain_query(s.create_dataframe(_table(700))).collect()
+    led = s._last_ledger
+    assert led is not None
+    phases = led.breakdown()["phases_ms"]
+    from spark_rapids_tpu.obs import ledger as OL
+
+    assert set(phases) <= set(OL.PHASES)
+    assert "pad" in phases  # 700 rows pad out to the 1024 lattice cell
+    assert GLOBAL.timer("batch.padTimeNs").value > 0
+
+
+# ── calibrated routing ─────────────────────────────────────────────────────
+
+
+def _write_cal(path, dev_ns, host_ns):
+    doc = {
+        "version": 1,
+        "ops": {
+            "TpuProjectExec": {"device_ns_per_row": dev_ns, "rows": 10_000,
+                               "updates": 3},
+            "TpuFilterExec": {"device_ns_per_row": dev_ns, "rows": 10_000,
+                              "updates": 3},
+            "CpuProjectExec": {"host_ns_per_row": host_ns, "rows": 10_000,
+                               "updates": 3},
+            "CpuFilterExec": {"host_ns_per_row": host_ns, "rows": 10_000,
+                              "updates": 3},
+        },
+    }
+    with open(path, "w") as f:
+        json.dump(doc, f)
+    obs_cal.invalidate(str(path))
+
+
+def _routing_session(path, strict=False):
+    return tpu_session(
+        {
+            "spark.rapids.tpu.routing.enabled": True,
+            "spark.rapids.tpu.cbo.calibrationFile": str(path),
+            "spark.rapids.sql.test.enabled": strict,
+        }
+    )
+
+
+def test_routing_flips_plan_to_host(tmp_path):
+    """Slow device + tiny input: the whole island routes to the CPU engine
+    and the explain reason carries the predicted numbers."""
+    p = tmp_path / "cal.json"
+    _write_cal(p, dev_ns=500.0, host_ns=5.0)
+    s = _routing_session(p)
+    rows = _chain_query(s.create_dataframe(_table(50))).collect()
+    types = _plan_types(s._last_plan)
+    assert "StageExec" not in types and "TpuProjectExec" not in types
+    assert "CpuProjectExec" in types and "CpuFilterExec" in types
+    reasons = [
+        r
+        for e in s._last_overrides.explain
+        for r in e.reasons
+        if "calibrated routing" in r
+    ]
+    assert reasons, "routed island left no explain entry"
+    # decision + numbers: predicted times, row count, and the per-op
+    # measured weights the verdict used
+    assert "predicted device" in reasons[0]
+    assert "ms > host" in reasons[0]
+    assert "TpuProjectExec 500ns/row vs CpuProjectExec 5ns/row" in reasons[0]
+    # and the routed plan still computes the right answer
+    assert rows == _chain_query(cpu_session().create_dataframe(_table(50))).collect()
+
+
+def test_routing_keeps_fast_device_plan(tmp_path):
+    p = tmp_path / "cal.json"
+    _write_cal(p, dev_ns=1.0, host_ns=500_000.0)
+    s = _routing_session(p, strict=True)
+    _chain_query(s.create_dataframe(_table(50))).collect()
+    assert "StageExec" in _plan_types(s._last_plan)
+
+
+def test_routing_off_by_default(tmp_path):
+    """The kill switch: same slow-device calibration, conf left at its
+    default — planning must be untouched."""
+    p = tmp_path / "cal.json"
+    _write_cal(p, dev_ns=500.0, host_ns=5.0)
+    s = tpu_session({"spark.rapids.tpu.cbo.calibrationFile": str(p)})
+    _chain_query(s.create_dataframe(_table(50))).collect()
+    assert "StageExec" in _plan_types(s._last_plan)
+
+
+def test_routing_skips_unmeasured_ops(tmp_path):
+    """An island containing any op the table has no measurement for stays
+    on device — routing only acts on numbers it has."""
+    p = tmp_path / "cal.json"
+    doc = {
+        "version": 1,
+        "ops": {
+            "TpuProjectExec": {"device_ns_per_row": 500.0, "rows": 1,
+                               "updates": 1},
+            "CpuProjectExec": {"host_ns_per_row": 5.0, "rows": 1,
+                               "updates": 1},
+            # no filter measurements
+        },
+    }
+    with open(p, "w") as f:
+        json.dump(doc, f)
+    obs_cal.invalidate(str(p))
+    s = _routing_session(p, strict=True)
+    _chain_query(s.create_dataframe(_table(50))).collect()
+    assert "StageExec" in _plan_types(s._last_plan)
+
+
+# ── per-plan run calibration (sched/estimate.py) ───────────────────────────
+
+
+def test_run_calibration_per_plan_buckets():
+    from spark_rapids_tpu.sched.estimate import RunCalibration
+
+    cal = RunCalibration()
+    cal.record(1000, 2.0, plan_key="q_heavy")
+    cal.record(1000, 0.010, plan_key="q_light")
+    # seen plans predict from their OWN history, not the polluted average
+    assert cal.estimate_run_s(1000, "q_heavy") == pytest.approx(2.0)
+    assert cal.estimate_run_s(1000, "q_light") == pytest.approx(0.010)
+    # unseen plan: global fallback (some blend of both)
+    g = cal.estimate_run_s(0, "q_never_seen")
+    assert 0.0 < g <= 2.0
+    # EWMA within a bucket
+    cal.record(1000, 1.0, plan_key="q_heavy")
+    assert 1.0 < cal.estimate_run_s(1000, "q_heavy") < 2.0
+    assert cal.plan_samples("q_heavy") == 2
+    cal.reset()
+    assert cal.estimate_run_s(1000, "q_heavy") == 0.0
+
+
+def test_run_calibration_lru_bound():
+    from spark_rapids_tpu.sched.estimate import RunCalibration
+
+    cal = RunCalibration()
+    for i in range(RunCalibration._MAX_PLANS + 10):
+        cal.record(100, 0.5, plan_key=f"p{i}")
+    assert cal.plan_samples("p0") == 0  # evicted
+    assert cal.plan_samples(f"p{RunCalibration._MAX_PLANS + 9}") == 1
+
+
+def test_admission_records_plan_key():
+    """End to end: running the same query twice gives the scheduler a
+    canonical plan key with recorded history."""
+    from spark_rapids_tpu.sched.estimate import CALIBRATION
+
+    CALIBRATION.reset()
+    s = tpu_session({"spark.rapids.tpu.scheduler.enabled": True})
+    # the SAME source table: a scan's canonical identity includes its
+    # in-memory source, so a fresh table per run would be a fresh plan key
+    t = _table(2000)
+    for _ in range(2):
+        _chain_query(s.create_dataframe(t)).collect()
+    with CALIBRATION._lock:
+        keyed = {k: v[1] for k, v in CALIBRATION._plans.items()}
+    assert keyed, "no per-plan calibration bucket was recorded"
+    assert max(keyed.values()) >= 2, "repeat run did not hit its own bucket"
+    CALIBRATION.reset()
+
+
+# ── precompile integration ─────────────────────────────────────────────────
+
+
+def test_precompile_warms_fused_stage():
+    """precompile_plan derives the stage's bucketed geometry and warms the
+    ONE fused program before execution."""
+    s = tpu_session({"spark.rapids.tpu.precompile.enabled": True})
+    df = s.create_dataframe(_table(300))
+    # a stage shape no other test builds, so the warm is a real compile
+    # (the module kernel cache is process-wide)
+    q = (
+        df.filter(col("a") > 17)
+        .select((col("a") * 37 + 11).alias("x"), (col("b") / 3.7).alias("y"))
+        .filter(col("x") < 10**9)
+    )
+    q.collect()
+    pc = s._last_precompile
+    assert pc and pc.get("kernels", 0) >= 1
+    assert pc.get("warmed", 0) >= 1, f"stage spec not warmed: {pc}"
+    assert "StageExec" in _plan_types(s._last_plan)
