@@ -173,7 +173,17 @@ chaos-serve:
 chaos-restart:
 	$(PYTEST) tests/test_chaos_restart.py -q -m chaos
 
-# The full chaos surface (in-process + serve-path + restart/corruption).
+# Recovery chaos suite (ISSUE 18): device-fault + peer-loss storms with
+# partition-granular lineage re-execution, straggler speculation under
+# concurrent faults, and serve-fleet failover (kill a peer mid-stream,
+# dedup-keyed replay, transparent re-prepare) — asserts bit-identical
+# results vs the CPU oracle with ZERO whole-query restarts.
+.PHONY: chaos-recovery
+chaos-recovery:
+	$(PYTEST) tests/test_chaos_recovery.py -q -m chaos
+
+# The full chaos surface (in-process + serve-path + restart/corruption +
+# recovery).
 # Every chaos-marked test runs under BOTH runtime harnesses: lockwatch
 # (lock-order races) and reswatch (end-of-test resource balance —
 # permits/threads/fds/flocks/spans back to the entry snapshot). Force

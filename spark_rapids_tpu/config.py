@@ -1126,6 +1126,103 @@ FAULTS_CACHE_LOCK_HOLDER_HOLD_MS = conf(
     "How long the simulated wedged lock holder keeps the entry flock."
 ).double_conf(500.0)
 
+FAULTS_MAP_OUTPUT_LOSS_EVERY_N = conf(
+    "spark.rapids.tpu.faults.shuffle.mapOutputLossEveryN"
+).doc(
+    "On every Nth managed shuffle-read, drop the shuffle's registered map "
+    "outputs AND its catalog-held blocks before the read — the lost-"
+    "executor simulation. The lineage recovery layer must rebuild the map "
+    "stage from its partition thunks instead of failing the query "
+    "(spark.rapids.tpu.recovery.recomputeMapOutputs); 0 disables."
+).int_conf(0)
+
+FAULTS_STALL_PARTITION = conf("spark.rapids.tpu.faults.stallPartition").doc(
+    "Stall the FIRST attempt of this partition id for stallPartitionSeconds "
+    "at task start — the deterministic straggler the speculation layer must "
+    "overtake (re-attempts and speculative duplicates never stall, so the "
+    "duplicate wins and the stalled loser is cancelled); -1 disables."
+).int_conf(-1)
+
+FAULTS_STALL_PARTITION_S = conf(
+    "spark.rapids.tpu.faults.stallPartitionSeconds"
+).doc(
+    "Injected stall duration for the straggler point. The sleep beats the "
+    "attempt's cancel token, so a cancelled loser exits within ~20ms."
+).double_conf(2.0)
+
+
+# ── lineage-based partition recovery (resilience/lineage.py) ───────────────
+
+RECOVERY_RECOMPUTE_ENABLED = conf(
+    "spark.rapids.tpu.recovery.recomputeMapOutputs"
+).doc(
+    "Rebuild lost shuffle map outputs from lineage instead of failing the "
+    "query: when a managed shuffle read hits an exhausted fetch budget, a "
+    "blacklisted peer, or finds its committed map outputs gone (lost "
+    "executor), the exchange marks the shuffle released and the partition "
+    "task's re-attempt re-runs the map stage under the next generation's "
+    "shuffle id. Counted in shuffle.recomputedPartitions."
+).boolean_conf(True)
+
+RECOVERY_MAX_MAP_RECOMPUTES = conf(
+    "spark.rapids.tpu.recovery.maxMapRecomputes"
+).doc(
+    "How many map-stage regenerations one exchange may perform per query "
+    "before a shuffle-read failure is allowed to propagate (a persistently "
+    "failing peer must not recompute forever; spark.task.maxFailures "
+    "bounds the per-partition attempts on top)."
+).int_conf(3)
+
+
+# ── straggler speculation (sched/speculation.py) ───────────────────────────
+
+SPECULATION_ENABLED = conf("spark.rapids.tpu.speculation.enabled").doc(
+    "Launch a speculative duplicate attempt for partitions that run far "
+    "past the measured baseline (spark.speculation analogue). The monitor "
+    "watches per-partition runtimes once speculation.quantile of the "
+    "query's partitions completed; first commit wins, the loser is "
+    "cancelled through its attempt token, and the duplicate's device "
+    "share is accounted as one extra scheduler permit (skipped when none "
+    "is free). Applies to multi-partition parallel collect()s."
+).boolean_conf(False)
+
+SPECULATION_QUANTILE = conf("spark.rapids.tpu.speculation.quantile").doc(
+    "Fraction of the query's partitions that must have completed before "
+    "stragglers are considered (the baseline sample; "
+    "spark.speculation.quantile)."
+).double_conf(0.75)
+
+SPECULATION_MULTIPLIER = conf("spark.rapids.tpu.speculation.multiplier").doc(
+    "A running partition is speculatable once its elapsed time exceeds "
+    "this multiple of the completed partitions' median runtime "
+    "(spark.speculation.multiplier)."
+).double_conf(1.5)
+
+SPECULATION_MIN_RUNTIME_S = conf(
+    "spark.rapids.tpu.speculation.minRuntime"
+).doc(
+    "Floor (seconds) under the speculation threshold: partitions faster "
+    "than this are never speculated regardless of the multiplier — "
+    "duplicating sub-100ms tasks only burns permits."
+).double_conf(0.25)
+
+SPECULATION_INTERVAL_S = conf("spark.rapids.tpu.speculation.interval").doc(
+    "How often (seconds) the speculation monitor scans running partitions "
+    "against the baseline (spark.speculation.interval)."
+).double_conf(0.05)
+
+
+# ── serve-fleet failover (serve/client.py dedup bookkeeping) ───────────────
+
+SERVE_FAILOVER_DEDUP_WINDOW = conf(
+    "spark.rapids.tpu.serve.failover.dedupWindow"
+).doc(
+    "How many client-generated dedup keys the server remembers (LRU). A "
+    "failover replay arriving with a key this server has already executed "
+    "counts serve.dedupReplays and is annotated in the query log — the "
+    "at-most-once bookkeeping behind mid-stream client failover."
+).int_conf(1024)
+
 
 class TpuConf:
     """An immutable-ish view over a key→string dict, with typed access.

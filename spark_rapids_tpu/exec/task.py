@@ -27,10 +27,17 @@ _LOCAL = threading.local()
 
 
 class TaskInfo:
-    """Mutable per-partition state (one per running partition iteration)."""
+    """Mutable per-partition state (one per running partition iteration).
 
-    def __init__(self, partition_id: int):
+    ``attempt`` is the lineage re-execution counter (Spark's
+    ``TaskContext.attemptNumber``): 0 on the first run, bumped by the
+    session's task-retry loop for each recovery re-execution and by the
+    speculation monitor for duplicate attempts.
+    """
+
+    def __init__(self, partition_id: int, attempt: int = 0):
         self.partition_id = partition_id
+        self.attempt = attempt
         # running live-row count for monotonically_increasing_id
         self.row_base = 0
 
@@ -46,6 +53,18 @@ def current() -> Optional[TaskInfo]:
 
 def set_current(info: Optional[TaskInfo]) -> None:
     _LOCAL.task = info
+
+
+def current_attempt() -> int:
+    """The attempt number the session's retry/speculation layer set for
+    this worker thread (0 outside any retry scope). Read by
+    ``plan/physical._scoped_part`` when minting each layer's TaskInfo so
+    every plan node of a re-executed partition observes the same attempt."""
+    return getattr(_LOCAL, "attempt", 0)
+
+
+def set_attempt(attempt: int) -> None:
+    _LOCAL.attempt = int(attempt)
 
 
 def get_or_create(partition_id: int = 0) -> TaskInfo:

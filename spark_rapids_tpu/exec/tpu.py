@@ -776,28 +776,67 @@ class TpuCoalescePartitionsExec(Exec):
             # partition completes.
             from concurrent.futures import ThreadPoolExecutor
 
-            def run_one(t):
-                try:
-                    return list(t())
-                finally:
-                    ctx.semaphore.release_if_necessary()
+            # straggler speculation (sched/speculation.py): this node IS
+            # the engine's executor-task-slot surface — the coalesce of a
+            # collect() drives every leaf partition — so the monitor
+            # watches HERE. A partition past the runtime bar gets a
+            # duplicate attempt of the same pure thunk; first commit wins,
+            # the loser unwinds through an attempt-scoped child token.
+            spec = None
+            token = getattr(ctx, "cancel_token", None)
+            if cfg.SPECULATION_ENABLED.get(ctx.conf) and token is not None:
+                from ..sched.speculation import SpeculationMonitor
+
+                scheduler = getattr(ctx.session, "_scheduler", None)
+                spec = SpeculationMonitor.from_conf(
+                    ctx.conf, ctx=ctx, token=token,
+                    pool=getattr(scheduler, "pool", None),
+                    n_partitions=len(child_parts.parts),
+                )
+
+            def run_one(i, t):
+                from ..resilience import faults as _faults
+
+                if spec is None:
+                    try:
+                        _faults.on_task_attempt(i, 0, token)
+                        return list(t())
+                    finally:
+                        ctx.semaphore.release_if_necessary()
+
+                def attempt(attempt_token):
+                    try:
+                        # chaos straggler point: the first attempt of the
+                        # configured partition crawls; a duplicate runs free
+                        _faults.on_task_attempt(i, 0, attempt_token)
+                        return list(t())
+                    finally:
+                        # primary runs on this worker thread, a duplicate
+                        # on the monitor's — each returns its own permit
+                        ctx.semaphore.release_if_necessary()
+
+                return spec.run_partition(i, attempt)
 
             parts = child_parts.parts
-            with ThreadPoolExecutor(max_workers=n_workers) as pool:
-                pending = {
-                    i: pool.submit(run_one, parts[i])
-                    for i in range(min(n_workers, len(parts)))
-                }
-                nxt = len(pending)
-                # graft: ok(cancel-beat: the worker threads drive the
-                # upstream iterators (which beat per batch); a cancel
-                # raises inside run_one and surfaces through result())
-                for i in range(len(parts)):
-                    batches = pending.pop(i).result()
-                    if nxt < len(parts):
-                        pending[nxt] = pool.submit(run_one, parts[nxt])
-                        nxt += 1
-                    yield from batches
+            try:
+                with ThreadPoolExecutor(max_workers=n_workers) as pool:
+                    pending = {
+                        i: pool.submit(run_one, i, parts[i])
+                        for i in range(min(n_workers, len(parts)))
+                    }
+                    nxt = len(pending)
+                    # graft: ok(cancel-beat: the worker threads drive the
+                    # upstream iterators (which beat per batch); a cancel
+                    # raises inside run_one and surfaces through result())
+                    for i in range(len(parts)):
+                        batches = pending.pop(i).result()
+                        if nxt < len(parts):
+                            pending[nxt] = pool.submit(run_one, nxt, parts[nxt])
+                            nxt += 1
+                        yield from batches
+            finally:
+                if spec is not None:
+                    spec.close()
 
         return PartitionSet([it])
 
@@ -2419,7 +2458,7 @@ class TpuShuffleExchangeExec(Exec):
             # deterministic per-generation offset within the query's id
             # namespace.
             base_sid = ctx.next_shuffle_id()
-            mgr_state = {"shuffle_id": None, "generation": 0}
+            mgr_state = {"shuffle_id": None, "generation": 0, "attempt": 0}
             mgr_lock = threading.Lock()
 
             def ensure_written():
@@ -2431,16 +2470,27 @@ class TpuShuffleExchangeExec(Exec):
                     writer = manager.get_writer(
                         sid, map_id=mp_rank if multiproc else 0,
                         num_partitions=nparts,
+                        attempt=mgr_state["attempt"],
                     )
-                    for p, bucket in enumerate(materialize()):
-                        for db in bucket:
-                            # graft: ok(host-sync: shuffle-manager write
-                            # filter — serializing an empty bucket batch
-                            # costs a frame per peer; one scalar pull per
-                            # bucket batch on the manager path only)
-                            if db.row_count():
-                                writer.write(p, db)
-                    writer.commit()
+                    try:
+                        for p, bucket in enumerate(materialize()):
+                            for db in bucket:
+                                # graft: ok(host-sync: shuffle-manager write
+                                # filter — serializing an empty bucket batch
+                                # costs a frame per peer; one scalar pull per
+                                # bucket batch on the manager path only)
+                                if db.row_count():
+                                    writer.write(p, db)
+                        writer.commit()
+                    except BaseException:
+                        # atomic per-(map, attempt) commit: a mid-write
+                        # failure drops THIS attempt's partial blocks and
+                        # advances the attempt id, so the task retry's
+                        # re-write can never duplicate batches a consumer
+                        # would read twice
+                        writer.abort()
+                        mgr_state["attempt"] += 1
+                        raise
                     state["buckets"] = None  # catalog owns the batches now
                     mgr_state["shuffle_id"] = sid
                     return sid
@@ -2475,10 +2525,67 @@ class TpuShuffleExchangeExec(Exec):
                         # a peer owns this reduce partition; this executor
                         # only had to contribute its map output (above)
                         return
-                    yield from ctx.shuffle_manager.get_reader().read_partitions(
-                        sid, p, p + 1,
-                        expected_maps=mp_size if multiproc else 0,
-                    )
+                    from ..resilience import faults as _faults
+                    from ..shuffle.client import ShuffleFetchError
+                    from ..shuffle.manager import MapOutputLostError
+
+                    if _faults.lose_map_output():
+                        # chaos: the committed map output vanishes wholesale
+                        # (peer death) — the recovery path below must rebuild
+                        # it from lineage, not silently read zero rows
+                        ctx.shuffle_manager.unregister_shuffle(sid)
+
+                    def _lost(cause):
+                        # Map-output recomputation: mark this generation
+                        # released so the NEXT attempt of any reduce task
+                        # re-runs the map stage under a fresh shuffle id,
+                        # then raise the recoverable error the session's
+                        # task-retry loop re-executes on. Guarded by the
+                        # sid match: concurrent losers of one generation
+                        # bump it exactly once.
+                        if not cfg.RECOVERY_RECOMPUTE_ENABLED.get(ctx.conf):
+                            raise cause
+                        if mgr_state["generation"] >= (
+                            cfg.RECOVERY_MAX_MAP_RECOMPUTES.get(ctx.conf)
+                        ):
+                            raise cause
+                        with mgr_lock:
+                            if (
+                                mgr_state["shuffle_id"] == sid
+                                and not mgr_state.get("released")
+                            ):
+                                mgr_state["released"] = True
+                                from ..obs.metrics import GLOBAL as _obs
+
+                                _obs.counter(
+                                    "shuffle.recomputedPartitions"
+                                ).add(1)
+                        raise MapOutputLostError(
+                            f"shuffle {sid} partition {p}: map output lost "
+                            f"({cause}); recomputing from lineage under "
+                            "generation "
+                            f"{mgr_state['generation'] + 1}"
+                        ) from cause
+
+                    if not multiproc and not (
+                        ctx.shuffle_manager.registry.outputs_for(sid)
+                    ):
+                        # single-process reads pass expected_maps=0, so an
+                        # emptied registry would otherwise yield NOTHING —
+                        # ensure_written always commits a MapStatus (even
+                        # all-empty sizes), so absence means loss
+                        _lost(MapOutputLostError(
+                            f"shuffle {sid}: no map outputs registered"
+                        ))
+                    try:
+                        yield from ctx.shuffle_manager.get_reader().read_partitions(
+                            sid, p, p + 1,
+                            expected_maps=mp_size if multiproc else 0,
+                        )
+                    except (ShuffleFetchError, TimeoutError) as e:
+                        # blacklisted peer / exhausted fetch budget: the
+                        # peer's output is unreachable — rebuild it
+                        _lost(e)
                     # free catalog-held map output once every partition has
                     # been drained (ShuffleBufferCatalog unregisterShuffle)
                     with mgr_lock:

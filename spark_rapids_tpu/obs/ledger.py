@@ -65,6 +65,7 @@ PHASES = (
     "d2h",
     "serialize",
     "glue",
+    "recovery",
 )
 
 

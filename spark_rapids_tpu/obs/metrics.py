@@ -439,6 +439,9 @@ CATALOG: Iterable[tuple] = (
     ("shuffle.evictedStale", MetricKind.COUNTER,
      "executors evicted by age-based registry sweeps (heartbeat "
      "evict_stale — including the watchdog's periodic sweep)"),
+    ("shuffle.recomputedPartitions", MetricKind.COUNTER,
+     "map outputs rebuilt from lineage after a lost/blacklisted peer or "
+     "an empty registry (spark.rapids.tpu.recovery.recomputeMapOutputs)"),
     # sched/* — multi-tenant admission control (per-pool admitted counters
     # under scheduler.pool.<name>.admitted and per-cause cancellations
     # under scheduler.cancelled.reason.<slug> register dynamically on
@@ -497,6 +500,12 @@ CATALOG: Iterable[tuple] = (
     ("serve.drainCancelled", MetricKind.COUNTER,
      "in-flight queries cancelled at drainTimeout with reason "
      "'shutdown'"),
+    ("serve.failovers", MetricKind.COUNTER,
+     "client-side redials to a peer server after mid-stream transport "
+     "death (query replayed under its dedup key)"),
+    ("serve.dedupReplays", MetricKind.COUNTER,
+     "EXECUTE/BIND commands recognised as failover replays by their "
+     "dedup key (spark.rapids.tpu.serve.failover.dedupWindow)"),
     # latency distributions (HISTOGRAM kind, log2 buckets; Prometheus
     # renders _bucket/_sum/_count) — the series that used to be bounded
     # raw-sample lists or bare nanos totals
@@ -531,6 +540,18 @@ CATALOG: Iterable[tuple] = (
     ("resilience.transport_reconnects", MetricKind.COUNTER, "TCP transport reconnects"),
     ("resilience.spill_write_errors", MetricKind.COUNTER, "disk-spill write failures (degraded to HOST)"),
     ("resilience.faults_injected", MetricKind.COUNTER, "chaos-harness injections fired"),
+    # resilience/lineage.py + sched/speculation.py — partition-granular
+    # recovery (task re-execution, straggler speculation, stage fallback)
+    ("task.reattempts", MetricKind.COUNTER,
+     "partition tasks re-executed under a fresh attempt id after a "
+     "recoverable fault (spark.task.maxFailures bounds the loop)"),
+    ("speculation.launched", MetricKind.COUNTER,
+     "speculative duplicate attempts launched for straggling partitions"),
+    ("speculation.won", MetricKind.COUNTER,
+     "speculative attempts that committed first (original cancelled)"),
+    ("fusion.breakerFallbacks", MetricKind.COUNTER,
+     "fused stages rebuilt as their unfused per-op chain because the "
+     "circuit breaker opened on the stage signature"),
 )
 
 for _name, _kind, _doc in CATALOG:

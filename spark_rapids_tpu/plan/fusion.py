@@ -68,6 +68,15 @@ def _op_key(op: Exec) -> tuple:
     return ("filter", op.condition)
 
 
+def stage_signature(fused: tuple) -> str:
+    """The circuit-breaker key for one fused chain. Per-STAGE, not the
+    class-wide \"StageExec\": one pathological fused program must not
+    condemn every other stage in the plan to the fallback path. Process-
+    local like the breaker itself (``hash`` randomization is fine — the
+    signature never leaves this process)."""
+    return f"StageExec:{hash(('stage',) + fused) & 0xFFFFFFFF:08x}"
+
+
 def stage_kernel(fused: tuple):
     """One jitted program evaluating every step of ``fused`` in sequence.
 
@@ -120,6 +129,11 @@ class StageExec(Exec):
         self.fused: Tuple[tuple, ...] = tuple(_op_key(op) for op in ops)
         self._needs_task = False
         self._fn = stage_kernel(self.fused)
+        # per-stage breaker identity: kernel failures recorded under THIS
+        # signature open the breaker for this chain only; the next planning
+        # pass rebuilds it unfused (fuse_stages' fallback) while other
+        # stages keep fusing
+        self.breaker_op = stage_signature(self.fused)
 
     @property
     def output(self):
@@ -137,7 +151,7 @@ class StageExec(Exec):
             # row-local map/compact, so concat(a, b) commutes with the stage
             return task.run_device(
                 fn, it, False, catalog=ctx.catalog,
-                policy=ctx.retry_policy, op="StageExec",
+                policy=ctx.retry_policy, op=self.breaker_op,
                 breaker=ctx.breaker, token=ctx.cancel_token,
             )
 
@@ -162,15 +176,32 @@ def _fusable(node: Exec) -> bool:
     return False
 
 
-def fuse_stages(plan: Exec, conf: TpuConf) -> tuple:
+def fuse_stages(plan: Exec, conf: TpuConf, breaker=None) -> tuple:
     """(fused plan, number of stages formed). Walks top-down, replacing
     every maximal chain of >= 2 fusable nodes with a ``StageExec``; all
     other nodes are rebuilt via ``with_new_children`` (fresh metric
-    registries, the standard rewrite currency)."""
+    registries, the standard rewrite currency).
+
+    Breaker-aware (graceful degradation, not wholesale surrender): a chain
+    whose ``stage_signature`` the circuit breaker has opened — its fused
+    kernel failed repeatedly — is rebuilt as the unfused per-op chain
+    instead of a StageExec. Each op then runs (and fails) under its OWN
+    breaker key, so a genuinely bad operator degrades one more step to
+    per-op CPU via the overrides pass, while its innocent chain-mates keep
+    running on device."""
     if not cfg.FUSION_ENABLED.get(conf):
         return plan, 0
     max_ops = max(2, cfg.FUSION_MAX_OPS.get(conf))
     count = 0
+
+    def unfuse(chain, below: Exec) -> Exec:
+        from ..obs.metrics import GLOBAL as _obs
+
+        _obs.counter("fusion.breakerFallbacks").add(1)
+        rebuilt = below
+        for op in reversed(chain):  # deepest first, original node on top
+            rebuilt = op.with_new_children([rebuilt])
+        return rebuilt
 
     def walk(node: Exec) -> Exec:
         nonlocal count
@@ -181,6 +212,11 @@ def fuse_stages(plan: Exec, conf: TpuConf) -> tuple:
                 chain.append(cur)
                 cur = cur.children[0]
             if len(chain) >= 2:
+                fused = tuple(_op_key(op) for op in reversed(chain))
+                if breaker is not None and breaker.is_open(
+                    stage_signature(fused)
+                ):
+                    return unfuse(chain, walk(cur))
                 count += 1
                 return StageExec(list(reversed(chain)), walk(cur))
         return node.with_new_children([walk(c) for c in node.children])

@@ -106,8 +106,13 @@ class ExecContext:
         self._shuffle_ids = itertools.count(seq * 1_000_000 + 1)
         # multi-tenant scheduler (sched/): the per-query cancellation token,
         # installed by the session at admission; operators check it at batch
-        # boundaries. None = unscheduled execution (no checks).
-        self.cancel_token = None
+        # boundaries. None = unscheduled execution (no checks). Worker
+        # threads may install a thread-local override (an attempt-scoped
+        # LinkedCancelToken) via ``token_override`` so ONE partition attempt
+        # can be cancelled — speculation losing the race — without touching
+        # the query token every other partition checks.
+        self._cancel_token = None
+        self._token_tls = threading.local()
         # depth counter: >0 while building a broadcast batch — exchanges
         # below a broadcast must run WHOLE in every process (no rank split,
         # no shared-registry map statuses). Thread-LOCAL: broadcast builds
@@ -132,6 +137,36 @@ class ExecContext:
             # session-init frozen flag, not the conf: mesh mode committed
             # the partition arity and exchange lowering at construction
             self.mesh = session.mesh_context()
+
+    @property
+    def cancel_token(self):
+        """The token operators should check: the thread-local attempt
+        override when one is installed (speculative/re-executed attempts),
+        else the query-level token set at admission. Operators capture this
+        lazily inside their partition closures, so the override reaches
+        every node of the running partition without plan surgery."""
+        tok = getattr(self._token_tls, "token", None)
+        return tok if tok is not None else self._cancel_token
+
+    @cancel_token.setter
+    def cancel_token(self, token) -> None:
+        self._cancel_token = token
+
+    def token_override(self, token):
+        """Context manager installing ``token`` as this worker thread's
+        cancel token for the duration of one partition attempt."""
+        import contextlib
+
+        @contextlib.contextmanager
+        def _scope():
+            prev = getattr(self._token_tls, "token", None)
+            self._token_tls.token = token
+            try:
+                yield token
+            finally:
+                self._token_tls.token = prev
+
+        return _scope()
 
     @property
     def broadcast_depth(self) -> int:
@@ -249,7 +284,10 @@ def _scoped_part(index: int, thunk):
     def run():
         from ..exec import task as _task
 
-        info = _task.TaskInfo(index)
+        # attempt id comes from the worker thread's retry/speculation scope
+        # (session._run_task): every plan-node layer of a re-executed
+        # partition observes the same attempt number
+        info = _task.TaskInfo(index, attempt=_task.current_attempt())
 
         def gen():
             _task.set_current(info)

@@ -62,6 +62,10 @@ class FaultConfig:
     cache_crash_before_rename_every_n: int = 0  # die between temp and rename
     cache_lock_holder_every_n: int = 0  # wedged peer holds the entry flock
     cache_lock_holder_hold_ms: float = 0.0
+    # recovery-layer points (resilience/lineage.py era)
+    map_output_loss_every_n: int = 0  # drop a committed shuffle map output
+    stall_partition: int = -1  # straggle this partition id (first attempt)
+    stall_partition_s: float = 2.0
 
 
 class FaultInjector:
@@ -174,6 +178,42 @@ class FaultInjector:
             self._record("tcp_corrupt")
             return True
         return False
+
+    def lose_map_output(self) -> bool:
+        """Whether this exchange read should find its committed map output
+        GONE (peer loss / blacklist simulation — the lineage layer must
+        rebuild it instead of failing the query)."""
+        if self._tick("map_output_loss", self.config.map_output_loss_every_n):
+            self._record("map_output_loss")
+            return True
+        return False
+
+    def on_task_attempt(self, partition_id: int, attempt: int,
+                        token=None) -> None:
+        """First attempt of the configured partition straggles: sleep in
+        token-beating slices so the watchdog sees progress (a straggler is
+        SLOW, not stalled — exactly what speculation, not the watchdog,
+        must catch). Re-executed and speculative attempts run at full
+        speed, so the duplicate attempt wins the race deterministically."""
+        c = self.config
+        if c.stall_partition < 0 or partition_id != c.stall_partition:
+            return
+        if attempt != 0 or c.stall_partition_s <= 0:
+            return
+        with self._lock:
+            # one-shot: only the FIRST attempt ever observed straggles;
+            # the speculative duplicate re-enters the retry loop at
+            # attempt 0 too, and stalling it as well would leave no
+            # attempt able to win the race
+            if self.injected.get("stall_partition", 0):
+                return
+            self.injected["stall_partition"] = 1
+        self._record("stall_partition")
+        deadline = time.monotonic() + c.stall_partition_s
+        while time.monotonic() < deadline:
+            if token is not None:
+                token.check()  # cancelled loser unwinds mid-straggle
+            time.sleep(0.02)
 
     # ── compile-cache damage points (cache/xla_store.py) ────────────────
     def cache_stale_fence(self) -> bool:
@@ -302,6 +342,19 @@ def on_kernel_stall() -> None:
         inj.on_kernel_stall()
 
 
+def lose_map_output() -> bool:
+    inj = _ACTIVE
+    if inj is not None:
+        return inj.lose_map_output()
+    return False
+
+
+def on_task_attempt(partition_id: int, attempt: int, token=None) -> None:
+    inj = _ACTIVE
+    if inj is not None:
+        inj.on_task_attempt(partition_id, attempt, token)
+
+
 def cache_stale_fence() -> bool:
     inj = _ACTIVE
     if inj is not None:
@@ -425,4 +478,9 @@ def config_from_conf(conf) -> Optional[FaultConfig]:
         cache_lock_holder_hold_ms=(
             cfg.FAULTS_CACHE_LOCK_HOLDER_HOLD_MS.get(conf)
         ),
+        map_output_loss_every_n=(
+            cfg.FAULTS_MAP_OUTPUT_LOSS_EVERY_N.get(conf)
+        ),
+        stall_partition=cfg.FAULTS_STALL_PARTITION.get(conf),
+        stall_partition_s=cfg.FAULTS_STALL_PARTITION_S.get(conf),
     )

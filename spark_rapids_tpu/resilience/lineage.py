@@ -1,0 +1,126 @@
+"""Task lineage — partition-granular recovery descriptors and attempt scopes.
+
+The engine's analogue of Spark's lineage-based fault tolerance: every
+partition thunk the session runs is described by a :class:`TaskDescriptor`
+(plan label, partition id, attempt counter). Because partition thunks are
+*pure* — they close over the plan subtree and re-derive their input from
+sources or shuffle reads — re-invoking the same thunk under a fresh attempt
+id recomputes exactly that partition from lineage. Nothing here snapshots
+data; the thunk IS the lineage.
+
+The attempt id travels as a thread-local (``exec/task.py``): the session's
+retry loop (or the speculation monitor) enters :func:`attempt_scope` before
+invoking the thunk, and ``plan/physical._scoped_part`` reads it when minting
+each layer's ``TaskInfo`` — so every operator of a re-executed partition
+observes the same attempt number, and the shuffle writer can commit map
+output atomically per (map, attempt).
+
+Recovery classification lives here too: :func:`is_recoverable` is the single
+predicate deciding whether an error is partition-scoped (device fault,
+spill-IO error, shuffle-fetch exhaustion, lost map output → re-execute this
+partition) or query-scoped (cancellation, deadline, ANSI violation, plan
+bug → propagate). ``task.reattempts`` counts every recovery re-execution;
+the ledger's ``recovery`` phase attributes the re-executed wall time.
+"""
+from __future__ import annotations
+
+import contextlib
+from typing import Optional
+
+from ..obs import metrics as obs_metrics
+
+_M = obs_metrics.GLOBAL
+_M_REATTEMPTS = _M.counter("task.reattempts")
+
+
+class TaskDescriptor:
+    """Lineage handle for one partition of one running query.
+
+    ``attempt`` counts *re-executions* of the whole partition (not the
+    batch-level OOM splits beneath it, which resilience/retry.py handles
+    in-place). The descriptor is mutable — the session's retry loop bumps
+    the attempt and re-invokes the same thunk.
+    """
+
+    __slots__ = ("plan_label", "partition_id", "attempt", "query_id")
+
+    def __init__(self, partition_id: int, plan_label: str = "",
+                 query_id: str = ""):
+        self.partition_id = int(partition_id)
+        self.plan_label = plan_label
+        self.query_id = query_id
+        self.attempt = 0
+
+    def next_attempt(self) -> int:
+        self.attempt += 1
+        return self.attempt
+
+    def __repr__(self):
+        return (
+            f"TaskDescriptor(part={self.partition_id}, "
+            f"attempt={self.attempt}, plan={self.plan_label!r})"
+        )
+
+
+@contextlib.contextmanager
+def attempt_scope(attempt: int):
+    """Install ``attempt`` as this worker thread's attempt id for the
+    duration of one partition execution (read back by
+    ``plan/physical._scoped_part`` → ``TaskInfo.attempt``)."""
+    from ..exec import task as _task
+
+    prev = _task.current_attempt()
+    _task.set_attempt(attempt)
+    try:
+        yield
+    finally:
+        _task.set_attempt(prev)
+
+
+def record_reattempt(desc: TaskDescriptor, error: BaseException,
+                     ledger=None, tracer=None) -> None:
+    """Account one lineage re-execution: the catalog counter, an optional
+    trace instant so the Perfetto export shows WHERE recovery happened,
+    and a debug-friendly attribution on the ledger (phase accrual itself
+    happens around the re-run via ``recovery_scope``)."""
+    _M_REATTEMPTS.add(1)
+    if tracer is not None:
+        try:
+            # zero-length span = a Perfetto instant marking WHERE the
+            # re-execution started and what killed the prior attempt
+            with tracer.span(
+                "task.reattempt",
+                cat="recovery",
+                args={
+                    "partition": desc.partition_id,
+                    "attempt": desc.attempt,
+                    "error": type(error).__name__,
+                },
+            ):
+                pass
+        except Exception:
+            pass
+
+
+def recovery_scope(ledger):
+    """Ledger scope attributing a re-executed partition's wall time to the
+    ``recovery`` phase (no-op without a ledger)."""
+    from ..obs import ledger as _ledger
+
+    return _ledger.scope_or_null(ledger, "recovery")
+
+
+def is_recoverable(error: BaseException) -> bool:
+    """Partition-scoped (re-execute from lineage) vs query-scoped
+    (propagate). Mirrors — and must stay in sync with — the session retry
+    loop's never-retry set: assertion failures and ANSI violations are
+    deterministic, scheduler errors mean the QUERY was cancelled/rejected,
+    and compile deadlines will not improve on a re-run."""
+    from ..expr.base import AnsiError
+    from ..sched.cancel import SchedulerError
+    from . import CompileDeadlineError
+
+    if isinstance(error, (AssertionError, AnsiError, SchedulerError,
+                          CompileDeadlineError)):
+        return False
+    return isinstance(error, Exception)

@@ -243,6 +243,20 @@ class WeightedPermitPool:
             _M_WAIT_HIST.observe(wait_ns)
         return w.granted_need
 
+    def try_acquire(self, need: int = 1, pool: str = "default") -> int:
+        """Non-blocking acquire: grant ``need`` permits immediately if the
+        pool is idle (no waiters to jump) and capacity allows, else return
+        0 without queueing. Speculative task attempts use this — a
+        duplicate attempt is opportunistic work that must never displace
+        or delay a real admission."""
+        need = self.clamp(need)
+        with self._lock:
+            self._ensure_pool(pool)
+            if self._queued == 0 and self._in_use + need <= self.effective_permits():
+                self._grant_locked(need, pool)
+                return need
+        return 0
+
     def release(self, granted: int, pool: str = "default") -> None:
         with self._lock:
             self._release_locked(granted, pool)

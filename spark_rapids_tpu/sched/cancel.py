@@ -152,3 +152,33 @@ class CancelToken:
                 "deadline (spark.rapids.tpu.scheduler.queryTimeout)",
                 reason="deadline",
             )
+
+
+class LinkedCancelToken(CancelToken):
+    """A child token chained to a parent: cancelling either stops the work.
+
+    Minted per task *attempt* by the recovery/speculation layer so one
+    attempt of a partition can be cancelled (speculation lost the race,
+    original overtaken) without touching the query's own token — while a
+    query-level cancel or deadline still reaches every attempt through the
+    parent. ``check()`` delegates to the parent first, which also stamps
+    the parent's watchdog beat: a query running only speculative attempts
+    keeps beating and is never misclassified as stalled.
+    """
+
+    __slots__ = ("parent",)
+
+    def __init__(self, parent: CancelToken, query_id: str = ""):
+        super().__init__(query_id or parent.query_id, timeout_s=None)
+        self.parent = parent
+        # Inherit the absolute deadline so expiry raises even when an
+        # attempt loop only checks the child.
+        self.deadline = parent.deadline
+
+    @property
+    def cancelled(self) -> bool:
+        return self._cancelled or self.expired or self.parent.cancelled
+
+    def check(self) -> None:
+        self.parent.check()
+        super().check()

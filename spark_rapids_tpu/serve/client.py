@@ -34,14 +34,21 @@ from .protocol import ProtocolError, ServeError  # noqa: F401 - re-export
 
 
 class PreparedHandle:
-    """A server-side prepared statement (PREPARE_OK payload)."""
+    """A server-side prepared statement (PREPARE_OK payload).
 
-    __slots__ = ("statement_id", "n_params", "sql")
+    ``_epoch`` stamps which incarnation of the connection prepared it:
+    statements are connection-scoped server-side, so after a reconnect or
+    failover (epoch bump) ``execute`` transparently re-prepares from the
+    retained ``sql`` and refreshes this handle in place."""
 
-    def __init__(self, statement_id: str, n_params: int, sql: str):
+    __slots__ = ("statement_id", "n_params", "sql", "_epoch")
+
+    def __init__(self, statement_id: str, n_params: int, sql: str,
+                 epoch: int = 0):
         self.statement_id = statement_id
         self.n_params = n_params
         self.sql = sql
+        self._epoch = epoch
 
 
 class ResultStream:
@@ -54,7 +61,7 @@ class ResultStream:
     the RESULT frame)."""
 
     def __init__(self, conn: "Connection", query_id: str, schema: pa.Schema,
-                 cache_hit: bool = False):
+                 cache_hit: bool = False, replay: Optional[dict] = None):
         self._conn = conn
         self.query_id = query_id
         self.schema = schema
@@ -65,6 +72,13 @@ class ResultStream:
         self.run_ms: Optional[float] = None
         self._done = False
         self._cancel_sent = False
+        # fleet failover: how to replay this query on a peer after
+        # mid-stream transport death ({'kind', 'sql'/'stmt', 'params',
+        # 'dedup_key'}); None disables failover for this stream
+        self._replay = replay
+        self._yielded = 0  # batches already delivered to the caller
+        self._skip = 0  # replayed batches to drop (already delivered)
+        self._failovers = 0
 
     def __iter__(self) -> Iterator[pa.RecordBatch]:
         while not self._done:
@@ -79,13 +93,16 @@ class ResultStream:
                 self._conn._stream = None
                 raise
             except BaseException as e:
-                # transport death (timeout, reset): the stream is over —
-                # clear it so the connection isn't wedged behind a
-                # misleading 'stream still open' error when it cannot (or
-                # chose not to) auto-reconnect
-                self._done = True
+                # transport death (timeout, reset): clear the stream so
+                # the connection isn't wedged, then try to fail over to a
+                # peer — redial, replay under the same dedup key, skip the
+                # batches the caller already has. Only when no peer can
+                # take the replay does the caller see the error.
                 self._conn._stream = None
                 self._conn._mark_dead_on(e)
+                if self._try_failover(e):
+                    continue
+                self._done = True
                 raise
             if ftype == P.END:
                 info = P.decode_json(body)
@@ -102,7 +119,41 @@ class ResultStream:
                     # command's reply framing stays aligned
                     self._conn._stale_cancel_oks += 1
                 return
+            if self._skip > 0:
+                # a failover replay re-streams from the start; the engine's
+                # batch sequence is deterministic for a given statement, so
+                # dropping the first `_yielded` frames resumes exactly
+                # where the dead server stopped — no duplicates, no gaps
+                self._skip -= 1
+                continue
+            self._yielded += 1
             yield ipc.read_batch(body)
+
+    def _try_failover(self, cause: BaseException) -> bool:
+        """Redial a peer and replay this query; True when the stream can
+        continue reading from the new server."""
+        conn = self._conn
+        if (
+            self._replay is None
+            or self._cancel_sent
+            or not conn._can_failover()
+            or self._failovers >= max(1, len(conn._servers) or 1)
+        ):
+            return False
+        self._failovers += 1
+        try:
+            conn._reconnect(prefer_next=True)
+            fresh = conn._resend_replay(self._replay)
+        except BaseException:
+            return False  # fleet exhausted — surface the ORIGINAL error
+        from ..obs.metrics import GLOBAL as _obs
+
+        _obs.counter("serve.failovers").add(1)
+        # adopt the replayed query's identity; drop already-seen batches
+        self.query_id = fresh["query_id"]
+        self._skip = self._yielded
+        conn._stream = self
+        return True
 
     def cancel(self) -> None:
         """Ask the server to cancel this query mid-stream. Keep iterating
@@ -139,7 +190,9 @@ class Connection:
     reconnect, re-``prepare`` (a stale handle answers a typed error)."""
 
     def __init__(self, sock: socket.socket, hello: dict,
-                 dial: Optional[dict] = None, reconnect: bool = True):
+                 dial: Optional[dict] = None, reconnect: bool = True,
+                 servers: Optional[List[tuple]] = None,
+                 server_idx: int = 0):
         self._sock = sock
         self.tenant = hello.get("tenant")
         self.pool = hello.get("pool")
@@ -156,6 +209,12 @@ class Connection:
         self._dial = dial or {}
         self._auto_reconnect = reconnect and bool(dial)
         self._dead = False
+        # serve-fleet failover (connect(servers=[...])): the peer rotation
+        # a dead transport redials through, and the connection epoch that
+        # invalidates prepared handles across incarnations
+        self._servers: List[tuple] = list(servers or [])
+        self._server_idx = server_idx
+        self._epoch = 0
 
     # ── queries ─────────────────────────────────────────────────────────
     def _begin(self) -> None:
@@ -167,20 +226,86 @@ class Connection:
                 "or cancel it before issuing the next command"
             )
 
-    def _reconnect(self) -> None:
-        """Redial + re-HELLO on the remembered address (new queries only;
-        an in-flight stream on the dead socket is already lost)."""
+    def _can_failover(self) -> bool:
+        return self._auto_reconnect or len(self._servers) > 1
+
+    def _reconnect(self, prefer_next: bool = False) -> None:
+        """Redial + re-HELLO. With a server fleet, candidates rotate from
+        the current server (``prefer_next`` starts at the NEXT peer — the
+        mid-stream-failover case, where the current server just died);
+        each successful redial bumps the connection epoch, invalidating
+        prepared handles (``execute`` re-prepares transparently)."""
         try:
             self._sock.close()
         except OSError:
             pass
+        if self._servers:
+            n = len(self._servers)
+            start = (self._server_idx + 1) % n if (prefer_next and n > 1) \
+                else self._server_idx
+            last: Optional[BaseException] = None
+            for off in range(n):
+                idx = (start + off) % n
+                host, port = self._servers[idx]
+                dial = dict(self._dial, host=host, port=port)
+                try:
+                    fresh = connect(reconnect=False, **dial)
+                except BaseException as e:  # dead peer — try the next one
+                    last = e
+                    continue
+                self._server_idx = idx
+                self._dial = dial
+                self._adopt(fresh)
+                return
+            assert last is not None
+            raise last
         fresh = connect(reconnect=False, **self._dial)
+        self._adopt(fresh)
+
+    def _adopt(self, fresh: "Connection") -> None:
         self._sock = fresh._sock
         self.tenant, self.pool = fresh.tenant, fresh.pool
         self.protocol = fresh.protocol
+        self.ready_timeout_s = fresh.ready_timeout_s
         self._stream = None
         self._stale_cancel_oks = 0
         self._dead = False
+        self._epoch += 1
+
+    def _resend_replay(self, replay: dict) -> dict:
+        """Re-issue a failed-over query on the fresh connection under its
+        ORIGINAL dedup key, re-preparing a stale statement first; returns
+        the RESULT payload after sending FETCH."""
+        req: dict
+        if replay["kind"] == "prepared":
+            stmt: PreparedHandle = replay["stmt"]
+            self._refresh_prepared(stmt)
+            req = {"statement_id": stmt.statement_id,
+                   "params": replay.get("params") or [],
+                   "dedup_key": replay["dedup_key"]}
+            self._send(P.EXECUTE_PREPARED, req)
+        else:
+            req = {"sql": replay["sql"], "dedup_key": replay["dedup_key"]}
+            if replay.get("params") is not None:
+                req["params"] = replay["params"]
+            self._send(P.EXECUTE, req)
+        _, body = self._reply(P.RESULT)
+        result = P.decode_json(body)
+        self._send(P.FETCH, {"query_id": result["query_id"]})
+        return result
+
+    def _refresh_prepared(self, stmt: PreparedHandle) -> None:
+        """Re-prepare a handle minted by an earlier connection incarnation
+        (statements are connection-scoped server-side); refreshed in place
+        so every holder of the handle sees the new statement id."""
+        if stmt._epoch == self._epoch:
+            return
+        self._send(P.PREPARE, {"sql": stmt.sql})
+        _, body = self._reply(P.PREPARE_OK)
+        info = P.decode_json(body)
+        stmt.statement_id = info["statement_id"]
+        stmt.n_params = info["n_params"]
+        stmt._epoch = self._epoch
 
     def _mark_dead_on(self, e: BaseException) -> None:
         # transport-level failures poison the socket; typed ServeErrors
@@ -214,7 +339,35 @@ class Connection:
             self._dead = True
             raise
 
-    def _fetch(self, result: dict) -> ResultStream:
+    @staticmethod
+    def _dedup_key() -> str:
+        import uuid
+
+        return uuid.uuid4().hex
+
+    def _execute_request(self, build_req, ftype: int) -> dict:
+        """Send one EXECUTE-family command and await its RESULT, failing
+        over ONCE to a peer on transport death. Safe to re-send: no result
+        frame arrived, so nothing was delivered, and the request's dedup
+        key makes the replay visible server-side. ``build_req`` is called
+        again after the redial so it can refresh connection-scoped ids
+        (prepared statement handles re-prepare under the new epoch)."""
+        try:
+            self._send(ftype, build_req())
+            _, body = self._reply(P.RESULT)
+        except (OSError, socket.timeout, P.ConnectionClosed):
+            # fleet-only: a single-server connection surfaces the error
+            # (op_timeout contract) and reconnects lazily on the NEXT
+            # command — redialing the same peer here would double every
+            # timeout for no new information
+            if len(self._servers) <= 1:
+                raise
+            self._reconnect(prefer_next=True)
+            self._send(ftype, build_req())
+            _, body = self._reply(P.RESULT)
+        return P.decode_json(body)
+
+    def _fetch(self, result: dict, replay: Optional[dict] = None) -> ResultStream:
         schema = ipc.schema_from_bytes(
             base64.b64decode(result["schema"])
         )
@@ -223,6 +376,7 @@ class Connection:
             result["query_id"],
             schema,
             cache_hit=bool(result.get("cache_hit")),
+            replay=replay,
         )
         self._send(P.FETCH, {"query_id": result["query_id"]})
         self._stream = stream
@@ -238,42 +392,65 @@ class Connection:
         from ..obs import trace as obs_trace
 
         self._begin()
-        req = {"sql": text}
-        if params is not None:
-            req["params"] = params
+        dedup = self._dedup_key()
         with obs_trace.span("serve-query", "client", {"sql": text[:120]}):
             ctx = obs_trace.current_context()
-            if ctx is not None:
-                req["trace"] = ctx.to_wire()
-            self._send(P.EXECUTE, req)
-            _, body = self._reply(P.RESULT)
-        return self._fetch(P.decode_json(body))
+
+            def build() -> dict:
+                req = {"sql": text, "dedup_key": dedup}
+                if params is not None:
+                    req["params"] = params
+                if ctx is not None:
+                    req["trace"] = ctx.to_wire()
+                return req
+
+            result = self._execute_request(build, P.EXECUTE)
+        return self._fetch(
+            result,
+            replay={"kind": "sql", "sql": text, "params": params,
+                    "dedup_key": dedup},
+        )
 
     def prepare(self, text: str) -> PreparedHandle:
         self._begin()
         self._send(P.PREPARE, {"sql": text})
         _, body = self._reply(P.PREPARE_OK)
         info = P.decode_json(body)
-        return PreparedHandle(info["statement_id"], info["n_params"], text)
+        return PreparedHandle(info["statement_id"], info["n_params"], text,
+                              epoch=self._epoch)
 
     def execute(
         self, stmt: PreparedHandle, params: Optional[List] = None
     ) -> ResultStream:
         """EXECUTE_PREPARED + FETCH: run a prepared statement with bound
-        parameters (the prepared-plan-cache path)."""
+        parameters (the prepared-plan-cache path). A handle from an
+        earlier connection incarnation (pre-reconnect/failover) is
+        re-prepared transparently first."""
         from ..obs import trace as obs_trace
 
         self._begin()
-        req = {"statement_id": stmt.statement_id, "params": params or []}
+        dedup = self._dedup_key()
         with obs_trace.span(
             "serve-execute-prepared", "client", {"statement": stmt.statement_id}
         ):
             ctx = obs_trace.current_context()
-            if ctx is not None:
-                req["trace"] = ctx.to_wire()
-            self._send(P.EXECUTE_PREPARED, req)
-            _, body = self._reply(P.RESULT)
-        return self._fetch(P.decode_json(body))
+
+            def build() -> dict:
+                # re-read the handle inside the builder: after a failover
+                # redial the refresh mints a NEW statement id on the peer
+                self._refresh_prepared(stmt)
+                req = {"statement_id": stmt.statement_id,
+                       "params": params or [], "dedup_key": dedup}
+                if ctx is not None:
+                    req["trace"] = ctx.to_wire()
+                return req
+
+            result = self._execute_request(build, P.EXECUTE_PREPARED)
+        return self._fetch(
+            result,
+            replay={"kind": "prepared", "stmt": stmt, "params": params,
+                    "dedup_key": dedup},
+        )
 
     # ── control ─────────────────────────────────────────────────────────
     def cancel(self, query_id: str) -> bool:
@@ -344,6 +521,15 @@ class Connection:
         return False
 
 
+def _parse_server(entry) -> tuple:
+    """``"host:port"`` / ``(host, port)`` → ``(host, int(port))``."""
+    if isinstance(entry, str):
+        host, _, port = entry.rpartition(":")
+        return host or "127.0.0.1", int(port)
+    host, port = entry
+    return host, int(port)
+
+
 def connect(
     host: str = "127.0.0.1",
     port: int = 8045,
@@ -351,6 +537,7 @@ def connect(
     timeout: Optional[float] = 30.0,
     op_timeout: Optional[float] = None,
     reconnect: bool = True,
+    servers: Optional[List] = None,
 ) -> Connection:
     """Open + authenticate one connection (HELLO → HELLO_OK). ``token``
     selects the tenant/pool under ``spark.rapids.tpu.serve.tenants``;
@@ -360,7 +547,28 @@ def connect(
     forever) is the per-reply socket timeout afterwards — the half-open-
     socket bound: a silently dead server surfaces as ``socket.timeout``
     and the connection marks itself dead, so the next new query redials
-    (``reconnect``)."""
+    (``reconnect``).
+
+    ``servers`` — the serve-fleet list (``"host:port"`` strings or
+    ``(host, port)`` tuples). The first reachable peer is dialed, in
+    order; afterwards, a transport death mid-stream rotates to the next
+    peer and replays the in-flight query under its dedup key, and dead-
+    connection redials walk the same rotation."""
+    if servers:
+        fleet = [_parse_server(s) for s in servers]
+        last: Optional[BaseException] = None
+        for idx, (h, p) in enumerate(fleet):
+            try:
+                conn = connect(host=h, port=p, token=token, timeout=timeout,
+                               op_timeout=op_timeout, reconnect=reconnect)
+            except OSError as e:
+                last = e
+                continue
+            conn._servers = fleet
+            conn._server_idx = idx
+            return conn
+        assert last is not None
+        raise last
     sock = socket.create_connection((host, port), timeout=timeout)
     # the dial timeout (still armed from create_connection) bounds the
     # HELLO exchange too — a server that accepts but never greets must

@@ -36,7 +36,7 @@ import select
 import socket
 import threading
 import time
-from collections import deque
+from collections import OrderedDict, deque
 from typing import Dict, Optional
 
 import pyarrow as pa
@@ -157,6 +157,7 @@ class TpuServer:
         self._sock: Optional[socket.socket] = None
         self._accept_thread: Optional[threading.Thread] = None
         self._conns: set = set()  # graft: guarded_by(_conn_lock)
+        self._handler_threads: set = set()  # graft: guarded_by(_conn_lock)
         self._conn_lock = threading.Lock()
         self._stopping = threading.Event()
         # ── survivability state ─────────────────────────────────────────
@@ -194,6 +195,13 @@ class TpuServer:
         #: (tenant, wait_s, run_s) per served query — the SLO bench's
         #: percentile source (bounded; aggregate totals live in serve.*)
         self.latency_samples: deque = deque(maxlen=8192)
+        #: failover dedup window: client-generated dedup keys recently
+        #: seen, bounded LRU sized by serve.failover.dedupWindow. A key
+        #: seen again is a failover replay of a query this server already
+        #: answered once (counted, for attribution; the engine is
+        #: side-effect-free, so re-execution is safe either way)
+        self._dedup_seen: OrderedDict = OrderedDict()  # graft: guarded_by(_dedup_lock)
+        self._dedup_lock = threading.Lock()
 
     # ── lifecycle ───────────────────────────────────────────────────────
     def start(self) -> tuple:
@@ -330,6 +338,38 @@ class TpuServer:
                 pass
         if self._accept_thread is not None:
             self._accept_thread.join(timeout=5)
+        # join handler threads so post-stop() session state (exported
+        # traces, ledgers, leak checks) is fully settled — a client that
+        # raced its END frame otherwise reads it mid-unwind (GIL-schedule
+        # dependent on small boxes). kill() deliberately skips this.
+        with self._conn_lock:
+            handlers = list(self._handler_threads)
+            self._handler_threads.clear()
+        me = threading.current_thread()
+        for t in handlers:
+            if t is not me:
+                t.join(timeout=5)
+
+    def kill(self) -> None:
+        """Crash simulation (the failover chaos hook): drop the listener
+        and every client socket on the floor — no drain window, no typed
+        END/ERROR frames. Clients observe a bare transport death
+        mid-stream, exactly the signal ``ResultStream`` fails over on."""
+        self._stopping.set()
+        self._ready.clear()
+        _M.gauge("serve.draining").set(0)
+        self._close_listener()
+        with self._conn_lock:
+            conns = list(self._conns)
+        for c in conns:
+            try:
+                c.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                c.close()
+            except OSError:
+                pass
 
     def __enter__(self) -> "TpuServer":
         self.start()
@@ -371,12 +411,20 @@ class TpuServer:
                 except OSError:
                     pass
                 return
-            threading.Thread(
+            t = threading.Thread(
                 target=self._handle_conn,
                 args=(conn, addr),
                 name=f"tpu-serve-{addr[0]}:{addr[1]}",
                 daemon=True,
-            ).start()
+            )
+            with self._conn_lock:
+                # track for stop()'s join; prune finished handlers so a
+                # long-lived server doesn't accumulate dead thread objects
+                self._handler_threads = {
+                    h for h in self._handler_threads if h.is_alive()
+                }
+                self._handler_threads.add(t)
+            t.start()
 
     def _handle_conn(self, sock: socket.socket, addr) -> None:
         with self._conn_lock:
@@ -578,11 +626,35 @@ class TpuServer:
             },
         )
 
+    def _note_dedup(self, key: Optional[str]) -> None:
+        """Record a client dedup key; a repeat is a failover replay of a
+        query already answered once (by this server or a dead peer that
+        shared the client). Counted for attribution — the engine is pure,
+        so re-executing is correct; at-most-once delivery is the CLIENT's
+        job (it skips the frames it already yielded)."""
+        if not key:
+            return
+        window = cfg.SERVE_FAILOVER_DEDUP_WINDOW.get(self.session.conf)
+        if window <= 0:
+            return
+        with self._dedup_lock:
+            if key in self._dedup_seen:
+                self._dedup_seen.move_to_end(key)
+                replay = True
+            else:
+                self._dedup_seen[key] = True
+                replay = False
+                while len(self._dedup_seen) > window:
+                    self._dedup_seen.popitem(last=False)
+        if replay:
+            _M.counter("serve.dedupReplays").add(1)
+
     def _cmd_execute(self, sock, tenant, pending, req) -> None:
         from ..obs.trace import SpanContext
 
         sql_text = req.get("sql") or ""
         params = req.get("params")
+        self._note_dedup(req.get("dedup_key"))
         df = self.session.sql(sql_text, params=params)
         final_plan, ctx = self.session._prepare_plan(df._plan)
         pq = _PendingQuery(
@@ -612,6 +684,7 @@ class TpuServer:
         stmt = statements.get(sid)
         if stmt is None:
             raise SqlError(f"unknown statement_id {sid!r}")
+        self._note_dedup(req.get("dedup_key"))
         from ..obs.trace import SpanContext
 
         final_plan, ctx, hit = self.prepared.resolve(

@@ -759,7 +759,7 @@ class TpuSession:
         from .plan.fusion import fuse_stages
 
         final_plan, self._last_fused_stages = fuse_stages(
-            final_plan, self.conf
+            final_plan, self.conf, breaker=self._breaker
         )
         if cfg.EXCHANGE_REUSE_ENABLED.get(self.conf):
             from .plan.reuse import reuse_exchanges
@@ -798,7 +798,9 @@ class TpuSession:
                 pass
         return final_plan, ctx
 
-    def _run_task(self, thunk, attempts: int, on_retry=None) -> List[pa.RecordBatch]:
+    def _run_task(self, thunk, attempts: int, on_retry=None,
+                  partition_id: int = 0, token=None, ledger=None,
+                  tracer=None) -> List[pa.RecordBatch]:
         """One partition task with Spark's retry model (spark.task.maxFailures;
         SURVEY §5 failure detection): the lineage IS the recovery mechanism —
         a partition thunk is a pure closure over its upstream pipeline, so a
@@ -806,15 +808,36 @@ class TpuSession:
         partial stream from a failed attempt is discarded). Deterministic
         semantic errors surface immediately: retrying an ANSI overflow or an
         assertion can only fail again — and so can a cancelled or
-        deadline-expired query (sched/ errors never retry)."""
+        deadline-expired query (sched/ errors never retry).
+
+        Each attempt runs under a lineage attempt scope
+        (resilience/lineage.py): the attempt id becomes this worker
+        thread's ``TaskInfo.attempt`` for every plan layer, shuffle writers
+        commit atomically per (map, attempt), and re-executions are
+        accounted on ``task.reattempts`` with their wall time attributed
+        to the ledger's ``recovery`` phase."""
         from .expr.base import AnsiError
         from .resilience import CompileDeadlineError
+        from .resilience import faults as _faults
+        from .resilience import lineage as _lineage
         from .sched import SchedulerError
 
+        desc = _lineage.TaskDescriptor(partition_id, query_id=getattr(
+            token, "query_id", ""
+        ))
         last: Optional[Exception] = None
         for attempt in range(max(1, attempts)):
+            desc.attempt = attempt
             try:
-                return list(thunk())
+                with _lineage.attempt_scope(attempt):
+                    # chaos straggler point: the configured partition's
+                    # FIRST attempt crawls (token-beating sleep) — what the
+                    # speculation monitor must overtake
+                    _faults.on_task_attempt(partition_id, attempt, token)
+                    if attempt == 0:
+                        return list(thunk())
+                    with _lineage.recovery_scope(ledger):
+                        return list(thunk())
             except (AssertionError, AnsiError, SchedulerError,
                     CompileDeadlineError):
                 # a blown compile budget is never task-retried: the retry
@@ -832,8 +855,12 @@ class TpuSession:
                 if attempt + 1 < attempts:
                     import logging
 
+                    _lineage.record_reattempt(desc, e, ledger=ledger,
+                                              tracer=tracer)
                     logging.getLogger(__name__).warning(
-                        "task failed (attempt %d/%d), retrying from lineage: %s",
+                        "task failed (partition %d, attempt %d/%d), "
+                        "retrying from lineage: %s",
+                        partition_id,
                         attempt + 1,
                         attempts,
                         e,
@@ -860,9 +887,10 @@ class TpuSession:
         yield from self._stream_parts(parts, attempts, token, on_retry, ledger)
 
     def _stream_parts(self, parts, attempts, token, on_retry, ledger=None):
-        for thunk in parts.parts:
+        for i, thunk in enumerate(parts.parts):
             for rb in self._run_task(
-                _token_checked(thunk, token, ledger), attempts, on_retry
+                _token_checked(thunk, token, ledger), attempts, on_retry,
+                partition_id=i, token=token, ledger=ledger,
             ):
                 if rb.num_rows:
                     yield rb
@@ -902,18 +930,44 @@ class TpuSession:
             # still being spawned (workers all exist once every submit
             # returns — ThreadPoolExecutor spawns up to max_workers threads
             # on submission, and len(parts) >= n_threads here).
+            # straggler speculation (sched/speculation.py): when enabled
+            # and this query runs under a cancel token, partitions route
+            # through the monitor — it launches duplicate attempts for
+            # stragglers, first commit wins, the loser is cancelled with
+            # reason 'speculation' through an attempt-scoped child token
+            spec = None
+            if cfg.SPECULATION_ENABLED.get(self.conf) and token is not None:
+                from .sched.speculation import SpeculationMonitor
+
+                spec = SpeculationMonitor.from_conf(
+                    self.conf, ctx=ctx, token=token,
+                    pool=getattr(self._scheduler, "pool", None),
+                    n_partitions=len(parts.parts),
+                )
+
+            def _submit_task(i, t):
+                if spec is None:
+                    return lambda: self._run_task(
+                        _token_checked(t, token, ledger), attempts, on_retry,
+                        partition_id=i, token=token, ledger=ledger,
+                    )
+
+                def run_attempt(attempt_token):
+                    return self._run_task(
+                        _token_checked(t, attempt_token, ledger), attempts,
+                        on_retry, partition_id=i, token=attempt_token,
+                        ledger=ledger,
+                    )
+
+                return lambda: spec.run_partition(i, run_attempt)
+
             with _STACK_SIZE_LOCK:
                 prev_stack = threading.stack_size(BIG_STACK_BYTES)
                 try:
                     pool = ThreadPoolExecutor(max_workers=n_threads)
                     futures = [
-                        pool.submit(
-                            self._run_task,
-                            _token_checked(t, token, ledger),
-                            attempts,
-                            on_retry,
-                        )
-                        for t in parts.parts
+                        pool.submit(_submit_task(i, t))
+                        for i, t in enumerate(parts.parts)
                     ]
                 finally:
                     threading.stack_size(prev_stack)
@@ -921,6 +975,8 @@ class TpuSession:
                 results = [f.result() for f in futures]
             finally:
                 pool.shutdown(wait=True)
+                if spec is not None:
+                    spec.close()
                 self._task_retries = query_retries[0]
             batches = [rb for rbs in results for rb in rbs if rb.num_rows]
         else:
@@ -1640,8 +1696,11 @@ class DataFrame:
                 try:
                     batches = [
                         db
-                        for t in parts.parts
-                        for db in self._session._run_task(t, attempts, on_retry)
+                        for i, t in enumerate(parts.parts)
+                        for db in self._session._run_task(
+                            t, attempts, on_retry, partition_id=i,
+                            token=admission.token,
+                        )
                     ]
                 finally:
                     self._session._task_retries = query_retries[0]

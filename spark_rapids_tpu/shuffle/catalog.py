@@ -135,6 +135,20 @@ class ShuffleBufferCatalog:
                     self._by_buffer.pop(bid, None)
                     handle.close()
 
+    def remove_map(self, shuffle_id: int, map_id: int):
+        """Unregister ONE map task's output (attempt abort): a failed map
+        attempt's partial writes are dropped wholesale so the re-run under
+        the next attempt id starts from a clean key range — the storage
+        half of the atomic per-(map, attempt) commit."""
+        with self._lock:
+            keys = [
+                k for k in self._parts if k[0] == shuffle_id and k[1] == map_id
+            ]
+            for k in keys:
+                for bid, handle, _rows in self._parts.pop(k):
+                    self._by_buffer.pop(bid, None)
+                    handle.close()
+
     def stats(self) -> dict:
         with self._lock:
             return {"cached_batches": len(self._by_buffer)}

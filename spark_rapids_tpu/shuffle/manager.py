@@ -25,15 +25,43 @@ from .server import ShuffleServer
 from .transport import ClientConnection, InflightThrottle, Transport
 
 
+#: storage-id stride separating task attempts of one logical map: attempt k
+#: stores its blocks under ``logical_map_id + k * ATTEMPT_STRIDE``, so a
+#: re-executed map task never touches the keys a previous (possibly
+#: partially-written) attempt used — commit is the only point an attempt
+#: becomes visible, and it replaces the logical map's status wholesale.
+ATTEMPT_STRIDE = 100_000
+
+
+class MapOutputLostError(RuntimeError):
+    """A shuffle's committed map output is gone (peer blacklisted/lost, or
+    a registry wiped by injected chaos). Partition-scoped and recoverable:
+    the lineage layer re-executes the map stage under a fresh generation
+    instead of failing the query."""
+
+
 class MapStatus:
     """Map-task completion record: where the output lives + per-partition
-    sizes (Spark MapStatus; RapidsShuffleInternalManagerBase:164+)."""
+    sizes (Spark MapStatus; RapidsShuffleInternalManagerBase:164+).
+
+    ``map_id`` is the STORAGE id (attempt-striped — what block keys and
+    fetch requests carry); ``logical_map_id``/``attempt`` recover the
+    lineage identity, so the registry keeps exactly one committed attempt
+    per logical map task."""
 
     def __init__(self, executor_id: str, shuffle_id: int, map_id: int, sizes: List[int]):
         self.executor_id = executor_id
         self.shuffle_id = shuffle_id
         self.map_id = map_id
         self.sizes = sizes
+
+    @property
+    def logical_map_id(self) -> int:
+        return self.map_id % ATTEMPT_STRIDE
+
+    @property
+    def attempt(self) -> int:
+        return self.map_id // ATTEMPT_STRIDE
 
 
 class MapOutputRegistry:
@@ -44,8 +72,11 @@ class MapOutputRegistry:
         self._statuses: Dict[Tuple[int, int], MapStatus] = {}
 
     def register(self, status: MapStatus):
+        # keyed by LOGICAL map id: committing a re-executed attempt
+        # atomically replaces its predecessor — consumers never see two
+        # attempts of one map task side by side
         with self._lock:
-            self._statuses[(status.shuffle_id, status.map_id)] = status
+            self._statuses[(status.shuffle_id, status.logical_map_id)] = status
 
     def outputs_for(self, shuffle_id: int) -> List[MapStatus]:
         with self._lock:
@@ -185,13 +216,24 @@ class ShuffleEnv:
 
 class CachingWriter:
     """Map-side writer: batches stay device-resident and spillable
-    (RapidsCachingWriter.write)."""
+    (RapidsCachingWriter.write).
 
-    def __init__(self, env: ShuffleEnv, registry: MapOutputRegistry, shuffle_id: int, map_id: int, num_partitions: int):
+    Attempt-atomic: blocks are parked under the attempt-striped storage id
+    (``map_id + attempt * ATTEMPT_STRIDE``), invisible to readers until
+    ``commit`` registers the MapStatus; ``abort`` drops a failed attempt's
+    partial writes so the re-run starts clean. Readers therefore never
+    observe a torn map output — the written-then-committed sequence is the
+    shuffle's equivalent of write-temp-then-rename."""
+
+    def __init__(self, env: ShuffleEnv, registry: MapOutputRegistry,
+                 shuffle_id: int, map_id: int, num_partitions: int,
+                 attempt: int = 0):
         self._env = env
         self._registry = registry
         self.shuffle_id = shuffle_id
-        self.map_id = map_id
+        self.logical_map_id = map_id
+        self.attempt = attempt
+        self.map_id = map_id + attempt * ATTEMPT_STRIDE
         self._sizes = [0] * num_partitions
 
     def write(self, partition_id: int, batch: DeviceBatch):
@@ -209,6 +251,12 @@ class CachingWriter:
         )
         self._registry.register(status)
         return status
+
+    def abort(self) -> None:
+        """Drop this attempt's partial output (never committed, so no
+        reader could have started on it)."""
+        self._env.catalog.remove_map(self.shuffle_id, self.map_id)
+        self._sizes = [0] * len(self._sizes)
 
 
 class CachingReader:
@@ -273,8 +321,12 @@ class TpuShuffleManager:
         self.env = env
         self.registry = registry
 
-    def get_writer(self, shuffle_id: int, map_id: int, num_partitions: int) -> CachingWriter:
-        return CachingWriter(self.env, self.registry, shuffle_id, map_id, num_partitions)
+    def get_writer(self, shuffle_id: int, map_id: int, num_partitions: int,
+                   attempt: int = 0) -> CachingWriter:
+        return CachingWriter(
+            self.env, self.registry, shuffle_id, map_id, num_partitions,
+            attempt=attempt,
+        )
 
     def get_reader(self) -> CachingReader:
         return CachingReader(self.env, self.registry)

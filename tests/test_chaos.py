@@ -145,6 +145,75 @@ def test_split_and_retry_executes_oversized_batch():
     assert rep["faults_injected"] > 0
 
 
+def _fused_chain_agg_query(session):
+    """A plan with a >=2-op project/filter chain (a fused StageExec when
+    fusion is on) feeding integer aggregates — the split-invariant shape."""
+    from spark_rapids_tpu.functions import col, count
+    from spark_rapids_tpu.functions import max as max_
+    from spark_rapids_tpu.functions import min as min_
+    from spark_rapids_tpu.functions import sum as sum_
+
+    rng = np.random.default_rng(11)
+    n = 6000
+    t = pa.table(
+        {
+            "k": (np.arange(n) % 7).astype(np.int64),
+            "v": rng.integers(0, 1000, n).astype(np.int64),
+        }
+    )
+    return (
+        session.create_dataframe(t, num_partitions=2)
+        .select(col("k"), (col("v") * 3 + 1).alias("v1"))
+        .filter(col("v1") > 400)
+        .select(col("k"), (col("v1") % 97).alias("v2"))
+        .filter(col("v2") > 5)
+        .group_by("k")
+        .agg(
+            sum_(col("v2")).alias("s"),
+            count(col("v2")).alias("c"),
+            min_(col("v2")).alias("mn"),
+            max_(col("v2")).alias("mx"),
+        )
+    )
+
+
+@pytest.mark.slow
+def test_oom_split_composes_with_fused_stages_and_shape_buckets():
+    """The three batch-geometry layers compose under injected OOM: a fused
+    StageExec (whole-stage program), pow-2 shape-bucketed capacities, and
+    the split-and-retry escalation. Splitting a bucketed batch re-buckets
+    the halves; the fused program recompiles (cache-hits) at the smaller
+    bucket; integer aggregates make the result split-invariant, so the
+    faulted run must match the fault-free one exactly."""
+    conf = {
+        "spark.sql.shuffle.partitions": 2,
+        "spark.rapids.tpu.fusion.enabled": True,
+        "spark.rapids.tpu.shapeBuckets.enabled": True,
+        "spark.rapids.tpu.shapeBuckets.minRows": 512,
+    }
+    clean = tpu_session(conf)
+    base = _collect(clean, _fused_chain_agg_query)
+    assert clean._last_fused_stages > 0, "plan formed no fused stage"
+    R.reset()
+    faulted = tpu_session(
+        dict(
+            conf,
+            **{
+                "spark.rapids.tpu.faults.enabled": True,
+                "spark.rapids.tpu.faults.oomAboveBytes": 48 * 1024,
+                "spark.rapids.tpu.retry.oom.maxRetries": 0,
+                "spark.rapids.tpu.retry.oom.minSplitRows": 512,
+            },
+        )
+    )
+    got = _collect(faulted, _fused_chain_agg_query)
+    assert got == base
+    assert faulted._last_fused_stages > 0, "fusion lost under faults"
+    rep = R.report()
+    assert rep["splits"] > 0, "oversized fused batches never split"
+    assert rep["faults_injected"] > 0
+
+
 def test_split_floor_fails_loudly():
     """Below the min-rows floor the state machine re-raises instead of
     splitting forever."""

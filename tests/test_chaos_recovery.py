@@ -1,0 +1,276 @@
+"""Recovery chaos suite (ISSUE 18) — lineage re-execution under storms.
+
+The acceptance storm: device faults, lost map outputs, and a killed serve
+peer — all partition-scoped — must complete with bit-identical results
+against the CPU oracle and ZERO whole-query restarts: every fault is
+absorbed at partition granularity (attempt re-execution, map-output
+recomputation from lineage, speculative duplicates, serve-fleet failover)
+and the recovery counters attribute each absorption.
+"""
+from __future__ import annotations
+
+import threading
+import time
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu.obs.metrics import GLOBAL
+from spark_rapids_tpu.resilience import retry as R
+from spark_rapids_tpu.serve import TpuServer, connect
+from tests.harness import _normalize, cpu_session, tpu_session
+
+# chaos + slow like test_chaos_restart.py: multi-second storm/fleet drills
+# run under `make chaos-recovery` / `make chaos`, not the tier-1 sweep
+pytestmark = [pytest.mark.chaos, pytest.mark.slow]
+
+
+@pytest.fixture(autouse=True)
+def _reset_counters():
+    R.reset()
+    yield
+    R.reset()
+
+
+def _counter(name: str) -> int:
+    return GLOBAL.counter(name).value
+
+
+def _storm_query(session):
+    """Integer filter + group aggregates over a shuffled table — the
+    split-invariant shape (see test_chaos.py header): bit-identity is
+    assertable no matter how recovery re-executes or splits batches."""
+    from spark_rapids_tpu.functions import col, count
+    from spark_rapids_tpu.functions import max as max_
+    from spark_rapids_tpu.functions import min as min_
+    from spark_rapids_tpu.functions import sum as sum_
+
+    rng = np.random.default_rng(29)
+    n = 12_000
+    t = pa.table(
+        {
+            "k": (np.arange(n) % 17).astype(np.int64),
+            "v": rng.integers(0, 10_000, n).astype(np.int64),
+        }
+    )
+    return (
+        session.create_dataframe(t, num_partitions=3)
+        .filter(col("v") > 50)
+        .group_by("k")
+        .agg(
+            sum_(col("v")).alias("s"),
+            count(col("v")).alias("c"),
+            min_(col("v")).alias("mn"),
+            max_(col("v")).alias("mx"),
+        )
+    )
+
+
+def test_device_fault_and_peer_loss_storm_bit_identical_vs_cpu_oracle(monkeypatch):
+    """Device OOM every 3rd recoverable launch AND a lost peer's map
+    outputs (twice): the query must finish bit-identical to the CPU
+    engine with zero whole-query restarts — losses recompute from
+    lineage, OOMs spill-retry, failed partition attempts re-execute, and
+    every recovery is counted. The peer loss is BOUNDED (two strikes)
+    rather than every-N: an unbounded modulus that divides the
+    reads-per-attempt would re-kill every regeneration forever, which no
+    real peer-loss storm does."""
+    oracle = _normalize(_storm_query(cpu_session({})).collect(), True)
+
+    from spark_rapids_tpu.resilience import faults as F
+
+    losses: list = []
+
+    def lose_twice() -> bool:
+        if len(losses) < 2:
+            losses.append(1)
+            return True
+        return False
+
+    monkeypatch.setattr(F, "lose_map_output", lose_twice)
+    s = tpu_session(
+        {
+            "spark.sql.shuffle.partitions": 4,
+            "spark.rapids.shuffle.manager.enabled": True,
+            "spark.task.maxFailures": 8,
+            "spark.rapids.tpu.recovery.maxMapRecomputes": 8,
+            "spark.rapids.tpu.faults.enabled": True,
+            "spark.rapids.tpu.faults.deviceOomEveryN": 3,
+        }
+    )
+    reattempts0 = _counter("task.reattempts")
+    recomputed0 = _counter("shuffle.recomputedPartitions")
+    runs = {"n": 0}
+    orig = type(s)._run_plan
+
+    def count_runs(self, final_plan, ctx):
+        runs["n"] += 1
+        return orig(self, final_plan, ctx)
+
+    type(s)._run_plan = count_runs
+    try:
+        got = _normalize(_storm_query(s).collect(), True)
+    finally:
+        type(s)._run_plan = orig
+    assert got == oracle
+    # zero whole-query restarts: ONE plan execution absorbed every fault
+    assert runs["n"] == 1
+    rep = R.report()
+    assert rep["faults_injected"] > 0, "the storm never fired — test is inert"
+    assert rep["oom_retries"] > 0
+    assert losses, "peer loss never fired — test is inert"
+    assert _counter("shuffle.recomputedPartitions") > recomputed0, (
+        "map-output loss never exercised lineage recomputation"
+    )
+    assert _counter("task.reattempts") > reattempts0, (
+        "no partition attempt was ever re-executed"
+    )
+
+
+def test_speculation_rides_out_straggler_during_fault_storm():
+    """Straggler speculation under concurrent device faults: the stalled
+    partition is overtaken by its duplicate while OTHER partitions absorb
+    injected OOMs — results stay bit-identical and permits balance."""
+    from spark_rapids_tpu.functions import col
+
+    def build(session):
+        t = pa.table({"v": np.arange(20_000, dtype=np.int64)})
+        return (
+            session.create_dataframe(t, num_partitions=4)
+            .select((col("v") * 7 + 3).alias("d"))
+            .filter(col("d") > 100)
+        )
+
+    oracle = _normalize(build(cpu_session({})).collect(), True)
+    s = tpu_session(
+        {
+            "spark.rapids.sql.concurrentGpuTasks": 4,
+            "spark.rapids.tpu.speculation.enabled": True,
+            "spark.rapids.tpu.speculation.quantile": 0.25,
+            "spark.rapids.tpu.speculation.multiplier": 1.2,
+            "spark.rapids.tpu.speculation.minRuntime": 0.05,
+            "spark.rapids.tpu.speculation.interval": 0.02,
+            "spark.rapids.tpu.faults.enabled": True,
+            "spark.rapids.tpu.faults.deviceOomEveryN": 5,
+            "spark.rapids.tpu.faults.stallPartition": 2,
+            "spark.rapids.tpu.faults.stallPartitionSeconds": 60.0,
+        }
+    )
+    launched0 = _counter("speculation.launched")
+    won0 = _counter("speculation.won")
+    t0 = time.monotonic()
+    got = _normalize(build(s).collect(), True)
+    elapsed = time.monotonic() - t0
+    assert got == oracle
+    assert elapsed < 50.0, f"straggler never overtaken ({elapsed:.1f}s)"
+    assert _counter("speculation.launched") > launched0
+    assert _counter("speculation.won") > won0
+    # permits balanced after the race (reswatch green)
+    assert s.scheduler.pool.in_use == 0
+    assert s.scheduler.pool.queued == 0
+
+
+# ── serve-fleet failover: kill a server mid-stream ─────────────────────────
+
+
+def _fleet_table() -> pa.Table:
+    rng = np.random.default_rng(31)
+    n = 30_000
+    return pa.table(
+        {
+            "k": (np.arange(n) % 13).astype(np.int64),
+            "v": rng.integers(0, 100_000, n).astype(np.int64),
+        }
+    )
+
+
+def test_kill_server_mid_stream_fails_over_and_loses_no_rows():
+    """Two serve peers over one session; the client streams from peer A,
+    A is killed abruptly mid-stream (bare transport death — no drain, no
+    typed ERROR), and the stream transparently redials peer B, replays
+    the query under its dedup key, skips the batches already delivered,
+    and finishes with exactly the oracle rows. Zero whole-query restarts
+    at the CLIENT: iteration never raises."""
+    t = _fleet_table()
+    oracle_s = cpu_session({})
+    oracle_s.create_or_replace_temp_view("fleet_chaos_t", oracle_s.create_dataframe(t))
+    # a WIDE row-level result (~10k rows → hundreds of 16-row frames) with
+    # a total order, so the kill lands mid-stream and the replayed peer
+    # re-emits the identical frame sequence for exact skip-resume
+    sql = (
+        "select k, v from fleet_chaos_t where v % 3 = 0 order by v, k"
+    )
+    oracle = _normalize(oracle_s.sql(sql).collect(), True)
+
+    s = tpu_session(
+        {
+            "spark.sql.shuffle.partitions": 2,
+            # many small frames so the kill lands mid-stream, not pre-END
+            "spark.rapids.tpu.serve.streamBatchRows": 16,
+        }
+    )
+    s.create_or_replace_temp_view("fleet_chaos_t", s.create_dataframe(t))
+    server_a = TpuServer(s, host="127.0.0.1", port=0)
+    server_b = TpuServer(s, host="127.0.0.1", port=0)
+    host_a, port_a = server_a.start()
+    host_b, port_b = server_b.start()
+    failovers0 = _counter("serve.failovers")
+    try:
+        with connect(
+            servers=[f"{host_a}:{port_a}", f"{host_b}:{port_b}"]
+        ) as conn:
+            assert conn._server_idx == 0
+            stream = conn.sql(sql)
+            got_batches = []
+            killed = False
+            for rb in stream:
+                got_batches.append(rb)
+                if not killed and len(got_batches) == 3:
+                    server_a.kill()  # abrupt: client sees transport death
+                    killed = True
+            assert killed, "stream ended before the kill — test is inert"
+            assert conn._server_idx == 1, "stream never moved to peer B"
+            got = _normalize(
+                [tuple(row) for rb in got_batches for row in zip(
+                    *[c.to_pylist() for c in rb.columns]
+                )],
+                True,
+            )
+            assert got == oracle
+            assert _counter("serve.failovers") > failovers0
+    finally:
+        server_a.kill()
+        server_b.stop()
+
+
+def test_prepared_statement_reprepared_after_failover():
+    """A prepared handle minted on peer A keeps working after A dies:
+    execute() re-prepares transparently on peer B (epoch bump) and the
+    replayed execution returns the same rows."""
+    t = _fleet_table()
+    s = tpu_session({"spark.sql.shuffle.partitions": 2})
+    s.create_or_replace_temp_view("fleet_prep_t", s.create_dataframe(t))
+    server_a = TpuServer(s, host="127.0.0.1", port=0)
+    server_b = TpuServer(s, host="127.0.0.1", port=0)
+    host_a, port_a = server_a.start()
+    host_b, port_b = server_b.start()
+    try:
+        with connect(
+            servers=[f"{host_a}:{port_a}", f"{host_b}:{port_b}"]
+        ) as conn:
+            stmt = conn.prepare(
+                "select count(*) as c from fleet_prep_t where v < ?"
+            )
+            before = conn.execute(stmt, [50_000]).to_table()
+            old_epoch = stmt._epoch
+            server_a.kill()
+            # the dead transport surfaces on the NEXT command; the
+            # connection redials peer B and execute() re-prepares
+            after = conn.execute(stmt, [50_000]).to_table()
+            assert after.to_pylist() == before.to_pylist()
+            assert conn._server_idx == 1
+            assert stmt._epoch > old_epoch
+    finally:
+        server_a.kill()
+        server_b.stop()

@@ -336,9 +336,34 @@ def test_drain_lets_inflight_finish_and_rejects_new_work():
         server.stop()
 
 
-def test_drain_timeout_cancels_with_shutdown_reason():
+def test_drain_timeout_cancels_with_shutdown_reason(monkeypatch):
     s, server = _mini_rig()
     try:
+        # Deterministic gating (this test used to flake): the stream is
+        # held in-flight not by wall-clock read pacing (which raced the
+        # 0.3s drain window — a fast machine could finish the whole query
+        # before the deadline) but by a gate INSIDE the batch generator:
+        # after the first batch it refuses to advance until drain has
+        # actually cancelled something, observed via the drainCancelled
+        # counter moving past its captured base. The next advance then
+        # hits the query token's check and raises the typed cancellation.
+        base = GLOBAL.counter("serve.drainCancelled").value
+        real_stream = s.run_plan_stream
+
+        def gated_stream(*a, **k):
+            first = True
+            for rb in real_stream(*a, **k):
+                yield rb
+                if first:
+                    first = False
+                    _poll(
+                        lambda:
+                            GLOBAL.counter("serve.drainCancelled").value
+                            > base,
+                        what="drain-deadline cancel",
+                    )
+
+        monkeypatch.setattr(s, "run_plan_stream", gated_stream)
         conn = connect(server.host, server.port)
         stream = conn.sql("select id from surv_big where id % 5 <> 0")
         it = iter(stream)
@@ -347,11 +372,8 @@ def test_drain_timeout_cancels_with_shutdown_reason():
 
         def consume():
             try:
-                for i, _ in enumerate(it):
-                    if i < 50:
-                        # slow reads span the drain window; then drain the
-                        # buffered frames fast to reach the ERROR frame
-                        time.sleep(0.02)
+                for _ in it:
+                    pass
             except ServeError as e:
                 got.append(e)
 
