@@ -722,6 +722,194 @@ def run_serve_slo(tpu, qids, n_clients, target_qps, duration_s, sf, smoke):
     return out
 
 
+def run_dashboard_replay(tpu, qids, n_clients, duration_s, sf, smoke):
+    """Dashboard-replay mode (--serve with BENCH_DASHBOARD_MIX set): two
+    tenants replay a FIXED mix of repeated TPC-H queries (the dashboard
+    refresh pattern the semantic result cache exists for) while a
+    background thread periodically replaces an ``events`` temp view that
+    one mix query reads — so invalidation runs during measurement, not
+    just in tests. Phase A runs with the result cache + subplan dedup
+    DISABLED, phase B with both ENABLED; the result reports the qps
+    ratio, the cache hit ratio, and the p99 delta between phases
+    (ISSUE 19 acceptance: >=5x qps at unchanged p99). Result:
+    SLO_r08.json."""
+    import threading
+    from spark_rapids_tpu.obs.metrics import GLOBAL
+    from spark_rapids_tpu.serve import TpuServer, connect
+    from spark_rapids_tpu.tpch.datagen import TABLES, gen_table
+    from spark_rapids_tpu.tpch.sql_queries import tpch_sql
+
+    tpu.set_conf(
+        "spark.rapids.tpu.serve.tenants",
+        "tok-dash:dash:interactive,tok-etl:etl:etl",
+    )
+    tpu.set_conf("spark.rapids.tpu.scheduler.pools", "interactive:3,etl:1")
+    for name in TABLES:
+        tpu.create_dataframe(gen_table(name, sf)).create_or_replace_temp_view(
+            name
+        )
+
+    def events_table(version: int):
+        import pyarrow as pa
+
+        n = 2000
+        return pa.table({
+            "ev": pa.array([version] * n, type=pa.int64()),
+            "val": pa.array(list(range(n)), type=pa.int64()),
+        })
+
+    tpu.create_dataframe(events_table(0)).create_or_replace_temp_view("events")
+    server = TpuServer(tpu, port=0)
+    host, port = server.start()
+    log({"dashboard_replay": {"host": host, "port": port, "sf": sf,
+                              "qids": list(qids)}})
+
+    # the fixed mix: the TPC-H repeats plus one query over the view the
+    # append thread churns (its entries invalidate mid-phase)
+    mix = [tpch_sql(q, sf=1.0) for q in qids]
+    mix.append("SELECT ev, sum(val) AS sv, count(*) AS n FROM events GROUP BY ev")
+    append_every_s = float(os.environ.get("BENCH_APPEND_SECONDS", "1.0"))
+
+    def set_cache(on: bool) -> None:
+        tpu.set_conf("spark.rapids.tpu.resultCache.enabled", on)
+        tpu.set_conf("spark.rapids.tpu.subplanDedup.enabled", on)
+        tpu.set_conf("spark.rapids.tpu.subplanDedup.minCostNs", 0)
+
+    # warm pass: compile every mix shape before either phase measures
+    set_cache(False)
+    with connect(host, port, token="tok-dash") as warm:
+        for text in mix:
+            warm.sql(text).drain()
+
+    stop_appends = threading.Event()
+    version = [0]
+
+    def appender():
+        while not stop_appends.wait(append_every_s):
+            version[0] += 1
+            tpu.create_dataframe(
+                events_table(version[0])
+            ).create_or_replace_temp_view("events")
+
+    app_thread = threading.Thread(target=appender, name="replay-appender")
+
+    def run_phase(duration: float) -> dict:
+        tokens = ("tok-dash", "tok-dash", "tok-etl")  # dashboard-heavy
+        errors: list = []
+        done = [0]
+        lock = threading.Lock()
+        h0 = _hist_states()
+        c0 = {
+            "hits": GLOBAL.counter("cache.result.hits").value,
+            "misses": GLOBAL.counter("cache.result.misses").value,
+            "invalidations":
+                GLOBAL.counter("cache.result.invalidations").value,
+        }
+        d0 = GLOBAL.counter("subplan.dedupHits").value
+        t_start = time.perf_counter()
+
+        def client(cid: int) -> None:
+            try:
+                conn = connect(host, port, token=tokens[cid % len(tokens)])
+            except Exception as e:  # noqa: BLE001
+                with lock:
+                    errors.append(f"connect: {str(e)[-200:]}")
+                return
+            try:
+                stmts = [conn.prepare(t) for t in mix]
+                k = cid  # stagger so clients collide on the same query too
+                while time.perf_counter() < t_start + duration:
+                    try:
+                        conn.execute(stmts[k % len(stmts)]).drain()
+                        with lock:
+                            done[0] += 1
+                    except Exception as e:  # noqa: BLE001
+                        with lock:
+                            errors.append(str(e)[-200:])
+                        if len(errors) > 20:
+                            return
+                    k += 1
+            finally:
+                conn.close()
+
+        threads = [
+            threading.Thread(target=client, args=(i,), name=f"replay-{i}")
+            for i in range(n_clients)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall = time.perf_counter() - t_start
+        pcts = _hist_pcts_ms(h0, _hist_states())
+        out = {
+            "queries_ok": done[0],
+            "wall_s": round(wall, 3),
+            "qps": round(done[0] / wall, 3) if wall > 0 else 0.0,
+            "latency_ms": pcts,
+            "cache_deltas": {
+                "hits":
+                    GLOBAL.counter("cache.result.hits").value - c0["hits"],
+                "misses":
+                    GLOBAL.counter("cache.result.misses").value
+                    - c0["misses"],
+                "invalidations":
+                    GLOBAL.counter("cache.result.invalidations").value
+                    - c0["invalidations"],
+            },
+            "dedup_hits_delta": GLOBAL.counter("subplan.dedupHits").value - d0,
+        }
+        hits = out["cache_deltas"]["hits"]
+        total = hits + out["cache_deltas"]["misses"]
+        out["hit_ratio"] = round(hits / total, 4) if total else 0.0
+        if errors:
+            out["errors"] = errors[:10]
+        return out
+
+    try:
+        app_thread.start()
+        set_cache(False)
+        phase_off = run_phase(duration_s)
+        set_cache(True)
+        phase_on = run_phase(duration_s)
+    finally:
+        # a phase that raises must not leave the appender replacing
+        # views against a stopped server
+        stop_appends.set()
+        if app_thread.ident is not None:
+            app_thread.join(timeout=10)
+    result_cache_stats = tpu._result_cache.stats()
+    server.stop()
+
+    qps_ratio = (
+        round(phase_on["qps"] / phase_off["qps"], 3)
+        if phase_off["qps"] > 0 else 0.0
+    )
+    p99_off = phase_off["latency_ms"]["total"]["p99"]
+    p99_on = phase_on["latency_ms"]["total"]["p99"]
+    out = {
+        "clients": n_clients,
+        "mix": {"tpch_qids": list(qids), "events_query": True,
+                "append_every_s": append_every_s,
+                "appends": version[0]},
+        "cache_off": phase_off,
+        "cache_on": phase_on,
+        "qps_ratio": qps_ratio,
+        "p99_total_ms": {"off": p99_off, "on": p99_on,
+                         "ratio": round(p99_on / p99_off, 3)
+                         if p99_off > 0 else 0.0},
+        "hit_ratio": phase_on["hit_ratio"],
+        "result_cache": result_cache_stats,
+        # the Prometheus-exported series (obs catalog slice): hit/miss/
+        # invalidation counters + the gauges the acceptance bar names
+        "cache_series": GLOBAL.view("cache.", strip=False),
+        "subplan_series": GLOBAL.view("subplan.", strip=False),
+        "smoke": smoke,
+    }
+    log({"dashboard_replay": out})
+    return out
+
+
 def run_query_pair(name, build_t, build_c, tpu, n_run, speedups, detail,
                    abs_tol: float = 0.0):
     """Time one query on both engines, attach per-plan diagnostics, and
@@ -930,6 +1118,37 @@ def main() -> None:
     }
     assert_backend(detail["platform"])
     speedups = []
+
+    if serve_clients > 0 and os.environ.get("BENCH_DASHBOARD_MIX", ""):
+        # dashboard-replay mode: two tenants replaying a fixed query mix
+        # against the result cache + subplan dedup, with background
+        # appends — phase A cache-off vs phase B cache-on (ISSUE 19)
+        ssf = min(sf, 0.02) if smoke else min(sf, 0.05)
+        mix_env = os.environ["BENCH_DASHBOARD_MIX"]
+        qids = (
+            tuple(int(x) for x in mix_env.split(",") if x.strip().isdigit())
+            or (1, 6)
+        )
+        duration_s = float(
+            os.environ.get("BENCH_SERVE_SECONDS", "5" if smoke else "15")
+        )
+        replay = run_dashboard_replay(
+            tpu, qids, serve_clients, duration_s, ssf, smoke
+        )
+        detail["dashboard_replay"] = replay
+        detail["wall_s"] = round(time.monotonic() - t_start, 1)
+        result = {
+            "metric": "dashboard_replay_qps_ratio",
+            "value": replay["qps_ratio"],
+            "unit": "x",
+            "vs_baseline": 0.0,
+            "detail": detail,
+        }
+        with open("SLO_r08.json", "w") as f:
+            json.dump(result, f, indent=1)
+        log({"slo_json": "SLO_r08.json"})
+        print(json.dumps(result), flush=True)
+        return
 
     if serve_clients > 0:
         # network serving SLO mode: the session behind a TpuServer, N wire

@@ -66,11 +66,16 @@ DOMAINS = (
         r"^spark_rapids_tpu/resilience/(faults|breaker|retry)\.py$",
     )),
     # session-cache bookkeeping (df.cache single-flight table, the H2D
-    # upload LRU, the retry counter): LEAF locks — dict/event ops only,
-    # materialization runs OUTSIDE them — acquired from deep inside
-    # operator execution (a broadcast build's H2D upload), so they sit
-    # near the bottom despite living on the session object
-    (78, "session-caches", (r"^spark_rapids_tpu/session\.py$",)),
+    # upload LRU, the retry counter, and the PR-19 result-cache /
+    # subplan-dedup / catalog-version structs): LEAF locks — dict/event
+    # ops only, materialization + spill IO + child execution all run
+    # OUTSIDE them — acquired from deep inside operator execution (a
+    # broadcast build's H2D upload, a waiter thunk's fallback), so they
+    # sit near the bottom despite living on the session object
+    (78, "session-caches", (
+        r"^spark_rapids_tpu/session\.py$",
+        r"^spark_rapids_tpu/cache/(keys|results|subplan)\.py$",
+    )),
     # native/bootstrap singletons
     (80, "native", (
         r"^spark_rapids_tpu/native/",

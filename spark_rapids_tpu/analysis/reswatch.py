@@ -45,6 +45,8 @@ _POOLS: "weakref.WeakSet" = weakref.WeakSet()
 _SEMAPHORES: "weakref.WeakSet" = weakref.WeakSet()
 _CATALOGS: "weakref.WeakSet" = weakref.WeakSet()
 _SCHEDULERS: "weakref.WeakSet" = weakref.WeakSet()
+_RESULT_CACHES: "weakref.WeakSet" = weakref.WeakSet()
+_SUBPLAN_REGISTRIES: "weakref.WeakSet" = weakref.WeakSet()
 
 #: engine thread-name prefixes the balance check owns; lazily-created
 #: process singletons that legitimately outlive any one test are named
@@ -112,6 +114,8 @@ def install() -> None:
         _installed = True
 
     from ..cache import xla_store as XS
+    from ..cache.results import ResultCache
+    from ..cache.subplan import SubplanRegistry
     from ..mem.semaphore import DeviceSemaphore
     from ..mem.spill import BufferCatalog
     from ..obs import ledger as OL
@@ -123,6 +127,8 @@ def install() -> None:
     _wrap_init(DeviceSemaphore, _SEMAPHORES, "sem.__init__")
     _wrap_init(BufferCatalog, _CATALOGS, "catalog.__init__")
     _wrap_init(QueryScheduler, _SCHEDULERS, "sched.__init__")
+    _wrap_init(ResultCache, _RESULT_CACHES, "rcache.__init__")
+    _wrap_init(SubplanRegistry, _SUBPLAN_REGISTRIES, "subplan.__init__")
     _wrap_scope(OT._OpenSpan, "span.scope", "span")
     _wrap_scope(OL._Scope, "ledger.scope", "ledger-phase")
 
@@ -275,6 +281,18 @@ def _check(entry: Snapshot, fd_slack: int) -> List[str]:
                 f"spill catalog {id(cat):#x}: {len(pinned)} buffer(s) "
                 "still PINNED"
             )
+    for rc in list(_RESULT_CACHES):
+        # absolute invariants, not snapshot-relative: a warm populated
+        # cache is fine; byte-accounting drift, an entry stuck mid-spill,
+        # or a negative counter is a bug whenever it is observed
+        for line in rc._orphan_report():
+            out.append(f"result cache {id(rc):#x}: {line}")
+    for reg in list(_SUBPLAN_REGISTRIES):
+        # subplan entries are concurrent-only (pin-refcounted, dropped at
+        # zero): ANY entry surviving to test end is an orphaned waiter or
+        # an unreleased lease
+        for line in reg._orphan_report():
+            out.append(f"subplan registry {id(reg):#x}: {line}")
     with _state_lock:
         counts = dict(_COUNTS)
     for kind in sorted(set(counts) | set(entry.counts)):

@@ -1,11 +1,19 @@
-"""Persistent caches that survive process boundaries.
+"""Caches above the executor: compiled artifacts and completed work.
 
 ``xla_store`` — the crash-safe on-disk XLA executable store behind
 ``kernels.GuardedJit`` (spark.rapids.tpu.compileCache.*): a restarted
 server deserializes yesterday's compiled executables instead of re-paying
 6–90s first-touch XLA compiles per query shape. See docs/operations.md
 ("Restart runbook") for the operator contract.
+
+``keys`` / ``results`` / ``subplan`` — the common-work-sharing layer for
+dashboard fleets (spark.rapids.tpu.resultCache.*, .subplanDedup.*):
+per-table data-version counters and the shared result fingerprint
+(``keys``), the bounded semantic result cache serving repeated queries
+without re-admission (``results``), and single-flight execution of
+common subtrees across concurrent in-flight queries (``subplan``). See
+docs/result-cache.md.
 """
 from . import xla_store  # noqa: F401
 
-__all__ = ["xla_store"]
+__all__ = ["xla_store", "keys", "results", "subplan"]

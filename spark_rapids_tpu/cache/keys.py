@@ -1,0 +1,215 @@
+"""Cache keying: the one fingerprint + per-table data-version layer.
+
+Result identity has four components — the plan's structural key
+(:func:`plan/reuse.py::canonical_key`), the bound parameter values (already
+folded into the plan as literals by ``sql/parser.py::bind_parameters``),
+the session conf fingerprint, and the **data version** of every table the
+plan reads. The first three existed before this module; the fourth was a
+single global ``_catalog_version`` counter that only
+``create_or_replace_temp_view`` bumped — an append through ``io/writer.py``
+invalidated nothing (the stale-read window ISSUE 19's fix satellite
+closes).
+
+This module owns the shared pieces so the prepared-plan cache
+(``serve/prepared.py``) and the semantic result cache
+(``cache/results.py``) can never drift:
+
+* **per-table monotonic write counters** — ``session._table_versions``
+  maps a *table key* (``view:<name>`` or ``path:<realpath>``) to a counter
+  bumped by every write path: temp-view (re)registration, temp-view drop,
+  and every ``DataFrameWriter`` materialization (append/overwrite/error
+  modes alike — overwrite bumps BEFORE the rewrite too, so a read racing
+  the rmtree can never cache under the old version).
+* **:func:`result_fingerprint`** — the (conf, catalog) slice of a cache
+  key. With no read set it degrades to the whole-catalog granularity the
+  prepared-plan cache uses (ANY write re-plans — the safe false negative);
+  with a plan's read set it returns per-table ``(key, version)`` pairs so
+  a write to ``orders`` leaves cached ``lineitem`` results warm.
+* **:func:`plan_read_keys`** — the read set of a physical plan: file-scan
+  leaves resolve to ``path:`` keys (the file's directory, plus any
+  registered write root that contains it); in-memory scans resolve to the
+  ``view:`` key their backing table was registered under. Unknown sources
+  contribute nothing — their identity is already structural
+  (``canonical_key`` keys in-memory tables by ``id``), so a miss here
+  costs warmth, never correctness.
+
+Path keys match by *directory containment* in both directions: a writer
+appending to ``path:/data/t`` must invalidate a reader of
+``path:/data/t/date=7`` and vice versa (hive-partitioned layouts put the
+scanned files below the written root).
+"""
+from __future__ import annotations
+
+import os
+import threading
+from typing import Iterable, Optional, Tuple
+
+
+def table_key_for_view(name: str) -> str:
+    return "view:" + name.lower()
+
+
+def table_key_for_path(path: str) -> str:
+    return "path:" + os.path.realpath(path)
+
+
+def _path_related(a: str, b: str) -> bool:
+    """Containment (either direction) between two ``path:`` keys."""
+    pa_, pb = a[5:], b[5:]
+    return pa_ == pb or pa_.startswith(pb + os.sep) or pb.startswith(pa_ + os.sep)
+
+
+def keys_related(read_key: str, written_key: str) -> bool:
+    """Does a write under ``written_key`` invalidate a read of
+    ``read_key``? Exact match for views; directory containment for
+    paths."""
+    if read_key == written_key:
+        return True
+    if read_key.startswith("path:") and written_key.startswith("path:"):
+        return _path_related(read_key, written_key)
+    return False
+
+
+def _catalog_lock(session) -> threading.Lock:
+    # sessions built by TpuSession.__init__ carry the lock eagerly; bare
+    # test doubles get one on first touch (setdefault under the GIL is
+    # atomic enough for a lazily-armed lock)
+    lock = getattr(session, "_catalog_lock", None)
+    if lock is None:
+        lock = session.__dict__.setdefault("_catalog_lock", threading.Lock())
+    return lock
+
+
+def bump_table_version(session, table_key: str) -> int:
+    """Monotonically bump one table's write counter AND the session's
+    global ``_catalog_version`` (the prepared-plan cache keys on the
+    global — a write it cannot attribute per-table must still re-plan),
+    then proactively evict matching result-cache entries. Returns the new
+    per-table version."""
+    with _catalog_lock(session):
+        versions = session.__dict__.setdefault("_table_versions", {})
+        v = versions.get(table_key, 0) + 1
+        versions[table_key] = v
+        session._catalog_version = getattr(session, "_catalog_version", 0) + 1
+    rc = getattr(session, "_result_cache", None)
+    if rc is not None:
+        # outside the catalog lock: the result cache has its own lock in
+        # the same session-caches tier and frees bytes beneath it
+        rc.invalidate_table(table_key)
+    return v
+
+
+def table_version(session, table_key: str) -> int:
+    """Current version of one table key. Path keys take the MAX over all
+    registered keys they contain or are contained by — an append to the
+    root counts against a partition-directory read."""
+    with _catalog_lock(session):
+        versions = getattr(session, "_table_versions", None) or {}
+        v = versions.get(table_key, 0)
+        if table_key.startswith("path:"):
+            for k, kv in versions.items():
+                if k.startswith("path:") and _path_related(table_key, k):
+                    v = max(v, kv)
+        return v
+
+
+def register_view_sources(session, view_key: str, tables) -> None:
+    """Remember which in-memory pa.Tables back a registered temp view, so
+    :func:`plan_read_keys` can resolve a ``CpuScanExec`` (keyed by source
+    identity) back to its ``view:`` counter. A derived view contributes
+    every base table its plan bottoms out in; replacing a view unmaps its
+    old ids — the map never grows past the live views' sources."""
+    with _catalog_lock(session):
+        by_id = session.__dict__.setdefault("_view_sources", {})
+        by_view = session.__dict__.setdefault("_view_source_ids", {})
+        for old in by_view.pop(view_key, ()):
+            by_id.pop(old, None)
+        ids = []
+        for t in tables:
+            if t is None:
+                continue
+            by_id[id(t)] = view_key
+            ids.append(id(t))
+        if ids:
+            by_view[view_key] = ids
+
+
+def view_backing_tables(logical_plan) -> list:
+    """The original pa.Tables a logical plan bottoms out in — the same
+    identity anchors ``CpuScanExec.source`` carries through planning, so
+    registering them maps physical scans back to the view."""
+    out = []
+    stack = [logical_plan]
+    while stack:
+        n = stack.pop()
+        if type(n).__name__ == "LocalRelation":
+            src = getattr(n, "source", None)
+            out.append(src if src is not None else getattr(n, "table", None))
+        stack.extend(n.children())
+    return out
+
+
+def _view_key_for_source(session, table) -> Optional[str]:
+    with _catalog_lock(session):
+        by_id = getattr(session, "_view_sources", None) or {}
+        return by_id.get(id(table))
+
+
+def _walk(node):
+    yield node
+    for c in node.children:
+        yield from _walk(c)
+
+
+def plan_read_keys(session, final_plan) -> Tuple[str, ...]:
+    """Sorted table keys a physical plan reads (its invalidation read
+    set). File scans contribute their files' directories plus any
+    registered write root containing them; in-memory scans contribute the
+    view their source table was registered under (when known)."""
+    keys: set = set()
+    with _catalog_lock(session):
+        registered = [
+            k for k in (getattr(session, "_table_versions", None) or {})
+            if k.startswith("path:")
+        ]
+    for node in _walk(final_plan):
+        name = type(node).__name__
+        if name == "CpuFileScanExec":
+            for f in getattr(node, "files", ()) or ():
+                fk = "path:" + os.path.dirname(os.path.realpath(f))
+                keys.add(fk)
+                for rk in registered:
+                    if _path_related(fk, rk):
+                        keys.add(rk)
+        elif name == "CpuScanExec":
+            src = getattr(node, "source", None)
+            vk = (
+                _view_key_for_source(session, src) if src is not None else None
+            )
+            if vk is not None:
+                keys.add(vk)
+    return tuple(sorted(keys))
+
+
+def result_fingerprint(
+    session, read_keys: Optional[Iterable[str]] = None
+) -> tuple:
+    """The (conf, catalog) slice of a cache key — THE shared helper
+    between ``serve/prepared.py`` and ``cache/results.py``.
+
+    The conf component is the session's entire explicit conf fingerprint:
+    many keys shape a compiled plan AND its result (batch geometry, ANSI
+    semantics, per-op kill switches), so any retune keys fresh — a
+    spurious miss is the safe false negative.
+
+    The catalog component is the global ``_catalog_version`` when no read
+    set is given (the prepared-plan cache's whole-catalog granularity),
+    else the per-table ``(key, version)`` pairs for exactly the tables
+    read — table-granular invalidation for the result cache."""
+    conf_fp = tuple(sorted(session.conf.items()))
+    if read_keys is None:
+        return (conf_fp, getattr(session, "_catalog_version", 0))
+    return (
+        conf_fp,
+        tuple((k, table_version(session, k)) for k in sorted(read_keys)),
+    )

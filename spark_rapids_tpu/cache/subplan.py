@@ -1,0 +1,409 @@
+"""Concurrent subplan dedup — single-flight execution of common subtrees.
+
+A dashboard fleet fires the same query from N sessions at once; the
+result cache (``cache/results.py``) only helps the queries that arrive
+*after* one completes. This layer closes the concurrent window: at
+admission time each query's plan is scanned for subtrees worth sharing
+(``canonical_key`` identity, cost above
+``spark.rapids.tpu.subplanDedup.minCostNs`` per the PR-9 calibration
+table), and every such subtree is wrapped in a :class:`SharedSubplanExec`
+registered under a session-wide :class:`SubplanRegistry`. The first
+wrapper to *execute* claims ownership and computes the subtree once,
+teeing each partition's batches into the registry entry; concurrent
+queries holding the same entry consume the owner's materialized batches
+instead of re-executing — the PR-5 ``df.cache()`` owner/waiter pattern,
+generalized from one explicit handle to automatic common-subtree
+detection.
+
+Failure policy (the part that must never cascade): an owner that errors,
+is cancelled, or abandons its stream mid-way marks the entry ABORTED and
+wakes every waiter into **independent execution** of its own copy of the
+subtree — a waiter can observe extra latency from a doomed owner, never
+a failure. Waiters poll with their own query's cancel token, so
+cancelling a waiter never touches the owner either.
+
+Sharing is deliberately conservative:
+
+* entries are **concurrent-only** — dropped the moment the last query
+  holding them releases its lease; cross-time reuse belongs to the
+  result cache with its invalidation machinery.
+* the registry key includes the same ``result_fingerprint`` (conf +
+  per-table data versions) as the result cache, so two in-flight queries
+  straddling a write never share.
+* plans carrying physically-shared nodes or AQE peer links
+  (``reuse_exchanges`` output) are only considered for whole-plan
+  sharing — rebuilding ancestors around a wrapped inner node would
+  duplicate shared subtrees and break id-linked peers.
+* multi-process topologies opt out: the registry is process-local state.
+
+Locking: ``_lock`` (session-caches tier) guards the entry map and entry
+state transitions. ``child.execute`` (exec tier — LOWER) is never called
+under it; waiter thunks block on a per-entry ``Event``, not the lock.
+"""
+from __future__ import annotations
+
+import threading
+from typing import List, Optional, Tuple
+
+from ..obs import metrics as obs_metrics
+from ..plan.physical import Exec, ExecContext, PartitionSet
+from . import keys as cache_keys
+
+_M = obs_metrics.GLOBAL
+
+IDLE = "idle"
+FILLING = "filling"
+COMPLETE = "complete"
+ABORTED = "aborted"
+
+
+class _Entry:
+    """One in-flight shared subtree. ``state``/``pins``/``parts`` move
+    under the registry lock; ``done`` is set (under the lock) strictly
+    after the terminal state is written, so a thread woken by ``done``
+    reads a stable COMPLETE/ABORTED without the lock."""
+
+    __slots__ = (
+        "key", "state", "owner_qid", "pins", "num_parts", "parts",
+        "done", "nbytes",
+    )
+
+    def __init__(self, key):
+        self.key = key
+        self.state = IDLE
+        self.owner_qid: Optional[str] = None
+        self.pins = 0
+        self.num_parts: Optional[int] = None
+        self.parts: Optional[List[Optional[list]]] = None
+        self.done = threading.Event()
+        self.nbytes = 0
+
+
+class SubplanLease:
+    """A query's pins on the entries its plan shares. Released exactly
+    once in the query's ``finally`` — whether it completed, errored, or
+    was cancelled — so entry lifetime is bounded by in-flight queries."""
+
+    def __init__(self, registry: "SubplanRegistry",
+                 items: List[Tuple[_Entry, str]]):
+        self._registry = registry
+        self._items = items
+        self._released = False
+
+    def release(self) -> None:
+        if self._released:
+            return
+        self._released = True
+        self._registry._release(self._items)
+
+
+class SharedSubplanExec(Exec):
+    """Pass-through wrapper marking a subtree as shared. Output, schema
+    and device-ness delegate to the child; ``execute`` routes through the
+    registry, which decides owner / waiter / independent per the entry's
+    state at that instant."""
+
+    def __init__(self, child: Exec, registry: "SubplanRegistry",
+                 entry: _Entry, qid: str):
+        super().__init__([child])
+        self._registry = registry
+        self._entry = entry
+        self._qid = qid
+        self._fallback: Optional[PartitionSet] = None
+
+    @property
+    def output(self):
+        return self._children[0].output
+
+    @property
+    def is_device(self) -> bool:
+        return self._children[0].is_device
+
+    def node_string(self) -> str:
+        return f"SharedSubplanExec[{self._children[0].node_string()}]"
+
+    def execute(self, ctx: ExecContext) -> PartitionSet:
+        return self._registry.execute_shared(self, ctx)
+
+    def _fallback_ps(self, ctx: ExecContext) -> PartitionSet:
+        # benign double-execute race on purpose: a lock here would sit in
+        # the session-caches tier ABOVE the exec-tier locks child.execute
+        # takes (lock_order.py), and partition thunks only ever pull their
+        # own index, so two racing builders never duplicate device work
+        ps = self._fallback
+        if ps is None:
+            ps = self._children[0].execute(ctx)
+            self._fallback = ps
+        return ps
+
+
+class SubplanRegistry:
+    """Session-wide map of in-flight shared subtrees."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        #: key -> _Entry (in-flight only)
+        self._entries: dict = {}  # graft: guarded_by(_lock)
+        self._bytes = 0  # graft: guarded_by(_lock)
+
+    # ── admission-time wrapping ─────────────────────────────────────────
+    def prepare(self, session, final_plan, conf,
+                qid: str) -> Tuple[Exec, Optional[SubplanLease]]:
+        """Wrap shareable subtrees of ``final_plan`` for query ``qid``.
+        Returns the plan to EXECUTE (the original object when nothing
+        qualifies) and the lease to release when the query exits. The
+        original plan stays untouched — admission, calibration and
+        prepared-statement interning keep keying off it."""
+        from .. import config as cfg
+
+        if not cfg.SUBPLAN_DEDUP_ENABLED.get(conf):
+            return final_plan, None
+        if session is not None and session.multiproc_topology()[2] > 1:
+            return final_plan, None
+        min_cost = cfg.SUBPLAN_DEDUP_MIN_COST_NS.get(conf)
+
+        root_only = _has_shared_or_aqe_nodes(final_plan)
+        candidates: List[Tuple[Exec, tuple]] = []
+        if root_only:
+            ck = _qualify(final_plan, conf, min_cost)
+            if ck is not None:
+                candidates.append((final_plan, ck))
+        else:
+            _select_maximal(final_plan, conf, min_cost, candidates)
+        if not candidates:
+            return final_plan, None
+
+        items: List[Tuple[_Entry, str]] = []
+        wrappers: dict = {}
+        for node, ck in candidates:
+            read_keys = cache_keys.plan_read_keys(session, node)
+            key = (ck, cache_keys.result_fingerprint(session, read_keys))
+            with self._lock:
+                e = self._entries.get(key)
+                if e is None:
+                    e = _Entry(key)
+                    self._entries[key] = e
+                e.pins += 1
+                _M.gauge("subplan.entries").set(len(self._entries))
+            items.append((e, qid))
+            wrappers[id(node)] = SharedSubplanExec(node, self, e, qid)
+
+        exec_plan = _rebuild(final_plan, wrappers)
+        return exec_plan, SubplanLease(self, items)
+
+    # ── execute-time role decision ──────────────────────────────────────
+    def execute_shared(self, wrapper: SharedSubplanExec,
+                       ctx: ExecContext) -> PartitionSet:
+        e, qid = wrapper._entry, wrapper._qid
+        child = wrapper.children[0]
+        with self._lock:
+            if e.state == IDLE:
+                e.state = FILLING
+                e.owner_qid = qid
+                role = "owner"
+            elif e.state == COMPLETE:
+                role = "serve"
+            elif (
+                e.state == FILLING
+                and e.owner_qid != qid
+                and e.num_parts is not None
+            ):
+                role = "wait"
+            else:
+                # ABORTED, the owner re-executing its own entry (query
+                # retry), or a FILLING entry whose shape is not yet
+                # published: independent execution, no blocking
+                role = "solo"
+        if role == "owner":
+            _M.counter("subplan.dedupOwners").add(1)
+            ps = child.execute(ctx)
+            parts = ps.parts
+            with self._lock:
+                if e.state == FILLING and e.owner_qid == qid:
+                    e.num_parts = len(parts)
+                    e.parts = [None] * len(parts)
+            return PartitionSet([
+                self._tee(e, qid, i, t) for i, t in enumerate(parts)
+            ])
+        if role == "serve":
+            _M.counter("subplan.dedupHits").add(1)
+            return PartitionSet([
+                self._serve(e, i) for i in range(e.num_parts)
+            ])
+        if role == "wait":
+            _M.counter("subplan.dedupHits").add(1)
+            return PartitionSet([
+                self._wait(e, i, wrapper, ctx) for i in range(e.num_parts)
+            ])
+        _M.counter("subplan.dedupFallbacks").add(1)
+        return child.execute(ctx)
+
+    # ── partition thunks ────────────────────────────────────────────────
+    def _tee(self, e: _Entry, qid: str, index: int, thunk):
+        """Owner partition: stream the child's batches through while
+        accumulating them; publish only on clean exhaustion (an early-
+        abandoned or erroring stream publishes nothing — fresh accumulator
+        per attempt keeps retries from committing a torn partition)."""
+
+        def run():
+            acc: list = []
+            for rb in thunk():
+                acc.append(rb)
+                yield rb
+            self._publish(e, qid, index, acc)
+
+        return run
+
+    def _publish(self, e: _Entry, qid: str, index: int, acc: list) -> None:
+        with self._lock:
+            if e.state != FILLING or e.owner_qid != qid or e.parts is None:
+                return
+            e.parts[index] = acc
+            if all(p is not None for p in e.parts):
+                e.state = COMPLETE
+                e.nbytes = sum(
+                    rb.nbytes for part in e.parts for rb in part
+                )
+                self._bytes += e.nbytes
+                _M.gauge("subplan.bytes").set(self._bytes)
+                e.done.set()
+
+    def _serve(self, e: _Entry, index: int):
+        def run():
+            for rb in e.parts[index]:
+                yield rb
+
+        return run
+
+    def _wait(self, e: _Entry, index: int, wrapper: SharedSubplanExec,
+              ctx: ExecContext):
+        """Waiter partition: block on the owner's completion, checking
+        this query's own cancel token each tick. COMPLETE serves the
+        owner's batches (the same objects — bit-identical by
+        construction); ABORTED falls back to independent execution —
+        owner failure costs waiters latency, never correctness."""
+
+        def run():
+            while not e.done.wait(0.05):
+                tok = ctx.cancel_token
+                if tok is not None:
+                    tok.check()
+            if e.state == COMPLETE:
+                for rb in e.parts[index]:
+                    yield rb
+                return
+            _M.counter("subplan.dedupFallbacks").add(1)
+            ps = wrapper._fallback_ps(ctx)
+            for rb in ps.parts[index]():
+                yield rb
+
+        return run
+
+    # ── lease release ───────────────────────────────────────────────────
+    def _release(self, items: List[Tuple[_Entry, str]]) -> None:
+        with self._lock:
+            for e, qid in items:
+                e.pins -= 1
+                if e.owner_qid == qid and e.state == FILLING:
+                    # the owner is exiting without having completed its
+                    # stream: error, cancellation, or partial consumption.
+                    # Wake waiters into independent execution.
+                    e.state = ABORTED
+                    e.done.set()
+                    _M.counter("subplan.dedupAborts").add(1)
+                if e.pins <= 0:
+                    if self._entries.get(e.key) is e:
+                        del self._entries[e.key]
+                    if e.state == COMPLETE:
+                        self._bytes -= e.nbytes
+                    elif e.state in (IDLE, FILLING):
+                        # last holder gone with the entry still open:
+                        # nothing can complete it — terminal-abort so any
+                        # straggler thread never blocks forever
+                        e.state = ABORTED
+                        e.done.set()
+            _M.gauge("subplan.entries").set(len(self._entries))
+            _M.gauge("subplan.bytes").set(self._bytes)
+
+    # ── introspection ───────────────────────────────────────────────────
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "entries": len(self._entries),
+                "bytes": self._bytes,
+                "pins": sum(e.pins for e in self._entries.values()),
+            }
+
+    def _orphan_report(self) -> List[str]:
+        """Invariant violations for reswatch's exit check: entries are
+        concurrent-only, so a drained test must leave the map empty."""
+        out: List[str] = []
+        with self._lock:
+            for e in self._entries.values():
+                out.append(
+                    f"subplan entry orphaned at exit: state={e.state} "
+                    f"pins={e.pins} owner={e.owner_qid}"
+                )
+            if not self._entries and self._bytes:
+                out.append(
+                    f"subplan byte gauge drifted: {self._bytes} bytes "
+                    "accounted with no entries"
+                )
+        return out
+
+
+# ── plan scanning helpers (module-local, no shared state) ───────────────
+
+
+def _has_shared_or_aqe_nodes(plan) -> bool:
+    seen: set = set()
+    stack = [plan]
+    while stack:
+        n = stack.pop()
+        if id(n) in seen:
+            return True
+        seen.add(id(n))
+        if getattr(n, "_reuse_shared", False):
+            return True
+        if getattr(n, "_aqe_peer", None) is not None:
+            return True
+        stack.extend(n.children)
+    return False
+
+
+def _qualify(node, conf, min_cost: int) -> Optional[tuple]:
+    """This subtree's canonical key when it is worth sharing, else None."""
+    from ..plan import reuse
+    from ..sched.estimate import estimate_plan_cost_ns
+
+    try:
+        ck = reuse.canonical_key(node)
+    except Exception:
+        return None
+    if estimate_plan_cost_ns(node, conf) < min_cost:
+        return None
+    return ck
+
+
+def _select_maximal(node, conf, min_cost: int, out: list) -> None:
+    """Top-down maximal qualifying subtrees: a wrapped node's descendants
+    are covered by it (nesting wrappers would stack waiters for nothing)."""
+    ck = _qualify(node, conf, min_cost)
+    if ck is not None:
+        out.append((node, ck))
+        return
+    for c in node.children:
+        _select_maximal(c, conf, min_cost, out)
+
+
+def _rebuild(node, wrappers: dict):
+    """Rebuild ancestors of wrapped nodes via ``with_new_children``;
+    untouched subtrees keep their identity (only called on plans verified
+    free of physically-shared nodes and AQE peer links)."""
+    w = wrappers.get(id(node))
+    if w is not None:
+        return w
+    new_children = [_rebuild(c, wrappers) for c in node.children]
+    if all(nc is oc for nc, oc in zip(new_children, node.children)):
+        return node
+    return node.with_new_children(new_children)

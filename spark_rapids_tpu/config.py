@@ -1224,6 +1224,59 @@ SERVE_FAILOVER_DEDUP_WINDOW = conf(
 ).int_conf(1024)
 
 
+# ── common-work sharing (cache/results.py, cache/subplan.py) ───────────────
+
+RESULT_CACHE_ENABLED = conf("spark.rapids.tpu.resultCache.enabled").doc(
+    "Serve repeated queries from the bounded semantic result cache: a "
+    "completed query's Arrow batches are stored under (plan canonical "
+    "key, bound params, conf fingerprint, per-table data version) and an "
+    "identical later query streams them back WITHOUT touching scheduler "
+    "admission. Invalidation is table-granular — any write path (temp-"
+    "view replacement, DataFrameWriter append/overwrite, view drop) "
+    "bumps the written table's version and evicts its dependents. Off by "
+    "default (kill switch): results are bit-identical by construction, "
+    "but a cache hit skips execution-side effects some harnesses assert "
+    "on (kernel first-call counters, retry metrics)."
+).boolean_conf(False)
+
+RESULT_CACHE_MAX_BYTES = conf("spark.rapids.tpu.resultCache.maxBytes").doc(
+    "In-memory budget of the result cache; the same figure again bounds "
+    "its disk tier (LRU entries demote to Arrow IPC files in the spill "
+    "directory before being dropped). Memory-resident bytes are reserved "
+    "against the host spill budget (mem/spill.py), so cached results "
+    "compete with spilled device buffers instead of hiding from the "
+    "memory ledger."
+).bytes_conf(256 * 1024 * 1024)
+
+RESULT_CACHE_MAX_ENTRIES = conf(
+    "spark.rapids.tpu.resultCache.maxEntries"
+).doc(
+    "Entry-count bound of the result cache across both tiers (LRU). "
+    "Bounds key-map growth for fleets cycling many distinct small "
+    "queries under the byte budget."
+).int_conf(256)
+
+SUBPLAN_DEDUP_ENABLED = conf("spark.rapids.tpu.subplanDedup.enabled").doc(
+    "Single-flight execution of common subtrees across CONCURRENT "
+    "in-flight queries: at admission each plan is scanned for subtrees "
+    "sharing a canonical key with another in-flight query's, and the "
+    "subtree is computed once — the first executor owns it, the rest "
+    "consume its materialized batches. Owner failure or cancellation "
+    "wakes waiters into independent execution (never cascades). Off by "
+    "default (kill switch); entries are concurrent-only and never "
+    "outlive the queries pinning them."
+).boolean_conf(False)
+
+SUBPLAN_DEDUP_MIN_COST_NS = conf(
+    "spark.rapids.tpu.subplanDedup.minCostNs"
+).doc(
+    "Estimated device cost (nanoseconds, from the calibration table via "
+    "sched/estimate.py::estimate_plan_cost_ns) below which a subtree is "
+    "not worth sharing — waiter coordination overhead beats recompute "
+    "for point lookups."
+).int_conf(1_000_000)
+
+
 class TpuConf:
     """An immutable-ish view over a key→string dict, with typed access.
 

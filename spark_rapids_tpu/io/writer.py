@@ -410,8 +410,17 @@ class DataFrameWriter:
                 self._check_append_bucket_spec(path)
         os.makedirs(path, exist_ok=True)
         session = self._df._session
+        from ..cache import keys as _ckeys
         from ..plan import logical as L
 
+        # bump the target table's data version BEFORE the write lands —
+        # a reader racing this write must not cache under the old
+        # version (the overwrite rmtree above already destroyed it) —
+        # and again after commit so results computed mid-write are
+        # rejected at cache admission. Closes the stale-read window the
+        # old global-counter-on-temp-view-only scheme left open.
+        table_key = _ckeys.table_key_for_path(path)
+        _ckeys.bump_table_version(session, table_key)
         opts = dict(self._options)
         # shim-routed write semantics (SparkShims seam)
         opts.setdefault("__rebase", session.shim.parquet_rebase_write())
@@ -428,6 +437,9 @@ class DataFrameWriter:
                        self._bucket_spec["cols"])
         # driver commit marker (FileFormatWriter's _SUCCESS)
         open(os.path.join(path, "_SUCCESS"), "w").close()
+        # post-commit bump: readers that fingerprinted during the write
+        # see a different version at cache admission and skip the store
+        _ckeys.bump_table_version(session, table_key)
         return stats
 
     def parquet(self, path: str):

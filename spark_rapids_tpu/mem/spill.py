@@ -491,6 +491,48 @@ class BufferCatalog:
             if excess > 0:
                 self.synchronous_spill(excess, dev)
 
+    def host_reserve(self, nbytes: int) -> bool:
+        """Reserve host-tier bytes for an external holder (the semantic
+        result cache keeps its Arrow batches outside the buffer map but
+        must still count against the host spill budget). Managed host
+        buffers are spilled to disk first if that makes room; returns
+        False — nothing reserved — when the budget cannot fit the
+        reservation even after spilling."""
+        if nbytes <= 0:
+            return True
+        with self._lock:
+            if self.host_bytes + nbytes > self.host_limit:
+                for buf in self._spill_order(StorageTier.HOST):
+                    if self.host_bytes + nbytes <= self.host_limit:
+                        break
+                    self._host_to_disk(buf)
+            if self.host_bytes + nbytes > self.host_limit:
+                return False
+            self.host_bytes += nbytes
+            return True
+
+    def host_release(self, nbytes: int) -> None:
+        if nbytes <= 0:
+            return
+        with self._lock:
+            self.host_bytes -= nbytes
+
+    def disk_reserve(self, nbytes: int) -> None:
+        """Account external disk-tier bytes (spilled result-cache
+        entries). Disk is unbounded here, matching managed buffers —
+        the caller bounds its own footprint."""
+        if nbytes <= 0:
+            return
+        with self._lock:
+            self.disk_bytes += nbytes
+            self.spill_count += 1
+
+    def disk_release(self, nbytes: int) -> None:
+        if nbytes <= 0:
+            return
+        with self._lock:
+            self.disk_bytes -= nbytes
+
     def _dev_add(self, dev, delta: int):
         cur = self.device_bytes_by_dev.get(dev, 0) + delta
         if cur:

@@ -552,6 +552,47 @@ CATALOG: Iterable[tuple] = (
     ("fusion.breakerFallbacks", MetricKind.COUNTER,
      "fused stages rebuilt as their unfused per-op chain because the "
      "circuit breaker opened on the stage signature"),
+    # cache/results.py — the semantic result cache (dashboard re-execution)
+    ("cache.result.hits", MetricKind.COUNTER,
+     "queries served from the result cache without scheduler admission"),
+    ("cache.result.misses", MetricKind.COUNTER,
+     "result-cache lookups that fell through to execution"),
+    ("cache.result.stores", MetricKind.COUNTER,
+     "completed results admitted into the cache"),
+    ("cache.result.evictions", MetricKind.COUNTER,
+     "entries dropped for entry-count or disk-budget overflow (LRU)"),
+    ("cache.result.invalidations", MetricKind.COUNTER,
+     "entries dropped because a read table's version moved (writes), "
+     "plus admissions rejected for racing a write mid-execution"),
+    ("cache.result.spills", MetricKind.COUNTER,
+     "memory-tier entries demoted to Arrow IPC files on disk"),
+    ("cache.result.spillDrops", MetricKind.COUNTER,
+     "demotions abandoned (spill-write failure or disk tier full) — the "
+     "entry is dropped, the query unaffected"),
+    ("cache.result.bytes", MetricKind.GAUGE,
+     "memory-resident cached result bytes (reserved against the host "
+     "spill budget)"),
+    ("cache.result.diskBytes", MetricKind.GAUGE,
+     "disk-tier cached result bytes"),
+    ("cache.result.entries", MetricKind.GAUGE,
+     "live result-cache entries across both tiers"),
+    ("cache.result.hitRatio", MetricKind.GAUGE,
+     "hits per mille of lookups since session start (0-1000)"),
+    # cache/subplan.py — concurrent common-subtree single-flight
+    ("subplan.dedupOwners", MetricKind.COUNTER,
+     "shared subtrees computed once on behalf of concurrent queries"),
+    ("subplan.dedupHits", MetricKind.COUNTER,
+     "queries that consumed another in-flight query's subtree batches"),
+    ("subplan.dedupFallbacks", MetricKind.COUNTER,
+     "sharing attempts that degraded to independent execution (unshaped "
+     "entry, owner abort, or re-entry)"),
+    ("subplan.dedupAborts", MetricKind.COUNTER,
+     "owners that exited without completing their shared entry (error, "
+     "cancellation, partial consumption) — waiters woken to recompute"),
+    ("subplan.entries", MetricKind.GAUGE,
+     "in-flight shared-subtree entries (concurrent-only, pin-bounded)"),
+    ("subplan.bytes", MetricKind.GAUGE,
+     "bytes materialized in completed shared-subtree entries"),
 )
 
 for _name, _kind, _doc in CATALOG:

@@ -50,6 +50,11 @@ def _val_key(v):
         return ("table", id(v))  # identity: same in-memory source only
     if isinstance(v, type):
         return v
+    if isinstance(v, dict):  # option maps (CpuFileScanExec.options)
+        try:
+            return ("dict", tuple((k, _val_key(x)) for k, x in sorted(v.items())))
+        except TypeError:  # unsortable keys
+            raise _NotCanonical("dict") from None
     # dataclass-ish parameter objects (SortOrder, partitionings): compare
     # by type + public attribute dict, recursively
     d = getattr(v, "__dict__", None)

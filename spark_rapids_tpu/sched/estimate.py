@@ -131,6 +131,45 @@ def estimate_plan_bytes(plan, conf=None) -> int:
     return int(total)
 
 
+#: ns/row charged for an operator the calibration table has never measured
+_DEFAULT_NS_PER_ROW = 50.0
+
+
+def estimate_plan_cost_ns(plan, conf=None, calibration=None) -> int:
+    """Estimated device cost of one physical (sub)plan in nanoseconds —
+    the admission-side 'is this subtree worth sharing' figure behind
+    ``spark.rapids.tpu.subplanDedup.minCostNs``.
+
+    Same coarse-but-monotone philosophy as :func:`estimate_plan_bytes`:
+    every operator is charged its measured per-row device cost from the
+    PR-9 calibration table (``obs/calibration.py``) times the plan's
+    dominant source cardinality; unmeasured operators get a flat default
+    so a cold table still ranks big scans above point lookups."""
+    if calibration is None:
+        from ..obs import calibration as _cal
+
+        path = None
+        if conf is not None:
+            from .. import config as cfg
+
+            path = cfg.CBO_CALIBRATION_FILE.get(conf) or None
+        calibration = _cal.get(path)
+    rows = 1
+    for node in _walk(plan):
+        lb = _leaf_bytes_rows(node)
+        if lb is not None:
+            rows = max(rows, lb[1])
+    total = 0.0
+    for node in _walk(plan):
+        per_row = None
+        try:
+            per_row = calibration.ns_per_row(type(node).__name__)
+        except Exception:
+            per_row = None
+        total += (per_row if per_row else _DEFAULT_NS_PER_ROW) * rows
+    return int(total)
+
+
 def permits_for_plan(plan, conf, pool_size: int) -> int:
     """ceil(estimate / bytesPerPermit) in [1, pool_size] — the weighted
     share one query takes from the WeightedPermitPool."""

@@ -82,15 +82,18 @@ class PreparedPlanCache:
 
     # ── keying ──────────────────────────────────────────────────────────
     def _geometry(self) -> tuple:
-        """The conf + catalog slice of the cache key: the session's ENTIRE
+        """The conf + catalog slice of the cache key, shared with the
+        semantic result cache through ONE helper
+        (``cache/keys.py::result_fingerprint``) so prepared-plan and
+        result invalidation can never drift: the session's ENTIRE
         explicit conf fingerprint (any retune — batch geometry, shuffle
-        width, ANSI, per-op kill switches — re-plans rather than risking a
-        stale compiled plan; a spurious re-plan is the safe false
-        negative) plus the temp-view catalog version."""
-        return (
-            tuple(sorted(self.session.conf.items())),
-            getattr(self.session, "_catalog_version", 0),
-        )
+        width, ANSI, per-op kill switches — re-plans rather than risking
+        a stale compiled plan; a spurious re-plan is the safe false
+        negative) plus the catalog version, which every write path bumps
+        (temp-view registration/drop, DataFrameWriter commits)."""
+        from ..cache import keys as cache_keys
+
+        return cache_keys.result_fingerprint(self.session)
 
     @staticmethod
     def _param_key(params) -> tuple:

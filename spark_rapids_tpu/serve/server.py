@@ -737,6 +737,8 @@ class TpuServer:
                 "scheduler": self.session.scheduler.state(),
                 "serve": _M.view("serve.", strip=False),
                 "prepared_cache": self.prepared.stats(),
+                "result_cache": self.session._result_cache.stats(),
+                "subplan_dedup": self.session._subplan_registry.stats(),
             },
         )
 
@@ -822,6 +824,7 @@ class TpuServer:
         led = getattr(pq.ctx, "ledger", None)
         if led is not None:
             led.wall_start()  # second wall window: prepare was the first
+        lease = None
         try:
             if pq.cancelled_reason:
                 raise QueryCancelledError(
@@ -829,6 +832,33 @@ class TpuServer:
                     f"{pq.cancelled_reason}",
                     reason=pq.cancelled_reason,
                 )
+            # semantic result cache (cache/results.py): an identical
+            # completed query streams its cached batches HERE — before
+            # scheduler admission; a hit costs no scheduler state at all
+            rkey, rkeys = None, ()
+            if cfg.RESULT_CACHE_ENABLED.get(self.session.conf):
+                from ..cache import results as _rcache
+
+                rkey, rkeys = _rcache.key_for(self.session, pq.final_plan)
+                if rkey is not None:
+                    # faults scope covers the disk-tier read-back (the
+                    # chaos harness's spill-read injection point)
+                    with _faults.scoped(self.session._fault_injector):
+                        hit = self.session._result_cache.get(rkey)
+                    if hit is not None:
+                        self._stream_cached(
+                            sock, tenant, qid, hit, max_rows, t0
+                        )
+                        return
+            # concurrent subplan dedup (cache/subplan.py): wrap shareable
+            # subtrees for single-flight execution across in-flight
+            # queries; admission keeps keying off the original plan
+            exec_plan, lease = self.session._subplan_registry.prepare(
+                self.session, pq.final_plan, self.session.conf, qid
+            )
+            rec: "list | None" = [] if rkey is not None else None
+            rec_bytes = 0
+            rec_cap = cfg.RESULT_CACHE_MAX_BYTES.get(self.session.conf)
             with _faults.scoped(self.session._fault_injector), \
                     obs_trace.query_scope(tracer, f"query-{qid}", {"qid": qid}):
                 with self.session._scheduler.admit(
@@ -841,14 +871,30 @@ class TpuServer:
                     if pq.cancelled_reason:  # raced the admission
                         adm.token.cancel(pq.cancelled_reason)
                     for rb in self.session.run_plan_stream(
-                        pq.final_plan, pq.ctx
+                        exec_plan, pq.ctx
                     ):
+                        if rec is not None:
+                            # tee the pre-rechunk stream for cache
+                            # admission; an over-budget result stops
+                            # recording, never the stream
+                            rec_bytes += rb.nbytes
+                            if rec_bytes > rec_cap:
+                                rec = None
+                            else:
+                                rec.append(rb)
                         for chunk in _rechunk(rb, max_rows):
                             self._send_batch(sock, adm.token, chunk)
                             rows += chunk.num_rows
                             batches += 1
                             self._poll_cancel(sock, adm.token)
                     adm.token.check()  # a cancel that raced the final batch
+                    if rec is not None:
+                        # commit only after the full stream survived the
+                        # final cancel check; admission re-fingerprints,
+                        # so an append that raced this stream rejects it
+                        self.session._result_cache.admit(
+                            self.session, rkey, rkeys, rec
+                        )
                     wait_ms = adm.queue_wait_ns / 1e6
                     run_ms = (time.perf_counter_ns() - t0) / 1e6 - wait_ms
                     P.send_json(
@@ -881,6 +927,8 @@ class TpuServer:
             _M.counter("serve.queryErrors").add(1)
             self._send_error(sock, e, query_id=qid)
         finally:
+            if lease is not None:
+                lease.release()
             if led is not None:
                 led.wall_stop()
                 self.session._last_ledger = led
@@ -889,6 +937,46 @@ class TpuServer:
                     tracer, pq.final_plan, pq.ctx.query_seq, ledger=led
                 )
             self.session._leak_check(pq.ctx)
+
+    def _stream_cached(
+        self, sock, tenant, qid: str, hit, max_rows: int, t0: int
+    ) -> None:
+        """Stream a result-cache hit to the client: same wire framing,
+        rechunking, cancel polling, and latency bookkeeping as a cold
+        stream, but with zero scheduler involvement (no admission, no
+        queue wait — the hit's wait time IS 0). A fresh CancelToken keeps
+        client-side CANCEL working mid-stream."""
+        from ..sched import CancelToken
+
+        token = CancelToken(query_id=qid)
+        rows = 0
+        batches = 0
+        for rb in hit:
+            if rb.num_rows == 0:
+                continue  # wire protocol never carries empty batches
+            for chunk in _rechunk(rb, max_rows):
+                self._send_batch(sock, token, chunk)
+                rows += chunk.num_rows
+                batches += 1
+                self._poll_cancel(sock, token)
+        token.check()  # a cancel that raced the final batch
+        run_ns = max(0, time.perf_counter_ns() - t0)
+        P.send_json(
+            sock, P.END,
+            {
+                "query_id": qid,
+                "rows": rows,
+                "batches": batches,
+                "wait_ms": 0.0,
+                "run_ms": round(run_ns / 1e6, 3),
+                "cache_hit": True,
+            },
+        )
+        _M.timer("serve.queryRunNs").add(run_ns)
+        _M_WAIT_HIST.observe(0)
+        _M_RUN_HIST.observe(run_ns)
+        _M_TOTAL_HIST.observe(run_ns)
+        self.latency_samples.append((tenant.name, 0.0, run_ns / 1e9))
 
     def _send_batch(self, sock, token, rb: pa.RecordBatch) -> None:
         from ..obs import ledger as obs_ledger

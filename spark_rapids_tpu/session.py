@@ -140,6 +140,22 @@ class TpuSession:
         # eager init lets the guarded-by pass anchor its annotations)
         self._cache_store: dict = {}  # graft: guarded_by(_cache_lock)
         self._h2d_cache: dict = {}  # graft: guarded_by(_h2d_lock)
+        # common-work sharing (cache/keys|results|subplan): per-table
+        # monotonic write counters behind result/prepared invalidation
+        # (every write path routes through cache/keys.bump_table_version,
+        # which also bumps the global _catalog_version the prepared-plan
+        # cache keys on), the bounded semantic result cache, and the
+        # in-flight shared-subtree registry. See docs/result-cache.md.
+        self._catalog_lock = _threading.Lock()
+        self._catalog_version = 0  # graft: guarded_by(_catalog_lock)
+        self._table_versions: dict = {}  # graft: guarded_by(_catalog_lock)
+        self._view_sources: dict = {}  # graft: guarded_by(_catalog_lock)
+        self._view_source_ids: dict = {}  # graft: guarded_by(_catalog_lock)
+        from .cache.results import ResultCache
+        from .cache.subplan import SubplanRegistry
+
+        self._result_cache = ResultCache(self.conf)
+        self._subplan_registry = SubplanRegistry()
         # resilience: session-lifetime CPU-fallback circuit breaker (runtime
         # kernel failures flip ops to CPU at the next planning pass) and the
         # deterministic fault-injection scenario (None unless
@@ -227,10 +243,33 @@ class TpuSession:
         return Compiler(self).compile(q)
 
     def create_or_replace_temp_view(self, name: str, df: "DataFrame"):
+        from .cache import keys as _ckeys
+
         self._temp_views[name.lower()] = df
-        # invalidates plans compiled against the old view (the serve
-        # prepared-plan cache keys on this version)
-        self._catalog_version = getattr(self, "_catalog_version", 0) + 1
+        key = _ckeys.table_key_for_view(name)
+        # map the view's backing tables so result-cache read sets resolve
+        # physical scans (keyed by source identity) back to this view
+        _ckeys.register_view_sources(
+            self, key, _ckeys.view_backing_tables(df._plan)
+        )
+        # bumps this view's write counter AND the global catalog version
+        # (the serve prepared-plan cache keys on the global), and evicts
+        # dependent result-cache entries
+        _ckeys.bump_table_version(self, key)
+
+    def drop_temp_view(self, name: str) -> bool:
+        """Unregister a temp view. A write path like any other: the
+        view's version bumps so cached results and prepared plans built
+        against it can never serve after the drop."""
+        from .cache import keys as _ckeys
+
+        df = self._temp_views.pop(name.lower(), None)
+        if df is None:
+            return False
+        key = _ckeys.table_key_for_view(name)
+        _ckeys.register_view_sources(self, key, ())
+        _ckeys.bump_table_version(self, key)
+        return True
 
     def table(self, name: str) -> "DataFrame":
         try:
@@ -569,18 +608,38 @@ class TpuSession:
         # session's queries execute (no-op when faults are not enabled)
         with _faults.scoped(self._fault_injector):
             final_plan, ctx = self._prepare_plan(lp)
+            # semantic result cache (cache/results.py): an identical
+            # completed query short-circuits HERE — before tracing,
+            # ledgers, and scheduler admission; a hit must cost no
+            # scheduler state at all
+            rkey, rkeys = None, ()
+            if cfg.RESULT_CACHE_ENABLED.get(self.conf):
+                from .cache import results as _rcache
+
+                rkey, rkeys = _rcache.key_for(self, final_plan)
+                if rkey is not None:
+                    hit = self._result_cache.get(rkey)
+                    if hit is not None:
+                        return _assemble_result(hit, final_plan.output)
             from .obs import ledger as obs_ledger
             from .obs import trace as obs_trace
             from .profiling import query_trace
 
             seq = ctx.query_seq
+            # concurrent subplan dedup (cache/subplan.py): wrap shareable
+            # subtrees for single-flight execution. Admission and
+            # calibration keep keying off final_plan; only execution
+            # runs the wrapped exec_plan.
+            exec_plan, lease = self._subplan_registry.prepare(
+                self, final_plan, self.conf, f"q{seq}"
+            )
             led = getattr(ctx, "ledger", None)
             tracer = self._maybe_tracer(seq)
             if tracer is not None:
                 # tracer pinned into the wrappers: a straggling producer
                 # thread keeps recording into ITS query's buffer, never
                 # into a later query's active tracer
-                obs_trace.instrument_plan(final_plan, tracer)
+                obs_trace.instrument_plan(exec_plan, tracer)
             if led is not None:
                 led.wall_start()
             try:
@@ -599,14 +658,23 @@ class TpuSession:
                         if led is not None:
                             led.add("queue_wait", admission.queue_wait_ns)
                         with query_trace(cfg.PROFILE_PATH.get(self.conf)):
-                            return self._run_plan(final_plan, ctx)
+                            result = self._run_plan(exec_plan, ctx)
+                        if rkey is not None:
+                            # admission re-fingerprints: a write that
+                            # raced this execution rejects the store
+                            self._result_cache.admit(
+                                self, rkey, rkeys, result.to_batches()
+                            )
+                        return result
             finally:
+                if lease is not None:
+                    lease.release()
                 if led is not None:
                     led.wall_stop()
                     self._last_ledger = led
                 self._harvest_calibration(final_plan)
                 if tracer is not None:
-                    self._export_trace(tracer, final_plan, seq, ledger=led)
+                    self._export_trace(tracer, exec_plan, seq, ledger=led)
                 self._leak_check(ctx)
 
     def _harvest_calibration(self, final_plan) -> None:
@@ -1211,6 +1279,17 @@ def _extract_generators(
         else:
             new_exprs.append(e)
     return new_exprs, L.Generate(generator, internal, plan)
+
+
+def _assemble_result(batches, schema) -> pa.Table:
+    """Rebuild a collect() table from cached batches — the exact
+    construction ``_run_plan`` uses, so cached and cold results are
+    bit-identical (including the empty-result arrow schema)."""
+    if not batches:
+        return pa.table(
+            {f.name: pa.array([], type=f.data_type.to_arrow()) for f in schema}
+        )
+    return pa.Table.from_batches(batches)
 
 
 class DataFrame:
