@@ -155,6 +155,26 @@ bench-serve-overload:
 	  BENCH_SERVE_PERMITS=1 BENCH_SERVE_MAXQUEUED=8 \
 	  $(PY) bench.py --serve 6 --smoke
 
+# Live-analytics SLO (ISSUE 20): paced appends against an incrementally
+# maintained aggregate on a small table vs a 10x larger one (equal delta
+# size) plus a full-refresh control, N wire subscribers draining UPDATE
+# trains — refresh-latency percentiles must scale with the DELTA, not the
+# table (SLO_r09.json: delta_scaling_p50_ratio ~1, incremental speedup
+# vs the full-refresh control).
+.PHONY: bench-live
+bench-live:
+	BENCH_PLATFORM=$(or $(BENCH_PLATFORM),cpu) BENCH_SF=0.01 \
+	  BENCH_RUNS=1 $(PY) bench.py --live 4
+
+# Live-analytics chaos suite (ISSUE 20): appender storms against wire
+# subscriber fleets with per-epoch bit-identity oracles replayed from the
+# delta log, subscribers killed mid-UPDATE train, and injected spill
+# faults on maintained-state demotion — degrade to full refresh, never
+# corrupt.
+.PHONY: chaos-live
+chaos-live:
+	$(PYTEST) tests/test_chaos_live.py -q -m chaos
+
 # Serve-path chaos suite (ISSUE 7): injected kernel stalls, compile delays,
 # slow-loris clients, mid-stream socket drops, corrupt frames — asserts
 # bit-identical results, watchdog cancellation, and zero leaked
@@ -183,7 +203,7 @@ chaos-recovery:
 	$(PYTEST) tests/test_chaos_recovery.py -q -m chaos
 
 # The full chaos surface (in-process + serve-path + restart/corruption +
-# recovery).
+# recovery + live-analytics).
 # Every chaos-marked test runs under BOTH runtime harnesses: lockwatch
 # (lock-order races) and reswatch (end-of-test resource balance —
 # permits/threads/fds/flocks/spans back to the entry snapshot). Force

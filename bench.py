@@ -408,10 +408,21 @@ def _suite_args():
         i = argv.index("--serve")
         nxt = argv[i + 1] if i + 1 < len(argv) else ""
         serve_clients = int(nxt) if nxt.isdigit() else (serve_clients or 4)
+    live_subscribers = int(
+        os.environ.get("BENCH_LIVE_SUBSCRIBERS", "0") or 0
+    )
+    if "--live" in argv:
+        # `--live` alone = default subscriber count; `--live N` pins it
+        i = argv.index("--live")
+        nxt = argv[i + 1] if i + 1 < len(argv) else ""
+        live_subscribers = (
+            int(nxt) if nxt.isdigit() else (live_subscribers or 4)
+        )
     qids = tuple(
         int(q.strip().lstrip("q")) for q in queries.split(",") if q.strip()
     )
-    return suite, smoke, trace_dir, qids, concurrency, serve_clients
+    return (suite, smoke, trace_dir, qids, concurrency, serve_clients,
+            live_subscribers)
 
 
 def run_concurrent(tpu, tables, qids, n_threads, sf, partitions, rounds=2):
@@ -910,6 +921,160 @@ def run_dashboard_replay(tpu, qids, n_clients, duration_s, sf, smoke):
     return out
 
 
+def run_live_slo(tpu, n_subscribers, smoke):
+    """Live-analytics SLO mode (--live N): a live table behind a
+    TpuServer with N wire subscribers on a maintained aggregate, a paced
+    appender landing fixed-size deltas, and the ISSUE 20 acceptance
+    question measured directly — does refresh latency scale with the
+    DELTA size or the TABLE size?
+
+    Three histogram windows over ``live.refresh.latencyHist`` (append →
+    refresh-complete, per refresh): (a) incremental maintenance on a
+    small table, (b) incremental maintenance on a 10x table with the
+    SAME delta size — p50 should be ~flat, that ratio is the headline
+    metric — and (c) a full-refresh control on the 10x table (a float
+    sum, classified FULL on purpose), which IS table-size-bound and
+    shows what incremental maintenance saves. Result: SLO_r09.json."""
+    import threading
+
+    import numpy as np
+    import pyarrow as pa
+
+    from spark_rapids_tpu.obs.metrics import (
+        GLOBAL, histogram_delta, quantile_from_counts,
+    )
+    from spark_rapids_tpu.serve import TpuServer, connect
+
+    tpu.set_conf("spark.rapids.tpu.live.enabled", "true")
+    tpu.set_conf("spark.rapids.tpu.scheduler.pools", "default:4,live:2")
+    rt = tpu.live
+    hist = GLOBAL.histogram("live.refresh.latencyHist")
+
+    small_rows = 20_000 if smoke else 100_000
+    large_rows = small_rows * 10
+    delta_rows = 512
+    rounds = 4 if smoke else 10
+
+    def mk(n, base=0):
+        idx = np.arange(base, base + n)
+        return pa.table({
+            "k": (idx % 64).astype(np.int64),
+            "v": (idx % 1000).astype(np.int64),
+            "f": (idx % 1000).astype(np.float64),
+        })
+
+    def pcts_ms(before, after):
+        counts, _s, n = histogram_delta(after, before)
+        d = {
+            p: round(quantile_from_counts(counts, n, v / 100.0) / 1e6, 3)
+            for p, v in (("p50", 50), ("p95", 95), ("p99", 99))
+        }
+        d["count"] = n
+        return d
+
+    def wait_version(q, v, timeout_s=240.0):
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            if q.last_version >= v:
+                return
+            time.sleep(0.005)
+        raise RuntimeError(f"refresh of {q.qid} to v{v} timed out")
+
+    server = TpuServer(tpu, port=0)
+    host, port = server.start()
+    log({"live": {"host": host, "port": port, "subscribers": n_subscribers,
+                  "rounds": rounds, "delta_rows": delta_rows}})
+
+    def measure(name, table_rows, sql_tmpl, with_subs):
+        tname = f"live_{name}"
+        rt.tables.create_table(tname, mk(table_rows))
+        sql = sql_tmpl.format(t=tname)
+        q = rt.register_query(sql)
+        delivered = [0]
+        conns, sub_handles, threads = [], [], []
+        if with_subs:
+            for i in range(n_subscribers):
+                conn = connect(host, port, timeout=30)
+                sub = conn.subscribe(sql)
+                conns.append(conn)
+                sub_handles.append(sub)
+
+                def drain(s=sub):
+                    try:
+                        for _upd in s:
+                            delivered[0] += 1
+                    except Exception:  # noqa: BLE001 - teardown race
+                        pass
+
+                th = threading.Thread(target=drain,
+                                      name=f"live-slo-sub-{name}-{i}")
+                threads.append(th)
+                th.start()
+        h0 = hist.state()
+        t0 = time.monotonic()
+        for i in range(rounds):
+            v = rt.tables.append(
+                tname, mk(delta_rows, base=table_rows + i * delta_rows)
+            )
+            # paced: one refresh in flight at a time, so the histogram
+            # window holds exactly `rounds` append→refresh latencies
+            wait_version(q, v)
+        wall = time.monotonic() - t0
+        pcts = pcts_ms(h0, hist.state())
+        for sub in sub_handles:
+            sub.cancel()
+        for th in threads:
+            th.join(timeout=60)
+        for conn in conns:
+            conn.close()
+        rt.retire_query(q.qid)
+        res = {
+            "table_rows": table_rows, "mode": q.klass,
+            "fallback_reason": q.reason, "refresh_ms": pcts,
+            "wall_s": round(wall, 2),
+            "updates_delivered": delivered[0],
+        }
+        log({f"live_{name}": res})
+        return res
+
+    incr_sql = "SELECT k, sum(v) AS s, count(*) AS c FROM {t} GROUP BY k"
+    # float sum is gated out of incremental maintenance → every refresh
+    # re-executes over the whole table: the table-size-bound control
+    full_sql = "SELECT k, sum(f) AS s FROM {t} GROUP BY k"
+    try:
+        small = measure("small", small_rows, incr_sql, with_subs=True)
+        large = measure("large", large_rows, incr_sql, with_subs=True)
+        control = measure("large_full", large_rows, full_sql,
+                          with_subs=False)
+    finally:
+        server.stop()
+        rt.close()
+
+    def ratio(a, b):
+        return round(a / b, 3) if b > 0 else 0.0
+
+    out = {
+        "subscribers": n_subscribers,
+        "append_rounds": rounds,
+        "delta_rows": delta_rows,
+        "small": small,
+        "large": large,
+        "large_full_control": control,
+        # ~1.0 = refresh cost tracks the delta; the table grew 10x
+        "delta_scaling_p50_ratio": ratio(
+            large["refresh_ms"]["p50"], small["refresh_ms"]["p50"]
+        ),
+        # what incremental maintenance saves on the large table
+        "incremental_speedup_vs_full_p50": ratio(
+            control["refresh_ms"]["p50"], large["refresh_ms"]["p50"]
+        ),
+        "live_metrics": GLOBAL.view("live.", strip=False),
+        "smoke": smoke,
+    }
+    log({"live_slo": out})
+    return out
+
+
 def run_query_pair(name, build_t, build_c, tpu, n_run, speedups, detail,
                    abs_tol: float = 0.0):
     """Time one query on both engines, attach per-plan diagnostics, and
@@ -1023,7 +1188,7 @@ TPCDS_DEFAULT_SLICE = (3, 7, 12, 19, 27, 34, 42, 52, 55, 68, 96, 98)
 def main() -> None:
     t_start = time.monotonic()
     (suite, smoke, trace_dir, only_qids, concurrency,
-     serve_clients) = _suite_args()
+     serve_clients, live_subscribers) = _suite_args()
     if BENCH_PLATFORM:
         import jax
 
@@ -1118,6 +1283,26 @@ def main() -> None:
     }
     assert_backend(detail["platform"])
     speedups = []
+
+    if live_subscribers > 0:
+        # live-analytics SLO mode: paced appends into a maintained live
+        # table behind the server, refresh-latency percentiles, and the
+        # delta-vs-table-size scaling ratio (ISSUE 20)
+        live = run_live_slo(tpu, live_subscribers, smoke)
+        detail["live_slo"] = live
+        detail["wall_s"] = round(time.monotonic() - t_start, 1)
+        result = {
+            "metric": "live_refresh_delta_scaling_p50_ratio",
+            "value": live["delta_scaling_p50_ratio"],
+            "unit": "x",
+            "vs_baseline": 0.0,
+            "detail": detail,
+        }
+        with open("SLO_r09.json", "w") as f:
+            json.dump(result, f, indent=1)
+        log({"slo_json": "SLO_r09.json"})
+        print(json.dumps(result), flush=True)
+        return
 
     if serve_clients > 0 and os.environ.get("BENCH_DASHBOARD_MIX", ""):
         # dashboard-replay mode: two tenants replaying a fixed query mix

@@ -29,6 +29,13 @@ DOMAINS = (
     # serving front-end: connection registry / in-flight gate, held briefly
     # around bookkeeping while calling into the scheduler
     (15, "serve", (r"^spark_rapids_tpu/serve/",)),
+    # live analytics: per-table ingest locks and per-query refresh locks
+    # are held across whole engine executions (scheduler admission,
+    # kernel dispatch, catalog/result-cache updates all run beneath
+    # them), so the domain sits just above the scheduler. Subscription
+    # fan-out runs OUTSIDE these locks — the sinks live in serve/ (tier
+    # 15) and only ever enqueue, never call back up
+    (17, "live", (r"^spark_rapids_tpu/live/",)),
     # scheduler registry + cancellation tokens, then the permit pool it
     # acquires beneath itself
     (20, "sched", (r"^spark_rapids_tpu/sched/(scheduler|cancel)\.py$",)),

@@ -47,12 +47,16 @@ _CATALOGS: "weakref.WeakSet" = weakref.WeakSet()
 _SCHEDULERS: "weakref.WeakSet" = weakref.WeakSet()
 _RESULT_CACHES: "weakref.WeakSet" = weakref.WeakSet()
 _SUBPLAN_REGISTRIES: "weakref.WeakSet" = weakref.WeakSet()
+_LIVE_RUNTIMES: "weakref.WeakSet" = weakref.WeakSet()
 
 #: engine thread-name prefixes the balance check owns; lazily-created
 #: process singletons that legitimately outlive any one test are named
-#: separately and excluded
+#: separately and excluded (srt-live-refresh belongs to a session-scoped
+#: LiveRuntime that may be created lazily mid-test and outlive it — the
+#: runtime's _orphan_report covers its real leak classes instead)
 _ENGINE_THREAD_PREFIXES = ("srt-", "tpu-serve-")
-_SINGLETON_THREADS = ("srt-watchdog", "srt-compile-deadline")
+_SINGLETON_THREADS = ("srt-watchdog", "srt-compile-deadline",
+                      "srt-live-refresh")
 
 
 def _bump(kind: str, delta: int) -> None:
@@ -116,6 +120,7 @@ def install() -> None:
     from ..cache import xla_store as XS
     from ..cache.results import ResultCache
     from ..cache.subplan import SubplanRegistry
+    from ..live.maintain import LiveRuntime
     from ..mem.semaphore import DeviceSemaphore
     from ..mem.spill import BufferCatalog
     from ..obs import ledger as OL
@@ -129,6 +134,7 @@ def install() -> None:
     _wrap_init(QueryScheduler, _SCHEDULERS, "sched.__init__")
     _wrap_init(ResultCache, _RESULT_CACHES, "rcache.__init__")
     _wrap_init(SubplanRegistry, _SUBPLAN_REGISTRIES, "subplan.__init__")
+    _wrap_init(LiveRuntime, _LIVE_RUNTIMES, "live.__init__")
     _wrap_scope(OT._OpenSpan, "span.scope", "span")
     _wrap_scope(OL._Scope, "ledger.scope", "ledger-phase")
 
@@ -293,6 +299,13 @@ def _check(entry: Snapshot, fd_slack: int) -> List[str]:
         # an unreleased lease
         for line in reg._orphan_report():
             out.append(f"subplan registry {id(reg):#x}: {line}")
+    for rt in list(_LIVE_RUNTIMES):
+        # absolute, like the result cache: a subscription whose sink is
+        # closed (its connection died), maintained state whose query was
+        # retired, or state-byte accounting drift is a bug whenever it is
+        # observed — no matter which test created the runtime
+        for line in rt._orphan_report():
+            out.append(f"live runtime {id(rt):#x}: {line}")
     with _state_lock:
         counts = dict(_COUNTS)
     for kind in sorted(set(counts) | set(entry.counts)):

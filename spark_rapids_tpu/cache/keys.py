@@ -175,6 +175,14 @@ def plan_read_keys(session, final_plan) -> Tuple[str, ...]:
     for node in _walk(final_plan):
         name = type(node).__name__
         if name == "CpuFileScanExec":
+            # the scan ROOTS the reader was pointed at, not just the files
+            # it expanded: a later append can create a partition
+            # subdirectory that did not exist at registration time, and a
+            # write under the root must still invalidate this entry even
+            # though no expanded file's dirname contains the new subdir
+            opts = getattr(node, "options", None) or {}
+            for r in opts.get("__roots", ()) or ():
+                keys.add("path:" + r)
             for f in getattr(node, "files", ()) or ():
                 fk = "path:" + os.path.dirname(os.path.realpath(f))
                 keys.add(fk)

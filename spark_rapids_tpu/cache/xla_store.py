@@ -72,7 +72,10 @@ FORMAT_VERSION = 1
 #: engine kernel-semantics revision — bump whenever a kernel's compiled
 #: behavior changes without its cache key changing (an executable compiled
 #: by the old engine would silently compute the OLD semantics)
-SCHEMA_REV = 1
+#: rev 2: Schema fingerprints include field nullability (a Schema repr
+#: hides it, so two kernels differing only in nullable flags collided on
+#: one digest and quarantine-thrashed each other at every proving run)
+SCHEMA_REV = 2
 MAGIC = b"SRTXC01\n"
 _ENTRY_EXT = ".xc"
 
@@ -199,6 +202,18 @@ def _fingerprint(obj, out: list, depth: int = 0) -> None:
         for f in dataclasses.fields(obj):
             out.append(f.name.encode() + b"=")
             _fingerprint(getattr(obj, f.name), out, depth + 1)
+        out.append(b")")
+        return
+    from ..types import Schema as _Schema
+
+    if isinstance(obj, _Schema):
+        # Schema's repr omits field NULLABILITY, but the jit pytree
+        # metadata (and so the proving run) distinguishes it: digest the
+        # StructFields structurally instead, or two kernels differing
+        # only in nullable flags share an entry and quarantine-thrash it
+        out.append(b"H(")
+        for f in obj.fields:
+            _fingerprint(f, out, depth + 1)
         out.append(b")")
         return
     r = repr(obj)

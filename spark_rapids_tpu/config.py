@@ -1277,6 +1277,53 @@ SUBPLAN_DEDUP_MIN_COST_NS = conf(
 ).int_conf(1_000_000)
 
 
+# ── live analytics (live/ingest.py, live/maintain.py, serve SUBSCRIBE) ─────
+
+LIVE_ENABLED = conf("spark.rapids.tpu.live.enabled").doc(
+    "Master kill switch for the live-analytics subsystem: streaming "
+    "append ingestion with a per-table delta log, incremental view "
+    "maintenance (pass-through / aggregate / top-N classes, full "
+    "re-execution fallback with an explain reason otherwise), and the "
+    "serve-side SUBSCRIBE/UPDATE delta-streaming protocol. Off by "
+    "default: SUBSCRIBE frames are rejected and session.live raises "
+    "until it is set."
+).boolean_conf(False)
+
+LIVE_POOL = conf("spark.rapids.tpu.live.pool").doc(
+    "Scheduler pool refresh re-executions are admitted under (created at "
+    "weight 1 if absent from spark.rapids.tpu.scheduler.pools). A "
+    "dedicated pool keeps a dashboard fleet's refresh storm from "
+    "starving ad-hoc interactive queries — size it explicitly in the "
+    "pools spec when refreshes dominate."
+).string_conf("live")
+
+LIVE_DELTA_LOG_MAX_ENTRIES = conf(
+    "spark.rapids.tpu.live.deltaLog.maxEntries"
+).doc(
+    "Per-table bound on retained delta-log entries. A consumer whose "
+    "last-seen version has been truncated past detects the gap and "
+    "falls back to a full re-execution for that refresh (correct, just "
+    "not incremental), so small bounds trade memory for fallbacks."
+).int_conf(256)
+
+LIVE_STATE_MAX_BYTES = conf("spark.rapids.tpu.live.state.maxBytes").doc(
+    "Host-memory budget for maintained query state (aggregate partials, "
+    "top-N candidate sets, accumulated pass-through output), reserved "
+    "against the spill catalog's host budget. On reserve failure state "
+    "demotes to Arrow IPC files in the spill directory through the "
+    "fault-injected spill IO points and is promoted back on next use."
+).bytes_conf(128 * 1024 * 1024)
+
+LIVE_SUBSCRIBER_MAX_PENDING = conf(
+    "spark.rapids.tpu.live.subscriber.maxPending"
+).doc(
+    "Per-subscription bound on queued-but-unsent UPDATE epochs for a "
+    "slow consumer. On overflow the pending deltas collapse into one "
+    "full snapshot at the latest version — the subscriber sees every "
+    "version's effect, not every version."
+).int_conf(8)
+
+
 class TpuConf:
     """An immutable-ish view over a key→string dict, with typed access.
 

@@ -115,6 +115,23 @@ class _FormatWriter:
         self._w.close()
 
 
+def append_live_file(path: str, fmt: str, table: pa.Table, basename: str,
+                     options: Optional[dict] = None) -> str:
+    """The live-ingestion append primitive (live/ingest.py): land one
+    Arrow table as a single ROOT-LEVEL data file named by the caller.
+    The caller picks a basename that sorts after every existing one so
+    a fresh directory listing replays files in append order — the
+    invariant the pass-through/top-N maintenance classes rely on."""
+    os.makedirs(path, exist_ok=True)
+    full = os.path.join(path, basename)
+    w = _FormatWriter(fmt, full, table.schema, dict(options or {}))
+    for rb in table.combine_chunks().to_batches():
+        if rb.num_rows:
+            w.write(rb)
+    w.close()
+    return full
+
+
 def _fmt_value(v) -> str:
     """Hive partition-directory encoding of one value (escaped like Spark's
     PartitioningUtils.escapePathName so read-back round-trips)."""
@@ -440,6 +457,12 @@ class DataFrameWriter:
         # post-commit bump: readers that fingerprinted during the write
         # see a different version at cache admission and skip the store
         _ckeys.bump_table_version(session, table_key)
+        # a write into a registered LIVE root advances that table's epoch
+        # as an opaque entry (live/ingest.py): versions stay consistent,
+        # maintenance does a full refresh for this epoch
+        live = getattr(session, "_live_runtime", None)
+        if live is not None:
+            live.tables.note_external_write(path)
         return stats
 
     def parquet(self, path: str):

@@ -1,0 +1,33 @@
+"""Live analytics: streaming append ingestion, incremental view
+maintenance, and subscription fan-out for dashboard fleets (ISSUE 20).
+
+Entry point: ``session.live`` (gated on ``spark.rapids.tpu.live.enabled``)
+returns the session's :class:`LiveRuntime` — register live tables, append
+batches, register maintained queries, attach subscribers. The serve layer
+(``serve/server.py``) speaks the SUBSCRIBE/UPDATE wire protocol on top.
+"""
+from .ingest import DeltaEntry, LiveTable, LiveTableCatalog
+from .maintain import (
+    AGGREGATE,
+    FULL,
+    PASSTHROUGH,
+    TOPN,
+    LiveQuery,
+    LiveRuntime,
+    LiveUpdate,
+    StateLost,
+)
+
+__all__ = [
+    "AGGREGATE",
+    "FULL",
+    "PASSTHROUGH",
+    "TOPN",
+    "DeltaEntry",
+    "LiveQuery",
+    "LiveRuntime",
+    "LiveTable",
+    "LiveTableCatalog",
+    "LiveUpdate",
+    "StateLost",
+]

@@ -1,0 +1,347 @@
+"""Streaming append ingestion for live tables (ISSUE 20 tentpole, part 1).
+
+A *live table* is a registered temp view whose contents grow by
+append-only batches. Two kinds:
+
+* **view-backed** (``create_table``): the rows live in one in-memory
+  ``pa.Table``; every append concatenates at the END and re-registers the
+  view, so a full re-execution's row order is exactly the append order.
+* **path-backed** (``register_path``): the view is pinned to an EXPLICIT
+  file list (snapshot semantics — no re-listing race between version bump
+  and query execution); appends write one new root-level file through
+  :func:`io/writer.py::append_live_file` and extend the pinned list.
+
+Every append bumps the table's **epoch** (``version``) through the same
+``cache/keys.py::bump_table_version`` counters PR 19 introduced — ad-hoc
+readers and the result cache see the write like any other — and records a
+:class:`DeltaEntry` in the per-table **delta log**: exactly which rows (or
+files) arrived between version v and v+1, so incremental maintenance
+(``live/maintain.py``) scans only the new data.
+
+Ordering invariants (what makes pass-through/top-N deltas *replayable*):
+an entry is ``ordered`` when appending it preserved "full scan order ==
+historical append order". View-backed appends always are (concat at the
+end). Path-backed appends are ordered iff the new basename sorts after
+every existing root basename and the root has no subdirectories — the
+conditions under which ``io/files.py::expand_paths`` (os.walk + sorted
+basenames) lists old files before new ones. ``DataFrameWriter`` appends
+into a registered root arrive through :func:`LiveTableCatalog.
+note_external_write` as *opaque* entries (no delta payload, unordered):
+versions stay consistent and maintenance falls back to a full refresh for
+that epoch.
+
+Locking: each table carries its own lock (``live`` tier 17 in
+``analysis/lock_order.py``) held across (mutate record → re-register view
+→ append delta log) so a refresh can never observe a version without its
+log entry; view (re)registration acquires the session catalog lock (tier
+78) BENEATH it. Version-advance listeners fire OUTSIDE every live lock.
+"""
+from __future__ import annotations
+
+import os
+import threading
+import uuid
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional, Tuple
+
+import pyarrow as pa
+
+from .. import config as cfg
+from ..obs import metrics as obs_metrics
+
+_M = obs_metrics.GLOBAL
+
+
+@dataclass
+class DeltaEntry:
+    """What arrived between ``version - 1`` and ``version`` of one table.
+
+    ``table`` carries the rows for view-backed tables, ``files`` the new
+    file paths for path-backed ones; BOTH None marks an opaque external
+    write (maintenance must fall back to a full refresh). ``ordered``
+    asserts the append kept full-scan order == append order."""
+
+    version: int
+    rows: int
+    nbytes: int
+    ordered: bool
+    table: Optional[pa.Table] = None
+    files: Optional[Tuple[str, ...]] = None
+
+    @property
+    def opaque(self) -> bool:
+        return self.table is None and self.files is None
+
+
+class LiveTable:
+    """One registered live table (view- or path-backed)."""
+
+    def __init__(self, name: str, kind: str, schema, arrow_schema):
+        self.name = name
+        self.kind = kind  # "view" | "path"
+        self.schema = schema  # types.Schema
+        self.arrow_schema = arrow_schema
+        #: the per-table live lock (tier 17): every field below moves
+        #: under it, and the view re-registration happens beneath it so
+        #: version/view/delta-log can never be observed torn
+        self.lock = threading.Lock()
+        self.version = 1  # graft: guarded_by(lock)
+        self.log: List[DeltaEntry] = []  # graft: guarded_by(lock)
+        self.table: Optional[pa.Table] = None  # graft: guarded_by(lock)
+        self.path: Optional[str] = None
+        self.fmt: Optional[str] = None
+        self.files: Tuple[str, ...] = ()  # graft: guarded_by(lock)
+        self._seq = 0  # graft: guarded_by(lock)
+
+    def describe(self) -> dict:
+        with self.lock:
+            return {
+                "kind": self.kind,
+                "version": self.version,
+                "rows": (
+                    self.table.num_rows if self.table is not None else None
+                ),
+                "files": len(self.files) if self.kind == "path" else None,
+                "log_entries": len(self.log),
+            }
+
+
+class LiveTableCatalog:
+    """The session's registry of live tables + the append write path."""
+
+    def __init__(self, session):
+        self._session = session
+        self._lock = threading.Lock()  # registry only, tier 17
+        self._tables: Dict[str, LiveTable] = {}  # graft: guarded_by(_lock)
+        self._listeners: List[Callable] = []  # graft: guarded_by(_lock)
+
+    # ── registration ────────────────────────────────────────────────────
+
+    def create_table(self, name: str, data) -> LiveTable:
+        """Register a view-backed live table seeded with ``data``
+        (pa.Table / RecordBatch / dict). Version starts at 1."""
+        table = self._to_table(data, None)
+        from ..types import Schema
+
+        schema = Schema.from_arrow(table.schema)
+        t = LiveTable(name, "view", schema, table.schema)
+        t.table = table
+        key = name.lower()
+        with self._lock:
+            if key in self._tables:
+                raise ValueError(f"live table {name!r} already registered")
+            self._tables[key] = t
+        with t.lock:
+            self._reregister(t)
+        return t
+
+    def register_path(self, name: str, path: str, fmt: str,
+                      options: Optional[dict] = None) -> LiveTable:
+        """Register a path-backed live table over the files currently
+        under ``path``. The view pins the EXPLICIT expanded file list;
+        appends extend it (snapshot-per-version semantics)."""
+        from ..io.files import expand_paths, infer_schema
+
+        real = os.path.realpath(path)
+        opts = dict(options or {})
+        files = tuple(expand_paths((real,), fmt))  # raises when empty
+        schema = infer_schema(list(files), fmt, opts)
+        opts["__roots"] = (real,)
+        t = LiveTable(name, "path", schema, schema.to_arrow())
+        t.path, t.fmt, t.files = real, fmt, files
+        t._options = opts
+        key = name.lower()
+        with self._lock:
+            if key in self._tables:
+                raise ValueError(f"live table {name!r} already registered")
+            self._tables[key] = t
+        with t.lock:
+            self._reregister(t)
+        return t
+
+    def get(self, name: str) -> Optional[LiveTable]:
+        key = name.lower()
+        with self._lock:
+            return self._tables.get(key)
+
+    def all(self) -> List[LiveTable]:
+        with self._lock:
+            return list(self._tables.values())
+
+    def add_listener(self, fn: Callable) -> None:
+        """``fn(table_name, new_version)`` after every version advance —
+        called OUTSIDE all live locks."""
+        with self._lock:
+            self._listeners.append(fn)
+
+    # ── the append write path ───────────────────────────────────────────
+
+    def append(self, name: str, data) -> int:
+        """Land one Arrow batch into a live table; returns the new
+        version. The delta-log entry, the version bump, and the view
+        re-registration commit atomically under the table lock."""
+        t = self.get(name)
+        if t is None:
+            raise ValueError(f"unknown live table {name!r}")
+        delta = self._to_table(data, t.arrow_schema)
+        with t.lock:
+            version = t.version + 1
+            if t.kind == "view":
+                t.table = (
+                    pa.concat_tables([t.table, delta])
+                    if t.table.num_rows
+                    else delta
+                )
+                entry = DeltaEntry(
+                    version, delta.num_rows, delta.nbytes, True, table=delta
+                )
+            else:
+                entry = self._append_file(t, delta, version)
+            t.version = version
+            self._log_append(t, entry)
+            self._reregister(t)
+        self._notify(t.name, version)
+        _M.counter("live.appends").add(1)
+        _M.counter("live.delta.rows").add(delta.num_rows)
+        _M.counter("live.delta.bytes").add(delta.nbytes)
+        return version
+
+    def note_external_write(self, path: str) -> None:
+        """A ``DataFrameWriter`` landed files under (or at) a registered
+        live root: bump the version with an OPAQUE unordered entry (no
+        delta payload → maintenance does a full refresh for this epoch)
+        and re-pin the file list from a fresh expansion."""
+        from ..io.files import expand_paths
+
+        real = os.path.realpath(path)
+        for t in self.all():
+            if t.kind != "path":
+                continue
+            if not (real == t.path or real.startswith(t.path + os.sep)
+                    or t.path.startswith(real + os.sep)):
+                continue
+            with t.lock:
+                version = t.version + 1
+                try:
+                    t.files = tuple(expand_paths((t.path,), t.fmt))
+                except FileNotFoundError:
+                    t.files = ()
+                t.version = version
+                self._log_append(
+                    t, DeltaEntry(version, 0, 0, ordered=False)
+                )
+                self._reregister(t)
+            self._notify(t.name, version)
+
+    # ── delta-log reads (the maintenance consumer) ──────────────────────
+
+    def entries_between(
+        self, t: LiveTable, from_version: int, to_version: int
+    ) -> Optional[List[DeltaEntry]]:
+        """The contiguous delta entries covering (from_version,
+        to_version], or None when the log has been truncated past the
+        span (gap → caller falls back to a full refresh). Caller holds
+        ``t.lock``."""
+        if from_version >= to_version:
+            return []
+        want = list(range(from_version + 1, to_version + 1))
+        by_v = {e.version: e for e in t.log}
+        out = []
+        for v in want:
+            e = by_v.get(v)
+            if e is None:
+                return None
+            out.append(e)
+        return out
+
+    def status(self) -> dict:
+        return {name: t.describe() for name, t in sorted(
+            ((t.name, t) for t in self.all())
+        )}
+
+    # ── internals ───────────────────────────────────────────────────────
+
+    def _to_table(self, data, arrow_schema) -> pa.Table:
+        if isinstance(data, pa.RecordBatch):
+            table = pa.Table.from_batches([data])
+        elif isinstance(data, pa.Table):
+            table = data
+        elif isinstance(data, dict):
+            table = pa.table(data)
+        else:
+            raise TypeError(f"cannot append {type(data)} to a live table")
+        if arrow_schema is not None:
+            table = table.cast(arrow_schema)
+        return table.combine_chunks()
+
+    def _append_file(self, t: LiveTable, delta: pa.Table,
+                     version: int) -> DeltaEntry:
+        from ..io.writer import append_live_file
+
+        t._seq += 1
+        ext = {"parquet": ".parquet", "orc": ".orc", "csv": ".csv"}[t.fmt]
+        # zero-padded sequence prefixed 'v': sorts after itself
+        # monotonically and after the writer path's 'part-*' basenames
+        fname = f"v{t._seq:010d}-{uuid.uuid4().hex[:8]}{ext}"
+        # ordered iff a fresh expand_paths would list every existing file
+        # BEFORE the new one: all pinned files at root level (os.walk
+        # visits subdirectory files in scandir order — unordered), and the
+        # new basename sorting last
+        root_names = [
+            os.path.basename(f) for f in t.files
+            if os.path.dirname(os.path.realpath(f)) == t.path
+        ]
+        try:
+            has_subdir = any(
+                e.is_dir() for e in os.scandir(t.path)
+            )
+        except OSError:
+            has_subdir = True
+        ordered = (
+            not has_subdir
+            and len(root_names) == len(t.files)
+            and (not root_names or fname > max(root_names))
+        )
+        full = append_live_file(t.path, t.fmt, delta, fname,
+                                getattr(t, "_options", None))
+        t.files = t.files + (full,)
+        return DeltaEntry(
+            version, delta.num_rows, delta.nbytes, ordered,
+            files=(full,),
+        )
+
+    def _log_append(self, t: LiveTable, entry: DeltaEntry) -> None:
+        t.log.append(entry)
+        keep = cfg.LIVE_DELTA_LOG_MAX_ENTRIES.get(self._session.conf)
+        if len(t.log) > keep:
+            del t.log[: len(t.log) - keep]
+
+    def _reregister(self, t: LiveTable) -> None:
+        """(Re)register the temp view pinned to the table's CURRENT
+        snapshot. Under ``t.lock`` by design: the catalog lock (tier 78)
+        and the result-cache invalidation it triggers both sit beneath
+        the live tier."""
+        from ..plan import logical as L
+        from ..session import DataFrame
+
+        session = self._session
+        if t.kind == "view":
+            lp = L.LocalRelation(t.table, t.schema, 1, source=t.table)
+        else:
+            lp = L.FileScan(
+                list(t.files), t.fmt, t.schema,
+                dict(getattr(t, "_options", {})),
+            )
+        session.create_or_replace_temp_view(t.name, DataFrame(session, lp))
+        if t.kind == "path":
+            from ..cache import keys as _ckeys
+
+            _ckeys.bump_table_version(
+                session, _ckeys.table_key_for_path(t.path)
+            )
+
+    def _notify(self, name: str, version: int) -> None:
+        with self._lock:
+            listeners = list(self._listeners)
+        for fn in listeners:
+            fn(name, version)

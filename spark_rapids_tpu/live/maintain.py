@@ -1,0 +1,1139 @@
+"""Incremental view maintenance for live queries (ISSUE 20 tentpole,
+part 2).
+
+A registered live query is classified by plan shape into a **maintenance
+class**; every refresh must be bit-identical to a from-scratch execution
+of the query at the same table version (the CPU-oracle differential in
+tests/test_live.py is the judge):
+
+* **passthrough** — Project/Filter chains over one live leaf. The chain
+  replays over ONLY the delta rows and the result appends to the
+  accumulated output. Sound because appends land at the END of the scan
+  order (``live/ingest.py`` ordering invariants) and Project/Filter are
+  row-local.
+* **aggregate** — a hash aggregate over a chain. State = the per-group
+  partial buffers (``__g*`` key columns + ``__b*`` buffer columns),
+  maintained by executing SYNTHESIZED engine plans: a partial aggregate
+  over the delta, then a merge aggregate over ``state ∪ delta-partials``
+  (single-partition LocalRelation → the planner's complete-mode path).
+  Bit-identity holds because group output order is a pure function of the
+  key set (``ops/sortkeys.py`` radix words are value-based, strings get
+  full-width lexicographic encoding) and because only EXACT-merge
+  functions are admitted: count, sum over integral children (wrapping
+  int64 — associative even on overflow), min/max, and avg over integral
+  children (double sums of integers are exact below 2^53 — the documented
+  caveat in docs/live-analytics.md). Float/decimal sums, first/last,
+  moments, and collect_* fall back per query with an explain reason.
+* **topn** — Limit(Sort(chain)) with a global sort. State = the current
+  top-N candidate rows; a refresh takes top-N of the delta alone, then
+  re-ranks candidates ∪ delta-top with candidates FIRST — the engine's
+  stable sort then resolves boundary ties exactly as the full input order
+  would, and under append-only a row that once left the top-N can never
+  re-enter it.
+* anything else (joins, windows, distinct aggregates, unbounded sorts…)
+  → **full** re-execution per refresh, with the reason recorded on the
+  query — the same explain philosophy as ``plan/overrides.py``.
+
+Refresh work is admitted through the PR-5 scheduler under the dedicated
+``spark.rapids.tpu.live.pool`` pool so a dashboard fleet cannot starve
+ad-hoc queries. Maintained state (aggregate buffers, top-N candidates,
+accumulated outputs) is host-byte-accounted against a spill catalog and
+demotes to Arrow IPC files through the SAME fault-injected spill points
+the result cache uses (``cache/results.py::_write_ipc``). After each
+refresh the PR-19 result cache is updated IN PLACE at the new version —
+an identical ad-hoc query hits the cache instead of re-executing.
+
+Locking (``live`` tier 17 in analysis/lock_order.py): the runtime's
+registry lock and each query's state lock guard dicts and buffer swaps;
+plan re-parses happen under the owning table's live lock (milliseconds),
+engine executions always run OUTSIDE every live lock.
+"""
+from __future__ import annotations
+
+import dataclasses
+import logging
+import os
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+import pyarrow as pa
+
+from .. import config as cfg
+from ..obs import metrics as obs_metrics
+from .ingest import DeltaEntry, LiveTable, LiveTableCatalog
+
+_M = obs_metrics.GLOBAL
+log = logging.getLogger(__name__)
+
+#: maintenance classes
+PASSTHROUGH = "passthrough"
+AGGREGATE = "aggregate"
+TOPN = "topn"
+FULL = "full"
+
+
+class StateLost(RuntimeError):
+    """A demoted state table failed to read back (injected spill-read
+    fault, pruned spill dir) — the refresh falls back to full and
+    reseeds."""
+
+
+# ── spill-accounted state buffers ───────────────────────────────────────────
+
+
+class _StateBuf:
+    """One maintained state table. Host bytes reserve against the
+    runtime's spill catalog; when the budget refuses, the table demotes
+    to an Arrow IPC file through the fault-injected spill writer and is
+    read back per use (promotion happens naturally at the next put once
+    the budget frees up). Mutated only under the owning query's state
+    lock."""
+
+    def __init__(self, catalog, name: str):
+        self._catalog = catalog
+        self._name = name
+        self._table: Optional[pa.Table] = None
+        self._path: Optional[str] = None
+        self._nbytes = 0
+        self._accounted = False  # host bytes reserved
+        self._disk = False  # disk bytes reserved + file present
+
+    def put(self, table: pa.Table) -> None:
+        self._drop()
+        nbytes = table.nbytes
+        if self._catalog.host_reserve(nbytes):
+            self._table, self._nbytes = table, nbytes
+            self._accounted = True
+            return
+        from ..cache.results import _write_ipc
+
+        batches = table.combine_chunks().to_batches()
+        if not batches:
+            batches = [pa.RecordBatch.from_arrays(
+                [pa.array([], type=f.type) for f in table.schema],
+                schema=table.schema,
+            )]
+        path = _write_ipc(self._catalog._dir(), batches)
+        if path is not None:
+            self._path, self._nbytes = path, nbytes
+            self._disk = True
+            self._catalog.disk_reserve(nbytes)
+            _M.counter("live.state.demotions").add(1)
+        else:
+            # spill write refused (injected fault / IO error): keep the
+            # state in memory UNACCOUNTED rather than lose it — dropped
+            # state would force full refreshes forever after
+            self._table, self._nbytes = table, nbytes
+
+    def get(self) -> pa.Table:
+        if self._table is not None:
+            return self._table
+        from ..cache.results import _read_ipc
+
+        batches = _read_ipc(self._path)
+        if batches is None:
+            raise StateLost(self._name)
+        return pa.Table.from_batches(batches)
+
+    def _drop(self) -> None:
+        if self._accounted:
+            self._catalog.host_release(self._nbytes)
+        if self._disk:
+            self._catalog.disk_release(self._nbytes)
+            try:
+                os.remove(self._path)
+            except OSError:
+                pass
+        self._table, self._path = None, None
+        self._nbytes, self._accounted, self._disk = 0, False, False
+
+    def close(self) -> None:
+        self._drop()
+
+    @property
+    def mem_bytes(self) -> int:
+        return self._nbytes if self._table is not None else 0
+
+    @property
+    def accounted_bytes(self) -> int:
+        return self._nbytes if self._accounted else 0
+
+    @property
+    def disk_bytes(self) -> int:
+        return self._nbytes if self._disk else 0
+
+
+# ── plan-shape classification ───────────────────────────────────────────────
+
+
+@dataclasses.dataclass
+class _AggSpec:
+    """How one output column of a maintained aggregate rebuilds from the
+    state table."""
+
+    out_name: str
+    kind: str  # "group" | "count" | "sum" | "min" | "max" | "avg"
+    gidx: int = -1
+    bufs: Tuple[str, ...] = ()
+
+
+@dataclasses.dataclass
+class _Shape:
+    klass: str
+    reason: Optional[str] = None
+    leaf: object = None
+    chain: Tuple = ()  # passthrough: root→leaf-parent operator path
+    agg: object = None  # aggregate: the Aggregate node
+    agg_specs: Optional[List[_AggSpec]] = None
+    agg_bufs: Optional[List] = None  # [(name, partial_expr, merge_op)]
+    state_schema: Optional[pa.Schema] = None
+    outer: Tuple = ()  # topn: ops above Limit
+    limit_n: int = 0
+    sort: object = None  # topn: the Sort node
+    inner: Tuple = ()  # topn: ops between Sort and leaf
+
+
+def _classify(lp, is_live_leaf: Callable) -> _Shape:
+    from ..plan import logical as L
+
+    hits: List[Tuple[object, Tuple]] = []
+
+    def rec(node, path):
+        if is_live_leaf(node):
+            hits.append((node, path))
+            return
+        for c in node.children():
+            rec(c, path + (node,))
+
+    rec(lp, ())
+    if not hits:
+        return _Shape(FULL, reason="no live input in plan")
+    if len(hits) > 1:
+        return _Shape(
+            FULL,
+            reason="multiple live inputs (joins over live tables fall "
+            "back to full refresh in v1)",
+        )
+    leaf, path = hits[0]
+    PF = (L.Project, L.Filter)
+    i = 0
+    while i < len(path) and isinstance(path[i], PF):
+        i += 1
+    if i == len(path):
+        return _Shape(PASSTHROUGH, leaf=leaf, chain=path)
+    node = path[i]
+    rest = path[i + 1:]
+    if isinstance(node, L.Aggregate):
+        if not all(isinstance(n, PF) for n in rest):
+            return _Shape(
+                FULL, reason="non-Project/Filter operators under the "
+                "aggregate",
+            )
+        # the SQL compiler always wraps Project(Aggregate(...)) to strip
+        # its internal __g*/__a* aliases — that outer chain is row-local
+        # over the aggregate output, so it replays after state assembly
+        shape = _classify_aggregate(node, leaf, rest)
+        if shape.klass == AGGREGATE:
+            shape.outer = path[:i]
+        return shape
+    if isinstance(node, L.Limit):
+        if i + 1 >= len(path) or not isinstance(path[i + 1], L.Sort):
+            return _Shape(
+                FULL, reason="limit without a defining sort order"
+            )
+        sort = path[i + 1]
+        inner = path[i + 2:]
+        if not sort.is_global:
+            return _Shape(FULL, reason="per-partition (non-global) sort")
+        if not all(isinstance(n, PF) for n in inner):
+            return _Shape(
+                FULL, reason="non-Project/Filter operators under the "
+                "top-N sort",
+            )
+        return _Shape(
+            TOPN, leaf=leaf, outer=path[:i], limit_n=node.n, sort=sort,
+            inner=inner,
+        )
+    if isinstance(node, L.Sort):
+        return _Shape(
+            FULL, reason="unbounded sort (every refresh reorders the "
+            "whole output)",
+        )
+    return _Shape(
+        FULL,
+        reason=f"unsupported operator for incremental maintenance: "
+        f"{type(node).__name__}",
+    )
+
+
+def _classify_aggregate(agg, leaf, rest) -> _Shape:
+    """Admit only EXACT-merge aggregate functions; map each output column
+    to its state columns. Any unsupported piece → FULL with the reason."""
+    from ..expr import Alias, bind, output_name
+    from ..expr import aggregates as AGG
+    from ..expr.cast import Cast
+    from ..plan import logical as L
+    from ..types import DOUBLE, IntegralType
+
+    if isinstance(leaf, L.LocalRelation) and leaf.num_partitions != 1:
+        return _Shape(
+            FULL, reason="aggregate over a multi-partition live input "
+            "(partial/exchange order is not incremental-stable)",
+        )
+    if isinstance(leaf, L.FileScan):
+        return _Shape(
+            FULL, reason="aggregate over a path-backed (multi-partition) "
+            "live input",
+        )
+    cschema = agg.child.schema
+    specs: List[_AggSpec] = []
+    bufs: List = []  # (name, partial_expr, merge_op)
+
+    def fail(reason):
+        return _Shape(FULL, reason=reason)
+
+    for e in agg.aggregates:
+        name = output_name(e)
+        inner = e.child if isinstance(e, Alias) else e
+        if not isinstance(inner, AGG.AggregateFunction):
+            # the compiler repeats the grouping ALIASES verbatim in the
+            # aggregate list — match either the alias or its child
+            gidx = next(
+                (j for j, g in enumerate(agg.grouping)
+                 if g == e or g == inner
+                 or (isinstance(g, Alias) and g.child == inner)),
+                None,
+            )
+            if gidx is None:
+                return fail(
+                    f"output {name!r} is neither a grouping column nor a "
+                    "supported aggregate (composite aggregate expression)"
+                )
+            specs.append(_AggSpec(name, "group", gidx=gidx))
+            continue
+        fn = inner
+        if getattr(fn, "distinct", False):
+            return fail(
+                "DISTINCT aggregates need the full input, not deltas"
+            )
+        k = len(bufs)
+        if isinstance(fn, AGG.Count):
+            bufs.append((f"__b{k}", AGG.Count(fn.child), "sum"))
+            specs.append(_AggSpec(name, "count", bufs=(f"__b{k}",)))
+        elif isinstance(fn, AGG.Sum):
+            if not isinstance(
+                bind(fn.child, cschema).data_type, IntegralType
+            ):
+                return fail(
+                    "sum over a non-integral child is not incrementally "
+                    "exact (float accumulation is non-associative; "
+                    "decimal sums re-widen precision)"
+                )
+            bufs.append((f"__b{k}", AGG.Sum(fn.child), "sum"))
+            specs.append(_AggSpec(name, "sum", bufs=(f"__b{k}",)))
+        elif isinstance(fn, AGG.Min):
+            bufs.append((f"__b{k}", AGG.Min(fn.child), "min"))
+            specs.append(_AggSpec(name, "min", bufs=(f"__b{k}",)))
+        elif isinstance(fn, AGG.Max):
+            bufs.append((f"__b{k}", AGG.Max(fn.child), "max"))
+            specs.append(_AggSpec(name, "max", bufs=(f"__b{k}",)))
+        elif isinstance(fn, AGG.Average):
+            if not isinstance(
+                bind(fn.child, cschema).data_type, IntegralType
+            ):
+                return fail(
+                    "avg over a non-integral child accumulates "
+                    "non-associatively in floating point"
+                )
+            bufs.append(
+                (f"__b{k}", AGG.Sum(Cast(fn.child, DOUBLE)), "sum")
+            )
+            bufs.append((f"__b{k + 1}", AGG.Count(fn.child), "sum"))
+            specs.append(
+                _AggSpec(name, "avg", bufs=(f"__b{k}", f"__b{k + 1}"))
+            )
+        else:
+            return fail(
+                f"{type(fn).__name__.lower()} is order-dependent or "
+                "non-associative — needs a full refresh"
+            )
+    shape = _Shape(
+        AGGREGATE, leaf=leaf, chain=tuple(rest), agg=agg, agg_specs=specs,
+        agg_bufs=bufs,
+    )
+    # canonical state schema = the ENGINE-derived partial output schema
+    # (names, types, AND nullability — count buffers are non-nullable):
+    # the merge kernel then digest-shares the on-disk XLA store entry
+    # with ordinary final aggregates instead of quarantine-thrashing it
+    # over a nullability-only pytree mismatch
+    shape.state_schema = _partial_plan(shape, leaf).schema.to_arrow()
+    return shape
+
+
+def _replay(nodes: Tuple, new_child):
+    """Rebuild a single-child operator chain (root→…→parent order) over a
+    new leaf; dataclasses.replace re-runs resolution against the leaf's
+    identical schema."""
+    node = new_child
+    for n in reversed(nodes):
+        node = dataclasses.replace(n, child=node)
+    return node
+
+
+def _partial_plan(shape: _Shape, delta_leaf):
+    """The synthesized partial aggregate over a (delta) leaf: key aliases
+    + buffer-producing functions, over the replayed chain."""
+    from ..expr import Alias
+    from ..plan import logical as L
+
+    # mimic the compiler's shape exactly: grouping holds Alias(expr,
+    # "__g{j}") entries repeated verbatim at the head of the aggregate
+    # list
+    grouping = [
+        Alias(g.child if isinstance(g, Alias) else g, f"__g{j}")
+        for j, g in enumerate(shape.agg.grouping)
+    ]
+    aggs = list(grouping) + [
+        Alias(pexpr, bname) for bname, pexpr, _op in shape.agg_bufs
+    ]
+    return L.Aggregate(grouping, aggs, _replay(shape.chain, delta_leaf))
+
+
+# ── subscriptions ───────────────────────────────────────────────────────────
+
+
+@dataclasses.dataclass
+class LiveUpdate:
+    """One refresh delivery: the epoch-stamped payload a subscriber
+    receives. ``kind`` is "delta" (append these rows — passthrough class)
+    or "snapshot" (replace the result — aggregate/top-N/full)."""
+
+    qid: str
+    epoch: int
+    kind: str
+    table: pa.Table
+    incremental: bool = True
+    reason: Optional[str] = None
+
+
+class LiveQuery:
+    """One maintained live query (shared by every subscriber with the
+    same SQL text)."""
+
+    def __init__(self, qid: str, sql: str, table_name: str, pinned: bool):
+        self.qid = qid
+        self.sql = sql
+        self.table_name = table_name
+        self.pinned = pinned
+        self.klass = FULL
+        self.reason: Optional[str] = None
+        self.last_version = 0
+        #: serializes seed/refresh compute per query (held across engine
+        #: runs — tier 17, only HIGHER tiers acquired beneath it)
+        self.refresh_lock = threading.Lock()
+        #: guards the state-buffer swaps and ``info`` (dict ops only)
+        self.state_lock = threading.Lock()
+        self.out_buf: Optional[_StateBuf] = None  # graft: guarded_by(state_lock)
+        self.agg_buf: Optional[_StateBuf] = None  # graft: guarded_by(state_lock)
+        self.cand_buf: Optional[_StateBuf] = None  # graft: guarded_by(state_lock)
+        self.info: dict = {}  # graft: guarded_by(state_lock)
+        self._dirty_since: Optional[int] = None
+
+    def snapshot(self) -> Optional[Tuple[int, pa.Table]]:
+        """(epoch, full current output) — what a new subscriber receives
+        first and what a collapsed slow-consumer queue resends. None when
+        demoted state fails to read back (next refresh reseeds)."""
+        with self.state_lock:
+            if self.out_buf is None:
+                return None
+            try:
+                return self.last_version, self.out_buf.get()
+            except StateLost:
+                return None
+
+    def describe(self) -> dict:
+        with self.state_lock:
+            d = dict(self.info)
+        d.update({
+            "sql": self.sql, "table": self.table_name, "class": self.klass,
+            "epoch": self.last_version,
+        })
+        if self.reason:
+            d["fallback_reason"] = self.reason
+        return d
+
+
+# ── the runtime ─────────────────────────────────────────────────────────────
+
+
+class LiveRuntime:
+    """The session's live-analytics runtime: table catalog + maintained
+    queries + the refresh worker + subscription fan-out."""
+
+    def __init__(self, session):
+        from ..mem.spill import BufferCatalog
+
+        self._session = session
+        self.tables = LiveTableCatalog(session)
+        #: registry lock (tier 17): _cv wraps it and is the ONLY name the
+        #: runtime acquires it under, so the guarded_by contract has one
+        #: lock name; dict/set ops only — compute runs outside
+        self._cv = threading.Condition(threading.Lock())
+        self._queries: Dict[str, LiveQuery] = {}  # graft: guarded_by(_cv)
+        self._by_sql: Dict[str, str] = {}  # graft: guarded_by(_cv)
+        self._subs: Dict[str, Tuple[str, object]] = {}  # graft: guarded_by(_cv)
+        self._dirty: set = set()  # graft: guarded_by(_cv)
+        self._reg_lock = threading.Lock()  # serializes query seeding
+        self._catalog = BufferCatalog(
+            device_limit=None,
+            host_limit=cfg.LIVE_STATE_MAX_BYTES.get(session.conf),
+            spill_dir=cfg.SPILL_DIR.get(session.conf),
+        )
+        self._worker: Optional[threading.Thread] = None
+        self._stopping = False
+        self._seq = 0
+        self._sub_seq = 0
+        self.tables.add_listener(self._on_advance)
+
+    # ── query registration / subscription ───────────────────────────────
+
+    def register_query(self, sql: str, pinned: bool = True) -> LiveQuery:
+        """Register (or share) a maintained live query. Seeds the state
+        with one full execution at the current version."""
+        with self._reg_lock:
+            with self._cv:
+                qid = self._by_sql.get(sql)
+                if qid is not None:
+                    q = self._queries[qid]
+                    q.pinned = q.pinned or pinned
+                    return q
+                self._seq += 1
+                qid = f"lq{self._seq}"
+            q = LiveQuery(qid, sql, "", pinned)
+            self._seed(q)  # outside the registry lock: runs queries
+            with self._cv:
+                self._queries[qid] = q
+                self._by_sql[sql] = qid
+                t = self.tables.get(q.table_name)
+                if t is not None and t.version > q.last_version:
+                    self._dirty.add(qid)
+                    self._cv.notify_all()
+            self._ensure_worker()
+            return q
+
+    def subscribe(self, sql: str, sink) -> dict:
+        """Attach a subscriber sink to a (possibly shared) live query.
+        ``sink`` must expose ``offer(LiveUpdate)`` (non-blocking) and a
+        ``closed`` attribute. Returns the subscription descriptor with
+        the initial snapshot."""
+        q = self.register_query(sql, pinned=False)
+        with self._cv:
+            self._sub_seq += 1
+            sub_id = f"sub{self._sub_seq}"
+            self._subs[sub_id] = (q.qid, sink)
+        _M.gauge("live.subscriptions.active").add(1)
+        snap = q.snapshot()
+        epoch, table = snap if snap is not None else (q.last_version, None)
+        return {
+            "subscription_id": sub_id,
+            "qid": q.qid,
+            "mode": q.klass,
+            "reason": q.reason,
+            "epoch": epoch,
+            "snapshot": table,
+        }
+
+    def unsubscribe(self, sub_id: str) -> bool:
+        """Detach one subscriber; retires the shared query when its last
+        non-pinned subscriber leaves (state buffers released)."""
+        with self._cv:
+            ent = self._subs.pop(sub_id, None)
+            if ent is None:
+                return False
+            qid = ent[0]
+            live = any(q == qid for q, _s in self._subs.values())
+            q = self._queries.get(qid)
+            retire = (
+                q is not None and not live and not q.pinned
+            )
+            if retire:
+                self._queries.pop(qid, None)
+                self._by_sql.pop(q.sql, None)
+                self._dirty.discard(qid)
+        _M.gauge("live.subscriptions.active").add(-1)
+        if retire:
+            self._close_query(q)
+        return True
+
+    def retire_query(self, qid: str) -> bool:
+        """Drop a pinned query and its state (no-op for unknown ids)."""
+        with self._cv:
+            q = self._queries.pop(qid, None)
+            if q is None:
+                return False
+            self._by_sql.pop(q.sql, None)
+            self._dirty.discard(qid)
+            drop_subs = [
+                s for s, (qq, _x) in self._subs.items() if qq == qid
+            ]
+            for s in drop_subs:
+                self._subs.pop(s, None)
+        if drop_subs:
+            _M.gauge("live.subscriptions.active").add(-len(drop_subs))
+        self._close_query(q)
+        return True
+
+    def query(self, qid: str) -> Optional[LiveQuery]:
+        with self._cv:
+            return self._queries.get(qid)
+
+    def status(self) -> dict:
+        with self._cv:
+            queries = {q.qid: q.describe() for q in self._queries.values()}
+            subs = len(self._subs)
+        return {
+            "tables": self.tables.status(),
+            "queries": queries,
+            "subscriptions": subs,
+            "state_mem_bytes": self._catalog.host_bytes,
+            "state_disk_bytes": self._catalog.disk_bytes,
+        }
+
+    def close(self) -> None:
+        """Stop the refresh worker and release every maintained state
+        buffer (reswatch-armed tests call this on teardown)."""
+        with self._cv:
+            self._stopping = True
+            self._cv.notify_all()
+            w = self._worker
+        if w is not None and w.is_alive():
+            w.join(timeout=15)
+        with self._cv:
+            queries = list(self._queries.values())
+            self._queries.clear()
+            self._by_sql.clear()
+            n_subs = len(self._subs)
+            self._subs.clear()
+            self._dirty.clear()
+        if n_subs:
+            _M.gauge("live.subscriptions.active").add(-n_subs)
+        for q in queries:
+            self._close_query(q)
+        self._publish_state_gauge()
+
+    # ── refresh machinery ───────────────────────────────────────────────
+
+    def _ensure_worker(self) -> None:
+        with self._cv:
+            if self._stopping:
+                return
+            if self._worker is None or not self._worker.is_alive():
+                self._worker = threading.Thread(
+                    target=self._worker_loop, name="srt-live-refresh",
+                    daemon=True,
+                )
+                self._worker.start()
+
+    def _on_advance(self, name: str, version: int) -> None:
+        now = time.perf_counter_ns()
+        key = name.lower()
+        with self._cv:
+            hit = False
+            for q in self._queries.values():
+                if q.table_name == key:
+                    self._dirty.add(q.qid)
+                    if q._dirty_since is None:
+                        q._dirty_since = now
+                    hit = True
+            if hit:
+                self._cv.notify_all()
+        if hit:
+            self._ensure_worker()
+
+    def _worker_loop(self) -> None:
+        while True:
+            with self._cv:
+                while not self._dirty and not self._stopping:
+                    self._cv.wait(timeout=0.5)
+                if self._stopping:
+                    return
+                qid = self._dirty.pop()
+                q = self._queries.get(qid)
+            if q is None:
+                continue
+            try:
+                self._refresh(q)
+            except Exception:
+                log.warning("live refresh of %s failed", q.qid,
+                            exc_info=True)
+                with q.state_lock:
+                    q.info["error"] = (
+                        "refresh failed; will retry on next version advance"
+                    )
+
+    def _refresh(self, q: LiveQuery) -> None:
+        with q.refresh_lock:
+            t = self.tables.get(q.table_name)
+            if t is None:
+                return
+            with t.lock:
+                version = t.version
+                if version <= q.last_version:
+                    with self._cv:
+                        q._dirty_since = None
+                    return
+                lp = self._session.sql(q.sql)._plan
+                entries = self.tables.entries_between(
+                    t, q.last_version, version
+                )
+                backing, files = t.table, t.files
+            rkey = self._prepare_key(lp, t, version)
+            out, kind, payload, incremental, reason = self._compute(
+                q, lp, t, entries, version, backing, files
+            )
+            with q.state_lock:
+                q.last_version = version
+                q.info = {
+                    "last_refresh_incremental": incremental,
+                    "last_refresh_reason": reason,
+                    "last_refresh_rows": out.num_rows,
+                }
+            with self._cv:
+                since = q._dirty_since
+                q._dirty_since = None
+            _M.counter("live.refreshes").add(1)
+            if incremental:
+                _M.counter("live.refresh.incremental").add(1)
+            else:
+                _M.counter("live.refresh.fallbackFull").add(1)
+            if since is not None:
+                _M.histogram("live.refresh.latencyHist").observe(
+                    time.perf_counter_ns() - since
+                )
+            self._admit_result(q, rkey, out)
+            self._publish_state_gauge()
+        # fan out OUTSIDE the refresh lock: sinks only enqueue
+        with self._cv:
+            sinks = [
+                s for (qq, s) in self._subs.values() if qq == q.qid
+            ]
+        if sinks:
+            upd = LiveUpdate(q.qid, version, kind, payload,
+                             incremental=incremental, reason=reason)
+            for s in sinks:
+                try:
+                    s.offer(upd)
+                except Exception:
+                    log.warning("subscriber offer failed", exc_info=True)
+
+    def _compute(self, q, lp, t, entries, version, backing, files):
+        """One refresh: returns (output, kind, payload, incremental,
+        reason). Falls back to a full re-execution (reseeding the state)
+        whenever the delta is unusable."""
+        shape = _classify(lp, self._matcher(t, backing, files))
+        if q.klass == FULL or shape.klass == FULL:
+            reason = q.reason or shape.reason
+            return self._full_refresh(q, lp, t, shape, reason)
+        if shape.klass != q.klass:
+            return self._full_refresh(
+                q, lp, t, shape,
+                "plan shape changed since registration",
+            )
+        if entries is None:
+            return self._full_refresh(
+                q, lp, t, shape,
+                "delta log gap (entries truncated past the last refresh)",
+            )
+        if any(e.opaque for e in entries):
+            return self._full_refresh(
+                q, lp, t, shape,
+                "opaque external write (DataFrameWriter append into the "
+                "live root)",
+            )
+        if q.klass in (PASSTHROUGH, TOPN) and not all(
+            e.ordered for e in entries
+        ):
+            return self._full_refresh(
+                q, lp, t, shape,
+                "unordered append (new file does not sort after existing "
+                "ones)",
+            )
+        try:
+            delta_leaf = self._delta_leaf(shape.leaf, t, entries)
+            if q.klass == PASSTHROUGH:
+                return self._refresh_passthrough(q, lp, shape, delta_leaf)
+            if q.klass == AGGREGATE:
+                return self._refresh_aggregate(q, lp, shape, delta_leaf)
+            return self._refresh_topn(q, lp, shape, delta_leaf)
+        except StateLost:
+            return self._full_refresh(
+                q, lp, t, shape,
+                "maintained state lost during spill IO — reseeded from a "
+                "full execution",
+            )
+
+    def _matcher(self, t: LiveTable, backing, files) -> Callable:
+        from ..plan import logical as L
+
+        def match(node):
+            if t.kind == "view":
+                return isinstance(node, L.LocalRelation) and (
+                    node.table is backing or node.source is backing
+                )
+            return (
+                isinstance(node, L.FileScan)
+                and tuple(node.paths) == tuple(files)
+            )
+
+        return match
+
+    def _delta_leaf(self, leaf, t: LiveTable, entries: List[DeltaEntry]):
+        from ..plan import logical as L
+
+        if t.kind == "view":
+            tables = [e.table for e in entries if e.table is not None]
+            delta = (
+                pa.concat_tables([x.cast(t.arrow_schema) for x in tables])
+                if tables
+                else t.arrow_schema.empty_table()
+            )
+            delta = delta.combine_chunks()
+            return L.LocalRelation(delta, leaf._schema, 1, source=delta)
+        dfiles: List[str] = []
+        for e in entries:
+            dfiles.extend(e.files or ())
+        return dataclasses.replace(leaf, paths=dfiles)
+
+    # ── per-class refreshes ─────────────────────────────────────────────
+
+    def _refresh_passthrough(self, q, lp, shape, delta_leaf):
+        delta_out = self._run_lp(_replay(shape.chain, delta_leaf), q.qid)
+        osa = lp.schema.to_arrow()
+        with q.state_lock:
+            prev = q.out_buf.get()
+        out = pa.concat_tables(
+            [prev.cast(osa), delta_out.cast(osa)]
+        ).combine_chunks()
+        with q.state_lock:
+            q.out_buf.put(out)
+        return out, "delta", delta_out, True, None
+
+    def _refresh_aggregate(self, q, lp, shape, delta_leaf):
+        ss = shape.state_schema
+        partial = _partial_plan(shape, delta_leaf)
+        delta_partial = self._run_lp(partial, q.qid)
+        with q.state_lock:
+            prev_state = q.agg_buf.get()
+        merged_in = pa.concat_tables(
+            [prev_state.cast(ss), delta_partial.cast(ss)]
+        ).combine_chunks()
+        merge_lp = self._merge_plan(shape, merged_in)
+        new_state = self._run_lp(merge_lp, q.qid).cast(ss)
+        out = self._assemble_out(q, lp, shape, new_state)
+        with q.state_lock:
+            q.agg_buf.put(new_state)
+            q.out_buf.put(out)
+        return out, "snapshot", out, True, None
+
+    def _assemble_out(self, q, lp, shape, state: pa.Table) -> pa.Table:
+        """Merged state → aggregate-node output columns, then replay the
+        compiler's outer Project/Filter chain (row-local, order
+        preserving) through the engine."""
+        from ..plan import logical as L
+        from ..types import Schema
+
+        agg_out = _assemble_aggregate(
+            shape.agg_specs, state, shape.agg.schema.to_arrow()
+        )
+        if shape.outer:
+            leaf = L.LocalRelation(
+                agg_out, Schema.from_arrow(agg_out.schema), 1,
+                source=agg_out,
+            )
+            out = self._run_lp(_replay(shape.outer, leaf), q.qid)
+        else:
+            out = agg_out
+        return out.cast(lp.schema.to_arrow())
+
+    def _refresh_topn(self, q, lp, shape, delta_leaf):
+        from ..plan import logical as L
+        from ..types import Schema
+
+        sub_schema = shape.sort.schema
+        ssa = sub_schema.to_arrow()
+        delta_top = self._run_lp(
+            L.Limit(shape.limit_n, dataclasses.replace(
+                shape.sort, child=_replay(shape.inner, delta_leaf)
+            )),
+            q.qid,
+        )
+        with q.state_lock:
+            cand = q.cand_buf.get()
+        # candidates FIRST: the stable sort then breaks boundary ties by
+        # historical input order, exactly as the full input would
+        merged_in = pa.concat_tables(
+            [cand.cast(ssa), delta_top.cast(ssa)]
+        ).combine_chunks()
+        merged_leaf = L.LocalRelation(
+            merged_in, Schema.from_arrow(ssa), 1, source=merged_in
+        )
+        new_cand = self._run_lp(
+            L.Limit(shape.limit_n, dataclasses.replace(
+                shape.sort, child=merged_leaf
+            )),
+            q.qid,
+        ).cast(ssa)
+        if shape.outer:
+            out_leaf = L.LocalRelation(
+                new_cand, Schema.from_arrow(ssa), 1, source=new_cand
+            )
+            out = self._run_lp(_replay(shape.outer, out_leaf), q.qid)
+        else:
+            out = new_cand
+        out = out.cast(lp.schema.to_arrow())
+        with q.state_lock:
+            q.cand_buf.put(new_cand)
+            q.out_buf.put(out)
+        return out, "snapshot", out, True, None
+
+    def _full_refresh(self, q, lp, t, shape, reason):
+        """Full re-execution + state reseed for the incremental classes
+        so the NEXT refresh can be incremental again."""
+        out = self._run_lp(lp, q.qid)
+        self._reseed_state(q, lp, shape, out)
+        return out, "snapshot", out, False, reason
+
+    def _seed(self, q: LiveQuery) -> None:
+        """First full execution + classification for a new query."""
+        session = self._session
+        candidates = self.tables.all()
+        lp = version = table = shape = None
+        for t in candidates:
+            with t.lock:
+                parsed = session.sql(q.sql)._plan
+                v, backing, files = t.version, t.table, t.files
+            s = _classify(parsed, self._matcher(t, backing, files))
+            if s.reason == "no live input in plan":
+                continue
+            lp, version, table, shape = parsed, v, t, s
+            break
+        if table is None:
+            raise ValueError(
+                "not a live query: no registered live table in its plan"
+            )
+        q.table_name = table.name.lower()
+        q.klass = shape.klass
+        q.reason = shape.reason
+        q.out_buf = _StateBuf(self._catalog, f"{q.qid}.out")
+        q.agg_buf = _StateBuf(self._catalog, f"{q.qid}.agg")
+        q.cand_buf = _StateBuf(self._catalog, f"{q.qid}.cand")
+        out = self._run_lp(lp, q.qid)
+        self._reseed_state(q, lp, shape, out)
+        q.last_version = version
+        with q.state_lock:
+            q.info = {"last_refresh_incremental": False,
+                      "last_refresh_reason": "initial seed",
+                      "last_refresh_rows": out.num_rows}
+        self._admit_result(q, self._prepare_key(lp, table, version), out)
+        self._publish_state_gauge()
+
+    def _reseed_state(self, q, lp, shape, out) -> None:
+        from ..plan import logical as L
+        from ..types import Schema
+
+        with q.state_lock:
+            q.out_buf.put(out)
+        if shape.klass == AGGREGATE:
+            # partial plan over the ORIGINAL leaf = seed state at the
+            # current version (_partial_plan replays the chain itself)
+            state = self._run_lp(_partial_plan(shape, shape.leaf),
+                                 q.qid)
+            with q.state_lock:
+                q.agg_buf.put(state.cast(shape.state_schema))
+        elif shape.klass == TOPN:
+            cand = self._run_lp(
+                L.Limit(shape.limit_n, shape.sort), q.qid
+            ).cast(shape.sort.schema.to_arrow())
+            with q.state_lock:
+                q.cand_buf.put(cand)
+
+    def _merge_plan(self, shape, merged_in: pa.Table):
+        """The synthesized merge aggregate over state ∪ delta-partials
+        (single-partition → the planner's complete-mode path, whose group
+        order is value-determined — the bit-identity linchpin)."""
+        from ..expr import Alias, UnresolvedAttribute
+        from ..expr import aggregates as AGG
+        from ..plan import logical as L
+        from ..types import Schema
+
+        mfn = {"sum": AGG.Sum, "min": AGG.Min, "max": AGG.Max}
+        grouping = [
+            Alias(UnresolvedAttribute(f"__g{j}"), f"__g{j}")
+            for j in range(len(shape.agg.grouping))
+        ]
+        aggs = list(grouping) + [
+            Alias(mfn[op](UnresolvedAttribute(bname)), bname)
+            for bname, _p, op in shape.agg_bufs
+        ]
+        leaf = L.LocalRelation(
+            merged_in, Schema.from_arrow(merged_in.schema), 1,
+            source=merged_in,
+        )
+        return L.Aggregate(grouping, aggs, leaf)
+
+    # ── execution / cache plumbing ──────────────────────────────────────
+
+    def _run_lp(self, lp, label: str) -> pa.Table:
+        """Execute one (possibly synthesized) logical plan through the
+        full engine, admitted under the dedicated live pool."""
+        session = self._session
+        from ..resilience import faults
+
+        with self._cv:
+            self._seq += 1
+            seq = self._seq
+        pool = cfg.LIVE_POOL.get(session.conf)
+        with faults.scoped(session._fault_injector):
+            final_plan, ctx = session._prepare_plan(lp)
+            with session._scheduler.admit(
+                f"live-{label}-{seq}", final_plan, session.conf, pool=pool
+            ) as adm:
+                ctx.cancel_token = adm.token
+                return session._run_plan(final_plan, ctx)
+
+    def _prepare_key(self, lp, t: LiveTable, version: int):
+        """The result-cache key for the FULL query at ``version`` —
+        computed right after the parse so the fingerprint matches the
+        refresh's snapshot; None when caching is off, the plan is not
+        canonicalizable, the read set missed the live table (a racing
+        re-registration), or the version already moved."""
+        session = self._session
+        if not cfg.RESULT_CACHE_ENABLED.get(session.conf):
+            return None
+        from ..cache import results as _rcache
+
+        try:
+            final_plan, _ctx = session._prepare_plan(lp)
+            rkey, rkeys = _rcache.key_for(session, final_plan)
+        except Exception:
+            return None
+        if rkey is None:
+            return None
+        if t.kind == "view":
+            if ("view:" + t.name.lower()) not in rkeys:
+                return None
+        else:
+            if not any(
+                k.startswith("path:") and (
+                    k[5:] == t.path
+                    or k[5:].startswith(t.path + os.sep)
+                    or t.path.startswith(k[5:] + os.sep)
+                )
+                for k in rkeys
+            ):
+                return None
+        with t.lock:
+            if t.version != version:
+                return None
+        return rkey, rkeys
+
+    def _admit_result(self, q, key, out: pa.Table) -> None:
+        """Update the PR-19 result cache IN PLACE at the new version: an
+        identical ad-hoc query now hits instead of re-executing. The
+        cache's own admission re-fingerprints, so a write racing this
+        refresh rejects the store."""
+        if key is None:
+            return
+        rkey, rkeys = key
+        try:
+            self._session._result_cache.admit(
+                self._session, rkey, rkeys, out.to_batches()
+            )
+        except Exception:
+            log.debug("live result-cache admit failed", exc_info=True)
+
+    def _close_query(self, q: LiveQuery) -> None:
+        with q.state_lock:
+            for buf in (q.out_buf, q.agg_buf, q.cand_buf):
+                if buf is not None:
+                    buf.close()
+        self._publish_state_gauge()
+
+    def _publish_state_gauge(self) -> None:
+        _M.gauge("live.state.bytes").set(self._catalog.host_bytes)
+
+    # ── reswatch hooks ──────────────────────────────────────────────────
+
+    def _orphan_report(self) -> List[str]:
+        """Absolute invariants for armed tests: no subscription may point
+        at a closed sink or a retired query, and the state-byte
+        accounting must agree with the catalog's counters."""
+        out: List[str] = []
+        with self._cv:
+            for sid, (qid, sink) in self._subs.items():
+                if getattr(sink, "closed", False):
+                    out.append(
+                        f"subscription {sid} still attached to a CLOSED "
+                        f"sink (query {qid})"
+                    )
+                if qid not in self._queries:
+                    out.append(
+                        f"subscription {sid} references retired query "
+                        f"{qid}"
+                    )
+            queries = list(self._queries.values())
+        mem = disk = 0
+        for q in queries:
+            with q.state_lock:
+                for buf in (q.out_buf, q.agg_buf, q.cand_buf):
+                    if buf is not None:
+                        mem += buf.accounted_bytes
+                        disk += buf.disk_bytes
+        if self._catalog.host_bytes != mem:
+            out.append(
+                f"live state host accounting drift: catalog "
+                f"{self._catalog.host_bytes}b vs buffers {mem}b"
+            )
+        if self._catalog.disk_bytes != disk:
+            out.append(
+                f"live state disk accounting drift: catalog "
+                f"{self._catalog.disk_bytes}b vs buffers {disk}b"
+            )
+        return out
+
+
+def _assemble_aggregate(
+    specs: List[_AggSpec], state: pa.Table, out_schema: pa.Schema
+) -> pa.Table:
+    """Final projection from the merged state table back to the query's
+    output columns, in the merge plan's (value-determined) group order.
+    avg divides its two buffers in float64 — IEEE division, bit-identical
+    to the engine's Average.evaluate on the same buffer values."""
+    import numpy as np
+
+    arrays = []
+    for i, s in enumerate(specs):
+        f = out_schema.field(i)
+        if s.kind == "group":
+            col = state.column(f"__g{s.gidx}")
+        elif s.kind == "avg":
+            sarr = state.column(s.bufs[0]).combine_chunks()
+            carr = state.column(s.bufs[1]).combine_chunks()
+            c_np = np.asarray(
+                carr.fill_null(0).to_numpy(zero_copy_only=False),
+                dtype=np.int64,
+            )
+            s_np = np.asarray(
+                sarr.fill_null(0.0).to_numpy(zero_copy_only=False),
+                dtype=np.float64,
+            )
+            safe = np.where(c_np != 0, c_np, 1).astype(np.float64)
+            vals = s_np / safe
+            col = pa.chunked_array([
+                pa.array(vals, type=pa.float64(), mask=(c_np == 0))
+            ])
+        else:
+            col = state.column(s.bufs[0])
+        arrays.append(col.combine_chunks().cast(f.type))
+    return pa.Table.from_arrays(arrays, schema=out_schema)

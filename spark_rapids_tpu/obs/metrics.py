@@ -593,6 +593,37 @@ CATALOG: Iterable[tuple] = (
      "in-flight shared-subtree entries (concurrent-only, pin-bounded)"),
     ("subplan.bytes", MetricKind.GAUGE,
      "bytes materialized in completed shared-subtree entries"),
+    # live/ — streaming ingestion + incremental view maintenance +
+    # SUBSCRIBE delta streaming
+    ("live.appends", MetricKind.COUNTER,
+     "append batches landed into registered live tables"),
+    ("live.delta.rows", MetricKind.COUNTER,
+     "rows appended through the live ingestion path"),
+    ("live.delta.bytes", MetricKind.COUNTER,
+     "bytes appended through the live ingestion path"),
+    ("live.refreshes", MetricKind.COUNTER,
+     "live-query refreshes computed (incremental + full fallback)"),
+    ("live.refresh.incremental", MetricKind.COUNTER,
+     "refreshes served by delta-only incremental maintenance"),
+    ("live.refresh.fallbackFull", MetricKind.COUNTER,
+     "refreshes that fell back to full re-execution (unsupported plan "
+     "shape, delta-log gap, or unordered append) — each carries an "
+     "explain reason in the query's live status"),
+    ("live.refresh.latencyHist", MetricKind.HISTOGRAM,
+     "version-advance to refreshed-result latency per refresh (ns "
+     "distribution — the dashboard-freshness SLO series)"),
+    ("live.subscriptions.active", MetricKind.GAUGE,
+     "wire subscriptions currently registered across all connections"),
+    ("live.updates.sent", MetricKind.COUNTER,
+     "epoch-stamped UPDATE frames delivered to subscribers"),
+    ("live.updates.collapsed", MetricKind.COUNTER,
+     "pending epochs collapsed into a snapshot for a slow subscriber"),
+    ("live.state.bytes", MetricKind.GAUGE,
+     "host-resident maintained-state bytes (reserved against the spill "
+     "catalog's host budget)"),
+    ("live.state.demotions", MetricKind.COUNTER,
+     "maintained-state tables demoted to disk through the fault-"
+     "injected spill IO points"),
 )
 
 for _name, _kind, _doc in CATALOG:

@@ -12,12 +12,20 @@ streamed record batches), scaled to a framed socket protocol:
 - :mod:`.prepared` — prepared statements + the canonical-key plan cache
   (re-execution skips parse/plan/compile);
 - :mod:`.client`   — :func:`connect` / :class:`Connection`: the python
-  driver (``connect().sql(...)`` → iterator of record batches).
+  driver (``connect().sql(...)`` → iterator of record batches;
+  ``connect().subscribe(...)`` → iterator of live-query updates).
 
 ``python -m spark_rapids_tpu.serve`` runs a standalone server
 (docs/serving.md; ``make serve`` for the TPC-H demo catalog).
 """
-from .client import Connection, PreparedHandle, ResultStream, connect
+from .client import (
+    Connection,
+    PreparedHandle,
+    ResultStream,
+    Subscription,
+    Update,
+    connect,
+)
 from .protocol import FrameCorruptError, ProtocolError, ServeError
 from .server import ServerDrainingError, TpuServer
 
@@ -29,6 +37,8 @@ __all__ = [
     "ResultStream",
     "ServeError",
     "ServerDrainingError",
+    "Subscription",
     "TpuServer",
+    "Update",
     "connect",
 ]

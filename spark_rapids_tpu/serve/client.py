@@ -19,6 +19,18 @@ per-connection fair-share admission).
 Mid-stream ``ResultStream.cancel()`` sends CANCEL on the same (full
 duplex) socket; the server stops at the next batch boundary and the
 stream raises the typed :class:`ServeError` carrying the cancel reason.
+
+Live subscriptions (ISSUE 20) ride the same connection::
+
+    sub = conn.subscribe("select k, sum(v) from events group by k")
+    for update in sub:              # Update(epoch, kind, table), blocking
+        render(update.table)        # kind: 'snapshot' replaces, 'delta'
+        if done:                    #       appends
+            sub.cancel()            # iterator ends after UNSUBSCRIBED
+
+The subscription occupies the connection's stream slot until it ends
+(cancel, server drain, or disconnect) — open another connection for
+concurrent queries, exactly like result streams.
 """
 from __future__ import annotations
 
@@ -174,6 +186,100 @@ class ResultStream:
         issue the next command)."""
         for _ in self:
             pass
+
+
+class Update:
+    """One subscription delivery: the epoch-stamped payload of a single
+    UPDATE train. ``kind`` is ``"snapshot"`` (replace the rendered
+    result) or ``"delta"`` (append these rows); ``incremental`` is False
+    when the server fell back to a full re-execution for this refresh
+    (``reason`` says why)."""
+
+    __slots__ = ("subscription_id", "epoch", "kind", "incremental",
+                 "reason", "table")
+
+    def __init__(self, subscription_id: str, epoch: int, kind: str,
+                 incremental: bool, reason: Optional[str],
+                 table: pa.Table):
+        self.subscription_id = subscription_id
+        self.epoch = epoch
+        self.kind = kind
+        self.incremental = incremental
+        self.reason = reason
+        self.table = table
+
+
+class Subscription:
+    """A live-query subscription (SUBSCRIBE_OK payload): iterate to
+    receive :class:`Update` trains as the server's live tables advance —
+    the first yield is the initial snapshot. ``cancel()`` unsubscribes;
+    keep iterating afterwards: any in-flight train completes, then the
+    UNSUBSCRIBED ack ends the iterator (``end_reason`` says why — a
+    draining server sheds subscribers the same way)."""
+
+    def __init__(self, conn: "Connection", info: dict):
+        self._conn = conn
+        self.subscription_id = info["subscription_id"]
+        self.query_id = info.get("query_id")
+        #: maintenance class the server chose (passthrough / aggregate /
+        #: topn / full) and, for full, the explain reason
+        self.mode = info.get("mode")
+        self.reason = info.get("reason")
+        self.epoch = info.get("epoch")
+        self.end_reason: Optional[str] = None
+        self._done = False
+        self._cancel_sent = False
+
+    def __iter__(self) -> Iterator[Update]:
+        while not self._done:
+            try:
+                ftype, body = P.expect_frame(
+                    self._conn._sock, P.UPDATE, P.UNSUBSCRIBED
+                )
+                info = P.decode_json(body)
+                if ftype == P.UNSUBSCRIBED:
+                    self._done = True
+                    self._conn._stream = None
+                    self.end_reason = info.get("reason") or (
+                        "cancelled" if self._cancel_sent else "unsubscribed"
+                    )
+                    return
+                batches = []
+                while True:
+                    ft, b = P.expect_frame(
+                        self._conn._sock, P.BATCH, P.UPDATE_END
+                    )
+                    if ft == P.UPDATE_END:
+                        break
+                    batches.append(ipc.read_batch(b))
+            except BaseException as e:
+                # transport/protocol death ends the subscription; the
+                # connection unwedges so a reconnecting caller can
+                # re-subscribe (no replay: a fresh SUBSCRIBE's snapshot
+                # IS the resume point)
+                self._done = True
+                self._conn._stream = None
+                self._conn._mark_dead_on(e)
+                raise
+            self.epoch = info.get("epoch")
+            yield Update(
+                self.subscription_id,
+                info.get("epoch"),
+                info.get("kind") or "snapshot",
+                bool(info.get("incremental", True)),
+                info.get("reason"),
+                pa.Table.from_batches(batches),
+            )
+
+    def cancel(self) -> None:
+        """Unsubscribe (CANCEL with the subscription id). Keep iterating:
+        the stream ends at the UNSUBSCRIBED ack."""
+        if not self._done and not self._cancel_sent:
+            self._cancel_sent = True
+            P.send_json(
+                self._conn._sock, P.CANCEL,
+                {"subscription_id": self.subscription_id},
+            )
 
 
 class Connection:
@@ -451,6 +557,19 @@ class Connection:
             replay={"kind": "prepared", "stmt": stmt, "params": params,
                     "dedup_key": dedup},
         )
+
+    def subscribe(self, sql: str) -> Subscription:
+        """SUBSCRIBE: register ``sql`` as a maintained live query on the
+        server and stream its refreshes. Occupies this connection's
+        stream slot until the subscription ends (``cancel()``, a server
+        drain, or disconnect); a draining server answers with the typed
+        DRAINING error instead — re-subscribe against a peer."""
+        self._begin()
+        self._send(P.SUBSCRIBE, {"sql": sql})
+        _, body = self._reply(P.SUBSCRIBE_OK)
+        sub = Subscription(self, P.decode_json(body))
+        self._stream = sub
+        return sub
 
     # ── control ─────────────────────────────────────────────────────────
     def cancel(self, query_id: str) -> bool:

@@ -34,10 +34,30 @@ Conversation shape::
                              ←            CANCEL_OK | the stream ends ERROR)
     STATUS {}                →
                              ←            STATUS_OK {active, scheduler, serve}
+    SUBSCRIBE {sql}          →
+                             ←            SUBSCRIBE_OK {subscription_id,
+                                                        mode, epoch}
+                             ←            UPDATE {subscription_id, epoch, kind}
+                                          BATCH* UPDATE_END   (initial
+                                          snapshot, then one per refresh)
+    CANCEL {subscription_id} →
+                             ←            UNSUBSCRIBED {subscription_id}
 
 Any command may answer ``ERROR {type, error, reason?, query_id?}``; the
 connection survives query errors (only protocol violations and transport
 failures close it).
+
+Subscriptions (ISSUE 20) ride the same connection: after ``SUBSCRIBE_OK``
+the server may interleave unsolicited ``UPDATE`` trains between command
+replies whenever the underlying live table advances; each train is
+``UPDATE`` (JSON header: subscription id, epoch, ``kind`` of ``delta`` |
+``snapshot``) followed by ``BATCH`` frames and a closing ``UPDATE_END``.
+A frame train is never interleaved with another reply — the handler
+thread owns all writes. ``CANCEL`` with a ``subscription_id`` (instead
+of a ``query_id``) unsubscribes; the server confirms with
+``UNSUBSCRIBED`` after any in-flight train finishes. A draining server
+rejects new SUBSCRIBEs and proactively sends
+``UNSUBSCRIBED {reason: "draining"}`` for existing ones.
 """
 from __future__ import annotations
 
@@ -73,6 +93,11 @@ STATUS = 14
 STATUS_OK = 15
 ERROR = 16
 BYE = 17
+SUBSCRIBE = 18
+SUBSCRIBE_OK = 19
+UPDATE = 20
+UPDATE_END = 21
+UNSUBSCRIBED = 22
 
 FRAME_NAMES = {
     HELLO: "HELLO", HELLO_OK: "HELLO_OK", EXECUTE: "EXECUTE",
@@ -80,7 +105,9 @@ FRAME_NAMES = {
     PREPARE: "PREPARE", PREPARE_OK: "PREPARE_OK", BIND: "BIND",
     EXECUTE_PREPARED: "EXECUTE_PREPARED", CANCEL: "CANCEL",
     CANCEL_OK: "CANCEL_OK", STATUS: "STATUS", STATUS_OK: "STATUS_OK",
-    ERROR: "ERROR", BYE: "BYE",
+    ERROR: "ERROR", BYE: "BYE", SUBSCRIBE: "SUBSCRIBE",
+    SUBSCRIBE_OK: "SUBSCRIBE_OK", UPDATE: "UPDATE",
+    UPDATE_END: "UPDATE_END", UNSUBSCRIBED: "UNSUBSCRIBED",
 }
 
 _HEADER = struct.Struct("<IBI")

@@ -25,7 +25,16 @@ query rides the session's existing machinery:
   ``scheduler.cancelled.reason.*`` series says why;
 - **observability**: connection/query/prepared/stream counters land in
   the process metric registry (``serve.*`` catalog slice), so the
-  Prometheus export carries the server story next to the engine's.
+  Prometheus export carries the server story next to the engine's;
+- **subscriptions** (ISSUE 20): SUBSCRIBE registers a live query with
+  the session's :class:`live.LiveRuntime`; the refresh worker fans
+  epoch-stamped updates into a per-connection sink (:class:`_ConnSubs`),
+  and the handler thread — the only thread that ever writes this socket —
+  drains them onto the wire as UPDATE trains between commands. A slow
+  consumer's queue collapses to one fresh snapshot
+  (``spark.rapids.tpu.live.subscriber.maxPending``); drain() refuses new
+  SUBSCRIBEs and proactively sheds existing ones with
+  ``UNSUBSCRIBED {reason: "draining"}`` so dashboards fail over.
 """
 from __future__ import annotations
 
@@ -130,6 +139,118 @@ class _PendingQuery:
         # the client's trace id + parent span id + sampled bit — the
         # Dapper propagation that merges client and server trees
         self.wire_trace = wire_trace
+
+
+class _ConnSubs:
+    """Per-connection subscription state: the sink the LiveRuntime's
+    refresh worker fans :class:`live.LiveUpdate` objects into, plus the
+    per-subscription pending queues the handler thread drains onto the
+    wire. ``offer()`` only enqueues (called off-thread, never blocks and
+    never touches the socket); every frame write stays on the handler
+    thread, so UPDATE trains can never interleave with command replies.
+
+    Slow consumers: a queue past ``spark.rapids.tpu.live.subscriber.
+    maxPending`` collapses — pending epochs are dropped and one fresh
+    snapshot is resent instead (the subscriber sees every version's
+    EFFECT, not every version). Epoch filtering in ``next_delivery``
+    makes redundant deliveries (handshake races, post-collapse stragglers)
+    harmless: anything at or below the last epoch put on the wire is
+    skipped."""
+
+    def __init__(self, max_pending: int):
+        self._lock = threading.Lock()
+        #: read by the runtime's fan-out and the reswatch orphan report
+        self.closed = False
+        self._max_pending = max(1, max_pending)
+        self._qid_of: Dict[str, str] = {}  # graft: guarded_by(_lock)
+        self._by_qid: Dict[str, list] = {}  # graft: guarded_by(_lock)
+        self._pending: Dict[str, deque] = {}  # graft: guarded_by(_lock)
+        self._collapsed: set = set()  # graft: guarded_by(_lock)
+        self._last_epoch: Dict[str, int] = {}  # graft: guarded_by(_lock)
+        #: updates fanned out between the runtime registering this sink
+        #: and SUBSCRIBE_OK minting the sub_id land here; register() moves
+        #: them into the real queue (the epoch filter drops duplicates of
+        #: the initial snapshot)
+        self._early: Dict[str, deque] = {}  # graft: guarded_by(_lock)
+
+    def register(self, sub_id: str, qid: str) -> None:
+        with self._lock:
+            self._qid_of[sub_id] = qid
+            self._by_qid.setdefault(qid, []).append(sub_id)
+            self._pending[sub_id] = deque(self._early.pop(qid, ()))
+            self._last_epoch[sub_id] = -1
+
+    def drop(self, sub_id: str) -> None:
+        with self._lock:
+            qid = self._qid_of.pop(sub_id, None)
+            if qid is not None:
+                lst = self._by_qid.get(qid, [])
+                if sub_id in lst:
+                    lst.remove(sub_id)
+                if not lst:
+                    self._by_qid.pop(qid, None)
+            self._pending.pop(sub_id, None)
+            self._collapsed.discard(sub_id)
+            self._last_epoch.pop(sub_id, None)
+
+    def offer(self, upd) -> None:
+        """Enqueue one refresh delivery (refresh-worker thread)."""
+        with self._lock:
+            if self.closed:
+                return
+            subs = self._by_qid.get(upd.qid)
+            if not subs:
+                dq = self._early.setdefault(upd.qid, deque(maxlen=4))
+                dq.append(upd)
+                return
+            for sub_id in subs:
+                if sub_id in self._collapsed:
+                    continue  # the snapshot resend already covers it
+                dq = self._pending.get(sub_id)
+                if dq is None:
+                    continue
+                dq.append(upd)
+                if len(dq) > self._max_pending:
+                    _M.counter("live.updates.collapsed").add(len(dq))
+                    dq.clear()
+                    self._collapsed.add(sub_id)
+
+    def active(self) -> bool:
+        with self._lock:
+            return bool(self._qid_of)
+
+    def sub_ids(self) -> list:
+        with self._lock:
+            return list(self._qid_of)
+
+    def next_delivery(self):
+        """One deliverable ``(sub_id, qid, update-or-None)`` — None means
+        collapsed (resend a fresh snapshot) — or None when nothing is
+        ready. Handler thread only."""
+        with self._lock:
+            while self._collapsed:
+                sub_id = self._collapsed.pop()
+                qid = self._qid_of.get(sub_id)
+                if qid is not None:
+                    return sub_id, qid, None
+            for sub_id, dq in self._pending.items():
+                while dq:
+                    upd = dq.popleft()
+                    if upd.epoch <= self._last_epoch.get(sub_id, -1):
+                        continue
+                    return sub_id, self._qid_of.get(sub_id), upd
+            return None
+
+    def mark_sent(self, sub_id: str, epoch: int) -> None:
+        with self._lock:
+            if sub_id in self._last_epoch:
+                self._last_epoch[sub_id] = max(
+                    self._last_epoch[sub_id], epoch
+                )
+
+    def last_epoch(self, sub_id: str) -> int:
+        with self._lock:
+            return self._last_epoch.get(sub_id, -1)
 
 
 class TpuServer:
@@ -457,6 +578,11 @@ class TpuServer:
         # cannot grow the registry without bound — cross-client sharing
         # happens at the plan-cache layer (canonical keys), not here
         statements: Dict[str, PreparedStatement] = {}
+        # live subscriptions are connection-scoped too: this is the sink
+        # the refresh worker fans updates into; the loop below drains it
+        subs = _ConnSubs(
+            cfg.LIVE_SUBSCRIBER_MAX_PENDING.get(self.session.conf)
+        )
         try:
             tenant = self._hello(sock)
             if tenant is None:
@@ -484,6 +610,21 @@ class TpuServer:
                 )
                 return
             while not self._stopping.is_set():
+                if subs.active():
+                    # subscription mode: the blocking recv becomes a short
+                    # select so the handler thread can interleave pending
+                    # UPDATE trains with inbound commands — it is the only
+                    # thread that ever writes this socket
+                    if self._draining.is_set():
+                        self._shed_subs(sock, subs, self._drain_reason)
+                        continue
+                    try:
+                        self._pump_updates(sock, subs)
+                        readable, _, _ = select.select([sock], [], [], 0.05)
+                    except (OSError, ValueError):
+                        return
+                    if not readable:
+                        continue
                 try:
                     ftype, body = P.recv_frame(sock)
                 except P.FrameCorruptError as e:
@@ -498,7 +639,7 @@ class TpuServer:
                     return
                 try:
                     self._dispatch(sock, tenant, pending, statements,
-                                   ftype, body)
+                                   subs, ftype, body)
                 except _ClientGone:
                     return
                 except P.ProtocolError:
@@ -507,12 +648,29 @@ class TpuServer:
                     # answered as ERROR frames; the connection (and the
                     # session behind it) keeps serving subsequent queries
                     self._send_error(sock, e)
+        except _ClientGone:
+            # the client vanished while we were answering it (e.g. died
+            # mid-UPDATE train and the ERROR reply failed too): plain
+            # teardown, the finally below reaps its subscriptions
+            _log.debug("connection %s vanished mid-reply", addr)
         except (P.ProtocolError, OSError) as e:
             _log.debug("connection %s closed: %s", addr, e)
         finally:
             # a vanished client must not leave queued-but-unfetched work
             for pq in pending.values():
                 pq.cancelled_reason = "client disconnect"
+            # … nor orphaned subscriptions: the runtime frees the shared
+            # query's state when the last subscriber leaves
+            subs.closed = True
+            rt = self.session._live_runtime
+            if rt is not None:
+                for sub_id in subs.sub_ids():
+                    try:
+                        rt.unsubscribe(sub_id)
+                    except Exception:  # noqa: BLE001 - teardown best-effort
+                        _log.debug("unsubscribe %s failed", sub_id,
+                                   exc_info=True)
+                    subs.drop(sub_id)
             with self._conn_lock:
                 self._conns.discard(sock)
                 if tenant_counted and tenant is not None:
@@ -578,9 +736,11 @@ class TpuServer:
         return tenant
 
     # ── command dispatch ────────────────────────────────────────────────
-    def _dispatch(self, sock, tenant, pending, statements, ftype, body) -> None:
+    def _dispatch(self, sock, tenant, pending, statements, subs,
+                  ftype, body) -> None:
         if self._draining.is_set() and ftype in (
-            P.EXECUTE, P.PREPARE, P.BIND, P.EXECUTE_PREPARED, P.FETCH
+            P.EXECUTE, P.PREPARE, P.BIND, P.EXECUTE_PREPARED, P.FETCH,
+            P.SUBSCRIBE,
         ):
             # drain contract: no NEW work once draining; STATUS and CANCEL
             # stay answerable so operators can watch the drain complete
@@ -599,9 +759,11 @@ class TpuServer:
         elif ftype == P.FETCH:
             self._cmd_fetch(sock, tenant, pending, P.decode_json(body))
         elif ftype == P.CANCEL:
-            self._cmd_cancel(sock, pending, P.decode_json(body))
+            self._cmd_cancel(sock, pending, subs, P.decode_json(body))
         elif ftype == P.STATUS:
             self._cmd_status(sock, tenant)
+        elif ftype == P.SUBSCRIBE:
+            self._cmd_subscribe(sock, tenant, subs, P.decode_json(body))
         else:
             raise P.ProtocolError(
                 f"unexpected frame {P.FRAME_NAMES.get(ftype, ftype)}"
@@ -697,7 +859,20 @@ class TpuServer:
         pending[pq.query_id] = pq
         self._send_result(sock, pq)
 
-    def _cmd_cancel(self, sock, pending, req) -> None:
+    def _cmd_cancel(self, sock, pending, subs, req) -> None:
+        sub_id = req.get("subscription_id")
+        if sub_id:
+            # CANCEL with a subscription_id = unsubscribe (valid any time,
+            # including between a train's frames — the handler thread only
+            # reads commands at train boundaries, so no interleaving)
+            rt = self.session._live_runtime
+            found = bool(rt is not None and rt.unsubscribe(sub_id))
+            subs.drop(sub_id)
+            if found:
+                _M.counter("serve.cancels").add(1)
+            P.send_json(sock, P.UNSUBSCRIBED,
+                        {"subscription_id": sub_id, "found": found})
+            return
         qid = req.get("query_id") or ""
         found = False
         pq = pending.get(qid)
@@ -739,8 +914,141 @@ class TpuServer:
                 "prepared_cache": self.prepared.stats(),
                 "result_cache": self.session._result_cache.stats(),
                 "subplan_dedup": self.session._subplan_registry.stats(),
+                # live-analytics slice (ISSUE 20): table versions,
+                # maintained queries (class + fallback reason + epoch),
+                # subscriber count, state bytes, and the live.* metric
+                # catalog slice; null until session.live is first touched
+                "live_analytics": (
+                    dict(
+                        self.session._live_runtime.status(),
+                        metrics=_M.view("live.", strip=False),
+                    )
+                    if self.session._live_runtime is not None
+                    else None
+                ),
             },
         )
+
+    # ── the subscription stream ─────────────────────────────────────────
+    def _cmd_subscribe(self, sock, tenant, subs, req) -> None:
+        sql_text = req.get("sql") or ""
+        # session.live raises a typed RuntimeError when
+        # spark.rapids.tpu.live.enabled is off — answered as an ERROR
+        # frame like any per-command failure; the connection survives
+        rt = self.session.live
+        desc = rt.subscribe(sql_text, subs)
+        sub_id = desc["subscription_id"]
+        subs.register(sub_id, desc["qid"])
+        P.send_json(
+            sock, P.SUBSCRIBE_OK,
+            {
+                "subscription_id": sub_id,
+                "query_id": desc["qid"],
+                "mode": desc["mode"],
+                "reason": desc["reason"],
+                "epoch": desc["epoch"],
+            },
+        )
+        snap = desc["snapshot"]
+        if snap is not None:
+            # the initial state, as a regular UPDATE train so the client
+            # reads one uniform stream; a just-seeded or quiet query may
+            # legitimately have nothing newer afterwards
+            self._send_update_train(
+                sock, sub_id, desc["epoch"], "snapshot", snap
+            )
+            subs.mark_sent(sub_id, desc["epoch"])
+            _M.counter("live.updates.sent").add(1)
+
+    def _pump_updates(self, sock, subs) -> None:
+        """Drain every deliverable subscription update onto the wire
+        (handler thread). A collapsed slow consumer gets one fresh
+        snapshot instead of its dropped epochs; if that snapshot is
+        unavailable (demoted state lost its file), the resend is skipped —
+        the reseeding refresh fans out a new update anyway."""
+        while True:
+            item = subs.next_delivery()
+            if item is None:
+                return
+            sub_id, qid, upd = item
+            if upd is None:
+                rt = self.session._live_runtime
+                q = rt.query(qid) if rt is not None else None
+                snap = q.snapshot() if q is not None else None
+                if snap is None:
+                    continue
+                epoch, table = snap
+                if epoch <= subs.last_epoch(sub_id):
+                    continue
+                self._send_update_train(
+                    sock, sub_id, epoch, "snapshot", table
+                )
+            else:
+                self._send_update_train(
+                    sock, sub_id, upd.epoch, upd.kind, upd.table,
+                    incremental=upd.incremental, reason=upd.reason,
+                )
+                epoch = upd.epoch
+            subs.mark_sent(sub_id, epoch)
+            _M.counter("live.updates.sent").add(1)
+
+    def _send_update_train(self, sock, sub_id: str, epoch: int, kind: str,
+                           table: pa.Table, incremental: bool = True,
+                           reason: Optional[str] = None) -> None:
+        """One epoch-stamped UPDATE train: JSON header, the payload
+        re-chunked as BATCH frames, UPDATE_END. Counted in-flight so
+        ``drain()`` waits for a train mid-write exactly as it does for a
+        FETCH stream. An empty payload still carries one zero-row batch —
+        the client needs the schema."""
+        max_rows = max(1, cfg.SERVE_STREAM_BATCH_ROWS.get(self.session.conf))
+        with self._inflight_cond:
+            self._inflight += 1
+        try:
+            hdr = {
+                "subscription_id": sub_id,
+                "epoch": epoch,
+                "kind": kind,
+                "rows": table.num_rows,
+                "incremental": incremental,
+            }
+            if reason:
+                hdr["reason"] = reason
+            P.send_json(sock, P.UPDATE, hdr)
+            batches = [
+                rb for rb in table.to_batches(max_chunksize=max_rows)
+                if rb.num_rows
+            ]
+            if not batches:
+                sch = table.schema
+                batches = [pa.RecordBatch.from_arrays(
+                    [pa.array([], type=f.type) for f in sch], schema=sch,
+                )]
+            for rb in batches:
+                payload = ipc.write_batch(rb)
+                P.send_frame(sock, P.BATCH, payload)
+                _M.counter("serve.streamedBatches").add(1)
+                _M.counter("serve.streamedBytes").add(len(payload))
+            P.send_json(sock, P.UPDATE_END,
+                        {"subscription_id": sub_id, "epoch": epoch})
+        finally:
+            with self._inflight_cond:
+                self._inflight -= 1
+                self._inflight_cond.notify_all()
+
+    def _shed_subs(self, sock, subs, reason: str) -> None:
+        """Drain contract for subscriptions: proactively unsubscribe every
+        live subscription and tell the client why, so dashboard clients
+        re-subscribe against a peer instead of waiting on a dead wire."""
+        rt = self.session._live_runtime
+        for sub_id in subs.sub_ids():
+            if rt is not None:
+                rt.unsubscribe(sub_id)
+            subs.drop(sub_id)
+            try:
+                P.send_json(sock, P.UNSUBSCRIBED,
+                            {"subscription_id": sub_id, "reason": reason})
+            except OSError:
+                return
 
     # ── the fetch stream ────────────────────────────────────────────────
     def _cmd_fetch(self, sock, tenant, pending, req) -> None:

@@ -156,6 +156,9 @@ class TpuSession:
 
         self._result_cache = ResultCache(self.conf)
         self._subplan_registry = SubplanRegistry()
+        # live analytics runtime (live/): built lazily by the `live`
+        # property, gated on spark.rapids.tpu.live.enabled
+        self._live_runtime = None
         # resilience: session-lifetime CPU-fallback circuit breaker (runtime
         # kernel failures flip ops to CPU at the next planning pass) and the
         # deterministic fault-injection scenario (None unless
@@ -281,6 +284,34 @@ class TpuSession:
         with self._retry_lock:
             self._query_seq += 1
             return self._query_seq
+
+    # ── live analytics (live/) ──────────────────────────────────────────
+    @property
+    def live(self):
+        """The session's :class:`live.LiveRuntime` — streaming append
+        ingestion, incremental view maintenance, and subscription fan-out
+        (ISSUE 20). Gated on ``spark.rapids.tpu.live.enabled`` (default
+        off); built lazily on first touch."""
+        if not cfg.LIVE_ENABLED.get(self.conf):
+            raise RuntimeError(
+                "live analytics is disabled: set "
+                "spark.rapids.tpu.live.enabled=true before using "
+                "session.live"
+            )
+        rt = self._live_runtime
+        if rt is None:
+            from .live import LiveRuntime
+
+            # construct OUTSIDE the session lock: the runtime's __init__
+            # acquires its own tier-17 live locks (listener registration),
+            # which must never nest under a tier-78 session lock. A racing
+            # loser is discarded before it spawns any thread or state.
+            candidate = LiveRuntime(self)
+            with self._retry_lock:
+                if self._live_runtime is None:
+                    self._live_runtime = candidate
+                rt = self._live_runtime
+        return rt
 
     # ── multi-tenant scheduling (sched/) ────────────────────────────────
     @property
@@ -1139,6 +1170,18 @@ class DataFrameReader:
             [p for p in paths if os.path.isdir(p)]
         ):
             opts["__bucket_spec"] = specs[0]
+        return self._root_options(paths, opts)
+
+    @staticmethod
+    def _root_options(roots, opts: dict) -> dict:
+        """Record the scan ROOTS (not just the expanded files) on the scan
+        node: cache/keys.py needs them so an append that creates a NEW
+        partition subdirectory under a scanned root — a directory that did
+        not exist at registration time — still invalidates entries keyed
+        by that root."""
+        import os
+
+        opts["__roots"] = tuple(os.path.realpath(r) for r in roots)
         return opts
 
     def parquet(self, *paths: str) -> "DataFrame":
@@ -1170,9 +1213,13 @@ class DataFrameReader:
         opts.update(kwargs)
         # shim-routed default (SparkShims seam): what string reads as NULL
         opts.setdefault("nullValue", self._session.shim.csv_null_value())
-        files = expand_paths(self._rewrite(paths), "csv")
+        roots = self._rewrite(paths)
+        files = expand_paths(roots, "csv")
         schema = infer_schema(files, "csv", opts)
-        return DataFrame(self._session, L.FileScan(files, "csv", schema, opts))
+        return DataFrame(
+            self._session,
+            L.FileScan(files, "csv", schema, self._root_options(roots, opts)),
+        )
 
 
 def _to_exprs(cols: Sequence[Union[str, Column, Expression]]) -> List[Expression]:

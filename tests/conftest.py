@@ -101,7 +101,7 @@ def _bound_jit_code_size():
 #: tier-1 suites that exercise the engine's real multi-thread interleavings
 #: (concurrent admissions, serve workers, pipeline producers) — they run
 #: under the lockwatch harness; chaos-marked tests ride it too (ISSUE 10)
-_LOCKWATCH_MODULES = {"test_scheduler", "test_serve"}
+_LOCKWATCH_MODULES = {"test_scheduler", "test_serve", "test_live"}
 
 #: suites that run under the reswatch resource-balance harness (ISSUE 15):
 #: same armed set as lockwatch — the suites whose tests acquire and must

@@ -1,0 +1,310 @@
+"""Live-analytics chaos suite (ISSUE 20) — the streaming stack under
+concurrent appenders, subscriber fleets, abrupt client death, and
+injected spill faults on the maintained state.
+
+The contract under chaos: every delivered update is epoch-stamped and
+per-subscriber epochs are strictly increasing; every aggregate update is
+bit-identical to a from-scratch execution over the table prefix at that
+epoch (reconstructed from the delta log); a subscriber killed mid-UPDATE
+train frees its registration and the shared query's state; spill faults
+during state demotion degrade refreshes to full re-executions (with the
+recorded reason) but NEVER corrupt results, and incremental maintenance
+resumes once the faults clear. Chaos-marked → the lockwatch + reswatch
+harnesses are armed: permits, threads, fds, and the runtime's own orphan
+report must balance at the end of every test.
+"""
+from __future__ import annotations
+
+import socket
+import struct
+import threading
+import time
+
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu.obs.metrics import GLOBAL
+from spark_rapids_tpu.resilience import faults
+from spark_rapids_tpu.serve import TpuServer, connect
+from spark_rapids_tpu.serve import protocol as P
+
+from tests.harness import tpu_session
+
+pytestmark = pytest.mark.chaos
+
+LIVE_CONF = {
+    "spark.rapids.tpu.live.enabled": "true",
+    "spark.rapids.tpu.scheduler.pools": "default:4,live:2",
+    "spark.rapids.tpu.serve.streamBatchRows": 256,
+}
+
+
+def _poll(pred, timeout_s: float = 120.0, what: str = "condition"):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if pred():
+            return
+        time.sleep(0.02)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+def _ints(**cols) -> pa.Table:
+    return pa.table(
+        {k: pa.array(v, pa.int64()) for k, v in cols.items()}
+    )
+
+
+class _Sink:
+    """In-process subscriber sink: unbounded, never collapses — records
+    EVERY fan-out delivery for the per-epoch oracle."""
+
+    def __init__(self):
+        self.updates = []
+        self.closed = False
+
+    def offer(self, upd):
+        self.updates.append(upd)
+
+
+def _oracle_view(sess, name: str, table: pa.Table) -> None:
+    """Register ``table`` exactly the way the live catalog pins a
+    view-backed table (single-partition LocalRelation) so a from-scratch
+    execution over it is THE bit-identity oracle for that prefix."""
+    from spark_rapids_tpu.plan import logical as L
+    from spark_rapids_tpu.session import DataFrame
+    from spark_rapids_tpu.types import Schema
+
+    lp = L.LocalRelation(
+        table, Schema.from_arrow(table.schema), 1, source=table
+    )
+    sess.create_or_replace_temp_view(name, DataFrame(sess, lp))
+
+
+# ── appender storm × wire subscriber fleet ─────────────────────────────────
+
+
+def test_appender_storm_subscriber_fleet_epoch_bit_identity():
+    sess = tpu_session(LIVE_CONF, strict=False)
+    rt = sess.live
+    seed = _ints(k=[i % 5 for i in range(50)], v=list(range(50)))
+    rt.tables.create_table("storm", seed)
+    agg_sql = (
+        "SELECT k, sum(v) AS s, count(*) AS c, max(v) AS m "
+        "FROM storm GROUP BY k"
+    )
+    pass_sql = "SELECT k, v FROM storm WHERE v % 3 = 0"
+    # the in-process oracle sink sees EVERY refresh (no wire collapse)
+    oracle = _Sink()
+    odesc = rt.subscribe(agg_sql, oracle)
+
+    N_APPENDERS, APPENDS_EACH = 2, 4
+    V_FINAL = 1 + N_APPENDERS * APPENDS_EACH
+    server = TpuServer(sess, host="127.0.0.1", port=0)
+    host, port = server.start()
+    wire_results, errs = {}, []
+
+    def subscriber(idx: int, sql: str):
+        try:
+            conn = connect(host, port, timeout=30)
+            sub = conn.subscribe(sql)
+            epochs, acc = [], None
+            for upd in sub:
+                epochs.append(upd.epoch)
+                # client-side materialization: snapshots replace, deltas
+                # append — collapse-degraded streams stay correct
+                if upd.kind == "snapshot" or acc is None:
+                    acc = upd.table
+                else:
+                    acc = pa.concat_tables([acc, upd.table])
+                if upd.epoch >= V_FINAL:
+                    sub.cancel()
+            wire_results[idx] = (epochs, acc)
+            conn.close()
+        except Exception as e:  # noqa: BLE001
+            errs.append((idx, e))
+
+    def appender(idx: int):
+        try:
+            for j in range(APPENDS_EACH):
+                base = 1000 * idx + 10 * j
+                rt.tables.append("storm", _ints(
+                    k=[idx, 5 + j], v=[base, base + 1]
+                ))
+        except Exception as e:  # noqa: BLE001
+            errs.append(("appender", e))
+
+    subs = [
+        threading.Thread(target=subscriber, args=(i, sql),
+                         name=f"chaos-live-sub-{i}")
+        for i, sql in enumerate([agg_sql, agg_sql, pass_sql, pass_sql])
+    ]
+    for th in subs:
+        th.start()
+    try:
+        _poll(lambda: rt.status()["subscriptions"] == 5 or errs,
+              what="fleet subscription registration")
+        assert not errs, errs
+        apps = [
+            threading.Thread(target=appender, args=(i,),
+                             name=f"chaos-live-app-{i}")
+            for i in range(N_APPENDERS)
+        ]
+        for th in apps:
+            th.start()
+        for th in apps:
+            th.join(timeout=120)
+            assert not th.is_alive(), "appender hung"
+        for th in subs:
+            th.join(timeout=240)
+            assert not th.is_alive(), "wire subscriber hung"
+        assert not errs, errs
+
+        # per-subscriber epochs strictly increase and end at the final
+        # version; the materialized stream equals a from-scratch run
+        full_agg = sess.sql(agg_sql).to_arrow()
+        full_pass = sess.sql(pass_sql).to_arrow()
+        for idx, (epochs, acc) in wire_results.items():
+            assert epochs == sorted(set(epochs)), (idx, epochs)
+            assert epochs[-1] == V_FINAL, (idx, epochs)
+            want = full_agg if idx < 2 else full_pass
+            assert acc.cast(want.schema).equals(want), (
+                idx, acc.to_pydict(), want.to_pydict(),
+            )
+
+        # per-EPOCH bit-identity: replay the delta log into prefix
+        # tables and compare every oracle-sink update against a
+        # from-scratch execution over its epoch's prefix
+        t = rt.tables.get("storm")
+        with t.lock:
+            entries = {e.version: e.table for e in t.log}
+        checked = 0
+        for upd in oracle.updates:
+            prefix = pa.concat_tables(
+                [seed] + [entries[v] for v in range(2, upd.epoch + 1)]
+            )
+            _oracle_view(sess, "storm_oracle", prefix)
+            want = sess.sql(
+                agg_sql.replace("FROM storm", "FROM storm_oracle")
+            ).to_arrow()
+            assert upd.table.cast(want.schema).equals(want), (
+                upd.epoch, upd.table.to_pydict(), want.to_pydict(),
+            )
+            checked += 1
+        assert checked >= 1, "oracle sink saw no refresh updates"
+    finally:
+        rt.unsubscribe(odesc["subscription_id"])
+        server.stop()
+        rt.close()
+    assert rt.status()["subscriptions"] == 0
+
+
+# ── subscriber killed mid-UPDATE train ─────────────────────────────────────
+
+
+def test_subscriber_killed_mid_update_frees_registration():
+    sess = tpu_session(LIVE_CONF, strict=False)
+    rt = sess.live
+    n = 200_000
+    rt.tables.create_table(
+        "big", _ints(k=[i % 7 for i in range(n)], v=list(range(n)))
+    )
+    sql = "SELECT k, v FROM big WHERE v % 2 = 0"
+    server = TpuServer(sess, host="127.0.0.1", port=0)
+    try:
+        host, port = server.start()
+        conn = connect(host, port, timeout=30)
+        sub = conn.subscribe(sql)
+        assert sub.mode == "passthrough"
+        # the ~100k-row initial snapshot train is in flight: read the
+        # UPDATE header and ONE batch, then die abruptly mid-train
+        sock = conn._sock
+        _ftype, body = P.expect_frame(sock, P.UPDATE)
+        assert P.decode_json(body)["kind"] == "snapshot"
+        P.expect_frame(sock, P.BATCH)
+        # RST on close: the server's sendall fails fast, like a crashed
+        # dashboard process
+        sock.setsockopt(socket.SOL_SOCKET, socket.SO_LINGER,
+                        struct.pack("ii", 1, 0))
+        sock.close()
+        # the handler unwinds: registration freed, the unpinned shared
+        # query retired with its state buffers
+        _poll(lambda: rt.status()["subscriptions"] == 0,
+              what="dead subscriber reaped")
+        _poll(lambda: not rt.status()["queries"],
+              what="orphaned query retired")
+        # the server keeps serving fresh connections and appends land
+        rt.tables.append("big", _ints(k=[1], v=[2]))
+        with connect(host, port, timeout=30) as c2:
+            got = c2.sql(
+                "SELECT count(*) AS c FROM big"
+            ).to_table()
+            assert got.to_pydict()["c"] == [n + 1]
+    finally:
+        server.stop()
+        rt.close()
+
+
+# ── spill faults on maintained state ───────────────────────────────────────
+
+
+def test_spill_faults_during_state_demotion_degrade_not_corrupt():
+    conf = dict(LIVE_CONF)
+    # a 1-byte budget demotes EVERY state put to the disk tier
+    conf["spark.rapids.tpu.live.state.maxBytes"] = 1
+    sess = tpu_session(conf, strict=False)
+    rt = sess.live
+    rt.tables.create_table("sp", _ints(k=[1, 2, 1], v=[10, 20, 30]))
+    sql = "SELECT k, sum(v) AS s FROM sp GROUP BY k"
+    sink = _Sink()
+    desc = rt.subscribe(sql, sink)
+    try:
+        assert desc["mode"] == "aggregate"
+        demotions0 = GLOBAL.view("live.", strip=False).get(
+            "live.state.demotions", 0
+        )
+        assert demotions0 >= 1, "seed state never demoted"
+
+        # every spill READ fails: the refresh loses its demoted state,
+        # falls back to a full re-execution, and reseeds
+        inj = faults.FaultInjector(
+            faults.FaultConfig(spill_read_error_every_n=1)
+        )
+        with faults.scoped(inj):
+            v = rt.tables.append("sp", _ints(k=[2, 3], v=[5, 7]))
+            _poll(lambda: any(u.epoch == v for u in sink.updates),
+                  what="refresh under read faults")
+        q = rt.query(desc["qid"])
+        assert q.info["last_refresh_incremental"] is False, q.info
+        assert "state lost" in (q.info["last_refresh_reason"] or "")
+        upd = next(u for u in sink.updates if u.epoch == v)
+        _oracle_view(sess, "sp_oracle", rt.tables.get("sp").table)
+        want = sess.sql(
+            sql.replace("FROM sp", "FROM sp_oracle")
+        ).to_arrow()
+        assert upd.table.cast(want.schema).equals(want)
+
+        # spill WRITES fail too: the state stays resident (unaccounted)
+        # instead of being lost — refreshes keep the exact results
+        inj2 = faults.FaultInjector(
+            faults.FaultConfig(spill_write_error_every_n=1)
+        )
+        with faults.scoped(inj2):
+            v = rt.tables.append("sp", _ints(k=[4], v=[40]))
+            _poll(lambda: any(u.epoch == v for u in sink.updates),
+                  what="refresh under write faults")
+        upd = next(u for u in sink.updates if u.epoch == v)
+        full = sess.sql(sql).to_arrow()
+        assert upd.table.cast(full.schema).equals(full)
+
+        # faults cleared: the next append is maintained incrementally
+        # again off the reseeded (re-demoted) state
+        v = rt.tables.append("sp", _ints(k=[5], v=[50]))
+        _poll(lambda: any(u.epoch == v for u in sink.updates),
+              what="post-fault refresh")
+        assert q.info["last_refresh_incremental"] is True, q.info
+        upd = next(u for u in sink.updates if u.epoch == v)
+        full = sess.sql(sql).to_arrow()
+        assert upd.table.cast(full.schema).equals(full)
+    finally:
+        rt.unsubscribe(desc["subscription_id"])
+        rt.close()

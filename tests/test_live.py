@@ -1,0 +1,430 @@
+"""Live analytics (live/) — the ISSUE 20 tier-1 acceptance suite.
+
+The acceptance bar: every maintained refresh is bit-identical to a
+from-scratch execution of the same SQL at the same table version
+(passthrough, aggregate, top-N); anything the classifier cannot maintain
+incrementally falls back to a full refresh with a recorded explain
+reason (float sums, DISTINCT, unbounded sorts, delta-log gaps, unordered
+path appends, opaque DataFrameWriter appends); subscriptions deliver
+epoch-stamped updates in-process and over the serve wire; and a refresh
+updates the PR-19 result cache in place so identical ad-hoc queries hit.
+
+Also home of the satellite regression: an append-mode write that creates
+a NEW hive-partition subdirectory under a scanned root must invalidate
+result-cache entries keyed by that root (cache/keys.py ``__roots``).
+
+The module runs under the lockwatch + reswatch harnesses (conftest): the
+refresh worker's lock orderings land in the order graph, and every test
+must leave the runtime balanced — no subscription on a closed sink, no
+state-byte drift.
+"""
+from __future__ import annotations
+
+import threading
+import time
+
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from spark_rapids_tpu.obs.metrics import GLOBAL
+
+from tests.harness import tpu_session
+
+LIVE_CONF = {
+    "spark.rapids.tpu.live.enabled": "true",
+    "spark.rapids.tpu.scheduler.pools": "default:4,live:2",
+    # small on purpose: the gap test overflows it with 6 appends
+    "spark.rapids.tpu.live.deltaLog.maxEntries": 4,
+}
+
+
+def _poll(pred, timeout_s: float = 120.0, what: str = "condition"):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if pred():
+            return
+        time.sleep(0.02)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+def _wait_refreshed(q, version: int):
+    _poll(
+        lambda: q.last_version >= version,
+        what=f"refresh of {q.qid} to v{version} (at v{q.last_version})",
+    )
+
+
+@pytest.fixture(scope="module")
+def rig():
+    """One session + live runtime for the module. The result cache stays
+    OFF here so ``sess.sql`` re-executes from scratch — a true oracle for
+    the bit-identity differentials (cache behavior gets its own
+    sessions below)."""
+    session = tpu_session(LIVE_CONF, strict=False)
+    rt = session.live
+    yield session, rt
+    rt.close()
+
+
+def _ints(**cols) -> pa.Table:
+    return pa.table(
+        {k: pa.array(v, pa.int64()) for k, v in cols.items()}
+    )
+
+
+# ── classification + explain reasons ───────────────────────────────────────
+
+
+def test_classification_and_fallback_reasons(rig):
+    sess, rt = rig
+    t = _ints(k=[1, 2, 1], v=[10, 20, 30])
+    t = t.append_column("f", pa.array([0.5, 1.5, 2.5], pa.float64()))
+    rt.tables.create_table("cls", t)
+    cases = [
+        ("SELECT k, v FROM cls WHERE v > 10", "passthrough", None),
+        ("SELECT k, sum(v) AS s FROM cls GROUP BY k", "aggregate", None),
+        ("SELECT k, v FROM cls ORDER BY v DESC LIMIT 2", "topn", None),
+        ("SELECT k, sum(f) AS s FROM cls GROUP BY k", "full",
+         "non-integral"),
+        ("SELECT count(DISTINCT v) AS d FROM cls", "full", "DISTINCT"),
+        ("SELECT k, v FROM cls ORDER BY v", "full", "unbounded sort"),
+    ]
+    qids = []
+    for sql, klass, reason_frag in cases:
+        q = rt.register_query(sql)
+        qids.append(q.qid)
+        assert q.klass == klass, (sql, q.klass, q.reason)
+        if reason_frag is None:
+            assert q.reason is None, (sql, q.reason)
+        else:
+            assert reason_frag in (q.reason or ""), (sql, q.reason)
+        # the fallback reason is part of the query's explain surface
+        if reason_frag is not None:
+            assert q.describe()["fallback_reason"] == q.reason
+    for qid in qids:
+        assert rt.retire_query(qid)
+
+
+# ── bit-identity differentials (the acceptance linchpin) ───────────────────
+
+
+def _assert_bit_identical(sess, q, label: str):
+    snap = q.snapshot()
+    assert snap is not None
+    epoch, got = snap
+    full = sess.sql(q.sql).to_arrow()
+    assert got.schema.equals(full.schema, check_metadata=False), (
+        label, got.schema, full.schema,
+    )
+    assert got.equals(full.cast(got.schema)), (
+        label, got.to_pydict(), full.to_pydict(),
+    )
+    return epoch
+
+
+@pytest.mark.parametrize(
+    "label,sql,kind",
+    [
+        ("passthrough", "SELECT k, v FROM bit_{n} WHERE v % 2 = 0",
+         "delta"),
+        ("aggregate",
+         "SELECT k, sum(v) AS s, count(*) AS c, max(v) AS m, "
+         "avg(v) AS a FROM bit_{n} GROUP BY k", "snapshot"),
+        ("topn",
+         "SELECT k, v FROM bit_{n} ORDER BY v DESC, k ASC LIMIT 3",
+         "snapshot"),
+    ],
+)
+def test_bit_identity_across_appends(rig, label, sql, kind):
+    sess, rt = rig
+    name = f"bit_{label}"
+    rt.tables.create_table(name, _ints(k=[1, 2, 1], v=[10, 20, 30]))
+    q = rt.register_query(sql.format(n=label))
+    assert q.klass == label
+    _assert_bit_identical(sess, q, f"{label} seed")
+    # ties (k=2 v=20 again) and new groups both cross the refresh
+    deltas = [
+        _ints(k=[2, 3], v=[20, 5]),
+        _ints(k=[3, 1, 4], v=[40, 2, 20]),
+        _ints(k=[4], v=[1]),
+    ]
+    t = rt.tables.get(name)
+    for d in deltas:
+        v = rt.tables.append(name, d)
+        _wait_refreshed(q, v)
+        assert q.info["last_refresh_incremental"] is True, q.info
+        epoch = _assert_bit_identical(sess, q, f"{label} v{v}")
+        assert epoch == v == t.version
+    assert rt.retire_query(q.qid)
+
+
+# ── delta-log gap → full fallback → reseed ─────────────────────────────────
+
+
+def test_delta_log_gap_full_fallback_then_reseed(rig):
+    sess, rt = rig
+    rt.tables.create_table("gap", _ints(k=[1], v=[1]))
+    q = rt.register_query("SELECT k, sum(v) AS s FROM gap GROUP BY k")
+    assert q.klass == "aggregate"
+    # park the refresh worker on the query's refresh lock, then overflow
+    # the 4-entry delta log with 6 appends: the span (1, 7] is truncated
+    # and the refresh MUST fall back with the gap reason
+    with q.refresh_lock:
+        for i in range(6):
+            v = rt.tables.append("gap", _ints(k=[i % 3], v=[i]))
+    assert v == 7
+    _wait_refreshed(q, 7)
+    assert q.info["last_refresh_incremental"] is False, q.info
+    assert "delta log gap" in (q.info["last_refresh_reason"] or "")
+    _assert_bit_identical(sess, q, "post-gap full")
+    # the fallback reseeded the state: the next single append is
+    # incremental again
+    v = rt.tables.append("gap", _ints(k=[9], v=[9]))
+    _wait_refreshed(q, v)
+    assert q.info["last_refresh_incremental"] is True, q.info
+    _assert_bit_identical(sess, q, "post-reseed incremental")
+    assert rt.retire_query(q.qid)
+
+
+# ── path-backed tables: ordering, opaque writes, class gating ──────────────
+
+
+def test_unordered_path_append_falls_back(rig, tmp_path):
+    sess, rt = rig
+    root = tmp_path / "unordered"
+    (root / "sub").mkdir(parents=True)
+    pq.write_table(_ints(k=[1, 2], v=[10, 20]),
+                   root / "part-000.parquet")
+    pq.write_table(_ints(k=[3], v=[30]), root / "sub" / "aaa.parquet")
+    rt.tables.register_path("upt", str(root), "parquet")
+    q = rt.register_query("SELECT k, v FROM upt WHERE v > 0")
+    assert q.klass == "passthrough"
+    # a subdirectory under the root breaks "scan order == append order",
+    # so the append lands as an UNORDERED entry → full fallback
+    v = rt.tables.append("upt", _ints(k=[4], v=[40]))
+    _wait_refreshed(q, v)
+    assert q.info["last_refresh_incremental"] is False, q.info
+    assert "unordered append" in (q.info["last_refresh_reason"] or "")
+    _assert_bit_identical(sess, q, "unordered path")
+    # aggregates over path-backed (multi-partition) inputs are gated out
+    qa = rt.register_query("SELECT k, sum(v) AS s FROM upt GROUP BY k")
+    assert qa.klass == "full"
+    assert "path-backed" in (qa.reason or "")
+    assert rt.retire_query(q.qid) and rt.retire_query(qa.qid)
+
+
+def test_ordered_path_append_then_opaque_external_write(rig, tmp_path):
+    sess, rt = rig
+    root = tmp_path / "ordered"
+    root.mkdir()
+    pq.write_table(_ints(k=[1, 2], v=[10, 20]),
+                   root / "part-000.parquet")
+    rt.tables.register_path("opt", str(root), "parquet")
+    t = rt.tables.get("opt")
+    q = rt.register_query("SELECT k, v FROM opt WHERE v >= 0")
+    assert q.klass == "passthrough"
+    # live appends write v{seq}-* basenames that sort after part-*:
+    # ordered → the refresh replays only the delta file
+    v = rt.tables.append("opt", _ints(k=[3], v=[30]))
+    _wait_refreshed(q, v)
+    assert q.info["last_refresh_incremental"] is True, q.info
+    _assert_bit_identical(sess, q, "ordered path append")
+    # a DataFrameWriter append into the same root arrives as an OPAQUE
+    # entry (no delta payload): version advances, refresh falls back
+    sess.create_dataframe(_ints(k=[4], v=[40])).write.mode(
+        "append"
+    ).parquet(str(root))
+    _poll(lambda: t.version > v, what="external-write version bump")
+    _wait_refreshed(q, t.version)
+    assert q.info["last_refresh_incremental"] is False, q.info
+    assert "opaque external write" in (
+        q.info["last_refresh_reason"] or ""
+    )
+    _assert_bit_identical(sess, q, "post external write")
+    assert rt.retire_query(q.qid)
+
+
+# ── subscriptions: in-process + over the serve wire ────────────────────────
+
+
+class _Sink:
+    def __init__(self):
+        self.updates = []
+        self.closed = False
+
+    def offer(self, upd):
+        self.updates.append(upd)
+
+
+def test_in_process_subscription_lifecycle(rig):
+    sess, rt = rig
+    rt.tables.create_table("subT", _ints(k=[1], v=[10]))
+    sink = _Sink()
+    desc = rt.subscribe("SELECT k, v FROM subT WHERE v > 0", sink)
+    assert desc["mode"] == "passthrough"
+    assert desc["epoch"] == 1
+    assert desc["snapshot"].num_rows == 1
+    assert rt.status()["subscriptions"] == 1
+    v = rt.tables.append("subT", _ints(k=[2, 3], v=[20, 30]))
+    _poll(lambda: any(u.epoch == v for u in sink.updates),
+          what="subscription update delivery")
+    upd = next(u for u in sink.updates if u.epoch == v)
+    # passthrough subscribers get the DELTA rows, not a re-snapshot
+    assert upd.kind == "delta" and upd.incremental is True
+    assert upd.table.to_pydict() == {"k": [2, 3], "v": [20, 30]}
+    qid = desc["qid"]
+    assert rt.unsubscribe(desc["subscription_id"]) is True
+    # last unpinned subscriber retires the shared query + its state
+    assert rt.query(qid) is None
+    assert rt.unsubscribe(desc["subscription_id"]) is False
+    assert rt.status()["subscriptions"] == 0
+
+
+def test_subscribe_over_the_wire(rig):
+    from spark_rapids_tpu.serve import TpuServer, connect
+
+    sess, rt = rig
+    rt.tables.create_table("wev", _ints(k=[1, 2, 1], v=[10, 20, 30]))
+    sql = "SELECT k, sum(v) AS s FROM wev GROUP BY k"
+    server = TpuServer(sess, host="127.0.0.1", port=0)
+    host, port = server.start()
+    got, errs = [], []
+
+    def subscriber():
+        try:
+            conn = connect(host, port, timeout=30)
+            sub = conn.subscribe(sql)
+            assert sub.mode == "aggregate", (sub.mode, sub.reason)
+            for upd in sub:
+                got.append(upd)
+                if upd.epoch >= 3:
+                    sub.cancel()
+            assert sub.end_reason == "cancelled", sub.end_reason
+            # the connection survives the unsubscribe and keeps serving
+            assert conn.sql("SELECT 1 AS one").to_table().num_rows == 1
+            st = conn.status()
+            la = st.get("live_analytics")
+            assert la and "wev" in la["tables"], la
+            assert "live.refreshes" in la["metrics"], sorted(la["metrics"])
+            conn.close()
+        except Exception as e:  # noqa: BLE001
+            errs.append(e)
+
+    th = threading.Thread(target=subscriber, name="test-live-subscriber")
+    th.start()
+    try:
+        _poll(lambda: rt.status()["subscriptions"] == 1 or errs,
+              what="wire subscription registration")
+        assert not errs, errs
+        qid = next(
+            i for i, d in rt.status()["queries"].items()
+            if d["sql"] == sql
+        )
+        q = rt.query(qid)
+        for i in range(2):
+            v = rt.tables.append("wev", _ints(k=[2, 3 + i], v=[5, 7]))
+            # wait for the refresh between appends so every version gets
+            # its own update train (no coalescing)
+            _wait_refreshed(q, v)
+        th.join(timeout=120)
+        assert not th.is_alive(), "wire subscriber hung"
+        assert not errs, errs
+        # initial snapshot at epoch 1, then one update per version
+        assert [u.epoch for u in got] == [1, 2, 3], [
+            (u.epoch, u.kind) for u in got
+        ]
+        full = sess.sql(sql).to_arrow()
+        assert got[-1].table.cast(full.schema).equals(full)
+    finally:
+        server.stop()
+    assert rt.status()["subscriptions"] == 0
+
+
+# ── result-cache integration (dedicated sessions) ──────────────────────────
+
+
+def test_refresh_updates_result_cache_in_place():
+    conf = dict(LIVE_CONF)
+    conf["spark.rapids.tpu.resultCache.enabled"] = "true"
+    sess = tpu_session(conf, strict=False)
+    try:
+        rt = sess.live
+        rt.tables.create_table("cev", _ints(k=[1, 2, 1], v=[10, 20, 30]))
+        sql = "SELECT k, sum(v) AS s FROM cev GROUP BY k"
+        q = rt.register_query(sql)
+        stats = sess._result_cache.stats
+        base = stats()
+        # the seed admitted the result: an identical ad-hoc query HITS
+        r1 = sess.sql(sql).to_arrow()
+        assert stats()["hits"] == base["hits"] + 1, (base, stats())
+        v = rt.tables.append("cev", _ints(k=[2, 3], v=[5, 7]))
+        _wait_refreshed(q, v)
+        # the refresh re-admitted at the NEW version: still a hit, with
+        # the post-append rows
+        mid = stats()
+        r2 = sess.sql(sql).to_arrow()
+        assert stats()["hits"] == mid["hits"] + 1, (mid, stats())
+        assert r2.cast(r1.schema).equals(q.snapshot()[1].cast(r1.schema))
+        assert r2.num_rows == 3
+    finally:
+        sess.live.close()
+
+
+def test_append_new_partition_subdir_invalidates_root_cache(tmp_path):
+    """The satellite regression (cache/keys.py __roots): a cached result
+    over a partitioned root must be invalidated by an append-mode write
+    that creates a partition subdirectory which did NOT exist when the
+    entry was admitted — the root-keyed version bump, not just the
+    touched leaf directories."""
+    sess = tpu_session(
+        {"spark.rapids.tpu.resultCache.enabled": "true"}, strict=False
+    )
+    root = str(tmp_path / "proot")
+    sess.create_dataframe(
+        _ints(p=[0, 1, 0, 1], v=[1, 2, 3, 4])
+    ).write.partitionBy("p").parquet(root)
+    sess.read.parquet(root).create_or_replace_temp_view("rv")
+    sql = "SELECT p, sum(v) AS s FROM rv GROUP BY p"
+    stats = sess._result_cache.stats
+    sess.sql(sql).to_arrow()  # admit
+    base = stats()
+    sess.sql(sql).to_arrow()
+    after_hit = stats()
+    assert after_hit["hits"] == base["hits"] + 1, (base, after_hit)
+    # append a row into a BRAND NEW p=2 subdirectory under the root
+    sess.create_dataframe(_ints(p=[2], v=[9])).write.partitionBy(
+        "p"
+    ).mode("append").parquet(root)
+    sess.sql(sql).to_arrow()
+    final = stats()
+    assert final["hits"] == after_hit["hits"], (
+        "stale root-keyed entry served after a new partition subdir "
+        "appeared", after_hit, final,
+    )
+    # at least one genuine re-execution (the write plan itself may add a
+    # miss of its own — the hit counter above is the real discriminator)
+    assert final["misses"] > after_hit["misses"], (after_hit, final)
+
+
+# ── status + metrics surface ───────────────────────────────────────────────
+
+
+def test_status_and_metrics_surface(rig):
+    sess, rt = rig
+    rt.tables.create_table("stT", _ints(k=[1], v=[1]))
+    q = rt.register_query("SELECT k, v FROM stT")
+    v = rt.tables.append("stT", _ints(k=[2], v=[2]))
+    _wait_refreshed(q, v)
+    st = rt.status()
+    assert st["tables"]["stT"]["kind"] == "view"
+    assert st["tables"]["stT"]["version"] == 2
+    assert q.qid in st["queries"]
+    assert st["queries"][q.qid]["class"] == "passthrough"
+    assert {"subscriptions", "state_mem_bytes",
+            "state_disk_bytes"} <= set(st)
+    view = GLOBAL.view("live.", strip=False)
+    for name in ("live.appends", "live.refreshes",
+                 "live.refresh.incremental"):
+        assert name in view, (name, sorted(view))
+    assert rt.retire_query(q.qid)
